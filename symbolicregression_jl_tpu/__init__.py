@@ -1,0 +1,79 @@
+"""symbolicregression_jl_tpu — a TPU-native symbolic regression framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+SymbolicRegression.jl (reference mounted at /root/reference): genetic-
+programming equation search with island populations, tournament selection,
+9-way weighted mutation, crossover, simulated annealing, adaptive parsimony,
+constraint checking, on-device BFGS constant optimization, migration as mesh
+collectives, and a per-complexity hall of fame / Pareto frontier.
+
+Layout:
+  models/    expression encoding, options, evolution, constant optimization
+  ops/       operators, losses, batched tree interpreter, Pallas kernels
+  parallel/  mesh/sharding, migration collectives, multi-host runtime
+  utils/     printing, export, checkpointing, recorder, progress
+"""
+
+from .models.dataset import Dataset, make_dataset, update_baseline_loss
+from .models.options import (
+    ComplexityMapping,
+    MutationWeights,
+    Options,
+    make_options,
+)
+from .models.trees import (
+    Expr,
+    TreeBatch,
+    decode_tree,
+    encode_tree,
+    parse_expression,
+    tree_to_string,
+)
+from .ops.interpreter import (
+    eval_grad_constants,
+    eval_grad_variables,
+    eval_tree,
+    eval_trees,
+)
+from .ops.losses import LOSS_REGISTRY
+from .ops.operators import (
+    OperatorSet,
+    make_operator_set,
+    register_binary,
+    register_unary,
+)
+
+__version__ = "0.1.0"
+
+# Populated lazily to avoid importing heavy modules at package import:
+from .api import EquationSearchResult, equation_search  # noqa: E402
+
+EquationSearch = equation_search
+
+__all__ = [
+    "Dataset",
+    "make_dataset",
+    "update_baseline_loss",
+    "Options",
+    "make_options",
+    "MutationWeights",
+    "ComplexityMapping",
+    "Expr",
+    "TreeBatch",
+    "encode_tree",
+    "decode_tree",
+    "tree_to_string",
+    "parse_expression",
+    "eval_tree",
+    "eval_trees",
+    "eval_grad_constants",
+    "eval_grad_variables",
+    "OperatorSet",
+    "make_operator_set",
+    "register_unary",
+    "register_binary",
+    "LOSS_REGISTRY",
+    "equation_search",
+    "EquationSearch",
+    "EquationSearchResult",
+]

@@ -1,0 +1,24 @@
+"""Public search API — `equation_search` (analog of the reference's
+`EquationSearch`, src/SymbolicRegression.jl:283-391).
+
+Placeholder while the evolution layers land; filled in by models/evolve.py +
+parallel/ in subsequent milestones.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+
+@dataclasses.dataclass
+class EquationSearchResult:
+    hall_of_fame: Any = None
+    state: Any = None
+
+
+def equation_search(X, y, **kwargs):  # pragma: no cover - placeholder
+    raise NotImplementedError(
+        "equation_search lands with the evolution milestone; "
+        "use ops.interpreter.eval_trees / models.* directly for now"
+    )

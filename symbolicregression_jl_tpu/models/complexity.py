@@ -1,0 +1,41 @@
+"""Expression complexity (reference src/Complexity.jl:13-40).
+
+Default complexity = node count (`count_nodes`); with custom mappings it is a
+weighted sum over nodes — on the flat encoding this is a masked gather+sum,
+fully jittable (SURVEY.md §7 decision 1).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .options import Options
+from .trees import BIN, CONST, UNA, VAR, TreeBatch
+
+Array = jax.Array
+
+
+def compute_complexity(trees: TreeBatch, options: Options) -> Array:
+    """Complexity per tree; shape = batch shape of `trees`."""
+    use, bin_c, una_c, var_c, const_c = options.complexity_arrays()
+    idx = jnp.arange(trees.max_len)
+    valid = idx < trees.length[..., None]
+    if not use:
+        return trees.length
+    bin_t = jnp.asarray(bin_c) if len(bin_c) else jnp.ones(1, jnp.int32)
+    una_t = jnp.asarray(una_c) if len(una_c) else jnp.ones(1, jnp.int32)
+    per_node = jnp.where(
+        trees.kind == CONST,
+        const_c,
+        jnp.where(
+            trees.kind == VAR,
+            var_c,
+            jnp.where(
+                trees.kind == UNA,
+                una_t[jnp.clip(trees.op, 0, una_t.shape[0] - 1)],
+                bin_t[jnp.clip(trees.op, 0, bin_t.shape[0] - 1)],
+            ),
+        ),
+    )
+    return jnp.sum(jnp.where(valid, per_node, 0), axis=-1).astype(jnp.int32)

@@ -1,0 +1,83 @@
+"""Dataset container (reference src/Dataset.jl:24-64).
+
+Holds X (nfeatures, n), y (n,), optional weights, variable names, the
+weighted mean of y (`avg_y`) and the baseline loss of the constant
+predictor avg_y (reference src/LossFunctions.jl:122-126), which normalizes
+all scores.
+
+Arrays live as jnp device arrays; on the TPU build the rows dimension may be
+sharded over the mesh's row axis (the analog of the reference's `batching`
+advice for >10k rows, src/Configure.jl:63-70).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class Dataset:
+    X: Array  # (nfeatures, n)
+    y: Array  # (n,)
+    weights: Optional[Array] = None  # (n,)
+    variable_names: Optional[Tuple[str, ...]] = None
+    avg_y: float = 0.0
+    baseline_loss: float = 1.0
+
+    @property
+    def n(self) -> int:
+        return self.X.shape[1]
+
+    @property
+    def nfeatures(self) -> int:
+        return self.X.shape[0]
+
+
+def make_dataset(
+    X,
+    y,
+    weights=None,
+    variable_names: Optional[Sequence[str]] = None,
+    dtype=jnp.float32,
+) -> Dataset:
+    X = jnp.asarray(X, dtype)
+    y = jnp.asarray(y, dtype)
+    if X.ndim != 2:
+        raise ValueError("X must be (nfeatures, n)")
+    if y.shape != (X.shape[1],):
+        raise ValueError(f"y shape {y.shape} != (n,) = ({X.shape[1]},)")
+    w = None
+    if weights is not None:
+        w = jnp.asarray(weights, dtype)
+        if w.shape != y.shape:
+            raise ValueError("weights must match y shape")
+    if w is None:
+        avg_y = float(jnp.mean(y))
+    else:
+        avg_y = float(jnp.sum(y * w) / jnp.sum(w))
+    names = tuple(variable_names) if variable_names is not None else None
+    if names is not None and len(names) != X.shape[0]:
+        raise ValueError("variable_names length must equal nfeatures")
+    return Dataset(X=X, y=y, weights=w, variable_names=names, avg_y=avg_y)
+
+
+def update_baseline_loss(dataset: Dataset, elementwise_loss) -> Dataset:
+    """Score the constant predictor avg_y
+    (reference src/LossFunctions.jl:122-126)."""
+    pred = jnp.full_like(dataset.y, dataset.avg_y)
+    elem = elementwise_loss(pred, dataset.y)
+    if dataset.weights is None:
+        base = float(jnp.mean(elem))
+    else:
+        base = float(
+            jnp.sum(elem * dataset.weights) / jnp.sum(dataset.weights)
+        )
+    dataset.baseline_loss = base if np.isfinite(base) and base > 0 else 1.0
+    return dataset

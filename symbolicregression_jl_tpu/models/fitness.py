@@ -1,0 +1,82 @@
+"""Scoring: loss evaluation + baseline-normalized, parsimony-penalized score.
+
+Analog of reference src/LossFunctions.jl: `_eval_loss` (eval_tree_array ->
+Inf-on-incomplete -> weighted mean, :34-50), `loss_to_score`
+(loss/baseline + size*parsimony, :70-83), `score_func` (:86-92) and
+`score_func_batch` (random minibatch, :95-115). Here every function is
+batched over whole populations: one XLA call scores thousands of trees.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.interpreter import eval_trees
+from ..ops.losses import aggregate_loss
+from ..ops.operators import OperatorSet
+from .complexity import compute_complexity
+from .options import Options
+from .trees import TreeBatch
+
+Array = jax.Array
+
+
+def eval_loss_trees(
+    trees: TreeBatch,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    operators: OperatorSet,
+    loss_fn: Callable,
+    row_idx: Optional[Array] = None,
+) -> Array:
+    """Per-tree aggregated loss over all rows (or the row_idx minibatch).
+
+    Trees whose evaluation hit NaN/Inf get Inf loss
+    (reference src/LossFunctions.jl:36-39)."""
+    if row_idx is not None:
+        X = X[:, row_idx]
+        y = y[row_idx]
+        weights = None if weights is None else weights[row_idx]
+    y_pred, ok = eval_trees(trees, X, operators)
+    elem = loss_fn(y_pred, y)
+    loss = aggregate_loss(elem, weights)
+    loss = jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
+    return loss
+
+
+def loss_to_score(
+    loss: Array, baseline: float, complexity: Array, options: Options
+) -> Array:
+    """score = loss/baseline + complexity*parsimony
+    (reference src/LossFunctions.jl:70-83)."""
+    normalized = loss / baseline
+    return normalized + complexity.astype(loss.dtype) * options.parsimony
+
+
+def score_trees(
+    trees: TreeBatch,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    options: Options,
+    row_idx: Optional[Array] = None,
+) -> Tuple[Array, Array]:
+    """(score, loss) per tree — the batched `score_func`/`score_func_batch`."""
+    loss = eval_loss_trees(
+        trees, X, y, weights, options.operators, options.elementwise_loss, row_idx
+    )
+    complexity = compute_complexity(trees, options)
+    score = loss_to_score(loss, baseline, complexity, options)
+    score = jnp.where(jnp.isfinite(loss), score, jnp.inf)
+    return score, loss
+
+
+def sample_batch_idx(key: Array, n_rows: int, batch_size: int) -> Array:
+    """Minibatch rows sampled with replacement
+    (reference src/LossFunctions.jl:100-103)."""
+    return jax.random.randint(key, (batch_size,), 0, n_rows)

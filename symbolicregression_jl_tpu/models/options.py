@@ -1,0 +1,285 @@
+"""Options / MutationWeights / ComplexityMapping — the single immutable config
+object threaded through every call.
+
+Analog of the reference's `Options{CT}` (src/OptionsStruct.jl:106-164) and its
+~60-kwarg constructor (src/Options.jl:315-686). Knob names and defaults mirror
+the reference (src/Options.jl:316-378: npop=33, npopulations=15,
+ncycles_per_iteration=550, maxsize=20, parsimony=0.0032,
+tournament_selection_n=12, tournament_selection_p=0.86, ...), plus TPU-native
+knobs (mesh layout, eval backend, parallel tournament width) that replace the
+reference's parallelism machinery.
+
+Static (hashable) so an Options instance can close over jitted functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..ops.losses import resolve_loss
+from ..ops.operators import OperatorSet, make_operator_set
+
+# Mutation kind indices (order matters: used by lax.switch in mutate_device)
+MUTATE_CONSTANT = 0
+MUTATE_OPERATOR = 1
+ADD_NODE = 2
+INSERT_NODE = 3
+DELETE_NODE = 4
+SIMPLIFY = 5
+RANDOMIZE = 6
+DO_NOTHING = 7
+OPTIMIZE = 8
+N_MUTATIONS = 9
+
+
+@dataclasses.dataclass(frozen=True)
+class MutationWeights:
+    """Weighted mutation choice (reference src/OptionsStruct.jl:8-52).
+
+    Defaults follow the reference's MutationWeights defaults."""
+
+    mutate_constant: float = 0.048
+    mutate_operator: float = 0.47
+    add_node: float = 0.79
+    insert_node: float = 5.1
+    delete_node: float = 1.7
+    simplify: float = 0.0020
+    randomize: float = 0.00023
+    do_nothing: float = 0.21
+    optimize: float = 0.0
+
+    def as_tuple(self) -> Tuple[float, ...]:
+        return (
+            self.mutate_constant,
+            self.mutate_operator,
+            self.add_node,
+            self.insert_node,
+            self.delete_node,
+            self.simplify,
+            self.randomize,
+            self.do_nothing,
+            self.optimize,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ComplexityMapping:
+    """Per-op/variable/constant complexity weights
+    (reference src/OptionsStruct.jl:75-104). When `use` is False, complexity
+    is simply the node count (`count_nodes`)."""
+
+    use: bool = False
+    binop_complexities: Tuple[int, ...] = ()
+    unaop_complexities: Tuple[int, ...] = ()
+    variable_complexity: int = 1
+    constant_complexity: int = 1
+
+
+# Deprecated camelCase kwargs accepted for parity with the reference's
+# back-compat table (src/Options.jl:122-143,380-427).
+_DEPRECATED_KWARGS = {
+    "hofMigration": "hof_migration",
+    "shouldOptimizeConstants": "should_optimize_constants",
+    "perturbationFactor": "perturbation_factor",
+    "batchSize": "batch_size",
+    "crossoverProbability": "crossover_probability",
+    "warmupMaxsizeBy": "warmup_maxsize_by",
+    "useFrequency": "use_frequency",
+    "useFrequencyInTournament": "use_frequency_in_tournament",
+    "npop": "npop",
+    "fractionReplaced": "fraction_replaced",
+    "fractionReplacedHof": "fraction_replaced_hof",
+    "ns": "tournament_selection_n",
+    "probPickFirst": "tournament_selection_p",
+    "earlyStopCondition": "early_stop_condition",
+    "stateReturn": "return_state",
+}
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@dataclasses.dataclass(frozen=True)
+class Options:
+    # --- operators ---
+    binary_operators: Tuple[str, ...] = ("+", "-", "*", "/")
+    unary_operators: Tuple[str, ...] = ()
+    # --- population / search shape ---
+    npopulations: int = 15
+    npop: int = 33
+    ncycles_per_iteration: int = 550
+    tournament_selection_n: int = 12
+    tournament_selection_p: float = 0.86
+    topn: int = 12
+    # --- size limits ---
+    maxsize: int = 20
+    maxdepth: Optional[int] = None
+    # --- loss / scoring ---
+    loss: Union[str, Callable] = "L2DistLoss"
+    parsimony: float = 0.0032
+    alpha: float = 0.100000
+    annealing: bool = False
+    use_frequency: bool = True
+    use_frequency_in_tournament: bool = True
+    adaptive_parsimony_scaling: float = 20.0
+    # --- mutation ---
+    mutation_weights: MutationWeights = MutationWeights()
+    crossover_probability: float = 0.066
+    perturbation_factor: float = 0.076
+    probability_negate_constant: float = 0.01
+    skip_mutation_failures: bool = True
+    # --- migration ---
+    migration: bool = True
+    hof_migration: bool = True
+    fraction_replaced: float = 0.00036
+    fraction_replaced_hof: float = 0.035
+    # --- constant optimization ---
+    should_optimize_constants: bool = True
+    optimizer_algorithm: str = "BFGS"
+    optimizer_probability: float = 0.14
+    optimizer_nrestarts: int = 2
+    optimizer_iterations: int = 8
+    # --- batching ---
+    batching: bool = False
+    batch_size: int = 50
+    # --- constraints ---
+    constraints: Tuple[Tuple[str, Any], ...] = ()
+    nested_constraints: Tuple[Tuple[str, Tuple[Tuple[str, int], ...]], ...] = ()
+    complexity_of_operators: Tuple[Tuple[str, int], ...] = ()
+    complexity_of_constants: int = 1
+    complexity_of_variables: int = 1
+    # --- schedule / stopping ---
+    warmup_maxsize_by: float = 0.0
+    early_stop_condition: Optional[Union[float, Callable]] = None
+    timeout_in_seconds: Optional[float] = None
+    max_evals: Optional[int] = None
+    # --- misc ---
+    seed: int = 0
+    deterministic: bool = True
+    verbosity: int = 1
+    progress: bool = True
+    output_file: Optional[str] = None
+    recorder: bool = False
+    recorder_file: str = "pysr_recorder.json"
+    # --- TPU-native knobs (no reference analog; replace Distributed.jl) ---
+    n_parallel_tournaments: int = 0  # 0 => npop // tournament_selection_n
+    eval_backend: str = "auto"  # "jnp" | "pallas" | "auto"
+    precision: str = "float32"
+    island_axis: str = "islands"
+    row_axis: str = "rows"
+    max_len: int = 0  # 0 => round_up(maxsize + 2, 8)
+
+    # ------------------------------------------------------------------
+    def __post_init__(self):
+        if self.maxdepth is None:
+            object.__setattr__(self, "maxdepth", self.maxsize)
+        if self.max_len == 0:
+            object.__setattr__(self, "max_len", _round_up(self.maxsize + 2, 8))
+        if self.n_parallel_tournaments == 0:
+            object.__setattr__(
+                self,
+                "n_parallel_tournaments",
+                max(1, self.npop // self.tournament_selection_n),
+            )
+        # normalize tuple-ized dict-like kwargs
+        for f in ("binary_operators", "unary_operators"):
+            v = getattr(self, f)
+            if not isinstance(v, tuple):
+                object.__setattr__(self, f, tuple(v))
+        for f in ("constraints", "nested_constraints", "complexity_of_operators"):
+            v = getattr(self, f)
+            if isinstance(v, dict):
+                object.__setattr__(
+                    self,
+                    f,
+                    tuple(
+                        (k, tuple(sorted(val.items())) if isinstance(val, dict) else val)
+                        for k, val in sorted(v.items())
+                    ),
+                )
+        if not 0 < self.tournament_selection_p <= 1:
+            raise ValueError("tournament_selection_p must be in (0, 1]")
+        if self.tournament_selection_n > self.npop:
+            raise ValueError("tournament_selection_n must be <= npop")
+        # build and cache derived structures
+        object.__setattr__(self, "_operators", make_operator_set(
+            self.binary_operators, self.unary_operators))
+        resolve_loss(self.loss)  # validate early
+
+    # ------------------------------------------------------------------
+    @property
+    def operators(self) -> OperatorSet:
+        return self._operators  # type: ignore[attr-defined]
+
+    @property
+    def elementwise_loss(self) -> Callable:
+        return resolve_loss(self.loss)
+
+    @property
+    def actual_maxsize(self) -> int:
+        # Reference: actualMaxsize = maxsize + MAX_DEGREE
+        # (src/SymbolicRegression.jl:479); hall-of-fame slots 1..maxsize+2.
+        return self.maxsize + 2
+
+    def complexity_arrays(self):
+        """Build integer complexity tables aligned with the operator set.
+
+        Returns (use_custom, binop_c, unaop_c, var_c, const_c) with numpy
+        arrays, for models/complexity.py."""
+        from ..ops.operators import canonical_name
+
+        ops = self.operators
+        custom = {canonical_name(k): v for k, v in self.complexity_of_operators}
+        use = bool(custom) or self.complexity_of_constants != 1 or self.complexity_of_variables != 1
+        bin_c = np.array(
+            [int(custom.get(n, 1)) for n in ops.binary_names], np.int32
+        )
+        una_c = np.array(
+            [int(custom.get(n, 1)) for n in ops.unary_names], np.int32
+        )
+        return use, bin_c, una_c, int(self.complexity_of_variables), int(
+            self.complexity_of_constants
+        )
+
+    def early_stop_fn(self) -> Optional[Callable]:
+        """Scalar threshold -> closure (reference src/Options.jl:601-605)."""
+        cond = self.early_stop_condition
+        if cond is None:
+            return None
+        if callable(cond):
+            return cond
+        thresh = float(cond)
+        return lambda loss, complexity: loss < thresh
+
+    def __hash__(self):
+        return hash((
+            self.binary_operators, self.unary_operators, self.npopulations,
+            self.npop, self.ncycles_per_iteration, self.maxsize, self.max_len,
+            self.parsimony, self.alpha, self.tournament_selection_n,
+            self.tournament_selection_p, self.batching, self.batch_size,
+            self.n_parallel_tournaments, self.eval_backend, self.precision,
+            self.constraints, self.nested_constraints,
+            self.complexity_of_operators, self.mutation_weights.as_tuple(),
+            self.crossover_probability, self.annealing, self.use_frequency,
+            self.use_frequency_in_tournament, str(self.loss) if not callable(self.loss) else id(self.loss),
+        ))
+
+
+def make_options(**kwargs) -> Options:
+    """Kwarg constructor accepting deprecated camelCase names
+    (reference src/Options.jl:122-143,380-427)."""
+    remapped = {}
+    for k, v in kwargs.items():
+        k2 = _DEPRECATED_KWARGS.get(k, k)
+        if k2 in remapped:
+            raise ValueError(f"Duplicate kwarg {k2!r}")
+        remapped[k2] = v
+    if isinstance(remapped.get("mutation_weights"), (list, tuple)):
+        remapped["mutation_weights"] = MutationWeights(*remapped["mutation_weights"])
+    elif isinstance(remapped.get("mutation_weights"), dict):
+        remapped["mutation_weights"] = MutationWeights(**remapped["mutation_weights"])
+    return Options(**remapped)

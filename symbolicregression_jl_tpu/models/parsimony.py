@@ -1,0 +1,66 @@
+"""Adaptive parsimony running statistics
+(reference src/AdaptiveParsimony.jl:20-95).
+
+A per-complexity frequency histogram over recently-seen expressions, used to
+(a) scale tournament fitness by `exp(scaling * normalized_freq)` and (b) bias
+mutation acceptance by the old/new size frequency ratio. Pure-array, jittable:
+state is a float vector of length actual_maxsize, updated by scatter-add and
+decayed toward a fixed window mass.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+WINDOW_SIZE = 100000.0  # reference src/AdaptiveParsimony.jl:29
+
+
+class RunningSearchStatistics(NamedTuple):
+    frequencies: Array  # (actual_maxsize,) float32
+    window_size: float = WINDOW_SIZE
+
+    @property
+    def normalized(self) -> Array:
+        tot = jnp.sum(self.frequencies)
+        return self.frequencies / jnp.maximum(tot, 1e-9)
+
+
+def init_search_statistics(actual_maxsize: int) -> RunningSearchStatistics:
+    # Reference initializes all-ones (src/AdaptiveParsimony.jl:26-33).
+    return RunningSearchStatistics(
+        frequencies=jnp.ones(actual_maxsize, jnp.float32)
+    )
+
+
+def update_frequencies(
+    stats: RunningSearchStatistics, complexities: Array
+) -> RunningSearchStatistics:
+    """Scatter-add 1 at each observed complexity
+    (reference src/AdaptiveParsimony.jl:42-49). complexities is any-shape
+    int array; out-of-range sizes are dropped."""
+    size = stats.frequencies.shape[0]
+    c = complexities.reshape(-1) - 1  # complexity 1 -> slot 0
+    valid = (c >= 0) & (c < size)
+    c = jnp.clip(c, 0, size - 1)
+    freqs = stats.frequencies.at[c].add(jnp.where(valid, 1.0, 0.0))
+    return stats._replace(frequencies=freqs)
+
+
+def move_window(stats: RunningSearchStatistics) -> RunningSearchStatistics:
+    """Decay total mass back to window_size, preferring to shrink the
+    largest bins — approximated here by proportional scaling (the reference
+    uses an iterative per-bin shave, src/AdaptiveParsimony.jl:57-89; the
+    fixed point of both is the same proportional cap)."""
+    tot = jnp.sum(stats.frequencies)
+    scale = jnp.where(tot > stats.window_size, stats.window_size / tot, 1.0)
+    return stats._replace(frequencies=stats.frequencies * scale)
+
+
+def normalize_frequencies(stats: RunningSearchStatistics) -> Array:
+    """(reference src/AdaptiveParsimony.jl:91-95)"""
+    return stats.normalized

@@ -1,0 +1,427 @@
+"""Flat postfix expression encoding — the TPU-native replacement for Node{T}.
+
+The reference stores expressions as linked `Node{T}` binary trees
+(DynamicExpressions.jl, imported at reference src/SymbolicRegression.jl:68-86)
+and walks pointers. On TPU we need static shapes and gather/scan-friendly
+layouts, so an expression is a fixed-width *postfix (RPN) program*:
+
+    slot fields (all shape (L,)):
+      kind : int32   PAD=0 | CONST=1 | VAR=2 | UNA=3 | BIN=4
+      op   : int32   index into OperatorSet.unary_names / binary_names
+      feat : int32   feature index for VAR nodes
+      cval : float32 constant value for CONST nodes
+    length : int32   number of valid slots; valid slots are [0, length)
+
+Postfix order means children precede parents and every subtree is a
+*contiguous span* [i - size(i) + 1, i], which makes crossover/mutation pure
+gather arithmetic (see models/mutate_device.py) and evaluation a single
+stack-machine scan (see ops/interpreter.py). A population is a stacked
+TreeBatch with leading batch dims — `jax.vmap` / `shard_map` ready.
+
+Host-side helpers here (Expr <-> arrays, parsing, printing) are the analog of
+`string_tree` / `node_to_symbolic` (reference
+src/InterfaceDynamicExpressions.jl:132-194) and are not on the hot path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, NamedTuple, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.operators import INFIX, OperatorSet
+
+Array = jax.Array
+
+# Node kinds
+PAD = 0
+CONST = 1
+VAR = 2
+UNA = 3
+BIN = 4
+
+ARITY = np.array([0, 0, 0, 1, 2], dtype=np.int32)  # indexed by kind
+
+
+class TreeBatch(NamedTuple):
+    """A batch of postfix trees. All fields share leading batch dims.
+
+    kind/op/feat: (..., L) int32; cval: (..., L) float; length: (...,) int32.
+    """
+
+    kind: Array
+    op: Array
+    feat: Array
+    cval: Array
+    length: Array
+
+    @property
+    def max_len(self) -> int:
+        return self.kind.shape[-1]
+
+    def __getitem__(self, idx) -> "TreeBatch":
+        return TreeBatch(
+            self.kind[idx], self.op[idx], self.feat[idx], self.cval[idx], self.length[idx]
+        )
+
+
+def empty_trees(batch_shape: Tuple[int, ...], max_len: int, dtype=jnp.float32) -> TreeBatch:
+    shape = tuple(batch_shape) + (max_len,)
+    return TreeBatch(
+        kind=jnp.zeros(shape, jnp.int32),
+        op=jnp.zeros(shape, jnp.int32),
+        feat=jnp.zeros(shape, jnp.int32),
+        cval=jnp.zeros(shape, dtype),
+        length=jnp.zeros(batch_shape, jnp.int32),
+    )
+
+
+def stack_trees(trees: Sequence[TreeBatch]) -> TreeBatch:
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+# ---------------------------------------------------------------------------
+# Host-side expression objects (for construction, printing, tests)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Expr:
+    """Host-side expression node (test/UX only — never on the hot path)."""
+
+    kind: int
+    op: int = 0
+    feat: int = 0
+    cval: float = 0.0
+    children: Tuple["Expr", ...] = ()
+
+    @staticmethod
+    def const(v: float) -> "Expr":
+        return Expr(kind=CONST, cval=float(v))
+
+    @staticmethod
+    def var(i: int) -> "Expr":
+        return Expr(kind=VAR, feat=int(i))
+
+    @staticmethod
+    def unary(op: int, child: "Expr") -> "Expr":
+        return Expr(kind=UNA, op=int(op), children=(child,))
+
+    @staticmethod
+    def binary(op: int, left: "Expr", right: "Expr") -> "Expr":
+        return Expr(kind=BIN, op=int(op), children=(left, right))
+
+    def size(self) -> int:
+        return 1 + sum(c.size() for c in self.children)
+
+    def depth(self) -> int:
+        if not self.children:
+            return 1
+        return 1 + max(c.depth() for c in self.children)
+
+    def postfix(self) -> List["Expr"]:
+        out: List[Expr] = []
+        for c in self.children:
+            out.extend(c.postfix())
+        out.append(self)
+        return out
+
+
+def encode_tree(expr: Expr, max_len: int, dtype=np.float32) -> TreeBatch:
+    """Expr -> single postfix TreeBatch (batch shape ())."""
+    nodes = expr.postfix()
+    n = len(nodes)
+    if n > max_len:
+        raise ValueError(f"Expression size {n} exceeds max_len {max_len}")
+    kind = np.zeros(max_len, np.int32)
+    op = np.zeros(max_len, np.int32)
+    feat = np.zeros(max_len, np.int32)
+    cval = np.zeros(max_len, dtype)
+    for i, nd in enumerate(nodes):
+        kind[i], op[i], feat[i], cval[i] = nd.kind, nd.op, nd.feat, nd.cval
+    return TreeBatch(
+        kind=jnp.asarray(kind),
+        op=jnp.asarray(op),
+        feat=jnp.asarray(feat),
+        cval=jnp.asarray(cval),
+        length=jnp.asarray(n, jnp.int32),
+    )
+
+
+def decode_tree(tree: TreeBatch) -> Expr:
+    """Single postfix TreeBatch (batch shape ()) -> Expr. Validates arity."""
+    kind = np.asarray(tree.kind)
+    op = np.asarray(tree.op)
+    feat = np.asarray(tree.feat)
+    cval = np.asarray(tree.cval)
+    n = int(tree.length)
+    stack: List[Expr] = []
+    for i in range(n):
+        k = int(kind[i])
+        if k == CONST:
+            stack.append(Expr.const(float(cval[i])))
+        elif k == VAR:
+            stack.append(Expr.var(int(feat[i])))
+        elif k == UNA:
+            if not stack:
+                raise ValueError(f"Invalid postfix: unary at {i} with empty stack")
+            a = stack.pop()
+            stack.append(Expr.unary(int(op[i]), a))
+        elif k == BIN:
+            if len(stack) < 2:
+                raise ValueError(f"Invalid postfix: binary at {i} with stack<2")
+            b = stack.pop()
+            a = stack.pop()
+            stack.append(Expr.binary(int(op[i]), a, b))
+        elif k == PAD:
+            raise ValueError(f"PAD inside valid region at slot {i}")
+        else:
+            raise ValueError(f"Bad kind {k} at slot {i}")
+    if len(stack) != 1:
+        raise ValueError(f"Invalid postfix: stack size {len(stack)} at end")
+    return stack[0]
+
+
+def is_valid_postfix(tree: TreeBatch) -> bool:
+    """Host-side validity check used by tests."""
+    try:
+        decode_tree(tree)
+        return True
+    except ValueError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Printing / parsing (analog of string_tree, reference
+# src/InterfaceDynamicExpressions.jl:132-153)
+# ---------------------------------------------------------------------------
+
+
+def _format_const(v: float) -> str:
+    return f"{v:.6g}"
+
+
+def expr_to_string(
+    expr: Expr,
+    operators: OperatorSet,
+    variable_names: Optional[Sequence[str]] = None,
+) -> str:
+    def vname(i: int) -> str:
+        if variable_names is not None:
+            return variable_names[i]
+        return f"x{i}"  # reference prints 1-indexed x1..; we use x0.. (Python)
+
+    def rec(e: Expr) -> str:
+        if e.kind == CONST:
+            return _format_const(e.cval)
+        if e.kind == VAR:
+            return vname(e.feat)
+        if e.kind == UNA:
+            name = operators.unary_names[e.op]
+            return f"{name}({rec(e.children[0])})"
+        name = operators.binary_names[e.op]
+        l, r = rec(e.children[0]), rec(e.children[1])
+        if name in INFIX:
+            return f"({l} {name} {r})"
+        return f"{name}({l}, {r})"
+
+    return rec(expr)
+
+
+def tree_to_string(
+    tree: TreeBatch,
+    operators: OperatorSet,
+    variable_names: Optional[Sequence[str]] = None,
+) -> str:
+    return expr_to_string(decode_tree(tree), operators, variable_names)
+
+
+def parse_expression(
+    s: str,
+    operators: OperatorSet,
+    variable_names: Optional[Sequence[str]] = None,
+) -> Expr:
+    """Parse an infix expression string back into an Expr.
+
+    Supports the grammar produced by expr_to_string: infix + - * / ^ with
+    standard precedence, function calls, unary minus, floats, and variable
+    names (default x0, x1, ...).
+    """
+    import re
+
+    tokens = re.findall(r"[A-Za-z_][A-Za-z_0-9]*|\d+\.?\d*(?:[eE][+-]?\d+)?|\S", s)
+    pos = 0
+
+    def peek() -> Optional[str]:
+        return tokens[pos] if pos < len(tokens) else None
+
+    def take() -> str:
+        nonlocal pos
+        t = tokens[pos]
+        pos += 1
+        return t
+
+    def var_index(name: str) -> Optional[int]:
+        if variable_names is not None and name in variable_names:
+            return list(variable_names).index(name)
+        m = re.fullmatch(r"x(\d+)", name)
+        if m and variable_names is None:
+            return int(m.group(1))
+        return None
+
+    def expect(tok: str) -> None:
+        got = take() if pos < len(tokens) else "<eof>"
+        if got != tok:
+            raise ValueError(f"Expected {tok!r}, got {got!r} in {s!r}")
+
+    def parse_primary() -> Expr:
+        if pos >= len(tokens):
+            raise ValueError(f"Unexpected end of expression in {s!r}")
+        t = take()
+        if t == "(":
+            e = parse_sum()
+            expect(")")
+            return e
+        if t == "-":
+            child = parse_primary()
+            if child.kind == CONST:
+                return Expr.const(-child.cval)
+            try:
+                return Expr.unary(operators.unary_index("neg"), child)
+            except ValueError:
+                return Expr.binary(
+                    operators.binary_index("-"), Expr.const(0.0), child
+                )
+        if re.fullmatch(r"\d+\.?\d*(?:[eE][+-]?\d+)?", t):
+            return Expr.const(float(t))
+        # identifier: function call or variable
+        if peek() == "(":
+            take()
+            args = [parse_sum()]
+            while peek() == ",":
+                take()
+                args.append(parse_sum())
+            expect(")")
+            if len(args) == 1:
+                return Expr.unary(operators.unary_index(t), args[0])
+            return Expr.binary(operators.binary_index(t), args[0], args[1])
+        vi = var_index(t)
+        if vi is None:
+            raise ValueError(f"Unknown identifier {t!r}")
+        return Expr.var(vi)
+
+    def parse_power() -> Expr:
+        base = parse_primary()
+        if peek() == "^":
+            take()
+            exp = parse_power()  # right-assoc
+            return Expr.binary(operators.binary_index("^"), base, exp)
+        return base
+
+    def parse_product() -> Expr:
+        e = parse_power()
+        while peek() in ("*", "/"):
+            t = take()
+            rhs = parse_power()
+            e = Expr.binary(operators.binary_index(t), e, rhs)
+        return e
+
+    def parse_sum() -> Expr:
+        e = parse_product()
+        while peek() in ("+", "-"):
+            t = take()
+            rhs = parse_product()
+            e = Expr.binary(operators.binary_index(t), e, rhs)
+        return e
+
+    out = parse_sum()
+    if pos != len(tokens):
+        raise ValueError(f"Trailing tokens: {tokens[pos:]}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Device-side structural queries (jittable; used by mutation + constraints)
+# ---------------------------------------------------------------------------
+
+
+def subtree_sizes(kind: Array, length: Array) -> Array:
+    """Per-slot subtree sizes via a stack scan. Shape (L,) int32.
+
+    For slot i holding a node of arity a, size[i] = 1 + sum of sizes of its
+    a children (which are the top a completed subtrees before i). PAD slots
+    get size 0. Jittable; vmap over batch dims.
+    """
+    L = kind.shape[-1]
+    arity = jnp.asarray(ARITY)[kind]
+
+    def step(carry, x):
+        stack, sp = carry  # stack of subtree sizes, stack pointer
+        a, valid = x
+        top1 = stack[jnp.maximum(sp - 1, 0)]
+        top2 = stack[jnp.maximum(sp - 2, 0)]
+        size = 1 + jnp.where(a >= 1, top1, 0) + jnp.where(a == 2, top2, 0)
+        new_sp = jnp.where(valid, sp - a + 1, sp)
+        write_at = jnp.maximum(new_sp - 1, 0)
+        new_stack = jnp.where(valid, stack.at[write_at].set(size), stack)
+        out = jnp.where(valid, size, 0)
+        return (new_stack, new_sp), out
+
+    init_stack = jnp.zeros(L // 2 + 2, jnp.int32)
+    idx = jnp.arange(L)
+    valid = idx < length
+    (_, _), sizes = jax.lax.scan(step, (init_stack, jnp.int32(0)), (arity, valid))
+    return sizes
+
+
+def node_depths(kind: Array, length: Array) -> Array:
+    """Per-slot subtree *depth* (height) via the same stack scan."""
+    L = kind.shape[-1]
+    arity = jnp.asarray(ARITY)[kind]
+
+    def step(carry, x):
+        stack, sp = carry
+        a, valid = x
+        top1 = stack[jnp.maximum(sp - 1, 0)]
+        top2 = stack[jnp.maximum(sp - 2, 0)]
+        d = 1 + jnp.maximum(jnp.where(a >= 1, top1, 0), jnp.where(a == 2, top2, 0))
+        new_sp = jnp.where(valid, sp - a + 1, sp)
+        write_at = jnp.maximum(new_sp - 1, 0)
+        new_stack = jnp.where(valid, stack.at[write_at].set(d), stack)
+        return (new_stack, new_sp), jnp.where(valid, d, 0)
+
+    init_stack = jnp.zeros(L // 2 + 2, jnp.int32)
+    idx = jnp.arange(L)
+    valid = idx < length
+    (_, _), depths = jax.lax.scan(step, (init_stack, jnp.int32(0)), (arity, valid))
+    return depths
+
+
+def tree_depth(kind: Array, length: Array) -> Array:
+    """Depth of the whole tree (root = slot length-1)."""
+    depths = node_depths(kind, length)
+    return depths[jnp.maximum(length - 1, 0)]
+
+
+def count_constants(tree: TreeBatch) -> Array:
+    idx = jnp.arange(tree.max_len)
+    valid = idx < tree.length[..., None]
+    return jnp.sum((tree.kind == CONST) & valid, axis=-1)
+
+
+def get_constants(tree: TreeBatch) -> Tuple[Array, Array]:
+    """Return (cval, is_const_mask) — the analog of get_constants/set_constants
+    (reference DynamicExpressions API, imported at src/SymbolicRegression.jl:68-86).
+    Constants stay in-place in the cval field; mask selects them."""
+    idx = jnp.arange(tree.max_len)
+    valid = idx < tree.length[..., None]
+    mask = (tree.kind == CONST) & valid
+    return tree.cval, mask
+
+
+def set_constants(tree: TreeBatch, cval: Array) -> TreeBatch:
+    _, mask = get_constants(tree)
+    return tree._replace(cval=jnp.where(mask, cval, tree.cval))

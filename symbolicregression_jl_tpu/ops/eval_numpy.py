@@ -1,0 +1,122 @@
+"""Host-side NumPy oracle evaluator — the ground truth the device kernels are
+tested against (SURVEY.md §7 build order step 2).
+
+Mirrors the semantics of the reference's `eval_tree_array`
+(DynamicExpressions.jl, wrapped at reference
+src/InterfaceDynamicExpressions.jl:17-52): returns (output, complete) where
+complete=False as soon as any intermediate value is non-finite.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ..models.trees import BIN, CONST, PAD, UNA, VAR, Expr, TreeBatch, decode_tree
+from .operators import OperatorSet
+
+# NumPy implementations of each operator, matching ops/operators.py semantics.
+_UNARY_NP = {
+    "cos": np.cos,
+    "sin": np.sin,
+    "tan": np.tan,
+    "exp": np.exp,
+    "log": lambda x: np.where(x > 0, np.log(np.where(x > 0, x, 1.0)), np.nan),
+    "log2": lambda x: np.where(x > 0, np.log2(np.where(x > 0, x, 1.0)), np.nan),
+    "log10": lambda x: np.where(x > 0, np.log10(np.where(x > 0, x, 1.0)), np.nan),
+    "log1p": lambda x: np.where(x > -1, np.log1p(np.where(x > -1, x, 0.0)), np.nan),
+    "sqrt": lambda x: np.where(x >= 0, np.sqrt(np.where(x >= 0, x, 0.0)), np.nan),
+    "abs": np.abs,
+    "square": lambda x: x * x,
+    "cube": lambda x: x * x * x,
+    "neg": lambda x: -x,
+    "relu": lambda x: np.maximum(x, 0.0),
+    "sinh": np.sinh,
+    "cosh": np.cosh,
+    "tanh": np.tanh,
+    "asin": lambda x: np.where(np.abs(x) <= 1, np.arcsin(np.clip(x, -1, 1)), np.nan),
+    "acos": lambda x: np.where(np.abs(x) <= 1, np.arccos(np.clip(x, -1, 1)), np.nan),
+    "atan": np.arctan,
+    "asinh": np.arcsinh,
+    "acosh": lambda x: np.where(x >= 1, np.arccosh(np.where(x >= 1, x, 1.0)), np.nan),
+    "atanh": lambda x: np.arctanh(((x + 1.0) % 2.0) - 1.0),
+    "sigmoid": lambda x: 1.0 / (1.0 + np.exp(-x)),
+    "gauss": lambda x: np.exp(-(x * x)),
+    "inv": lambda x: 1.0 / x,
+    "sign": np.sign,
+    "identity": lambda x: x,
+}
+try:  # SpecialFunctions analog (reference src/Operators.jl:3-12)
+    from scipy import special as _sp
+
+    _UNARY_NP["erf"] = _sp.erf
+    _UNARY_NP["erfc"] = _sp.erfc
+
+    def _gamma_np(x):
+        out = _sp.gamma(x)
+        return np.where(np.isfinite(out), out, np.nan)
+
+    _UNARY_NP["gamma"] = _gamma_np
+except ImportError:  # pragma: no cover
+    import math
+
+    _UNARY_NP["erf"] = np.vectorize(math.erf)
+    _UNARY_NP["erfc"] = np.vectorize(math.erfc)
+
+
+def _safe_pow_np(x, y):
+    bad = ((x < 0) & (y != np.round(y))) | ((x == 0) & (y < 0))
+    out = np.power(np.where(bad, 1.0, x), y)
+    return np.where(bad, np.nan, out)
+
+
+_BINARY_NP = {
+    "+": lambda x, y: x + y,
+    "-": lambda x, y: x - y,
+    "*": lambda x, y: x * y,
+    "/": lambda x, y: x / y,
+    "^": _safe_pow_np,
+    "mod": np.mod,
+    "max": np.maximum,
+    "min": np.minimum,
+    "greater": lambda x, y: np.where(x > y, 1.0, 0.0),
+    "logical_or": lambda x, y: np.where((x > 0) | (y > 0), 1.0, 0.0),
+    "logical_and": lambda x, y: np.where((x > 0) & (y > 0), 1.0, 0.0),
+    "atan2": np.arctan2,
+}
+
+
+def eval_expr_numpy(
+    expr: Expr, X: np.ndarray, operators: OperatorSet
+) -> Tuple[np.ndarray, bool]:
+    """Evaluate one Expr over X (nfeatures, nrows). Returns (y, complete)."""
+    complete = True
+
+    def rec(e: Expr) -> np.ndarray:
+        nonlocal complete
+        if e.kind == CONST:
+            v = np.full(X.shape[1], e.cval, dtype=X.dtype)
+        elif e.kind == VAR:
+            v = X[e.feat].astype(X.dtype)
+        elif e.kind == UNA:
+            a = rec(e.children[0])
+            with np.errstate(all="ignore"):
+                v = _UNARY_NP[operators.unary_names[e.op]](a)
+        else:
+            a = rec(e.children[0])
+            b = rec(e.children[1])
+            with np.errstate(all="ignore"):
+                v = _BINARY_NP[operators.binary_names[e.op]](a, b)
+        if not np.all(np.isfinite(v)):
+            complete = False
+        return v
+
+    y = rec(expr)
+    return y, complete
+
+
+def eval_tree_numpy(
+    tree: TreeBatch, X: np.ndarray, operators: OperatorSet
+) -> Tuple[np.ndarray, bool]:
+    return eval_expr_numpy(decode_tree(tree), X, operators)

@@ -1,0 +1,198 @@
+"""Elementwise loss library + weighted aggregation.
+
+TPU-native analog of the LossFunctions.jl losses the reference re-exports
+(reference: src/SymbolicRegression.jl:87-113 re-exports 25 losses;
+src/LossFunctions.jl:11-31 aggregates with mean / weighted mean).
+
+Distance losses take (pred, target) and are evaluated on the residual;
+margin losses take (target, pred) agreement = target*pred, as in
+LossFunctions.jl. All are elementwise jnp functions fused by XLA into the
+interpreter's reduction.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# ---------------------------------------------------------------------------
+# Distance-based losses: f(difference) where difference = pred - target
+# ---------------------------------------------------------------------------
+
+
+def l2_dist_loss(pred: Array, target: Array) -> Array:
+    d = pred - target
+    return d * d
+
+
+def l1_dist_loss(pred: Array, target: Array) -> Array:
+    return jnp.abs(pred - target)
+
+
+def lp_dist_loss(p: float) -> Callable[[Array, Array], Array]:
+    def loss(pred: Array, target: Array) -> Array:
+        return jnp.abs(pred - target) ** p
+
+    return loss
+
+
+def logit_dist_loss(pred: Array, target: Array) -> Array:
+    d = pred - target
+    return -jnp.log(4.0 * jax.nn.sigmoid(d) * jax.nn.sigmoid(-d))
+
+
+def huber_loss(delta: float = 1.0) -> Callable[[Array, Array], Array]:
+    def loss(pred: Array, target: Array) -> Array:
+        d = jnp.abs(pred - target)
+        quad = 0.5 * d * d
+        lin = delta * (d - 0.5 * delta)
+        return jnp.where(d <= delta, quad, lin)
+
+    return loss
+
+
+def l1_epsilon_ins_loss(eps: float = 1.0) -> Callable[[Array, Array], Array]:
+    def loss(pred: Array, target: Array) -> Array:
+        return jnp.maximum(0.0, jnp.abs(pred - target) - eps)
+
+    return loss
+
+
+def l2_epsilon_ins_loss(eps: float = 1.0) -> Callable[[Array, Array], Array]:
+    def loss(pred: Array, target: Array) -> Array:
+        e = jnp.maximum(0.0, jnp.abs(pred - target) - eps)
+        return e * e
+
+    return loss
+
+
+def periodic_loss(c: float = 1.0) -> Callable[[Array, Array], Array]:
+    def loss(pred: Array, target: Array) -> Array:
+        return 1.0 - jnp.cos((pred - target) * 2.0 * jnp.pi / c)
+
+    return loss
+
+
+def quantile_loss(tau: float = 0.5) -> Callable[[Array, Array], Array]:
+    def loss(pred: Array, target: Array) -> Array:
+        d = target - pred
+        return jnp.where(d >= 0, tau * d, (tau - 1.0) * d)
+
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# Margin-based losses: f(agreement) where agreement = target * pred
+# ---------------------------------------------------------------------------
+
+
+def zero_one_loss(pred: Array, target: Array) -> Array:
+    return jnp.where(target * pred >= 0, 0.0, 1.0)
+
+
+def perceptron_loss(pred: Array, target: Array) -> Array:
+    return jnp.maximum(0.0, -target * pred)
+
+
+def l1_hinge_loss(pred: Array, target: Array) -> Array:
+    return jnp.maximum(0.0, 1.0 - target * pred)
+
+
+def l2_hinge_loss(pred: Array, target: Array) -> Array:
+    h = jnp.maximum(0.0, 1.0 - target * pred)
+    return h * h
+
+
+def smoothed_l1_hinge_loss(gamma: float = 1.0) -> Callable[[Array, Array], Array]:
+    def loss(pred: Array, target: Array) -> Array:
+        a = target * pred
+        h = jnp.maximum(0.0, 1.0 - a)
+        return jnp.where(a >= 1.0 - gamma, 0.5 / gamma * h * h, 1.0 - gamma / 2.0 - a)
+
+    return loss
+
+
+def modified_huber_loss(pred: Array, target: Array) -> Array:
+    a = target * pred
+    h = jnp.maximum(0.0, 1.0 - a)
+    return jnp.where(a >= -1.0, h * h, -4.0 * a)
+
+
+def l2_margin_loss(pred: Array, target: Array) -> Array:
+    d = 1.0 - target * pred
+    return d * d
+
+
+def exp_loss(pred: Array, target: Array) -> Array:
+    return jnp.exp(-target * pred)
+
+
+def sigmoid_loss(pred: Array, target: Array) -> Array:
+    return 1.0 - jnp.tanh(target * pred)
+
+
+def dwd_margin_loss(q: float = 1.0) -> Callable[[Array, Array], Array]:
+    def loss(pred: Array, target: Array) -> Array:
+        a = target * pred
+        thresh = q / (q + 1.0)
+        big = (q ** q) / ((q + 1.0) ** (q + 1.0)) / jnp.maximum(a, thresh) ** q
+        return jnp.where(a <= thresh, 1.0 - a, big)
+
+    return loss
+
+
+def logit_margin_loss(pred: Array, target: Array) -> Array:
+    return jnp.log1p(jnp.exp(-target * pred))
+
+
+# Name table mirroring the reference's re-export list
+# (src/SymbolicRegression.jl:87-113). Parameterized losses are exposed as
+# factories; the bare name maps to the default-parameter instance.
+LOSS_REGISTRY: Dict[str, Callable[[Array, Array], Array]] = {
+    "L2DistLoss": l2_dist_loss,
+    "mse": l2_dist_loss,
+    "L1DistLoss": l1_dist_loss,
+    "mae": l1_dist_loss,
+    "LogitDistLoss": logit_dist_loss,
+    "HuberLoss": huber_loss(1.0),
+    "L1EpsilonInsLoss": l1_epsilon_ins_loss(1.0),
+    "EpsilonInsLoss": l1_epsilon_ins_loss(1.0),
+    "L2EpsilonInsLoss": l2_epsilon_ins_loss(1.0),
+    "PeriodicLoss": periodic_loss(1.0),
+    "QuantileLoss": quantile_loss(0.5),
+    "PinballLoss": quantile_loss(0.5),
+    "ZeroOneLoss": zero_one_loss,
+    "PerceptronLoss": perceptron_loss,
+    "L1HingeLoss": l1_hinge_loss,
+    "HingeLoss": l1_hinge_loss,
+    "L2HingeLoss": l2_hinge_loss,
+    "SmoothedL1HingeLoss": smoothed_l1_hinge_loss(1.0),
+    "ModifiedHuberLoss": modified_huber_loss,
+    "L2MarginLoss": l2_margin_loss,
+    "ExpLoss": exp_loss,
+    "SigmoidLoss": sigmoid_loss,
+    "DWDMarginLoss": dwd_margin_loss(1.0),
+    "LogitMarginLoss": logit_margin_loss,
+}
+
+
+def resolve_loss(loss) -> Callable[[Array, Array], Array]:
+    """Accept a name from LOSS_REGISTRY or a callable (pred, target) -> elem."""
+    if callable(loss):
+        return loss
+    if loss in LOSS_REGISTRY:
+        return LOSS_REGISTRY[loss]
+    raise ValueError(f"Unknown loss {loss!r}")
+
+
+def aggregate_loss(
+    elem: Array, weights: Optional[Array] = None, axis=-1
+) -> Array:
+    """Mean / weighted-mean aggregation (reference: src/LossFunctions.jl:11-31)."""
+    if weights is None:
+        return jnp.mean(elem, axis=axis)
+    return jnp.sum(elem * weights, axis=axis) / jnp.sum(weights, axis=axis)

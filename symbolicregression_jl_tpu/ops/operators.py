@@ -1,0 +1,328 @@
+"""Operator library with NaN-safe semantics.
+
+TPU-native analog of the reference's scalar operator library
+(reference: src/Operators.jl:8-111). Where the reference defines NaN-guarded
+scalar Julia functions consumed by DynamicExpressions' fused eval loops, we
+define jnp elementwise functions over row vectors consumed by the batched
+tree interpreter (ops/interpreter.py) and the Pallas kernel.
+
+Every operator must be total on float inputs: invalid domains return NaN
+(never raise), matching the reference's "safe_*" convention
+(src/Operators.jl:38-73). NaN/Inf is detected by the interpreter as a
+per-tree validity flag, the analog of `eval_tree_array`'s `complete=false`.
+
+Users can register custom operators with `register_unary` / `register_binary`
+(analog of `@extend_operators`, reference
+src/InterfaceDynamicExpressions.jl:206-215).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# ---------------------------------------------------------------------------
+# NaN-safe scalar/elementwise definitions (reference: src/Operators.jl)
+# ---------------------------------------------------------------------------
+
+
+def _nan_like(x: Array) -> Array:
+    return jnp.full_like(x, jnp.nan)
+
+
+def safe_pow(x: Array, y: Array) -> Array:
+    """x^y, NaN when x<0 with non-integer y, or x==0 with y<0.
+
+    Reference: src/Operators.jl:38-46 (safe_pow) — negative bases are legal
+    for integer exponents ((-2)^2 == 4).
+    """
+    bad = ((x < 0) & (y != jnp.round(y))) | ((x == 0) & (y < 0))
+    base = jnp.where(bad, 1.0, x)
+    out = jnp.power(base, y)
+    return jnp.where(bad, jnp.nan, out)
+
+
+def safe_log(x: Array) -> Array:
+    """log(x), NaN for x<=0. Reference: src/Operators.jl:50-53."""
+    return jnp.where(x > 0, jnp.log(jnp.where(x > 0, x, 1.0)), jnp.nan)
+
+
+def safe_log2(x: Array) -> Array:
+    return jnp.where(x > 0, jnp.log2(jnp.where(x > 0, x, 1.0)), jnp.nan)
+
+
+def safe_log10(x: Array) -> Array:
+    return jnp.where(x > 0, jnp.log10(jnp.where(x > 0, x, 1.0)), jnp.nan)
+
+
+def safe_log1p(x: Array) -> Array:
+    return jnp.where(x > -1, jnp.log1p(jnp.where(x > -1, x, 0.0)), jnp.nan)
+
+
+def safe_sqrt(x: Array) -> Array:
+    """sqrt(x), NaN for x<0. Reference: src/Operators.jl:70-73."""
+    return jnp.where(x >= 0, jnp.sqrt(jnp.where(x >= 0, x, 0.0)), jnp.nan)
+
+
+def safe_acosh(x: Array) -> Array:
+    """acosh(x), NaN for x<1. Reference: src/Operators.jl:66-69."""
+    return jnp.where(x >= 1, jnp.arccosh(jnp.where(x >= 1, x, 1.0)), jnp.nan)
+
+
+def safe_asin(x: Array) -> Array:
+    ok = jnp.abs(x) <= 1
+    return jnp.where(ok, jnp.arcsin(jnp.clip(x, -1, 1)), jnp.nan)
+
+
+def safe_acos(x: Array) -> Array:
+    ok = jnp.abs(x) <= 1
+    return jnp.where(ok, jnp.arccos(jnp.clip(x, -1, 1)), jnp.nan)
+
+
+def atanh_clip(x: Array) -> Array:
+    """atanh of x wrapped to (-1, 1). Reference: src/Operators.jl:14."""
+    return jnp.arctanh(((x + 1.0) % 2.0) - 1.0)
+
+
+def gamma_op(x: Array) -> Array:
+    """gamma(x) with poles -> NaN. Reference: src/Operators.jl:8-12.
+
+    lgamma gives log|Gamma|; for x<0 recover the signed value via the
+    reflection formula. The reference maps Inf -> NaN at the poles.
+    """
+    pos = jnp.exp(jax.lax.lgamma(x))
+    # Reflection: Gamma(x) = pi / (sin(pi x) Gamma(1-x)) for x < 0.
+    neg = jnp.pi / (jnp.sin(jnp.pi * x) * jnp.exp(jax.lax.lgamma(1.0 - x)))
+    out = jnp.where(x > 0, pos, neg)
+    is_pole = (x <= 0) & (x == jnp.round(x))
+    out = jnp.where(is_pole | ~jnp.isfinite(out), jnp.nan, out)
+    return out
+
+
+def erf_op(x: Array) -> Array:
+    return jax.lax.erf(x)
+
+
+def erfc_op(x: Array) -> Array:
+    return jax.lax.erfc(x)
+
+
+def square(x: Array) -> Array:
+    return x * x
+
+
+def cube(x: Array) -> Array:
+    return x * x * x
+
+
+def neg(x: Array) -> Array:
+    return -x
+
+
+def relu(x: Array) -> Array:
+    return jnp.maximum(x, 0.0)
+
+
+def greater(x: Array, y: Array) -> Array:
+    """1.0 if x > y else 0.0. Reference: src/Operators.jl:90-96."""
+    return jnp.where(x > y, 1.0, 0.0)
+
+
+def logical_or(x: Array, y: Array) -> Array:
+    """Reference: src/Operators.jl:99-104."""
+    return jnp.where((x > 0) | (y > 0), 1.0, 0.0)
+
+
+def logical_and(x: Array, y: Array) -> Array:
+    return jnp.where((x > 0) & (y > 0), 1.0, 0.0)
+
+
+def plus(x, y):
+    return x + y
+
+
+def sub(x, y):
+    return x - y
+
+
+def mult(x, y):
+    return x * y
+
+
+def div(x, y):
+    return x / y
+
+
+def mod_op(x, y):
+    return jnp.mod(x, y)
+
+
+def identity_op(x):
+    return x
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def gauss(x):
+    return jnp.exp(-(x * x))
+
+
+def inv(x):
+    return 1.0 / x
+
+
+def safe_tan(x):
+    return jnp.tan(x)
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+# Canonical name -> fn. Names match the reference's spellings where they
+# exist (plus Julia builtins the reference lets users pass directly).
+UNARY_REGISTRY: Dict[str, Callable] = {
+    "cos": jnp.cos,
+    "sin": jnp.sin,
+    "tan": safe_tan,
+    "exp": jnp.exp,
+    "log": safe_log,
+    "log2": safe_log2,
+    "log10": safe_log10,
+    "log1p": safe_log1p,
+    "sqrt": safe_sqrt,
+    "abs": jnp.abs,
+    "square": square,
+    "cube": cube,
+    "neg": neg,
+    "relu": relu,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "asin": safe_asin,
+    "acos": safe_acos,
+    "atan": jnp.arctan,
+    "asinh": jnp.arcsinh,
+    "acosh": safe_acosh,
+    "atanh": atanh_clip,
+    "erf": erf_op,
+    "erfc": erfc_op,
+    "gamma": gamma_op,
+    "sigmoid": sigmoid,
+    "gauss": gauss,
+    "inv": inv,
+    "sign": jnp.sign,
+    "identity": identity_op,
+}
+
+BINARY_REGISTRY: Dict[str, Callable] = {
+    "+": plus,
+    "-": sub,
+    "*": mult,
+    "/": div,
+    "^": safe_pow,
+    "pow": safe_pow,
+    "mod": mod_op,
+    "max": jnp.maximum,
+    "min": jnp.minimum,
+    "greater": greater,
+    "logical_or": logical_or,
+    "logical_and": logical_and,
+    "atan2": jnp.arctan2,
+}
+
+# Aliases accepted on input (reference maps raw -> safe ops in
+# src/Options.jl:86-120 binopmap/unaopmap).
+_ALIASES = {
+    "plus": "+",
+    "sub": "-",
+    "mult": "*",
+    "div": "/",
+    "safe_pow": "^",
+    "safe_log": "log",
+    "safe_log2": "log2",
+    "safe_log10": "log10",
+    "safe_log1p": "log1p",
+    "safe_sqrt": "sqrt",
+    "safe_acosh": "acosh",
+    "atanh_clip": "atanh",
+}
+
+# Infix printing set
+INFIX = {"+", "-", "*", "/", "^"}
+
+
+def register_unary(name: str, fn: Callable) -> None:
+    """Register a custom unary operator (jnp elementwise fn)."""
+    UNARY_REGISTRY[name] = fn
+
+
+def register_binary(name: str, fn: Callable) -> None:
+    """Register a custom binary operator (jnp elementwise fn)."""
+    BINARY_REGISTRY[name] = fn
+
+
+def canonical_name(name: str) -> str:
+    return _ALIASES.get(name, name)
+
+
+@dataclasses.dataclass(frozen=True)
+class OperatorSet:
+    """The operator tables selected by an Options instance.
+
+    Analog of the reference's `OperatorEnum` (src/Options.jl:586-591): an
+    ordered list of unary and binary operators; tree nodes store indices
+    into these lists.
+    """
+
+    unary_names: Tuple[str, ...]
+    binary_names: Tuple[str, ...]
+
+    @property
+    def unary_fns(self) -> List[Callable]:
+        return [UNARY_REGISTRY[n] for n in self.unary_names]
+
+    @property
+    def binary_fns(self) -> List[Callable]:
+        return [BINARY_REGISTRY[n] for n in self.binary_names]
+
+    @property
+    def n_unary(self) -> int:
+        return len(self.unary_names)
+
+    @property
+    def n_binary(self) -> int:
+        return len(self.binary_names)
+
+    def unary_index(self, name: str) -> int:
+        return self.unary_names.index(canonical_name(name))
+
+    def binary_index(self, name: str) -> int:
+        return self.binary_names.index(canonical_name(name))
+
+
+def make_operator_set(
+    binary_operators: Sequence[str] = ("+", "-", "*", "/"),
+    unary_operators: Sequence[str] = (),
+) -> OperatorSet:
+    bins = tuple(canonical_name(b) for b in binary_operators)
+    unas = tuple(canonical_name(u) for u in unary_operators)
+    for b in bins:
+        if b not in BINARY_REGISTRY:
+            raise ValueError(f"Unknown binary operator {b!r}")
+    for u in unas:
+        if u not in UNARY_REGISTRY:
+            raise ValueError(f"Unknown unary operator {u!r}")
+    if set(bins) & set(unas):
+        # Reference rejects binop/unaop overlap (src/Configure.jl:44-50).
+        raise ValueError("Operators cannot be both unary and binary")
+    if len(set(bins)) != len(bins) or len(set(unas)) != len(unas):
+        raise ValueError("Duplicate operators")
+    return OperatorSet(unary_names=unas, binary_names=bins)

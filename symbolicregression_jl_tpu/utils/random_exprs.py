@@ -1,0 +1,69 @@
+"""Host-side random expression generation (tests / fuzzing only).
+
+Mirrors gen_random_tree_fixed_size semantics (reference
+src/MutationFunctions.jl:248-263): grow a tree to an exact node count by
+repeatedly replacing a random leaf with a random operator node. The on-device
+generator lives in models/mutate_device.py; this host version is its test
+oracle.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..models.trees import CONST, VAR, Expr
+from ..ops.operators import OperatorSet
+
+
+def make_random_leaf(rng: np.random.Generator, nfeatures: int) -> Expr:
+    # 50/50 const/feature (reference src/MutationFunctions.jl:151-157)
+    if rng.random() < 0.5:
+        return Expr.const(float(rng.standard_normal()))
+    return Expr.var(int(rng.integers(nfeatures)))
+
+
+def _leaves(e: Expr, out: List[Expr]) -> None:
+    if not e.children:
+        out.append(e)
+    for c in e.children:
+        _leaves(c, out)
+
+
+def random_expr_fixed_size(
+    rng: np.random.Generator,
+    operators: OperatorSet,
+    nfeatures: int,
+    target_size: int,
+) -> Expr:
+    """Grow to exactly target_size nodes (unary adds 1, binary adds 2; may
+    overshoot by 1 with unary ops present, like the reference)."""
+    root = make_random_leaf(rng, nfeatures)
+    while root.size() < target_size:
+        leaves: List[Expr] = []
+        _leaves(root, leaves)
+        leaf = leaves[rng.integers(len(leaves))]
+        remaining = target_size - root.size()
+        use_unary = operators.n_unary > 0 and (
+            operators.n_binary == 0 or (remaining == 1 or rng.random() < 0.5)
+        )
+        if use_unary:
+            op = int(rng.integers(operators.n_unary))
+            new = Expr.unary(op, make_random_leaf(rng, nfeatures))
+        else:
+            op = int(rng.integers(operators.n_binary))
+            new = Expr.binary(
+                op,
+                make_random_leaf(rng, nfeatures),
+                make_random_leaf(rng, nfeatures),
+            )
+        # replace leaf in place
+        leaf.kind, leaf.op, leaf.feat, leaf.cval, leaf.children = (
+            new.kind,
+            new.op,
+            new.feat,
+            new.cval,
+            new.children,
+        )
+    return root

@@ -1,0 +1,30 @@
+"""Test harness: force CPU with 8 virtual devices so multi-chip sharding
+tests run without TPU hardware (SURVEY.md §4 implication (e): the analog of
+the reference's in-process addprocs(2) trick, test/runtests.jl).
+
+Note: this image's sitecustomize registers the experimental 'axon' TPU
+tunnel backend and forces jax_platforms='axon,cpu'; initializing it from
+tests would hang on the single tunnel slot, so we override to pure CPU
+*before* any backend initialization.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("SYMBOLIC_REGRESSION_TEST", "true")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

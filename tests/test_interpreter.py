@@ -1,0 +1,193 @@
+"""Interpreter exactness vs the NumPy oracle, NaN semantics, gradients.
+
+Parity targets: reference test/test_evaluation.jl (every fusion branch ×
+dtypes), test/test_nan_detection.jl (NaN/Inf -> complete=false),
+test/test_derivatives.jl (gradient correctness)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.models.trees import (
+    Expr,
+    TreeBatch,
+    encode_tree,
+    stack_trees,
+)
+from symbolicregression_jl_tpu.ops.eval_numpy import eval_expr_numpy
+from symbolicregression_jl_tpu.ops.interpreter import (
+    eval_grad_constants,
+    eval_grad_variables,
+    eval_tree,
+    eval_trees,
+)
+from symbolicregression_jl_tpu.ops.operators import make_operator_set
+from symbolicregression_jl_tpu.utils.random_exprs import random_expr_fixed_size
+
+MAX_LEN = 24
+
+
+def rand_X(rng, nfeat=5, n=37, scale=2.0):
+    return (rng.standard_normal((nfeat, n)) * scale).astype(np.float32)
+
+
+@pytest.mark.parametrize(
+    "binary,unary",
+    [
+        (["+", "-", "*", "/"], ["cos", "exp"]),
+        (["+", "*", "^"], ["log", "sqrt", "abs", "neg"]),
+        (["+", "-", "*", "/", "greater", "logical_or"], ["sin", "tanh", "relu", "square", "cube"]),
+        (["max", "min", "mod"], ["sigmoid", "gauss", "erf", "atan"]),
+    ],
+)
+def test_random_trees_match_oracle(rng, binary, unary):
+    ops = make_operator_set(binary, unary)
+    X = rand_X(rng)
+    exprs = [
+        random_expr_fixed_size(rng, ops, X.shape[0], int(rng.integers(1, 16)))
+        for _ in range(50)
+    ]
+    trees = stack_trees([encode_tree(e, MAX_LEN) for e in exprs])
+    y, ok = jax.jit(lambda t: eval_trees(t, jnp.asarray(X), ops))(trees)
+    y, ok = np.asarray(y), np.asarray(ok)
+    for i, e in enumerate(exprs):
+        y_ref, complete_ref = eval_expr_numpy(e, X, ops)
+        assert bool(ok[i]) == complete_ref, f"tree {i} ok flag mismatch"
+        if complete_ref:
+            # Mask rows where float32 itself is ill-conditioned (e.g. trig of
+            # huge arguments): float32 vs float64 oracle disagreement.
+            y_ref64, _ = eval_expr_numpy(e, X.astype(np.float64), ops)
+            stable = np.abs(y_ref - y_ref64) <= 1e-4 * (1.0 + np.abs(y_ref64))
+            np.testing.assert_allclose(
+                y[i][stable],
+                y_ref[stable],
+                rtol=2e-4,
+                atol=2e-4,
+                err_msg=f"tree {i}",
+            )
+
+
+def test_fusion_shapes(rng):
+    """Each arity/structure case the reference kernels specialize
+    (test/test_evaluation.jl:12-23): deg2 with const/var children, deg1 over
+    deg2, etc."""
+    ops = make_operator_set(["+", "*"], ["cos"])
+    plus, mult, cos = 0, 1, 0
+    X = rand_X(rng, nfeat=3, n=11)
+    cases = [
+        Expr.binary(plus, Expr.const(1.5), Expr.const(2.5)),  # deg2_l0_r0
+        Expr.binary(plus, Expr.const(1.5), Expr.var(1)),  # deg2_l0
+        Expr.binary(mult, Expr.var(0), Expr.const(2.5)),  # deg2_r0
+        Expr.unary(cos, Expr.binary(plus, Expr.const(1.0), Expr.var(2))),  # deg1_l2
+        Expr.unary(cos, Expr.unary(cos, Expr.const(0.5))),  # deg1_l1_ll0
+        Expr.var(2),
+        Expr.const(3.25),
+    ]
+    for e in cases:
+        t = encode_tree(e, MAX_LEN)
+        y, ok = eval_tree(t, jnp.asarray(X), ops)
+        y_ref, complete = eval_expr_numpy(e, X, ops)
+        assert bool(ok) == complete
+        np.testing.assert_allclose(np.asarray(y), y_ref, rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.float64])
+def test_nan_detection(rng, dtype):
+    """Division by zero, sqrt(-1), log(0), Inf constants -> ok=False
+    (reference test/test_nan_detection.jl:5-47)."""
+    if dtype == np.float64:
+        jax.config.update("jax_enable_x64", True)
+    try:
+        ops = make_operator_set(["+", "/", "^"], ["sqrt", "log"])
+        div, plus = ops.binary_index("/"), ops.binary_index("+")
+        sqrt, log = ops.unary_index("sqrt"), ops.unary_index("log")
+        X = np.zeros((2, 5), dtype)
+        X[0] = [1.0, 2.0, 3.0, 4.0, 5.0]  # positive feature
+        X[1] = [-1.0, -2.0, 0.0, 1.0, 2.0]  # mixed feature
+        cases = [
+            (Expr.binary(div, Expr.const(1.0), Expr.var(1)), False),  # 1/0
+            (Expr.unary(sqrt, Expr.var(1)), False),  # sqrt(-1)
+            (Expr.unary(sqrt, Expr.var(0)), True),
+            (Expr.unary(log, Expr.var(1)), False),
+            (Expr.unary(log, Expr.var(0)), True),
+            (Expr.binary(plus, Expr.var(0), Expr.const(np.inf)), False),
+            (Expr.binary(plus, Expr.var(0), Expr.const(np.nan)), False),
+            # intermediate NaN must flag even if later ops could mask it:
+            (
+                Expr.binary(
+                    plus, Expr.unary(sqrt, Expr.var(1)), Expr.const(0.0)
+                ),
+                False,
+            ),
+        ]
+        for e, expect_ok in cases:
+            t = encode_tree(e, MAX_LEN, dtype=dtype)
+            _, ok = eval_tree(t, jnp.asarray(X), ops)
+            assert bool(ok) == expect_ok, f"{e}"
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+def test_empty_and_padded_batch(rng):
+    ops = make_operator_set(["+"], [])
+    X = rand_X(rng, nfeat=2, n=7)
+    t = encode_tree(Expr.var(0), MAX_LEN)
+    empty = TreeBatch(
+        kind=jnp.zeros(MAX_LEN, jnp.int32),
+        op=jnp.zeros(MAX_LEN, jnp.int32),
+        feat=jnp.zeros(MAX_LEN, jnp.int32),
+        cval=jnp.zeros(MAX_LEN, jnp.float32),
+        length=jnp.int32(0),
+    )
+    batch = stack_trees([t, empty])
+    y, ok = eval_trees(batch, jnp.asarray(X), ops)
+    assert bool(ok[0]) and not bool(ok[1])
+    np.testing.assert_allclose(np.asarray(y[0]), X[0], rtol=1e-6)
+
+
+def test_grad_constants(rng):
+    """d/dc of c*cos(x0) + c2 matches analytic."""
+    ops = make_operator_set(["+", "*"], ["cos"])
+    plus, mult, cos = 0, 1, 0
+    e = Expr.binary(
+        plus,
+        Expr.binary(mult, Expr.const(1.7), Expr.unary(cos, Expr.var(0))),
+        Expr.const(0.3),
+    )
+    t = encode_tree(e, MAX_LEN)
+    X = rand_X(rng, nfeat=1, n=9)
+    batch = stack_trees([t])
+    y, ok, dy = eval_grad_constants(batch, jnp.asarray(X), ops)
+    dy = np.asarray(dy)[0]  # (L, n)
+    # constant slots: slot0 = 1.7 (postfix: [1.7, x0, cos, *, 0.3, +])
+    np.testing.assert_allclose(dy[0], np.cos(X[0]), rtol=1e-5)
+    np.testing.assert_allclose(dy[4], np.ones(9), rtol=1e-5)
+    # non-const slots have zero gradient
+    np.testing.assert_allclose(dy[1], 0.0)
+
+
+def test_grad_variables(rng):
+    ops = make_operator_set(["*"], ["sin"])
+    e = Expr.unary(0, Expr.binary(0, Expr.const(2.0), Expr.var(0)))  # sin(2x)
+    t = encode_tree(e, MAX_LEN)
+    X = rand_X(rng, nfeat=1, n=13)
+    y, dX = eval_grad_variables(t, jnp.asarray(X), ops)
+    np.testing.assert_allclose(
+        np.asarray(dX)[0], 2.0 * np.cos(2.0 * X[0]), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_batch_shapes(rng):
+    """eval_trees supports arbitrary leading batch dims (islands, npop)."""
+    ops = make_operator_set(["+", "*"], ["cos"])
+    X = rand_X(rng, nfeat=2, n=5)
+    exprs = [
+        random_expr_fixed_size(rng, ops, 2, 5) for _ in range(6)
+    ]
+    flat = stack_trees([encode_tree(e, MAX_LEN) for e in exprs])
+    nested = jax.tree_util.tree_map(
+        lambda x: x.reshape((2, 3) + x.shape[1:]), flat
+    )
+    y, ok = eval_trees(nested, jnp.asarray(X), ops)
+    assert y.shape == (2, 3, 5) and ok.shape == (2, 3)

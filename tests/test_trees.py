@@ -1,0 +1,123 @@
+"""Tree encoding round-trip, printing, parsing, structural queries.
+
+Parity targets: reference test/test_print.jl (string forms) and
+DynamicExpressions tree manipulation semantics."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.models.trees import (
+    BIN,
+    CONST,
+    PAD,
+    UNA,
+    VAR,
+    Expr,
+    decode_tree,
+    encode_tree,
+    expr_to_string,
+    is_valid_postfix,
+    node_depths,
+    parse_expression,
+    subtree_sizes,
+    tree_depth,
+    tree_to_string,
+)
+from symbolicregression_jl_tpu.ops.operators import make_operator_set
+
+OPS = make_operator_set(["+", "-", "*", "/"], ["cos", "exp"])
+
+
+def example_expr():
+    # 2*cos(x3) + x0^2 - 2  (the reference's precompile workload family,
+    # reference src/precompile.jl:39-41) using * for square
+    cos = OPS.unary_index("cos")
+    plus = OPS.binary_index("+")
+    sub = OPS.binary_index("-")
+    mult = OPS.binary_index("*")
+    x0 = Expr.var(0)
+    return Expr.binary(
+        sub,
+        Expr.binary(
+            plus,
+            Expr.binary(mult, Expr.const(2.0), Expr.unary(cos, Expr.var(3))),
+            Expr.binary(mult, x0, x0),
+        ),
+        Expr.const(2.0),
+    )
+
+
+def test_encode_decode_roundtrip():
+    e = example_expr()
+    t = encode_tree(e, max_len=24)
+    assert int(t.length) == e.size() == 10
+    e2 = decode_tree(t)
+    assert expr_to_string(e, OPS) == expr_to_string(e2, OPS)
+
+
+def test_postfix_layout():
+    # cos(x1) encodes as [x1, cos]
+    e = Expr.unary(OPS.unary_index("cos"), Expr.var(1))
+    t = encode_tree(e, max_len=8)
+    kind = np.asarray(t.kind)
+    assert kind[0] == VAR and kind[1] == UNA and kind[2] == PAD
+    assert int(t.length) == 2
+
+
+def test_string_form():
+    s = tree_to_string(encode_tree(example_expr(), 24), OPS)
+    assert s == "(((2 * cos(x3)) + (x0 * x0)) - 2)"
+
+
+def test_variable_names():
+    e = Expr.binary(OPS.binary_index("+"), Expr.var(0), Expr.var(1))
+    s = expr_to_string(e, OPS, variable_names=["alpha", "beta"])
+    assert s == "(alpha + beta)"
+
+
+def test_parse_roundtrip():
+    e = example_expr()
+    s = expr_to_string(e, OPS)
+    e2 = parse_expression(s, OPS)
+    assert expr_to_string(e2, OPS) == s
+
+
+def test_parse_unary_minus_and_pow():
+    ops = make_operator_set(["+", "-", "*", "/", "^"], ["neg", "sqrt"])
+    e = parse_expression("-sqrt(x0) + x1 ^ 2.5", ops)
+    s = expr_to_string(e, ops)
+    assert "sqrt" in s and "^" in s
+
+
+def test_subtree_sizes():
+    e = example_expr()
+    t = encode_tree(e, 24)
+    sizes = np.asarray(subtree_sizes(t.kind, t.length))
+    # root at slot length-1 covers the whole tree
+    assert sizes[int(t.length) - 1] == 10
+    # leaves have size 1
+    kind = np.asarray(t.kind)
+    for i in range(int(t.length)):
+        if kind[i] in (CONST, VAR):
+            assert sizes[i] == 1
+    assert np.all(sizes[int(t.length):] == 0)
+
+
+def test_depths():
+    e = example_expr()
+    t = encode_tree(e, 24)
+    assert int(tree_depth(t.kind, t.length)) == e.depth() == 5
+
+
+def test_decode_rejects_invalid():
+    t = encode_tree(example_expr(), 24)
+    bad = t._replace(kind=t.kind.at[0].set(BIN))
+    assert not is_valid_postfix(bad)
+    assert is_valid_postfix(t)
+
+
+def test_oversized_raises():
+    e = example_expr()
+    with pytest.raises(ValueError):
+        encode_tree(e, max_len=4)
