@@ -1,0 +1,124 @@
+"""Constraint checking on flat postfix trees
+(reference src/CheckConstraints.jl:9-170).
+
+All checks are pure integer array ops, jittable and vmappable:
+* global size cap (complexity <= curmaxsize) and depth cap;
+* per-operator subtree-size caps (`constraints=...`, reference
+  flag_bin/una_operator_complexity :9-65): for each flagged operator, every
+  occurrence's child subtree sizes must be within the cap;
+* nested-operator caps (`nested_constraints=...`, reference
+  flag_illegal_nests / count_max_nestedness :68-139): for each (outer op ->
+  inner op, max) rule, the count of inner ops strictly inside any outer-op
+  subtree must be <= max. Subtree occurrence counts come from prefix sums
+  over the postfix span.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .complexity import compute_complexity
+from .options import Options
+from .trees import BIN, UNA, TreeBatch, subtree_sizes, tree_depth
+
+Array = jax.Array
+
+
+def _op_occurrence_mask(tree: TreeBatch, kind: int, op_idx: int) -> Array:
+    live = jnp.arange(tree.max_len) < tree.length
+    return (tree.kind == kind) & (tree.op == op_idx) & live
+
+
+def check_constraints_single(
+    tree: TreeBatch, options: Options, curmaxsize: Array
+) -> Array:
+    """Single tree (fields (L,)) -> bool. vmap for batches.
+
+    Reference entry point: check_constraints(tree, options, maxsize)
+    (src/CheckConstraints.jl:142-170)."""
+    ops = options.operators
+    ok = compute_complexity(tree, options) <= curmaxsize
+    ok &= tree_depth(tree.kind, tree.length) <= options.maxdepth
+    ok &= tree.length >= 1
+
+    sizes = None
+    need_sizes = bool(options.constraints) or bool(options.nested_constraints)
+    if need_sizes:
+        sizes = subtree_sizes(tree.kind, tree.length)
+
+    # per-operator subtree-size caps
+    for name, caps in options.constraints:
+        from ..ops.operators import canonical_name
+
+        cname = canonical_name(name)
+        if cname in ops.binary_names:
+            op_idx = ops.binary_names.index(cname)
+            if isinstance(caps, int):
+                caps = (caps, caps)
+            l_cap, r_cap = caps
+            mask = _op_occurrence_mask(tree, BIN, op_idx)
+            idx = jnp.arange(tree.max_len)
+            r_size = sizes[jnp.maximum(idx - 1, 0)]
+            l_root = idx - 1 - r_size
+            l_size = sizes[jnp.clip(l_root, 0, tree.max_len - 1)]
+            viol = jnp.zeros_like(mask)
+            if l_cap is not None and l_cap >= 0:
+                viol |= mask & (l_size > l_cap)
+            if r_cap is not None and r_cap >= 0:
+                viol |= mask & (r_size > r_cap)
+            ok &= ~jnp.any(viol)
+        elif cname in ops.unary_names:
+            op_idx = ops.unary_names.index(cname)
+            cap = caps if isinstance(caps, int) else caps[0]
+            if cap is not None and cap >= 0:
+                mask = _op_occurrence_mask(tree, UNA, op_idx)
+                idx = jnp.arange(tree.max_len)
+                c_size = sizes[jnp.maximum(idx - 1, 0)]
+                ok &= ~jnp.any(mask & (c_size > cap))
+
+    # nested-operator caps
+    for outer_name, inner_rules in options.nested_constraints:
+        from ..ops.operators import canonical_name
+
+        o_name = canonical_name(outer_name)
+        if o_name in ops.binary_names:
+            o_kind, o_idx = BIN, ops.binary_names.index(o_name)
+        elif o_name in ops.unary_names:
+            o_kind, o_idx = UNA, ops.unary_names.index(o_name)
+        else:
+            continue
+        outer_mask = _op_occurrence_mask(tree, o_kind, o_idx)
+        idx = jnp.arange(tree.max_len)
+        span_start = idx - sizes + 1
+        for inner_name, max_count in inner_rules:
+            i_name = canonical_name(inner_name)
+            if i_name in ops.binary_names:
+                i_kind, i_idx = BIN, ops.binary_names.index(i_name)
+            elif i_name in ops.unary_names:
+                i_kind, i_idx = UNA, ops.unary_names.index(i_name)
+            else:
+                continue
+            inner_occ = _op_occurrence_mask(tree, i_kind, i_idx).astype(jnp.int32)
+            prefix = jnp.concatenate([jnp.zeros(1, jnp.int32), jnp.cumsum(inner_occ)])
+            # strict inside: occurrences in [span_start, idx) (excl. root)
+            count = prefix[idx] - prefix[jnp.clip(span_start, 0, tree.max_len)]
+            ok &= ~jnp.any(outer_mask & (count > max_count))
+
+    return ok
+
+
+def check_constraints(
+    trees: TreeBatch, options: Options, curmaxsize: Array
+) -> Array:
+    """Batched over leading dims."""
+    batch_shape = trees.length.shape
+    if batch_shape == ():
+        return check_constraints_single(trees, options, curmaxsize)
+    flat = jax.tree_util.tree_map(
+        lambda x: x.reshape((-1,) + x.shape[len(batch_shape):]), trees
+    )
+    out = jax.vmap(lambda t: check_constraints_single(t, options, curmaxsize))(flat)
+    return out.reshape(batch_shape)

@@ -1,0 +1,582 @@
+"""On-device tree surgery: mutations, crossover, random generation.
+
+The reference mutates linked Node trees with pointer surgery on the host
+(src/MutationFunctions.jl). Here every genetic operator is pure array
+arithmetic on the flat postfix encoding (SURVEY.md §7 decision 3), so the
+entire evolution step jits and shards:
+
+* every subtree is a contiguous postfix span [i-size(i)+1, i];
+* all edits reduce to one primitive, `splice` (replace a span with a donor
+  span) implemented as a piecewise index-mapped gather;
+* node choice is masked categorical sampling with jax.random.
+
+All functions operate on a SINGLE tree (fields shape (L,)) and are designed
+to be `jax.vmap`-ed over the mutation batch. Each returns (tree', ok) where
+ok=False means the edit could not be applied (no eligible node / result too
+long) and tree' is the unchanged input.
+
+Reference parity targets cited per-function.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.operators import OperatorSet
+from .trees import (
+    ARITY,
+    BIN,
+    CONST,
+    PAD,
+    UNA,
+    VAR,
+    TreeBatch,
+    subtree_sizes,
+)
+
+Array = jax.Array
+
+_NEG_INF = -1e9
+
+
+# ---------------------------------------------------------------------------
+# Sampling helpers
+# ---------------------------------------------------------------------------
+
+
+def select_node(key: Array, mask: Array) -> Tuple[Array, Array]:
+    """Uniformly sample an index where mask is True.
+
+    Analog of `random_node` with a predicate (reference
+    src/MutationFunctions.jl:8-29). Returns (index, any_valid)."""
+    logits = jnp.where(mask, 0.0, _NEG_INF)
+    idx = jax.random.categorical(key, logits)
+    return idx, jnp.any(mask)
+
+
+def valid_mask(tree: TreeBatch) -> Array:
+    return jnp.arange(tree.max_len) < tree.length
+
+
+def make_random_leaf(
+    key: Array, nfeatures: int
+) -> Tuple[Array, Array, Array, Array]:
+    """50/50 constant (randn) / feature leaf
+    (reference src/MutationFunctions.jl:151-157). Returns scalar fields."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    is_const = jax.random.bernoulli(k1)
+    kind = jnp.where(is_const, CONST, VAR)
+    feat = jax.random.randint(k2, (), 0, nfeatures)
+    cval = jax.random.normal(k3)
+    return kind.astype(jnp.int32), jnp.int32(0), jnp.where(is_const, feat * 0, feat), cval
+
+
+# ---------------------------------------------------------------------------
+# The splice primitive
+# ---------------------------------------------------------------------------
+
+
+def splice(
+    tree: TreeBatch,
+    start: Array,
+    end: Array,
+    donor_kind: Array,
+    donor_op: Array,
+    donor_feat: Array,
+    donor_cval: Array,
+    d_start: Array,
+    d_len: Array,
+) -> Tuple[TreeBatch, Array]:
+    """Replace tree[start:end) with donor[d_start : d_start+d_len).
+
+    Pure gather: for each output slot pick from the prefix, the donor span,
+    or the shifted suffix. Returns (tree', ok) with ok=False (and tree
+    unchanged) if the result would exceed max_len."""
+    L = tree.max_len
+    DL = donor_kind.shape[0]
+    new_len = tree.length - (end - start) + d_len
+    ok = (new_len <= L) & (new_len >= 1)
+
+    i = jnp.arange(L)
+    in_pre = i < start
+    in_donor = (i >= start) & (i < start + d_len)
+    src_suffix = jnp.clip(i - (start + d_len) + end, 0, L - 1)
+    src_tree = jnp.where(in_pre, i, src_suffix)
+    src_donor = jnp.clip(d_start + i - start, 0, DL - 1)
+    live = i < new_len
+
+    def pick(tf, df, pad_val):
+        out = jnp.where(in_donor, df[src_donor], tf[src_tree])
+        return jnp.where(live, out, pad_val)
+
+    new = TreeBatch(
+        kind=pick(tree.kind, donor_kind, PAD),
+        op=pick(tree.op, donor_op, 0),
+        feat=pick(tree.feat, donor_feat, 0),
+        cval=pick(tree.cval, donor_cval, jnp.zeros((), tree.cval.dtype)),
+        length=jnp.where(ok, new_len, tree.length).astype(jnp.int32),
+    )
+    new = jax.tree_util.tree_map(
+        lambda n, o: jnp.where(ok, n, o), new, tree
+    )
+    return new, ok
+
+
+def splice_tree_donor(
+    tree: TreeBatch, start, end, donor: TreeBatch, d_start, d_len
+) -> Tuple[TreeBatch, Array]:
+    return splice(
+        tree, start, end, donor.kind, donor.op, donor.feat, donor.cval, d_start, d_len
+    )
+
+
+def _donor4(kinds, ops, feats, cvals, dtype):
+    """Pack up to 4 scalar nodes into fixed donor arrays."""
+    return (
+        jnp.stack(kinds).astype(jnp.int32),
+        jnp.stack(ops).astype(jnp.int32),
+        jnp.stack(feats).astype(jnp.int32),
+        jnp.stack(cvals).astype(dtype),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Structural spans
+# ---------------------------------------------------------------------------
+
+
+def node_span(tree: TreeBatch, idx: Array, sizes: Array) -> Tuple[Array, Array]:
+    """Postfix span [start, end) of the subtree rooted at slot idx."""
+    size = sizes[idx]
+    return idx - size + 1, idx + 1
+
+
+def child_spans(tree: TreeBatch, idx: Array, sizes: Array):
+    """For an op node at idx: (left_start, left_end, right_start, right_end).
+    For unary nodes the 'right' span is the child and left is empty."""
+    r_size = sizes[jnp.maximum(idx - 1, 0)]
+    r_start = idx - r_size
+    l_root = idx - 1 - r_size
+    l_size = sizes[jnp.maximum(l_root, 0)]
+    l_start = l_root - l_size + 1
+    return l_start, l_root + 1, r_start, idx
+
+
+# ---------------------------------------------------------------------------
+# Mutations (reference src/MutationFunctions.jl)
+# ---------------------------------------------------------------------------
+
+
+def mutate_constant(
+    key: Array, tree: TreeBatch, temperature: Array, perturbation_factor: float,
+    probability_negate: float,
+) -> Tuple[TreeBatch, Array]:
+    """Multiplicative perturbation + occasional negation of one constant
+    (reference src/MutationFunctions.jl:50-79)."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    mask = (tree.kind == CONST) & valid_mask(tree)
+    idx, ok = select_node(k1, mask)
+    max_change = perturbation_factor * temperature + 1.1
+    factor = max_change ** jax.random.uniform(k2)
+    bigger = jax.random.bernoulli(k3)
+    factor = jnp.where(bigger, factor, 1.0 / factor)
+    negate = jax.random.bernoulli(k4, probability_negate)
+    new_val = tree.cval[idx] * factor * jnp.where(negate, -1.0, 1.0)
+    new_cval = tree.cval.at[idx].set(new_val.astype(tree.cval.dtype))
+    new = tree._replace(cval=jnp.where(ok, new_cval, tree.cval))
+    return new, ok
+
+
+def mutate_operator(
+    key: Array, tree: TreeBatch, operators: OperatorSet
+) -> Tuple[TreeBatch, Array]:
+    """Swap one operator for a random same-arity operator
+    (reference src/MutationFunctions.jl:33-47)."""
+    k1, k2 = jax.random.split(key)
+    mask = ((tree.kind == UNA) | (tree.kind == BIN)) & valid_mask(tree)
+    idx, ok = select_node(k1, mask)
+    is_una = tree.kind[idx] == UNA
+    n_una = max(operators.n_unary, 1)
+    n_bin = max(operators.n_binary, 1)
+    new_op = jnp.where(
+        is_una,
+        jax.random.randint(k2, (), 0, n_una),
+        jax.random.randint(k2, (), 0, n_bin),
+    )
+    new = tree._replace(op=jnp.where(ok, tree.op.at[idx].set(new_op), tree.op))
+    return new, ok
+
+
+def _random_op_donor(key: Array, use_unary: Array, nfeatures: int,
+                     operators: OperatorSet, dtype):
+    """Donor [leaf, op] (unary, d_len=2) or [leaf, leaf, op] (binary,
+    d_len=3) with fresh random leaves."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    lk1, lo1, lf1, lc1 = make_random_leaf(k1, nfeatures)
+    lk2, lo2, lf2, lc2 = make_random_leaf(k2, nfeatures)
+    op_u = jax.random.randint(k3, (), 0, max(operators.n_unary, 1))
+    op_b = jax.random.randint(k4, (), 0, max(operators.n_binary, 1))
+    zero = jnp.int32(0)
+    zf = jnp.zeros((), dtype)
+    # unary layout: [leaf1, OP, -, -]; binary layout: [leaf1, leaf2, OP, -]
+    dk = jnp.where(
+        use_unary,
+        jnp.stack([lk1, jnp.int32(UNA), zero, zero]),
+        jnp.stack([lk1, lk2, jnp.int32(BIN), zero]),
+    )
+    do = jnp.where(
+        use_unary,
+        jnp.stack([zero, op_u, zero, zero]),
+        jnp.stack([zero, zero, op_b, zero]),
+    )
+    df = jnp.where(
+        use_unary,
+        jnp.stack([lf1, zero, zero, zero]),
+        jnp.stack([lf1, lf2, zero, zero]),
+    )
+    dc = jnp.where(
+        use_unary,
+        jnp.stack([lc1, zf, zf, zf]),
+        jnp.stack([lc1, lc2, zf, zf]),
+    )
+    d_len = jnp.where(use_unary, 2, 3)
+    return dk, do, df, dc, d_len
+
+
+def _choose_unary(key: Array, operators: OperatorSet) -> Array:
+    """Coin-flip unary vs binary, degenerate when one family is absent."""
+    if operators.n_unary == 0:
+        return jnp.bool_(False)
+    if operators.n_binary == 0:
+        return jnp.bool_(True)
+    return jax.random.bernoulli(key)
+
+
+def append_random_op(
+    key: Array, tree: TreeBatch, nfeatures: int, operators: OperatorSet
+) -> Tuple[TreeBatch, Array]:
+    """Replace a random leaf with a random operator over fresh leaves
+    (reference src/MutationFunctions.jl:82-111)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    mask = ((tree.kind == CONST) | (tree.kind == VAR)) & valid_mask(tree)
+    idx, any_leaf = select_node(k1, mask)
+    use_unary = _choose_unary(k2, operators)
+    dk, do, df, dc, d_len = _random_op_donor(
+        k3, use_unary, nfeatures, operators, tree.cval.dtype
+    )
+    new, fit = splice(tree, idx, idx + 1, dk, do, df, dc, 0, d_len)
+    ok = any_leaf & fit
+    new = jax.tree_util.tree_map(lambda n, o: jnp.where(ok, n, o), new, tree)
+    return new, ok
+
+
+def insert_random_op(
+    key: Array, tree: TreeBatch, nfeatures: int, operators: OperatorSet,
+    at_root: bool = False,
+) -> Tuple[TreeBatch, Array]:
+    """Make a random node the child of a new random operator; binary gets a
+    fresh leaf as the other child, side chosen at random
+    (reference insert_random_op src/MutationFunctions.jl:114-130; with
+    at_root=True this is prepend_random_op, :133-149)."""
+    k1, k2, k3, k4, k5, k6 = jax.random.split(key, 6)
+    sizes = subtree_sizes(tree.kind, tree.length)
+    if at_root:
+        idx = tree.length - 1
+        any_node = tree.length > 0
+    else:
+        idx, any_node = select_node(k1, valid_mask(tree))
+    s, e = node_span(tree, idx, sizes)
+
+    use_unary = _choose_unary(k2, operators)
+    as_left = jax.random.bernoulli(k3)
+    op_u = jax.random.randint(k4, (), 0, max(operators.n_unary, 1))
+    op_b = jax.random.randint(k5, (), 0, max(operators.n_binary, 1))
+    lk, lo, lf, lc = make_random_leaf(k6, nfeatures)
+    zero = jnp.int32(0)
+    zf = jnp.zeros((), tree.cval.dtype)
+    dtype = tree.cval.dtype
+
+    # Case 1 (unary): insert [OP] at e.
+    # Case 2 (binary, subtree as left): insert [leaf, OP] at e.
+    # Case 3 (binary, subtree as right): insert [OP] at e then [leaf] at s.
+    op_kind = jnp.where(use_unary, UNA, BIN).astype(jnp.int32)
+    op_idx = jnp.where(use_unary, op_u, op_b)
+
+    dk1 = jnp.stack([lk, jnp.int32(0), zero, zero])
+    do1 = jnp.stack([zero, zero, zero, zero])
+    df1 = jnp.stack([lf, zero, zero, zero])
+    dc1 = jnp.stack([lc, zf, zf, zf])
+
+    tail_is_leaf_op = (~use_unary) & as_left
+    dk_tail = jnp.where(
+        tail_is_leaf_op,
+        jnp.stack([lk, op_kind, zero, zero]),
+        jnp.stack([op_kind, zero, zero, zero]),
+    )
+    do_tail = jnp.where(
+        tail_is_leaf_op,
+        jnp.stack([zero, op_idx, zero, zero]),
+        jnp.stack([op_idx, zero, zero, zero]),
+    )
+    df_tail = jnp.where(
+        tail_is_leaf_op,
+        jnp.stack([lf, zero, zero, zero]),
+        jnp.stack([zero, zero, zero, zero]),
+    )
+    dc_tail = jnp.where(
+        tail_is_leaf_op,
+        jnp.stack([lc, zf, zf, zf]),
+        jnp.stack([zf, zf, zf, zf]),
+    )
+    tail_len = jnp.where(tail_is_leaf_op, 2, 1)
+    new, ok1 = splice(tree, e, e, dk_tail, do_tail, df_tail, dc_tail, 0, tail_len)
+
+    need_front_leaf = (~use_unary) & (~as_left)
+    front_len = jnp.where(need_front_leaf, 1, 0)
+    new2, ok2 = splice(new, s, s, dk1, do1, df1, dc1, 0, front_len)
+
+    ok = any_node & ok1 & ok2
+    out = jax.tree_util.tree_map(lambda n, o: jnp.where(ok, n, o), new2, tree)
+    return out, ok
+
+
+def prepend_random_op(key, tree, nfeatures, operators):
+    return insert_random_op(key, tree, nfeatures, operators, at_root=True)
+
+
+def delete_random_op(
+    key: Array, tree: TreeBatch, nfeatures: int, operators: OperatorSet
+) -> Tuple[TreeBatch, Array]:
+    """Replace a random operator node by one of its children
+    (reference delete_random_op src/MutationFunctions.jl:193-233). If the
+    tree is a single leaf, regenerates a fresh random leaf."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    sizes = subtree_sizes(tree.kind, tree.length)
+    mask = ((tree.kind == UNA) | (tree.kind == BIN)) & valid_mask(tree)
+    idx, any_op = select_node(k1, mask)
+    s, e = node_span(tree, idx, sizes)
+    l_start, l_end, r_start, r_end = child_spans(tree, idx, sizes)
+    is_una = tree.kind[idx] == UNA
+    keep_right = jax.random.bernoulli(k2) | is_una
+    c_start = jnp.where(keep_right, r_start, l_start)
+    c_end = jnp.where(keep_right, r_end, l_end)
+    new, fit = splice_tree_donor(tree, s, e, tree, c_start, c_end - c_start)
+    ok = any_op & fit
+
+    # single-leaf fallback: fresh random leaf (reference :198-205)
+    lk, lo, lf, lc = make_random_leaf(k3, nfeatures)
+    leaf_tree = TreeBatch(
+        kind=jnp.zeros_like(tree.kind).at[0].set(lk),
+        op=jnp.zeros_like(tree.op),
+        feat=jnp.zeros_like(tree.feat).at[0].set(lf),
+        cval=jnp.zeros_like(tree.cval).at[0].set(lc),
+        length=jnp.int32(1),
+    )
+    is_leaf_only = tree.length == 1
+    out = jax.tree_util.tree_map(
+        lambda n, o, l: jnp.where(
+            is_leaf_only, l, jnp.where(ok, n, o)
+        ),
+        new,
+        tree,
+        leaf_tree,
+    )
+    return out, ok | is_leaf_only
+
+
+def gen_random_tree_fixed_size(
+    key: Array,
+    target_size: Array,
+    nfeatures: int,
+    operators: OperatorSet,
+    max_len: int,
+    dtype=jnp.float32,
+) -> TreeBatch:
+    """Grow a random tree to ~target_size nodes by repeatedly replacing a
+    random leaf with a random operator
+    (reference gen_random_tree_fixed_size src/MutationFunctions.jl:248-263).
+    Fully on-device: a fori_loop of masked append_random_op steps."""
+    k0, kloop = jax.random.split(key)
+    lk, lo, lf, lc = make_random_leaf(k0, nfeatures)
+    tree = TreeBatch(
+        kind=jnp.zeros(max_len, jnp.int32).at[0].set(lk),
+        op=jnp.zeros(max_len, jnp.int32),
+        feat=jnp.zeros(max_len, jnp.int32).at[0].set(lf),
+        cval=jnp.zeros(max_len, dtype).at[0].set(lc),
+        length=jnp.int32(1),
+    )
+    target = jnp.minimum(target_size, max_len)
+
+    def body(i, carry):
+        tree, key = carry
+        key, k1, k2, k3 = jax.random.split(key, 4)
+        remaining = target - tree.length
+        # choose arity so we don't overshoot when exactly 1 slot remains
+        if operators.n_unary > 0 and operators.n_binary > 0:
+            use_unary = (remaining == 1) | jax.random.bernoulli(k1)
+        elif operators.n_unary > 0:
+            use_unary = jnp.bool_(True)
+        else:
+            use_unary = jnp.bool_(False)
+        mask = ((tree.kind == CONST) | (tree.kind == VAR)) & valid_mask(tree)
+        idx, any_leaf = select_node(k2, mask)
+        dk, do, df, dc, d_len = _random_op_donor(
+            k3, use_unary, nfeatures, operators, dtype
+        )
+        new, fit = splice(tree, idx, idx + 1, dk, do, df, dc, 0, d_len)
+        grow = (tree.length < target) & any_leaf & fit
+        tree = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(grow, n, o), new, tree
+        )
+        return tree, key
+
+    steps = max_len // 2 + 1
+    tree, _ = jax.lax.fori_loop(0, steps, body, (tree, kloop))
+    return tree
+
+
+def crossover_trees(
+    key: Array, a: TreeBatch, b: TreeBatch
+) -> Tuple[TreeBatch, TreeBatch, Array]:
+    """Swap random subtrees between two trees
+    (reference crossover_trees src/MutationFunctions.jl:266-294).
+    Returns (a', b', ok); ok=False if either result would overflow."""
+    k1, k2 = jax.random.split(key)
+    sizes_a = subtree_sizes(a.kind, a.length)
+    sizes_b = subtree_sizes(b.kind, b.length)
+    ia, ok_a = select_node(k1, valid_mask(a))
+    ib, ok_b = select_node(k2, valid_mask(b))
+    sa, ea = node_span(a, ia, sizes_a)
+    sb, eb = node_span(b, ib, sizes_b)
+    a2, fit_a = splice_tree_donor(a, sa, ea, b, sb, eb - sb)
+    b2, fit_b = splice_tree_donor(b, sb, eb, a, sa, ea - sa)
+    ok = ok_a & ok_b & fit_a & fit_b
+    a_out = jax.tree_util.tree_map(lambda n, o: jnp.where(ok, n, o), a2, a)
+    b_out = jax.tree_util.tree_map(lambda n, o: jnp.where(ok, n, o), b2, b)
+    return a_out, b_out, ok
+
+
+# ---------------------------------------------------------------------------
+# Simplification: constant folding (analog of simplify_tree, reference
+# src/SingleIteration.jl:73-74 via DynamicExpressions.simplify_tree)
+# ---------------------------------------------------------------------------
+
+
+def _const_fold_scan(tree: TreeBatch, operators: OperatorSet):
+    """Per-node (is_const, folded_value, parent_index) via one stack scan.
+
+    folded_value is meaningful only where is_const. Parent of the root is -1.
+    Operator values are computed on scalars with the same jnp semantics as
+    the interpreter, so folding is bit-compatible with evaluation."""
+    L = tree.max_len
+    arity_table = jnp.asarray(ARITY)
+    unary_fns = operators.unary_fns
+    binary_fns = operators.binary_fns
+
+    def step(carry, x):
+        (cstack, vstack, istack, sp, parent) = carry
+        i, k, o, c = x
+        a_c = cstack[jnp.maximum(sp - 1, 0)]
+        b_c = cstack[jnp.maximum(sp - 2, 0)]
+        a_v = vstack[jnp.maximum(sp - 1, 0)]
+        b_v = vstack[jnp.maximum(sp - 2, 0)]
+        a_i = istack[jnp.maximum(sp - 1, 0)]
+        b_i = istack[jnp.maximum(sp - 2, 0)]
+
+        if unary_fns:
+            una_all = jnp.stack([fn(a_v) for fn in unary_fns])
+            una = una_all[jnp.clip(o, 0, len(unary_fns) - 1)]
+        else:
+            una = a_v
+        if binary_fns:
+            bin_all = jnp.stack([fn(b_v, a_v) for fn in binary_fns])
+            binv = bin_all[jnp.clip(o, 0, len(binary_fns) - 1)]
+        else:
+            binv = a_v
+
+        is_leaf_const = k == CONST
+        node_const = jnp.where(
+            k <= VAR,
+            is_leaf_const,
+            jnp.where(k == UNA, a_c, a_c & b_c),
+        )
+        node_val = jnp.where(
+            k <= VAR, c, jnp.where(k == UNA, una, binv)
+        )
+        # only fold finite values (don't bake NaN/Inf constants in)
+        node_const = node_const & jnp.isfinite(node_val)
+
+        # record parents of consumed children
+        arity = arity_table[k]
+        parent = jnp.where(
+            arity >= 1, parent.at[jnp.maximum(a_i, 0)].set(i), parent
+        )
+        parent = jnp.where(
+            arity == 2, parent.at[jnp.maximum(b_i, 0)].set(i), parent
+        )
+
+        new_sp = jnp.where(k == PAD, sp, sp - arity + 1)
+        w = jnp.maximum(new_sp - 1, 0)
+        valid = k != PAD
+        cstack = jnp.where(valid, cstack.at[w].set(node_const), cstack)
+        vstack = jnp.where(valid, vstack.at[w].set(node_val), vstack)
+        istack = jnp.where(valid, istack.at[w].set(i), istack)
+        return (cstack, vstack, istack, new_sp, parent), (node_const, node_val)
+
+    D = L // 2 + 2
+    init = (
+        jnp.zeros(D, jnp.bool_),
+        jnp.zeros(D, tree.cval.dtype),
+        jnp.full(D, -1, jnp.int32),
+        jnp.int32(0),
+        jnp.full(L, -1, jnp.int32),
+    )
+    xs = (jnp.arange(L, dtype=jnp.int32), tree.kind, tree.op, tree.cval)
+    (c_, v_, i_, sp_, parent), (is_const, fold_val) = jax.lax.scan(step, init, xs)
+    live = valid_mask(tree)
+    return is_const & live, fold_val, parent
+
+
+def simplify_tree(
+    tree: TreeBatch, operators: OperatorSet
+) -> Tuple[TreeBatch, Array]:
+    """Fold maximal constant subtrees into single CONST leaves.
+
+    Keeps nodes that are not inside any constant subtree; replaces each
+    fold-root by a CONST leaf; compacts the survivors preserving postfix
+    order (scatter by cumulative index). Returns (tree', changed)."""
+    is_const, fold_val, parent = _const_fold_scan(tree, operators)
+    live = valid_mask(tree)
+    parent_const = jnp.where(
+        parent >= 0, is_const[jnp.clip(parent, 0, tree.max_len - 1)], False
+    )
+    fold_root = is_const & ~parent_const
+    keep = live & (~is_const | fold_root)
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    n_new = jnp.sum(keep.astype(jnp.int32))
+    L = tree.max_len
+    tgt = jnp.where(keep, pos, L)  # L = dropped
+
+    new_kind_src = jnp.where(fold_root, CONST, tree.kind)
+    new_op_src = jnp.where(fold_root, 0, tree.op)
+    new_feat_src = jnp.where(fold_root, 0, tree.feat)
+    new_cval_src = jnp.where(fold_root, fold_val, tree.cval)
+
+    def scatter(src, fill):
+        out = jnp.full((L,), fill, src.dtype)
+        return out.at[tgt].set(src, mode="drop")
+
+    new = TreeBatch(
+        kind=scatter(new_kind_src, PAD),
+        op=scatter(new_op_src, 0),
+        feat=scatter(new_feat_src, 0),
+        cval=scatter(new_cval_src, jnp.zeros((), tree.cval.dtype)),
+        length=n_new.astype(jnp.int32),
+    )
+    changed = n_new < tree.length
+    out = jax.tree_util.tree_map(lambda n, o: jnp.where(changed, n, o), new, tree)
+    return out, changed

@@ -1,0 +1,138 @@
+"""Constraint checks (reference test/test_constraints.jl,
+test/test_nested_constraints.jl, test/test_complexity.jl)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from symbolicregression_jl_tpu.models.complexity import compute_complexity
+from symbolicregression_jl_tpu.models.constraints import check_constraints
+from symbolicregression_jl_tpu.models.options import make_options
+from symbolicregression_jl_tpu.models.trees import Expr, encode_tree
+from symbolicregression_jl_tpu.ops.operators import make_operator_set
+
+
+def build(expr, maxlen=24):
+    return encode_tree(expr, maxlen)
+
+
+def test_size_cap():
+    opt = make_options(binary_operators=["+", "*"], unary_operators=["cos"])
+    ops = opt.operators
+    e = Expr.binary(0, Expr.var(0), Expr.var(1))  # size 3
+    t = build(e)
+    assert bool(check_constraints(t, opt, jnp.int32(3)))
+    assert not bool(check_constraints(t, opt, jnp.int32(2)))
+
+
+def test_depth_cap():
+    opt = make_options(
+        binary_operators=["+"], unary_operators=["cos"], maxdepth=2, maxsize=20
+    )
+    cos = 0
+    shallow = Expr.unary(cos, Expr.var(0))  # depth 2
+    deep = Expr.unary(cos, Expr.unary(cos, Expr.var(0)))  # depth 3
+    assert bool(check_constraints(build(shallow), opt, jnp.int32(20)))
+    assert not bool(check_constraints(build(deep), opt, jnp.int32(20)))
+
+
+def test_unary_op_subtree_cap():
+    # exp's argument limited to 2 nodes (reference constraints=Dict("exp"=>2))
+    opt = make_options(
+        binary_operators=["+", "*"],
+        unary_operators=["exp"],
+        constraints={"exp": 2},
+    )
+    ops = opt.operators
+    exp_i = ops.unary_index("exp")
+    plus = ops.binary_index("+")
+    ok_tree = Expr.unary(exp_i, Expr.var(0))  # child size 1
+    bad_tree = Expr.unary(
+        exp_i, Expr.binary(plus, Expr.var(0), Expr.var(1))
+    )  # child size 3
+    assert bool(check_constraints(build(ok_tree), opt, jnp.int32(20)))
+    assert not bool(check_constraints(build(bad_tree), opt, jnp.int32(20)))
+
+
+def test_binary_op_asymmetric_caps():
+    # ^ with (-1, 2): unlimited base, exponent at most 2 nodes
+    opt = make_options(
+        binary_operators=["+", "^"],
+        unary_operators=["cos"],
+        constraints={"^": (-1, 2)},
+    )
+    ops = opt.operators
+    pow_i = ops.binary_index("^")
+    plus = ops.binary_index("+")
+    big = Expr.binary(plus, Expr.var(0), Expr.binary(plus, Expr.var(1), Expr.var(2)))
+    ok_tree = Expr.binary(pow_i, big, Expr.const(2.0))
+    bad_tree = Expr.binary(pow_i, Expr.var(0), big)
+    assert bool(check_constraints(build(ok_tree), opt, jnp.int32(20)))
+    assert not bool(check_constraints(build(bad_tree), opt, jnp.int32(20)))
+
+
+def test_nested_constraints():
+    # cos may not contain cos (reference nested_constraints syntax
+    # Dict("cos" => Dict("cos" => 0)))
+    opt = make_options(
+        binary_operators=["+"],
+        unary_operators=["cos"],
+        nested_constraints={"cos": {"cos": 0}},
+    )
+    cos, plus = 0, 0
+    ok_tree = Expr.binary(
+        plus, Expr.unary(cos, Expr.var(0)), Expr.unary(cos, Expr.var(1))
+    )  # sibling cos: fine
+    bad_tree = Expr.unary(cos, Expr.binary(plus, Expr.unary(cos, Expr.var(0)), Expr.var(1)))
+    assert bool(check_constraints(build(ok_tree), opt, jnp.int32(20)))
+    assert not bool(check_constraints(build(bad_tree), opt, jnp.int32(20)))
+
+
+def test_nested_count_threshold():
+    # + may contain at most 2 nested + strictly inside
+    opt = make_options(
+        binary_operators=["+"],
+        nested_constraints={"+": {"+": 2}},
+    )
+    plus = 0
+    t2 = Expr.binary(
+        plus, Expr.binary(plus, Expr.var(0), Expr.var(1)),
+        Expr.binary(plus, Expr.var(2), Expr.var(3)),
+    )  # root + contains 2 inner +
+    assert bool(check_constraints(build(t2), opt, jnp.int32(20)))
+    t3 = Expr.binary(plus, t2, Expr.binary(plus, Expr.var(0), Expr.var(1)))
+    # new root contains 4 inner +
+    assert not bool(check_constraints(build(t3), opt, jnp.int32(20)))
+
+
+def test_custom_complexity():
+    opt = make_options(
+        binary_operators=["+", "*"],
+        unary_operators=["exp"],
+        complexity_of_operators={"exp": 3, "*": 2},
+        complexity_of_constants=2,
+        complexity_of_variables=1,
+    )
+    ops = opt.operators
+    e = Expr.binary(
+        ops.binary_index("*"),
+        Expr.unary(ops.unary_index("exp"), Expr.var(0)),
+        Expr.const(1.0),
+    )
+    # exp(x0) * 1.0: * (2) + exp (3) + var (1) + const (2) = 8
+    assert int(compute_complexity(build(e), opt)) == 8
+
+
+def test_batched_constraints(rng):
+    from symbolicregression_jl_tpu.models.trees import stack_trees
+    from symbolicregression_jl_tpu.utils.random_exprs import random_expr_fixed_size
+
+    opt = make_options(binary_operators=["+", "*"], unary_operators=["cos"])
+    trees = stack_trees(
+        [
+            build(random_expr_fixed_size(rng, opt.operators, 3, s))
+            for s in [3, 5, 7, 9, 11]
+        ]
+    )
+    ok = check_constraints(trees, opt, jnp.int32(7))
+    lens = np.asarray(trees.length)
+    np.testing.assert_array_equal(np.asarray(ok), lens <= 7)

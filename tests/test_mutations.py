@@ -1,0 +1,217 @@
+"""On-device tree surgery property tests.
+
+Parity targets: reference test/test_crossover.jl (conservation of symbols),
+mutation semantics of src/MutationFunctions.jl, simplify equivalence
+(test/test_simplification.jl)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.models.mutate_device import (
+    append_random_op,
+    crossover_trees,
+    delete_random_op,
+    gen_random_tree_fixed_size,
+    insert_random_op,
+    mutate_constant,
+    mutate_operator,
+    prepend_random_op,
+    simplify_tree,
+)
+from symbolicregression_jl_tpu.models.trees import (
+    CONST,
+    VAR,
+    Expr,
+    decode_tree,
+    encode_tree,
+    expr_to_string,
+    is_valid_postfix,
+    stack_trees,
+)
+from symbolicregression_jl_tpu.ops.eval_numpy import eval_expr_numpy
+from symbolicregression_jl_tpu.ops.operators import make_operator_set
+from symbolicregression_jl_tpu.utils.random_exprs import random_expr_fixed_size
+
+OPS = make_operator_set(["+", "-", "*", "/"], ["cos", "exp"])
+L = 24
+NFEAT = 5
+
+
+def random_tree(rng, size=None):
+    size = size or int(rng.integers(1, 14))
+    return encode_tree(random_expr_fixed_size(rng, OPS, NFEAT, size), L)
+
+
+def keys(n, seed=0):
+    return jax.random.split(jax.random.PRNGKey(seed), n)
+
+
+def test_gen_random_tree_validity():
+    gen = jax.jit(
+        lambda k, s: gen_random_tree_fixed_size(k, s, NFEAT, OPS, L)
+    )
+    for i, k in enumerate(keys(40)):
+        target = 1 + i % 15
+        t = gen(k, target)
+        assert is_valid_postfix(t), f"invalid tree at {i}"
+        # size lands within overshoot-by-1 of target
+        assert 1 <= int(t.length) <= target + 1
+
+
+def test_mutate_constant_changes_one_constant(rng):
+    f = jax.jit(
+        lambda k, t: mutate_constant(k, t, jnp.float32(1.0), 0.076, 0.01)
+    )
+    hits = 0
+    for k in keys(30):
+        t = random_tree(rng)
+        t2, ok = f(k, t)
+        n_const = int(np.sum((np.asarray(t.kind) == CONST)))
+        if n_const == 0:
+            assert not bool(ok)
+            continue
+        hits += 1
+        assert bool(ok)
+        assert is_valid_postfix(t2)
+        diff = np.sum(np.asarray(t.cval) != np.asarray(t2.cval))
+        assert diff == 1
+        # structure untouched
+        np.testing.assert_array_equal(np.asarray(t.kind), np.asarray(t2.kind))
+    assert hits > 5
+
+
+def test_mutate_operator_same_arity(rng):
+    f = jax.jit(lambda k, t: mutate_operator(k, t, OPS))
+    for k in keys(30):
+        t = random_tree(rng, size=9)
+        t2, ok = f(k, t)
+        assert bool(ok)
+        assert is_valid_postfix(t2)
+        np.testing.assert_array_equal(np.asarray(t.kind), np.asarray(t2.kind))
+        changed = np.asarray(t.op) != np.asarray(t2.op)
+        assert changed.sum() <= 1
+
+
+def test_append_random_op(rng):
+    f = jax.jit(lambda k, t: append_random_op(k, t, NFEAT, OPS))
+    for k in keys(30):
+        t = random_tree(rng)
+        t2, ok = f(k, t)
+        if bool(ok):
+            assert is_valid_postfix(t2)
+            delta = int(t2.length) - int(t.length)
+            assert delta in (1, 2)  # unary leaf->op(leaf): +1; binary: +2
+
+
+def test_insert_and_prepend(rng):
+    fi = jax.jit(lambda k, t: insert_random_op(k, t, NFEAT, OPS))
+    fp = jax.jit(lambda k, t: prepend_random_op(k, t, NFEAT, OPS))
+    for k in keys(30):
+        t = random_tree(rng)
+        for f in (fi, fp):
+            t2, ok = f(k, t)
+            if bool(ok):
+                assert is_valid_postfix(t2)
+                delta = int(t2.length) - int(t.length)
+                assert delta in (1, 2)
+
+
+def test_prepend_puts_old_root_under_new_root(rng):
+    fp = jax.jit(lambda k, t: prepend_random_op(k, t, NFEAT, OPS))
+    t = random_tree(rng, size=7)
+    old = expr_to_string(decode_tree(t), OPS)
+    for k in keys(10, seed=3):
+        t2, ok = fp(k, t)
+        if bool(ok):
+            s = expr_to_string(decode_tree(t2), OPS)
+            assert old in s  # old tree is a contiguous child of the new root
+
+
+def test_delete_random_op(rng):
+    f = jax.jit(lambda k, t: delete_random_op(k, t, NFEAT, OPS))
+    for k in keys(40):
+        t = random_tree(rng)
+        t2, ok = f(k, t)
+        assert bool(ok)
+        assert is_valid_postfix(t2)
+        if int(t.length) > 1:
+            assert int(t2.length) < int(t.length)
+
+
+def test_crossover_validity_and_conservation(rng):
+    """Conservation of symbols (reference test/test_crossover.jl:18-45):
+    the multiset of nodes in (a', b') equals the multiset in (a, b)."""
+    f = jax.jit(lambda k, a, b: crossover_trees(k, a, b))
+    n_ok = 0
+    for k in keys(100):
+        a, b = random_tree(rng), random_tree(rng)
+        a2, b2, ok = f(k, a, b)
+        if not bool(ok):
+            continue
+        n_ok += 1
+        assert is_valid_postfix(a2) and is_valid_postfix(b2)
+
+        def sig(t):
+            n = int(t.length)
+            return sorted(
+                zip(
+                    np.asarray(t.kind)[:n].tolist(),
+                    np.asarray(t.op)[:n].tolist(),
+                    np.asarray(t.feat)[:n].tolist(),
+                    np.round(np.asarray(t.cval)[:n], 5).tolist(),
+                )
+            )
+
+        assert sorted(sig(a) + sig(b)) == sorted(sig(a2) + sig(b2))
+    assert n_ok > 50
+
+
+def test_simplify_constant_folding():
+    # (1 + 2) * x0 -> 3 * x0
+    plus, mult = OPS.binary_index("+"), OPS.binary_index("*")
+    e = Expr.binary(
+        mult, Expr.binary(plus, Expr.const(1.0), Expr.const(2.0)), Expr.var(0)
+    )
+    t = encode_tree(e, L)
+    t2, changed = jax.jit(lambda t: simplify_tree(t, OPS))(t)
+    assert bool(changed)
+    assert int(t2.length) == 3
+    s = expr_to_string(decode_tree(t2), OPS)
+    assert s == "(3 * x0)"
+
+
+def test_simplify_preserves_value(rng):
+    f = jax.jit(lambda t: simplify_tree(t, OPS))
+    X = rng.standard_normal((NFEAT, 20)).astype(np.float32)
+    for _ in range(40):
+        t = random_tree(rng)
+        t2, changed = f(t)
+        assert is_valid_postfix(t2)
+        assert int(t2.length) <= int(t.length)
+        y1, c1 = eval_expr_numpy(decode_tree(t), X, OPS)
+        y2, c2 = eval_expr_numpy(decode_tree(t2), X, OPS)
+        if c1 and c2:
+            np.testing.assert_allclose(y1, y2, rtol=1e-4, atol=1e-4)
+
+
+def test_simplify_whole_constant_tree():
+    plus = OPS.binary_index("+")
+    cos = OPS.unary_index("cos")
+    e = Expr.binary(plus, Expr.unary(cos, Expr.const(0.0)), Expr.const(1.0))
+    t = encode_tree(e, L)
+    t2, changed = simplify_tree(t, OPS)
+    assert bool(changed) and int(t2.length) == 1
+    assert abs(float(t2.cval[0]) - 2.0) < 1e-6
+
+
+def test_mutations_under_vmap(rng):
+    """All mutations batch cleanly under vmap (the evolution-step usage)."""
+    trees = stack_trees([random_tree(rng, size=7) for _ in range(16)])
+    ks = jax.random.split(jax.random.PRNGKey(7), 16)
+    t2, ok = jax.vmap(lambda k, t: append_random_op(k, t, NFEAT, OPS))(ks, trees)
+    assert ok.shape == (16,)
+    for i in range(16):
+        if bool(ok[i]):
+            assert is_valid_postfix(t2[i])
