@@ -1,0 +1,522 @@
+"""The evolution engine: batched regularized evolution + simulated annealing.
+
+Analogs: next_generation / crossover_generation (reference src/Mutate.jl:25-341),
+reg_evol_cycle (src/RegularizedEvolution.jl:14-159), s_r_cycle +
+optimize_and_simplify_population (src/SingleIteration.jl:17-127).
+
+TPU-first redesign (SURVEY.md §7 decision 3): instead of the reference's one
+sequential steady-state step at a time, each cycle runs
+B = options.n_parallel_tournaments tournaments *in parallel*, mutates/crosses
+the B winners in parallel (vmapped device tree surgery), scores them in one
+batched interpreter call, and replaces the B oldest members. The whole
+s_r_cycle is a single `lax.scan` — one XLA computation per island iteration,
+vmappable over islands and shardable over the mesh.
+
+Algorithmic knobs preserved: tournament geometric rank sampling,
+annealing acceptance exp(-Δscore/(alpha·T)) (src/Mutate.jl:226-245),
+adaptive-parsimony frequency ratio acceptance, per-mutation weight
+adjustment (src/Mutate.jl:51-62), ≤10 constraint retries (src/Mutate.jl:75-177),
+replace-oldest aging, temperature schedule LinRange(1,0)
+(src/SingleIteration.jl:27-32).
+
+The `optimize` mutation (weight 0.0 by default in the reference) is handled
+at population level by constant_opt.py rather than inside the mutation
+switch; in the switch it falls through to do_nothing.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .complexity import compute_complexity
+from .constraints import check_constraints_single
+from .fitness import sample_batch_idx, score_trees
+from .mutate_device import (
+    append_random_op,
+    crossover_trees,
+    delete_random_op,
+    gen_random_tree_fixed_size,
+    insert_random_op,
+    mutate_constant,
+    mutate_operator,
+    simplify_tree,
+)
+from .options import (
+    ADD_NODE,
+    DELETE_NODE,
+    DO_NOTHING,
+    INSERT_NODE,
+    MUTATE_CONSTANT,
+    MUTATE_OPERATOR,
+    N_MUTATIONS,
+    OPTIMIZE,
+    RANDOMIZE,
+    SIMPLIFY,
+    Options,
+)
+from .parsimony import (
+    RunningSearchStatistics,
+    move_window,
+    update_frequencies,
+)
+from .population import (
+    HallOfFame,
+    Population,
+    init_hall_of_fame,
+    tournament_winner,
+    update_hall_of_fame,
+)
+from .trees import TreeBatch
+
+Array = jax.Array
+
+
+class IslandState(NamedTuple):
+    """Everything one island owns. vmap/shard_map over a leading axis of
+    these gives multi-island search."""
+
+    pop: Population
+    stats: RunningSearchStatistics
+    hof: HallOfFame  # island-local best-seen (best_examples_seen analog)
+    key: Array
+    birth_counter: Array  # int32 scalar
+    num_evals: Array  # float32 scalar
+
+
+# ---------------------------------------------------------------------------
+# Mutation of one member (vmapped over the B winners)
+# ---------------------------------------------------------------------------
+
+
+def _adjusted_mutation_logits(
+    tree: TreeBatch, curmaxsize: Array, options: Options
+) -> Array:
+    """Per-member mutation weights with the reference's adjustments
+    (src/Mutate.jl:51-62): no constants -> no mutate_constant; at the size
+    cap -> no add/insert."""
+    w = jnp.asarray(options.mutation_weights.as_tuple(), jnp.float32)
+    idx = jnp.arange(tree.max_len)
+    n_const = jnp.sum((tree.kind == 1) & (idx < tree.length))
+    n_ops = jnp.sum((tree.kind >= 3) & (idx < tree.length))
+    complexity = compute_complexity(tree, options)
+    at_cap = complexity >= curmaxsize
+    sel = jnp.arange(N_MUTATIONS)
+    w = jnp.where((sel == MUTATE_CONSTANT) & (n_const == 0), 0.0, w)
+    w = jnp.where((sel == MUTATE_OPERATOR) & (n_ops == 0), 0.0, w)
+    w = jnp.where((sel == ADD_NODE) & at_cap, 0.0, w)
+    w = jnp.where((sel == INSERT_NODE) & at_cap, 0.0, w)
+    return jnp.where(w > 0, jnp.log(jnp.maximum(w, 1e-30)), -jnp.inf)
+
+
+def _apply_mutation(
+    key: Array,
+    kind: Array,
+    tree: TreeBatch,
+    temperature: Array,
+    curmaxsize: Array,
+    nfeatures: int,
+    options: Options,
+) -> Tuple[TreeBatch, Array]:
+    """One attempt of the sampled mutation kind. Returns (tree', ok) where
+    ok includes the constraint check (reference retry body,
+    src/Mutate.jl:75-177)."""
+    ops = options.operators
+    k1, k2 = jax.random.split(key)
+
+    def b_mutate_constant(k):
+        return mutate_constant(
+            k, tree, temperature, options.perturbation_factor,
+            options.probability_negate_constant,
+        )
+
+    def b_mutate_operator(k):
+        return mutate_operator(k, tree, ops)
+
+    def b_add_node(k):
+        return append_random_op(k, tree, nfeatures, ops)
+
+    def b_insert_node(k):
+        ka, kb = jax.random.split(k)
+        do_prepend = jax.random.bernoulli(ka)
+        t_i, ok_i = insert_random_op(kb, tree, nfeatures, ops, at_root=False)
+        t_p, ok_p = insert_random_op(kb, tree, nfeatures, ops, at_root=True)
+        t = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(do_prepend, b, a), t_i, t_p
+        )
+        return t, jnp.where(do_prepend, ok_p, ok_i)
+
+    def b_delete_node(k):
+        return delete_random_op(k, tree, nfeatures, ops)
+
+    def b_simplify(k):
+        t, _ = simplify_tree(tree, ops)
+        return t, jnp.bool_(True)
+
+    def b_randomize(k):
+        ka, kb = jax.random.split(k)
+        # size ~ U{1..curmaxsize} (reference src/Mutate.jl randomize path)
+        hi = jnp.minimum(jnp.maximum(curmaxsize, 1), tree.max_len) + 1
+        size = jax.random.randint(ka, (), 1, hi)
+        t = gen_random_tree_fixed_size(
+            kb, size, nfeatures, ops, tree.max_len, tree.cval.dtype
+        )
+        return t, jnp.bool_(True)
+
+    def b_nothing(k):
+        return tree, jnp.bool_(True)
+
+    branches = [
+        b_mutate_constant,
+        b_mutate_operator,
+        b_add_node,
+        b_insert_node,
+        b_delete_node,
+        b_simplify,
+        b_randomize,
+        b_nothing,
+        b_nothing,  # OPTIMIZE handled at population level
+    ]
+    new_tree, ok = jax.lax.switch(kind, branches, k1)
+    ok &= check_constraints_single(new_tree, options, curmaxsize)
+    return new_tree, ok
+
+
+_N_RETRIES = 10  # reference src/Mutate.jl:75
+
+
+def _mutate_member(
+    key: Array,
+    tree: TreeBatch,
+    score: Array,
+    temperature: Array,
+    frequencies: Array,
+    curmaxsize: Array,
+    nfeatures: int,
+    options: Options,
+) -> Tuple[TreeBatch, Array]:
+    """Sample a mutation kind and apply it with <=10 constraint retries.
+    Returns (tree', was_mutated). Acceptance happens later (needs score)."""
+    k_kind, k_apply = jax.random.split(key)
+    logits = _adjusted_mutation_logits(tree, curmaxsize, options)
+    kind = jax.random.categorical(k_kind, logits)
+
+    def body(i, carry):
+        result, done, k = carry
+        k, k_try = jax.random.split(k)
+        cand, ok = _apply_mutation(
+            k_try, kind, tree, temperature, curmaxsize, nfeatures, options
+        )
+        take = ok & ~done
+        result = jax.tree_util.tree_map(
+            lambda c, r: jnp.where(take, c, r), cand, result
+        )
+        return result, done | ok, k
+
+    result, success, _ = jax.lax.fori_loop(
+        0, _N_RETRIES, body, (tree, jnp.bool_(False), k_apply)
+    )
+    # on total failure keep the parent (skip_mutation_failures=true behavior,
+    # reference src/Mutate.jl:179-205)
+    was_mutated = success & (kind != DO_NOTHING) & (kind != OPTIMIZE)
+    always_accept = (kind == SIMPLIFY) & success
+    return result, was_mutated, always_accept
+
+
+def _accept_mutation(
+    key: Array,
+    old_tree: TreeBatch,
+    new_tree: TreeBatch,
+    old_score: Array,
+    new_score: Array,
+    temperature: Array,
+    frequencies: Array,
+    options: Options,
+) -> Array:
+    """Annealing x adaptive-parsimony acceptance
+    (reference src/Mutate.jl:207-245). Returns bool accept."""
+    prob = jnp.float32(1.0)
+    if options.annealing:
+        delta = new_score - old_score
+        prob = prob * jnp.exp(
+            -delta / (options.alpha * jnp.maximum(temperature, 1e-6))
+        )
+    if options.use_frequency:
+        S = frequencies.shape[0]
+        c_old = jnp.clip(compute_complexity(old_tree, options) - 1, 0, S - 1)
+        c_new = jnp.clip(compute_complexity(new_tree, options) - 1, 0, S - 1)
+        f_old = jnp.maximum(frequencies[c_old], 1e-6)
+        f_new = jnp.maximum(frequencies[c_new], 1e-6)
+        prob = prob * f_old / f_new
+    accept = jax.random.uniform(key) < prob
+    accept &= jnp.isfinite(new_score)
+    return accept
+
+
+def _crossover_pair(
+    key: Array,
+    a: TreeBatch,
+    b: TreeBatch,
+    curmaxsize: Array,
+    options: Options,
+) -> Tuple[TreeBatch, TreeBatch, Array]:
+    """Crossover with <=10 constraint retries
+    (reference crossover_generation src/Mutate.jl:285-341)."""
+
+    def body(i, carry):
+        ra, rb, done, k = carry
+        k, k_try = jax.random.split(k)
+        ca, cb, ok = crossover_trees(k_try, a, b)
+        ok &= check_constraints_single(ca, options, curmaxsize)
+        ok &= check_constraints_single(cb, options, curmaxsize)
+        take = ok & ~done
+        ra = jax.tree_util.tree_map(lambda c, r: jnp.where(take, c, r), ca, ra)
+        rb = jax.tree_util.tree_map(lambda c, r: jnp.where(take, c, r), cb, rb)
+        return ra, rb, done | ok, k
+
+    ra, rb, success, _ = jax.lax.fori_loop(
+        0, _N_RETRIES, body, (a, b, jnp.bool_(False), key)
+    )
+    return ra, rb, success
+
+
+# ---------------------------------------------------------------------------
+# One batched steady-state cycle
+# ---------------------------------------------------------------------------
+
+
+def reg_evol_cycle(
+    state: IslandState,
+    temperature: Array,
+    curmaxsize: Array,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    options: Options,
+    row_idx: Optional[Array] = None,
+) -> IslandState:
+    """B parallel tournaments -> mutate/crossover -> score -> accept ->
+    replace B oldest (reference src/RegularizedEvolution.jl:14-159,
+    batched)."""
+    B = options.n_parallel_tournaments
+    B += B % 2  # paired slots for crossover
+    nfeatures = X.shape[0]
+    pop, stats = state.pop, state.stats
+
+    key, k_tour, k_mut, k_acc, k_cross, k_coin = jax.random.split(state.key, 6)
+
+    # tournaments
+    tkeys = jax.random.split(k_tour, B)
+    parent_idx = jax.vmap(
+        lambda k: tournament_winner(k, pop, stats.frequencies, options)
+    )(tkeys)
+    parents = pop.trees[parent_idx]
+    parent_scores = pop.scores[parent_idx]
+
+    # mutation path
+    mkeys = jax.random.split(k_mut, B)
+    mut_trees, was_mutated, always_accept = jax.vmap(
+        lambda k, t, s: _mutate_member(
+            k, t, s, temperature, stats.frequencies, curmaxsize, nfeatures,
+            options,
+        )
+    )(mkeys, parents, parent_scores)
+
+    # crossover path on slot pairs (2j, 2j+1)
+    ckeys = jax.random.split(k_cross, B // 2)
+    pa = jax.tree_util.tree_map(lambda x: x[0::2], parents)
+    pb = jax.tree_util.tree_map(lambda x: x[1::2], parents)
+    ca, cb, cross_ok = jax.vmap(
+        lambda k, a, b: _crossover_pair(k, a, b, curmaxsize, options)
+    )(ckeys, pa, pb)
+    cross_trees = jax.tree_util.tree_map(
+        lambda a, b: jnp.stack([a, b], axis=1).reshape((B,) + a.shape[1:]),
+        ca,
+        cb,
+    )
+
+    use_cross_pair = (
+        jax.random.bernoulli(k_coin, options.crossover_probability, (B // 2,))
+        & cross_ok
+    )
+    use_cross = jnp.repeat(use_cross_pair, 2)
+
+    children = jax.tree_util.tree_map(
+        lambda c, m: jnp.where(
+            jnp.reshape(use_cross, use_cross.shape + (1,) * (c.ndim - 1)), c, m
+        ),
+        cross_trees,
+        mut_trees,
+    )
+
+    # one batched scoring call for all B children
+    child_scores, child_losses = score_trees(
+        children, X, y, weights, baseline, options, row_idx
+    )
+
+    # acceptance (mutation slots only; crossover children always enter,
+    # reference src/Mutate.jl:285-341 has no annealing gate for crossover)
+    akeys = jax.random.split(k_acc, B)
+    accept = jax.vmap(
+        lambda k, ot, nt, os, ns: _accept_mutation(
+            k, ot, nt, os, ns, temperature, stats.frequencies, options
+        )
+    )(akeys, parents, children, parent_scores, child_scores)
+    # simplify is value-preserving: always accepted (reference early return,
+    # src/Mutate.jl:107-140)
+    accept = accept | use_cross | (always_accept & ~use_cross)
+    # slots whose child == parent (do_nothing / failed mutation) keep parent
+    accept = jnp.where(was_mutated | use_cross, accept, False)
+
+    final_trees = jax.tree_util.tree_map(
+        lambda c, p: jnp.where(
+            jnp.reshape(accept, accept.shape + (1,) * (c.ndim - 1)), c, p
+        ),
+        children,
+        parents,
+    )
+    final_scores = jnp.where(accept, child_scores, parent_scores)
+    final_losses = jnp.where(accept, child_losses, pop.losses[parent_idx])
+
+    # replace the B oldest members (reference replace-oldest-by-birth,
+    # src/RegularizedEvolution.jl:101,134)
+    oldest = jnp.argsort(pop.birth)[:B]
+    new_pop_trees = jax.tree_util.tree_map(
+        lambda all_t, ch: all_t.at[oldest].set(ch), pop.trees, final_trees
+    )
+    new_birth = pop.birth.at[oldest].set(
+        state.birth_counter + jnp.arange(B, dtype=jnp.int32)
+    )
+    new_pop = Population(
+        trees=new_pop_trees,
+        scores=pop.scores.at[oldest].set(final_scores),
+        losses=pop.losses.at[oldest].set(final_losses),
+        birth=new_birth,
+    )
+
+    # adaptive parsimony statistics fed by the new members
+    # (reference src/RegularizedEvolution.jl:103-132)
+    child_complexity = compute_complexity(final_trees, options)
+    new_stats = update_frequencies(stats, child_complexity)
+
+    # island-local hall of fame (best_examples_seen,
+    # reference src/SingleIteration.jl:47-57)
+    new_hof = update_hall_of_fame(
+        state.hof, final_trees, final_scores, final_losses, options
+    )
+
+    eval_fraction = (
+        options.batch_size / X.shape[1] if options.batching else 1.0
+    )
+    return IslandState(
+        pop=new_pop,
+        stats=new_stats,
+        hof=new_hof,
+        key=key,
+        birth_counter=state.birth_counter + B,
+        num_evals=state.num_evals + B * eval_fraction,
+    )
+
+
+# ---------------------------------------------------------------------------
+# s_r_cycle: the per-iteration hot loop as one lax.scan
+# ---------------------------------------------------------------------------
+
+
+def s_r_cycle(
+    state: IslandState,
+    curmaxsize: Array,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    options: Options,
+    ncycles: Optional[int] = None,
+) -> IslandState:
+    """ncycles batched evolution cycles over the annealing temperature
+    schedule LinRange(1, 0) (reference src/SingleIteration.jl:17-61)."""
+    ncycles = ncycles or options.ncycles_per_iteration
+    if options.annealing and ncycles > 1:
+        temperatures = jnp.linspace(1.0, 0.0, ncycles)
+    else:
+        temperatures = jnp.ones((ncycles,))
+
+    n_rows = X.shape[1]
+
+    def step(carry, temperature):
+        st = carry
+        if options.batching:
+            kb, key = jax.random.split(st.key)
+            st = st._replace(key=key)
+            row_idx = sample_batch_idx(kb, n_rows, options.batch_size)
+        else:
+            row_idx = None
+        st = reg_evol_cycle(
+            st, temperature, curmaxsize, X, y, weights, baseline, options,
+            row_idx,
+        )
+        return st, None
+
+    state, _ = jax.lax.scan(step, state, temperatures)
+    state = state._replace(stats=move_window(state.stats))
+    return state
+
+
+def simplify_population(
+    state: IslandState,
+    curmaxsize: Array,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    options: Options,
+) -> IslandState:
+    """Simplify every member then rescore on the full dataset
+    (the simplify + finalize_scores parts of
+    optimize_and_simplify_population, reference src/SingleIteration.jl:63-127;
+    constant optimization is applied separately by constant_opt.py)."""
+    trees, _ = jax.vmap(lambda t: simplify_tree(t, options.operators))(
+        state.pop.trees
+    )
+    scores, losses = score_trees(trees, X, y, weights, baseline, options)
+    # guard: if a simplified tree somehow scores worse (numerical edge),
+    # keep it anyway — value-preserving by construction.
+    new_pop = state.pop._replace(trees=trees, scores=scores, losses=losses)
+    new_hof = update_hall_of_fame(state.hof, trees, scores, losses, options)
+    eval_fraction = 1.0
+    return state._replace(
+        pop=new_pop,
+        hof=new_hof,
+        num_evals=state.num_evals + state.pop.npop * eval_fraction,
+    )
+
+
+def init_island_state(
+    key: Array,
+    options: Options,
+    nfeatures: int,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    dtype=jnp.float32,
+) -> IslandState:
+    from .population import init_population
+
+    k1, k2 = jax.random.split(key)
+    pop = init_population(
+        k1, options, nfeatures, X, y, weights, baseline, dtype=dtype
+    )
+    from .parsimony import init_search_statistics
+
+    return IslandState(
+        pop=pop,
+        stats=init_search_statistics(options.actual_maxsize),
+        hof=init_hall_of_fame(options, dtype),
+        key=k2,
+        birth_counter=jnp.int32(pop.npop),
+        num_evals=jnp.float32(pop.npop),
+    )

@@ -1,0 +1,215 @@
+"""Population & HallOfFame state + tournament selection.
+
+Analogs: Population/PopMember (reference src/Population.jl:14-76,
+src/PopMember.jl:9-67) and HallOfFame (src/HallOfFame.jl:11-88). State is a
+struct-of-arrays NamedTuple so a whole island (and a whole mesh axis of
+islands) is one pytree of rectangular arrays.
+
+PopMember bookkeeping differences from the reference: `birth` is a
+deterministic per-island counter instead of wall-clock time (reference
+src/Utils.jl:18-30 uses time-of-day; the counter makes replace-oldest exact
+and deterministic under jit), and lineage `ref` ids for the recorder are
+assigned host-side when recording is enabled.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .complexity import compute_complexity
+from .fitness import score_trees
+from .mutate_device import gen_random_tree_fixed_size
+from .options import Options
+from .parsimony import RunningSearchStatistics
+from .trees import TreeBatch
+
+Array = jax.Array
+
+
+class Population(NamedTuple):
+    trees: TreeBatch  # fields (npop, L)
+    scores: Array  # (npop,)
+    losses: Array  # (npop,)
+    birth: Array  # (npop,) int32
+
+    @property
+    def npop(self) -> int:
+        return self.scores.shape[-1]
+
+
+class HallOfFame(NamedTuple):
+    """One slot per complexity 1..actual_maxsize
+    (reference src/HallOfFame.jl:11-45)."""
+
+    trees: TreeBatch  # fields (S, L)
+    scores: Array  # (S,)
+    losses: Array  # (S,)
+    exists: Array  # (S,) bool
+
+
+def init_hall_of_fame(options: Options, dtype=jnp.float32) -> HallOfFame:
+    S = options.actual_maxsize
+    L = options.max_len
+    return HallOfFame(
+        trees=TreeBatch(
+            kind=jnp.zeros((S, L), jnp.int32),
+            op=jnp.zeros((S, L), jnp.int32),
+            feat=jnp.zeros((S, L), jnp.int32),
+            cval=jnp.zeros((S, L), dtype),
+            length=jnp.zeros((S,), jnp.int32),
+        ),
+        scores=jnp.full((S,), jnp.inf, dtype),
+        losses=jnp.full((S,), jnp.inf, dtype),
+        exists=jnp.zeros((S,), jnp.bool_),
+    )
+
+
+def init_population(
+    key: Array,
+    options: Options,
+    nfeatures: int,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    npop: Optional[int] = None,
+    nlength: int = 3,
+    dtype=jnp.float32,
+) -> Population:
+    """Random initial population of small trees
+    (reference src/Population.jl:31-46, npop x gen_random_tree(nlength))."""
+    npop = npop or options.npop
+    keys = jax.random.split(key, npop)
+    trees = jax.vmap(
+        lambda k: gen_random_tree_fixed_size(
+            k, jnp.int32(nlength), nfeatures, options.operators,
+            options.max_len, dtype,
+        )
+    )(keys)
+    scores, losses = score_trees(trees, X, y, weights, baseline, options)
+    return Population(
+        trees=trees,
+        scores=scores,
+        losses=losses,
+        birth=jnp.arange(npop, dtype=jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Tournament selection (reference src/Population.jl:72-132)
+# ---------------------------------------------------------------------------
+
+
+def tournament_winner(
+    key: Array,
+    pop: Population,
+    stats_frequencies: Array,
+    options: Options,
+) -> Array:
+    """One tournament: sample tournament_selection_n members without
+    replacement, reweight scores by adaptive-parsimony frequency
+    (score * exp(scaling * normalized_freq[complexity]), reference
+    src/Population.jl:79-119), then pick the k-th best with the truncated
+    geometric distribution p(1-p)^k (reference sample_tournament
+    :122-132). Returns the population index of the winner."""
+    n = options.tournament_selection_n
+    k1, k2 = jax.random.split(key)
+    idx = jax.random.choice(k1, pop.npop, (n,), replace=False)
+    scores = pop.scores[idx]
+    if options.use_frequency_in_tournament:
+        complexity = compute_complexity(pop.trees[idx], options)
+        tot = jnp.maximum(jnp.sum(stats_frequencies), 1e-9)
+        freq = stats_frequencies[
+            jnp.clip(complexity - 1, 0, stats_frequencies.shape[0] - 1)
+        ] / tot
+        scores = scores * jnp.exp(options.adaptive_parsimony_scaling * freq)
+    order = jnp.argsort(scores)  # ascending: best first
+    p = options.tournament_selection_p
+    ranks = jnp.arange(n)
+    logits = ranks * jnp.log1p(-min(p, 1 - 1e-6)) + jnp.log(p)
+    pick = jax.random.categorical(k2, logits)
+    return idx[order[pick]]
+
+
+def best_sub_pop(pop: Population, topn: int) -> Tuple[TreeBatch, Array, Array]:
+    """Top-n members by score (reference src/Population.jl:151-154).
+    Returns (trees, scores, losses) of shape (topn, ...)."""
+    order = jnp.argsort(pop.scores)[:topn]
+    return pop.trees[order], pop.scores[order], pop.losses[order]
+
+
+# ---------------------------------------------------------------------------
+# Hall of fame updates & Pareto frontier
+# ---------------------------------------------------------------------------
+
+
+def update_hall_of_fame(
+    hof: HallOfFame,
+    trees: TreeBatch,
+    scores: Array,
+    losses: Array,
+    options: Options,
+) -> HallOfFame:
+    """Merge a batch of candidates into the per-complexity best table
+    (reference merge at src/SymbolicRegression.jl:722-744). For each
+    complexity slot, keep the lowest-loss candidate if it beats the
+    incumbent."""
+    S = options.actual_maxsize
+    complexity = compute_complexity(trees, options)  # (B,)
+    slot = jnp.clip(complexity - 1, 0, S - 1)
+    in_range = (complexity >= 1) & (complexity <= S) & jnp.isfinite(losses)
+
+    # per-slot best candidate among the batch
+    masked_loss = jnp.where(in_range[None, :] & (slot[None, :] == jnp.arange(S)[:, None]),
+                            losses[None, :], jnp.inf)  # (S, B)
+    best_idx = jnp.argmin(masked_loss, axis=1)  # (S,)
+    best_loss = jnp.take_along_axis(masked_loss, best_idx[:, None], axis=1)[:, 0]
+    better = best_loss < hof.losses
+
+    cand_trees = jax.tree_util.tree_map(lambda x: x[best_idx], trees)
+    new_trees = jax.tree_util.tree_map(
+        lambda c, h: jnp.where(
+            jnp.reshape(better, better.shape + (1,) * (c.ndim - 1)), c, h
+        ),
+        cand_trees,
+        hof.trees,
+    )
+    return HallOfFame(
+        trees=new_trees,
+        scores=jnp.where(better, scores[best_idx], hof.scores),
+        losses=jnp.where(better, best_loss, hof.losses),
+        exists=hof.exists | better,
+    )
+
+
+def merge_halls_of_fame(a: HallOfFame, b: HallOfFame) -> HallOfFame:
+    """Elementwise per-slot min-loss merge (used for cross-island reduce)."""
+    better = jnp.where(b.exists & ~a.exists, True, b.losses < a.losses)
+    new_trees = jax.tree_util.tree_map(
+        lambda x, y: jnp.where(
+            jnp.reshape(better, better.shape + (1,) * (x.ndim - 1)), y, x
+        ),
+        a.trees,
+        b.trees,
+    )
+    return HallOfFame(
+        trees=new_trees,
+        scores=jnp.where(better, b.scores, a.scores),
+        losses=jnp.where(better, b.losses, a.losses),
+        exists=a.exists | b.exists,
+    )
+
+
+def calculate_pareto_frontier(hof: HallOfFame) -> Array:
+    """Boolean mask of hall-of-fame slots on the Pareto frontier: slots whose
+    loss is strictly better than every smaller-complexity slot
+    (reference src/HallOfFame.jl:58-88)."""
+    S = hof.losses.shape[0]
+    best_so_far = jax.lax.associative_scan(
+        jnp.minimum, jnp.where(hof.exists, hof.losses, jnp.inf)
+    )
+    prev_best = jnp.concatenate([jnp.full((1,), jnp.inf), best_so_far[:-1]])
+    return hof.exists & (jnp.where(hof.exists, hof.losses, jnp.inf) < prev_best)

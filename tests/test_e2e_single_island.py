@@ -1,0 +1,60 @@
+"""End-to-end: a single island recovers the reference's precompile workload
+target 2*cos(x4) + x1^2 - 2 with loss < 1e-2
+(parity: reference test/test_mixed.jl:129-141 quality bar, BASELINE.md)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.models.evolve import (
+    init_island_state,
+    s_r_cycle,
+    simplify_population,
+)
+from symbolicregression_jl_tpu.models.options import make_options
+from symbolicregression_jl_tpu.models.trees import is_valid_postfix, tree_to_string
+
+
+@pytest.mark.slow
+def test_recovers_synthetic_target(rng):
+    X = (rng.standard_normal((5, 100)) * 2).astype(np.float32)
+    y = 2 * np.cos(X[4]) + X[1] ** 2 - 2
+    opt = make_options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp"],
+        npop=66,
+        maxsize=18,
+        ncycles_per_iteration=300,
+    )
+    Xj, yj = jnp.asarray(X), jnp.asarray(y)
+    baseline = float(np.mean((y - y.mean()) ** 2))
+    state = init_island_state(
+        jax.random.PRNGKey(1), opt, 5, Xj, yj, None, baseline
+    )
+    cm = jnp.int32(opt.maxsize)
+    step = jax.jit(
+        lambda st: simplify_population(
+            s_r_cycle(st, cm, Xj, yj, None, baseline, opt),
+            cm, Xj, yj, None, baseline, opt,
+        )
+    )
+    best = np.inf
+    for it in range(12):
+        state = step(state)
+        hl, he = np.asarray(state.hof.losses), np.asarray(state.hof.exists)
+        best = hl[he].min()
+        if best < 1e-2:
+            break
+    assert best < 1e-2, f"failed to recover target, best loss {best}"
+
+    # all hall-of-fame trees decode as valid postfix programs
+    for i in np.where(he)[0]:
+        t = jax.tree_util.tree_map(lambda x: x[i], state.hof.trees)
+        assert is_valid_postfix(t)
+        tree_to_string(t, opt.operators)  # printable
+
+    # population invariants
+    assert int(state.pop.npop) == 66
+    assert bool(np.isfinite(np.asarray(state.pop.scores)).any())
+    assert float(state.num_evals) > 0
