@@ -1,0 +1,200 @@
+"""On-device batched constant optimization.
+
+Analog of the reference's optimize_constants
+(src/ConstantOptimization.jl:22-65): members are selected with probability
+optimizer_probability, their constants fitted by BFGS with backtracking line
+search and `optimizer_nrestarts` random restarts, and results written back
+only when improved.
+
+TPU-first design (SURVEY.md §7 build step 5): instead of Optim.jl's host
+loop per member, every (member x restart) is an independent BFGS instance
+run in lockstep under vmap — gradients come from jax.grad through the tree
+interpreter, the line search evaluates all K candidate steps in one batched
+call, and per-instance convergence is handled by masking. One XLA call
+optimizes the whole population.
+
+The optimization variable is the full cval vector (L,) with gradients masked
+to constant slots — non-constant slots stay exactly zero-gradient so H stays
+block-structured automatically.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.interpreter import _eval_single
+from ..ops.losses import aggregate_loss
+from .fitness import loss_to_score
+from .complexity import compute_complexity
+from .options import Options
+from .population import Population
+from .trees import CONST, TreeBatch
+
+Array = jax.Array
+
+_LS_STEPS = 8  # candidate step sizes per line search: 2^0 .. 2^-7
+
+
+def _member_loss_fn(
+    tree: TreeBatch,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    options: Options,
+):
+    """loss(cval) for one member over the full dataset
+    (reference opt objective src/ConstantOptimization.jl:11-19)."""
+    loss_fn = options.elementwise_loss
+
+    def f(cval: Array) -> Array:
+        y_pred, ok = _eval_single(
+            tree.kind, tree.op, tree.feat, cval, tree.length, X,
+            options.operators,
+        )
+        elem = loss_fn(y_pred, y)
+        loss = aggregate_loss(elem, weights)
+        return jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
+
+    return f
+
+
+def _bfgs_single(
+    loss_f, x0: Array, cmask: Array, n_iters: int
+) -> Tuple[Array, Array]:
+    """Fixed-iteration BFGS with parallel backtracking line search.
+
+    Runs on one (member, restart) instance; vmapped by the caller. Returns
+    (x_final, loss_final). Gradient is masked to constant slots."""
+    L = x0.shape[0]
+    grad_f = jax.grad(loss_f)
+
+    def masked_grad(x):
+        g = grad_f(x) * cmask
+        return jnp.where(jnp.isfinite(g), g, 0.0)
+
+    def body(i, carry):
+        x, f, g, H = carry
+        d = -(H @ g)
+        # safeguard: if d is not a descent direction, fall back to -g
+        descent = jnp.dot(d, g) < 0
+        d = jnp.where(descent, d, -g)
+        ts = 2.0 ** -jnp.arange(_LS_STEPS, dtype=x.dtype)
+        cand = x[None, :] + ts[:, None] * d[None, :]
+        fs = jax.vmap(loss_f)(cand)
+        k = jnp.argmin(fs)
+        f_new = fs[k]
+        improved = f_new < f
+        t = ts[k]
+        x_new = jnp.where(improved, x + t * d, x)
+        g_new = jnp.where(improved, masked_grad(x_new), g)
+        s = x_new - x
+        yv = g_new - g
+        sy = jnp.dot(s, yv)
+        rho = jnp.where(jnp.abs(sy) > 1e-10, 1.0 / sy, 0.0)
+        I = jnp.eye(L, dtype=x.dtype)
+        V = I - rho * jnp.outer(s, yv)
+        H_new = V @ H @ V.T + rho * jnp.outer(s, s)
+        ok_H = improved & (rho > 0) & jnp.all(jnp.isfinite(H_new))
+        H = jnp.where(ok_H, H_new, H)
+        f = jnp.where(improved, f_new, f)
+        return x_new, f, g_new, H
+
+    f0 = loss_f(x0)
+    g0 = masked_grad(x0)
+    H0 = jnp.eye(L, dtype=x0.dtype)
+    x, f, _, _ = jax.lax.fori_loop(0, n_iters, body, (x0, f0, g0, H0))
+    return x, f
+
+
+def optimize_constants_population(
+    key: Array,
+    pop: Population,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    options: Options,
+) -> Tuple[Population, Array]:
+    """Select members w.p. optimizer_probability, fit their constants, write
+    back where improved (reference src/SingleIteration.jl:75-79 +
+    src/ConstantOptimization.jl:22-65). Returns (population', n_extra_evals).
+    """
+    npop = pop.npop
+    L = pop.trees.max_len
+    n_restarts = options.optimizer_nrestarts
+    n_starts = 1 + n_restarts
+    k_sel, k_perturb = jax.random.split(key)
+
+    # Fixed-size random subset K ~= npop * p (static shape; the reference's
+    # per-member Bernoulli draw has the same mean). Members without
+    # constants are deprioritized and later masked out.
+    K = max(1, int(round(npop * options.optimizer_probability)))
+    idx = jnp.arange(L)
+    has_consts = jnp.sum(
+        (pop.trees.kind == CONST) & (idx < pop.trees.length[:, None]), axis=-1
+    ) > 0
+    priority = jax.random.uniform(k_sel, (npop,)) + has_consts.astype(jnp.float32)
+    _, sel_idx = jax.lax.top_k(priority, K)  # (K,)
+    sub_trees = jax.tree_util.tree_map(lambda x: x[sel_idx], pop.trees)
+    sub_losses = pop.losses[sel_idx]
+    eligible = has_consts[sel_idx]
+
+    # starts: x0 plus perturbed restarts x0 * (1 + 0.5*randn)
+    # (reference src/ConstantOptimization.jl:46-54)
+    eps = jax.random.normal(k_perturb, (n_starts, K, L), pop.trees.cval.dtype)
+    scale = jnp.concatenate(
+        [
+            jnp.zeros((1, K, L), pop.trees.cval.dtype),
+            0.5 * jnp.ones((n_restarts, K, L), pop.trees.cval.dtype),
+        ]
+    )
+    starts = sub_trees.cval[None] * (1.0 + scale * eps)
+
+    cmask = (
+        (sub_trees.kind == CONST) & (idx < sub_trees.length[:, None])
+    ).astype(pop.trees.cval.dtype)
+
+    def run_one(tree, x0, cm):
+        f = _member_loss_fn(tree, X, y, weights, options)
+        return _bfgs_single(f, x0, cm, options.optimizer_iterations)
+
+    # vmap over restarts then members
+    run_members = jax.vmap(run_one)
+    xs, fs = jax.vmap(lambda s: run_members(sub_trees, s, cmask))(starts)
+    # best restart per member
+    best_r = jnp.argmin(fs, axis=0)  # (K,)
+    x_best = jnp.take_along_axis(xs, best_r[None, :, None], axis=0)[0]
+    f_best = jnp.take_along_axis(fs, best_r[None, :], axis=0)[0]
+
+    improved = eligible & (f_best < sub_losses) & jnp.isfinite(f_best)
+    new_sub_cval = jnp.where(improved[:, None], x_best, sub_trees.cval)
+    sub_complexity = compute_complexity(
+        sub_trees._replace(cval=new_sub_cval), options
+    )
+    new_sub_losses = jnp.where(improved, f_best, sub_losses)
+    new_sub_scores = jnp.where(
+        improved,
+        loss_to_score(new_sub_losses, baseline, sub_complexity, options),
+        pop.scores[sel_idx],
+    )
+
+    new_cval = pop.trees.cval.at[sel_idx].set(new_sub_cval)
+    new_trees = pop.trees._replace(cval=new_cval)
+    n_evals = (
+        jnp.sum(eligible.astype(jnp.float32))
+        * n_starts
+        * options.optimizer_iterations
+        * (_LS_STEPS + 1)
+    )
+    return (
+        Population(
+            trees=new_trees,
+            scores=pop.scores.at[sel_idx].set(new_sub_scores),
+            losses=pop.losses.at[sel_idx].set(new_sub_losses),
+            birth=pop.birth,
+        ),
+        n_evals,
+    )
