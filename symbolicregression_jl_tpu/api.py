@@ -1,24 +1,336 @@
-"""Public search API — `equation_search` (analog of the reference's
-`EquationSearch`, src/SymbolicRegression.jl:283-391).
+"""Public search API — the analog of the reference's `EquationSearch`
+(src/SymbolicRegression.jl:283-391 dispatchers + :393-940 `_EquationSearch`).
 
-Placeholder while the evolution layers land; filled in by models/evolve.py +
-parallel/ in subsequent milestones.
+Architecture (SURVEY.md §7): where the reference's head node spawns one task
+per (output, population) and merges results through channels, here all
+islands advance together inside ONE jitted iteration function:
+
+    s_r_cycle (lax.scan of batched evolution cycles)
+    -> simplify_population
+    -> optimize_constants_population      (vmapped BFGS)
+    -> merge_halls_of_fame across islands (cross-island reduction)
+    -> migrate                            (all-gather topn pool + masked replace)
+
+vmapped over the islands axis and sharded over the device mesh. The host
+loop only orchestrates: warm-up curriculum, early stopping, checkpoint CSV,
+progress printing, recorder — all off the hot path.
+
+Multi-output (y matrix) runs one island group per output row, like the
+reference's per-output populations (src/SymbolicRegression.jl:308-315).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Optional
+import time
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .models.constant_opt import optimize_constants_population
+from .models.dataset import Dataset, make_dataset, update_baseline_loss
+from .models.evolve import (
+    IslandState,
+    init_island_state,
+    s_r_cycle,
+    simplify_population,
+)
+from .models.options import Options, make_options
+from .models.population import HallOfFame, update_hall_of_fame
+from .models.trees import TreeBatch
+from .ops.interpreter import eval_tree
+from .parallel.distributed import is_primary_host
+from .parallel.mesh import make_mesh, shard_dataset, shard_island_states
+from .parallel.migration import merge_hofs_across_islands, migrate
+from .utils.output import Candidate, hof_to_candidates, pareto_table, save_hof_csv
+from .utils.preflight import preflight_checks
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class SearchState:
+    """Resumable state (analog of StateType,
+    reference src/SearchUtils.jl:270-273)."""
+
+    island_states: IslandState  # leading (I,)
+    global_hof: HallOfFame
+    iteration: int = 0
 
 
 @dataclasses.dataclass
 class EquationSearchResult:
-    hall_of_fame: Any = None
-    state: Any = None
+    """Hall of fame + Pareto frontier per output."""
+
+    candidates: List[List[Candidate]]  # [output][rank]
+    options: Options
+    variable_names: Optional[Sequence[str]]
+    state: Optional[List[SearchState]] = None
+    num_evals: float = 0.0
+    search_time_s: float = 0.0
+
+    @property
+    def multi_output(self) -> bool:
+        return len(self.candidates) > 1
+
+    def frontier(self, output: int = 0) -> List[Candidate]:
+        return self.candidates[output]
+
+    def best(self, output: int = 0) -> Candidate:
+        """Highest-score frontier member (reference picks best trade-off via
+        the score column; we return the min-loss among top-score ties)."""
+        front = self.candidates[output]
+        if not front:
+            raise ValueError("Search produced no valid equations")
+        return min(front, key=lambda c: c.loss)
+
+    def predict(
+        self, X, output: int = 0, complexity: Optional[int] = None
+    ):
+        cands = self.candidates[output]
+        if complexity is None:
+            cand = self.best(output)
+        else:
+            matches = [c for c in cands if c.complexity == complexity]
+            if not matches:
+                raise ValueError(f"No frontier member at complexity {complexity}")
+            cand = matches[0]
+        X = jnp.asarray(X, jnp.float32)
+        tree = jax.tree_util.tree_map(jnp.asarray, cand.tree)
+        y, ok = eval_tree(tree, X, self.options.operators)
+        return np.asarray(y)
+
+    def __repr__(self):
+        parts = []
+        for j, cands in enumerate(self.candidates):
+            title = "Hall of Fame" + (f" (output {j})" if self.multi_output else "")
+            parts.append(pareto_table(cands, title))
+        return "\n".join(parts)
 
 
-def equation_search(X, y, **kwargs):  # pragma: no cover - placeholder
-    raise NotImplementedError(
-        "equation_search lands with the evolution milestone; "
-        "use ops.interpreter.eval_trees / models.* directly for now"
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _make_iteration_fn(options: Options, has_weights: bool):
+    """One jitted function per Options; X/y/weights/baseline are traced
+    arguments so multi-output searches (and repeated equation_search calls
+    with equal Options) reuse the compilation."""
+
+    def one_iteration(
+        states: IslandState,
+        key: Array,
+        curmaxsize: Array,
+        X: Array,
+        y: Array,
+        weights,
+        baseline: Array,
+    ):
+        k_mig, k_opt = jax.random.split(key)
+        states = jax.vmap(
+            lambda st: s_r_cycle(
+                st, curmaxsize, X, y, weights, baseline, options
+            )
+        )(states)
+        states = jax.vmap(
+            lambda st: simplify_population(
+                st, curmaxsize, X, y, weights, baseline, options
+            )
+        )(states)
+        if options.should_optimize_constants and options.optimizer_probability > 0:
+            I = states.birth_counter.shape[0]
+            okeys = jax.random.split(k_opt, I)
+
+            def opt_island(k, st: IslandState) -> IslandState:
+                pop2, n_evals = optimize_constants_population(
+                    k, st.pop, X, y, weights, baseline, options
+                )
+                hof2 = update_hall_of_fame(
+                    st.hof, pop2.trees, pop2.scores, pop2.losses, options
+                )
+                return st._replace(
+                    pop=pop2, hof=hof2, num_evals=st.num_evals + n_evals
+                )
+
+            states = jax.vmap(opt_island)(okeys, states)
+        ghof = merge_hofs_across_islands(states.hof)
+        states = migrate(k_mig, states, ghof, options)
+        return states, ghof
+
+    if has_weights:
+        return jax.jit(one_iteration)
+    return jax.jit(
+        lambda states, key, cm, X, y, baseline: one_iteration(
+            states, key, cm, X, y, None, baseline
+        )
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _make_init_fn(options: Options, nfeatures: int, has_weights: bool):
+    def init(keys, X, y, weights, baseline):
+        return jax.vmap(
+            lambda k: init_island_state(
+                k, options, nfeatures, X, y, weights, baseline
+            )
+        )(keys)
+
+    if has_weights:
+        return jax.jit(init)
+    return jax.jit(
+        lambda keys, X, y, baseline: init(keys, X, y, None, baseline)
+    )
+
+
+def _curmaxsize(
+    options: Options, iteration: int, niterations: int
+) -> int:
+    """Maxsize warm-up curriculum (reference
+    src/SymbolicRegression.jl:838-850): with warmup_maxsize_by=w > 0, the
+    size cap ramps 3 -> maxsize over the first w fraction of iterations."""
+    if options.warmup_maxsize_by <= 0:
+        return options.maxsize
+    frac = (iteration / max(niterations * options.warmup_maxsize_by, 1e-9))
+    cur = 3 + int((options.maxsize - 3) * min(frac, 1.0))
+    return min(cur, options.maxsize)
+
+
+def equation_search(
+    X,
+    y,
+    *,
+    weights=None,
+    variable_names: Optional[Sequence[str]] = None,
+    options: Optional[Options] = None,
+    niterations: int = 10,
+    saved_state: Optional[List[SearchState]] = None,
+    return_state: bool = False,
+    runtests: bool = True,
+    on_iteration: Optional[Callable] = None,
+    **option_kwargs,
+) -> EquationSearchResult:
+    """Search for symbolic expressions f(X) ~= y.
+
+    X: (nfeatures, n); y: (n,) or (nout, n) for multi-output; weights
+    optional (n,). Extra kwargs construct Options (e.g.
+    binary_operators=..., npop=..., niterations is a search kwarg like the
+    reference's). Returns the per-complexity hall of fame; with
+    return_state=True the result carries resumable state (the analog of the
+    reference's saved_state round-trip)."""
+    if options is None:
+        options = make_options(**option_kwargs)
+    elif option_kwargs:
+        raise ValueError("Pass either options= or option kwargs, not both")
+
+    X = np.asarray(X, np.float32)
+    y = np.asarray(y, np.float32)
+    if X.ndim != 2:
+        raise ValueError("X must be (nfeatures, n)")
+    multi = y.ndim == 2
+    ys = y if multi else y[None, :]
+    if ys.shape[1] != X.shape[1]:
+        raise ValueError(
+            f"y rows {ys.shape[1]} must match X columns {X.shape[1]}"
+        )
+    nfeatures = X.shape[0]
+
+    if runtests:
+        preflight_checks(options, X, ys, weights)
+
+    I = options.npopulations
+    mesh = make_mesh(options, I)
+    t_start = time.time()
+    early_stop = options.early_stop_fn()
+    iteration_fn = _make_iteration_fn(options, weights is not None)
+
+    results: List[List[Candidate]] = []
+    out_states: List[SearchState] = []
+    total_evals = 0.0
+
+    for j in range(ys.shape[0]):
+        ds = make_dataset(X, ys[j], weights, variable_names)
+        ds = update_baseline_loss(ds, options.elementwise_loss)
+        Xj, yj, wj = shard_dataset(ds.X, ds.y, ds.weights, mesh, options)
+
+        master_key = jax.random.PRNGKey(options.seed + 7919 * j)
+        if saved_state is not None:
+            state = saved_state[j]
+            states, ghof = state.island_states, state.global_hof
+            start_iter = state.iteration
+        else:
+            k_init, master_key = jax.random.split(master_key)
+            init_keys = jax.random.split(k_init, I)
+            init_fn = _make_init_fn(options, nfeatures, wj is not None)
+            bl = jnp.float32(ds.baseline_loss)
+            if wj is not None:
+                states = init_fn(init_keys, Xj, yj, wj, bl)
+            else:
+                states = init_fn(init_keys, Xj, yj, bl)
+            ghof = merge_hofs_across_islands(states.hof)
+            start_iter = 0
+        states = shard_island_states(states, mesh, options)
+
+        it = start_iter
+        for step in range(niterations):
+            it = start_iter + step
+            cm = jnp.int32(_curmaxsize(options, it, max(niterations, 1)))
+            master_key, k_it = jax.random.split(master_key)
+            baseline = jnp.float32(ds.baseline_loss)
+            if wj is not None:
+                states, ghof = iteration_fn(
+                    states, k_it, cm, Xj, yj, wj, baseline
+                )
+            else:
+                states, ghof = iteration_fn(states, k_it, cm, Xj, yj, baseline)
+
+            # ---- host-side orchestration (off the hot path) ----
+            cands = hof_to_candidates(ghof, options, variable_names)
+            if options.output_file and is_primary_host():
+                path = options.output_file
+                if multi:
+                    base, dot, ext = path.partition(".")
+                    path = f"{base}.out{j}{dot}{ext}" if dot else f"{path}.out{j}"
+                save_hof_csv(cands, path)
+            if options.verbosity > 0 and is_primary_host():
+                best_loss = min((c.loss for c in cands), default=float("inf"))
+                evals = float(jnp.sum(states.num_evals))
+                print(
+                    f"[output {j}] iter {it + 1}: best_loss={best_loss:.6g} "
+                    f"evals={evals:.3g} elapsed={time.time() - t_start:.1f}s"
+                )
+                if options.progress:
+                    print(pareto_table(cands))
+            if on_iteration is not None:
+                on_iteration(j, it, cands)
+
+            # early stopping (reference src/SearchUtils.jl:109-141)
+            if early_stop is not None and any(
+                early_stop(c.loss, c.complexity) for c in cands
+            ):
+                break
+            if (
+                options.timeout_in_seconds is not None
+                and time.time() - t_start > options.timeout_in_seconds
+            ):
+                break
+            if options.max_evals is not None:
+                evals = float(jnp.sum(states.num_evals))
+                if evals > options.max_evals:
+                    break
+
+        total_evals += float(jnp.sum(states.num_evals))
+        results.append(hof_to_candidates(ghof, options, variable_names))
+        out_states.append(
+            SearchState(island_states=states, global_hof=ghof, iteration=it + 1)
+        )
+
+    return EquationSearchResult(
+        candidates=results,
+        options=options,
+        variable_names=variable_names,
+        state=out_states if return_state else None,
+        num_evals=total_evals,
+        search_time_s=time.time() - t_start,
     )

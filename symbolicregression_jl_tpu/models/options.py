@@ -103,7 +103,7 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, eq=False)
 class Options:
     # --- operators ---
     binary_operators: Tuple[str, ...] = ("+", "-", "*", "/")
@@ -255,18 +255,38 @@ class Options:
         thresh = float(cond)
         return lambda loss, complexity: loss < thresh
 
-    def __hash__(self):
-        return hash((
+    def _graph_key(self):
+        """Fields that affect the compiled search graph. Hash/eq use only
+        these so jit-compilation caches hit across Options that differ only
+        in orchestration knobs (verbosity, output_file, stopping...)."""
+        return (
             self.binary_operators, self.unary_operators, self.npopulations,
             self.npop, self.ncycles_per_iteration, self.maxsize, self.max_len,
-            self.parsimony, self.alpha, self.tournament_selection_n,
-            self.tournament_selection_p, self.batching, self.batch_size,
+            self.maxdepth, self.parsimony, self.alpha,
+            self.tournament_selection_n, self.tournament_selection_p,
+            self.topn, self.batching, self.batch_size,
             self.n_parallel_tournaments, self.eval_backend, self.precision,
             self.constraints, self.nested_constraints,
-            self.complexity_of_operators, self.mutation_weights.as_tuple(),
-            self.crossover_probability, self.annealing, self.use_frequency,
-            self.use_frequency_in_tournament, str(self.loss) if not callable(self.loss) else id(self.loss),
-        ))
+            self.complexity_of_operators, self.complexity_of_constants,
+            self.complexity_of_variables, self.mutation_weights.as_tuple(),
+            self.crossover_probability, self.perturbation_factor,
+            self.probability_negate_constant, self.annealing,
+            self.use_frequency, self.use_frequency_in_tournament,
+            self.adaptive_parsimony_scaling, self.migration,
+            self.hof_migration, self.fraction_replaced,
+            self.fraction_replaced_hof, self.should_optimize_constants,
+            self.optimizer_probability, self.optimizer_nrestarts,
+            self.optimizer_iterations,
+            str(self.loss) if not callable(self.loss) else id(self.loss),
+        )
+
+    def __hash__(self):
+        return hash(self._graph_key())
+
+    def __eq__(self, other):
+        if not isinstance(other, Options):
+            return NotImplemented
+        return self._graph_key() == other._graph_key()
 
 
 def make_options(**kwargs) -> Options:

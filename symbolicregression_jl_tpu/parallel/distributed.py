@@ -1,0 +1,53 @@
+"""Multi-host runtime initialization.
+
+The reference reaches multiple nodes through Distributed.jl `addprocs` with
+pluggable cluster managers (src/SymbolicRegression.jl:258-265,500-528,
+e.g. addprocs_slurm). The JAX-native equivalent is
+`jax.distributed.initialize`: every host starts the same SPMD program, the
+global mesh spans all hosts' devices, collectives ride ICI within a pod and
+DCN across pods. No code or closures are shipped (the program is identical
+on every host), which subsumes the reference's move_functions_to_workers
+machinery (src/Configure.jl:86-189).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+) -> bool:
+    """Initialize the JAX distributed runtime if configured.
+
+    Arguments default from the standard env vars / cluster auto-detection
+    (SLURM, GKE, ...). Returns True if multi-process mode is active.
+    Safe to call on a single host: falls back to no-op."""
+    already = getattr(jax.distributed, "is_initialized", None)
+    if callable(already) and jax.distributed.is_initialized():
+        return jax.process_count() > 1
+    if (
+        coordinator_address is None
+        and "JAX_COORDINATOR_ADDRESS" not in os.environ
+        and num_processes is None
+        and "SLURM_NTASKS" not in os.environ
+    ):
+        return False  # single-host
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    return jax.process_count() > 1
+
+
+def is_primary_host() -> bool:
+    """Only the primary host does printing/checkpoint IO."""
+    return jax.process_index() == 0

@@ -1,0 +1,85 @@
+"""Device mesh construction and island-state sharding.
+
+Replaces the reference's Distributed.jl head/worker runtime (§2.3 of
+SURVEY.md: @spawnat/RemoteChannel/addprocs, src/SymbolicRegression.jl:500-528)
+with SPMD over a `jax.sharding.Mesh`:
+
+* axis `islands` — population parallelism (the island model): island state
+  arrays carry a leading I dim sharded over this axis;
+* axis `rows` — dataset-row parallelism (the analog of the reference's
+  batching advice for big datasets, src/Configure.jl:63-70): X/y shard their
+  row dim; loss reductions become cross-axis psums inserted by XLA.
+
+Multi-host: `jax.distributed.initialize()` + the same mesh spanning all
+processes' devices (DCN between hosts, ICI within) — see
+parallel/distributed.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.options import Options
+
+
+def make_mesh(
+    options: Options,
+    n_islands: int,
+    devices=None,
+    row_shards: int = 1,
+) -> Optional[Mesh]:
+    """Build a (islands, rows) mesh from available devices.
+
+    Uses the largest device count d <= len(devices) such that d divides
+    n_islands * row_shards layouts cleanly; returns None for a single
+    device (plain jit, no sharding needed)."""
+    devices = devices if devices is not None else jax.devices()
+    n_dev = len(devices)
+    if n_dev <= 1:
+        return None
+    island_shards = n_dev // row_shards
+    while island_shards > 1 and n_islands % island_shards != 0:
+        island_shards -= 1
+    use = island_shards * row_shards
+    dev_array = np.array(devices[:use]).reshape(island_shards, row_shards)
+    return Mesh(dev_array, (options.island_axis, options.row_axis))
+
+
+def island_sharding(mesh: Optional[Mesh], options: Options):
+    """NamedSharding putting the leading islands dim on the islands axis
+    (None => fully replicated single-device)."""
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P(options.island_axis))
+
+
+def data_sharding(mesh: Optional[Mesh], options: Options, rows_dim: int = 1):
+    """Shard dataset rows over the rows axis (features replicated)."""
+    if mesh is None:
+        return None
+    spec = [None, None]
+    spec[rows_dim] = options.row_axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_island_states(states, mesh: Optional[Mesh], options: Options):
+    if mesh is None:
+        return states
+    sh = island_sharding(mesh, options)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), states)
+
+
+def shard_dataset(X, y, weights, mesh: Optional[Mesh], options: Options):
+    if mesh is None:
+        return X, y, weights
+    xsh = data_sharding(mesh, options, rows_dim=1)
+    vsh = NamedSharding(mesh, P(options.row_axis))
+    X = jax.device_put(X, xsh)
+    y = jax.device_put(y, vsh)
+    if weights is not None:
+        weights = jax.device_put(weights, vsh)
+    return X, y, weights

@@ -1,0 +1,147 @@
+"""Inter-island migration as collective-friendly array ops.
+
+The reference migrates through the head node: it pools topn members of every
+island (`bestSubPops`, src/SymbolicRegression.jl:709-779) and replaces
+fraction_replaced of each returning island with pool samples plus
+fraction_replaced_hof with hall-of-fame members (src/Migration.jl:15-35).
+
+Here migration is SPMD (SURVEY.md §2.3 "TPU-native equivalent"): all arrays
+carry a leading islands axis I; building the pool is a reshape across that
+axis, which under a sharded `jit` lowers to an all-gather over the ICI mesh —
+no head node, no channels. Each island then does masked scatter-replace
+locally.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.evolve import IslandState
+from ..models.options import Options
+from ..models.population import (
+    HallOfFame,
+    Population,
+    calculate_pareto_frontier,
+)
+from ..models.trees import TreeBatch
+
+Array = jax.Array
+
+
+def _topn_pool(states: IslandState, topn: int):
+    """(I, topn) best members of every island -> flattened pool (I*topn,)."""
+
+    def one(pop: Population):
+        order = jnp.argsort(pop.scores)[:topn]
+        return (
+            jax.tree_util.tree_map(lambda x: x[order], pop.trees),
+            pop.scores[order],
+            pop.losses[order],
+        )
+
+    trees, scores, losses = jax.vmap(one)(states.pop)
+    flat = lambda x: x.reshape((-1,) + x.shape[2:])
+    return (
+        jax.tree_util.tree_map(flat, trees),
+        scores.reshape(-1),
+        losses.reshape(-1),
+    )
+
+
+def migrate(
+    key: Array,
+    states: IslandState,
+    global_hof: HallOfFame,
+    options: Options,
+) -> IslandState:
+    """Replace random slots of every island with pool / hall-of-fame members
+    (reference src/Migration.jl:15-35; fractions
+    fraction_replaced=3.6e-4, fraction_replaced_hof=0.035 per member)."""
+    if not options.migration:
+        return states
+    I = states.pop.scores.shape[0]
+    npop = states.pop.scores.shape[1]
+    topn = min(options.topn, npop)
+
+    pool_trees, pool_scores, pool_losses = _topn_pool(states, topn)
+    pool_size = I * topn
+
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    # pool migration
+    replace_pool = jax.random.bernoulli(
+        k1, options.fraction_replaced, (I, npop)
+    )
+    choice_pool = jax.random.randint(k2, (I, npop), 0, pool_size)
+
+    # hall-of-fame migration: sample only from existing Pareto-front slots
+    # (reference hofMigration uses the dominating curve,
+    # src/SymbolicRegression.jl:770-779)
+    front = calculate_pareto_frontier(global_hof)
+    any_front = jnp.any(front)
+    logits = jnp.where(front, 0.0, -1e9)
+    choice_hof = jax.random.categorical(
+        k3, logits[None, :], shape=(I, npop)
+    )
+    replace_hof = (
+        jax.random.bernoulli(k4, options.fraction_replaced_hof, (I, npop))
+        & any_front
+        & options.hof_migration
+    )
+
+    def blend(member_field, pool_field, hof_field):
+        pool_pick = pool_field[choice_pool]  # (I, npop, ...)
+        hof_pick = hof_field[choice_hof]
+        extra = (1,) * (member_field.ndim - 2)
+        rp = replace_pool.reshape(replace_pool.shape + extra)
+        rh = replace_hof.reshape(replace_hof.shape + extra)
+        out = jnp.where(rp, pool_pick, member_field)
+        return jnp.where(rh, hof_pick, out)
+
+    new_trees = jax.tree_util.tree_map(
+        blend, states.pop.trees, pool_trees, global_hof.trees
+    )
+    new_scores = blend(states.pop.scores, pool_scores, global_hof.scores)
+    new_losses = blend(states.pop.losses, pool_losses, global_hof.losses)
+
+    # migrated members get fresh birth (reference src/Migration.jl:28-33)
+    migrated = replace_pool | replace_hof
+    new_birth = jnp.where(
+        migrated,
+        states.birth_counter[:, None] + jnp.arange(npop, dtype=jnp.int32)[None, :],
+        states.pop.birth,
+    )
+    new_counter = states.birth_counter + npop
+
+    return states._replace(
+        pop=Population(
+            trees=new_trees,
+            scores=new_scores,
+            losses=new_losses,
+            birth=new_birth,
+        ),
+        birth_counter=new_counter,
+    )
+
+
+def merge_hofs_across_islands(hofs: HallOfFame) -> HallOfFame:
+    """Per-slot argmin-loss across the islands axis. Under a sharded jit the
+    argmin lowers to a cross-island reduction over ICI (the analog of the
+    head-node HoF merge, reference src/SymbolicRegression.jl:722-744)."""
+    masked = jnp.where(hofs.exists, hofs.losses, jnp.inf)  # (I, S)
+    best_i = jnp.argmin(masked, axis=0)  # (S,)
+    S = best_i.shape[0]
+
+    def pick(x):  # x: (I, S, ...)
+        return jnp.take_along_axis(
+            x, best_i.reshape((1, S) + (1,) * (x.ndim - 2)), axis=0
+        )[0]
+
+    return HallOfFame(
+        trees=jax.tree_util.tree_map(pick, hofs.trees),
+        scores=pick(hofs.scores),
+        losses=pick(hofs.losses),
+        exists=jnp.any(hofs.exists, axis=0),
+    )

@@ -1,0 +1,135 @@
+"""Hall-of-fame rendering, CSV checkpointing, resume loading.
+
+Analogs: string_dominating_pareto_curve (reference src/HallOfFame.jl:112-152,
+score column = -Δlog(loss)/Δcomplexity), the double-write CSV checkpoint
+(src/SymbolicRegression.jl:747-767: file + .bkup each update to survive a
+mid-write kill), and load_saved_hall_of_fame (src/SearchUtils.jl:275-301).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..models.options import Options
+from ..models.population import HallOfFame, calculate_pareto_frontier
+from ..models.trees import TreeBatch, decode_tree, expr_to_string
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class Candidate:
+    """One hall-of-fame entry, host-side."""
+
+    complexity: int
+    loss: float
+    score: float  # -Δlog(loss)/Δcomplexity vs previous frontier point
+    equation: str
+    tree: TreeBatch  # single tree (batch shape ())
+
+    def __repr__(self):
+        return (
+            f"Candidate(complexity={self.complexity}, loss={self.loss:.6g}, "
+            f"equation={self.equation!r})"
+        )
+
+
+def hof_to_candidates(
+    hof: HallOfFame,
+    options: Options,
+    variable_names: Optional[Sequence[str]] = None,
+    pareto_only: bool = True,
+) -> List[Candidate]:
+    """Decode the device HoF into sorted host-side candidates with the
+    reference's Pareto score column (src/HallOfFame.jl:136-139)."""
+    exists = np.asarray(hof.exists)
+    losses = np.asarray(hof.losses)
+    front = np.asarray(calculate_pareto_frontier(hof))
+    pick = front if pareto_only else exists
+    out: List[Candidate] = []
+    prev_loss, prev_c = None, None
+    for i in np.where(pick)[0]:
+        tree = jax.tree_util.tree_map(lambda x: np.asarray(x[i]), hof.trees)
+        eq = expr_to_string(decode_tree(tree), options.operators, variable_names)
+        c = i + 1
+        loss = float(losses[i])
+        if prev_loss is None or prev_loss <= 0 or loss <= 0:
+            score = 0.0 if prev_loss is None else np.inf
+        else:
+            score = -(np.log(loss) - np.log(prev_loss)) / max(c - prev_c, 1)
+        out.append(
+            Candidate(
+                complexity=int(c),
+                loss=loss,
+                score=float(max(score, 0.0)),
+                equation=eq,
+                tree=tree,
+            )
+        )
+        prev_loss, prev_c = loss, c
+    return out
+
+
+def pareto_table(
+    candidates: List[Candidate], title: str = "Hall of Fame"
+) -> str:
+    """Render the frontier like the reference's progress table."""
+    lines = [
+        "-" * 78,
+        f"{title}",
+        "-" * 78,
+        f"{'Complexity':<12}{'Loss':<16}{'Score':<12}Equation",
+    ]
+    for c in candidates:
+        lines.append(
+            f"{c.complexity:<12}{c.loss:<16.8g}{c.score:<12.4g}{c.equation}"
+        )
+    lines.append("-" * 78)
+    return "\n".join(lines)
+
+
+def save_hof_csv(
+    candidates: List[Candidate], path: str
+) -> None:
+    """Double-write checkpoint: path then path.bkup
+    (reference src/SymbolicRegression.jl:749-767)."""
+    body = "Complexity;Loss;Equation\n" + "".join(
+        f"{c.complexity};{c.loss:.12g};{c.equation}\n" for c in candidates
+    )
+    for p in (path, path + ".bkup"):
+        with open(p, "w") as f:
+            f.write(body)
+
+
+def load_hof_csv(
+    path: str, options: Options, variable_names=None
+) -> List[Candidate]:
+    """Re-parse a checkpoint CSV back into candidates (equations re-parsed
+    through parse_expression; analog of load_saved_hall_of_fame)."""
+    from ..models.trees import encode_tree, parse_expression
+
+    use = path if os.path.exists(path) else path + ".bkup"
+    out: List[Candidate] = []
+    with open(use) as f:
+        header = f.readline()
+        for line in f:
+            parts = line.rstrip("\n").split(";", 2)
+            if len(parts) != 3:
+                continue
+            c, loss, eq = parts
+            expr = parse_expression(eq, options.operators, variable_names)
+            out.append(
+                Candidate(
+                    complexity=int(c),
+                    loss=float(loss),
+                    score=0.0,
+                    equation=eq,
+                    tree=encode_tree(expr, options.max_len),
+                )
+            )
+    return out

@@ -1,0 +1,69 @@
+"""Preflight configuration checks before a search starts.
+
+Analog of the reference's Configure.jl battery
+(test_option_configuration :3-50, test_dataset_configuration :53-83): verify
+operators are NaN-safe over a probe grid (they must return NaN, not raise),
+shapes line up, and batching is suggested for very large datasets. The
+worker-shipping half of Configure.jl (:86-285) has no analog — SPMD programs
+are identical on every host by construction.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.options import Options
+
+
+class PreflightError(ValueError):
+    pass
+
+
+def preflight_checks(options: Options, X, ys, weights) -> None:
+    ops = options.operators
+    # probe grid +-100 like the reference (src/Configure.jl:29-43)
+    grid = jnp.asarray(
+        np.concatenate([np.linspace(-100, 100, 41), [0.0, -0.0, 1e-9]]),
+        jnp.float32,
+    )
+    with jax.disable_jit():  # tiny arrays; avoid 2*n_ops compilations
+        for name, fn in zip(ops.unary_names, ops.unary_fns):
+            try:
+                out = fn(grid)
+            except Exception as e:  # pragma: no cover
+                raise PreflightError(
+                    f"Unary operator {name!r} raised on the probe grid: {e}"
+                ) from e
+            if out.shape != grid.shape:
+                raise PreflightError(
+                    f"Unary operator {name!r} is not elementwise"
+                )
+        for name, fn in zip(ops.binary_names, ops.binary_fns):
+            try:
+                out = fn(grid[:, None], grid[None, :])
+            except Exception as e:  # pragma: no cover
+                raise PreflightError(
+                    f"Binary operator {name!r} raised on the probe grid: {e}"
+                ) from e
+
+    if weights is not None:
+        w = np.asarray(weights)
+        if w.shape != (X.shape[1],):
+            raise PreflightError(
+                f"weights shape {w.shape} must be (n,) = ({X.shape[1]},)"
+            )
+    if not np.all(np.isfinite(np.asarray(X))):
+        raise PreflightError("X contains non-finite values")
+    if not np.all(np.isfinite(np.asarray(ys))):
+        raise PreflightError("y contains non-finite values")
+    if X.shape[1] > 10000 and not options.batching:
+        # reference src/Configure.jl:63-70
+        warnings.warn(
+            "Dataset has >10k rows; consider Options(batching=True) "
+            "(or shard rows over the mesh) for faster evolution",
+            stacklevel=3,
+        )
