@@ -13,6 +13,7 @@ from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..ops.interpreter import eval_trees
 from ..ops.losses import aggregate_loss
@@ -24,6 +25,27 @@ from .trees import TreeBatch
 Array = jax.Array
 
 
+_PALLAS_MIN_BATCH = 512
+
+
+def dispatch_eval(
+    trees: TreeBatch, X: Array, operators: OperatorSet, backend: str = "auto"
+):
+    """Choose the eval kernel. 'auto': the Pallas scalar-dispatch kernel for
+    large top-level batches on TPU (the bench / standalone-eval hot path);
+    the portable jnp lockstep interpreter otherwise (small per-island
+    batches inside the vmapped evolution step, CPU, grads)."""
+    if backend == "pallas" or (
+        backend == "auto"
+        and jax.default_backend() in ("tpu", "axon")
+        and int(np.prod(trees.length.shape)) >= _PALLAS_MIN_BATCH
+    ):
+        from ..ops.pallas_eval import eval_trees_pallas
+
+        return eval_trees_pallas(trees, X, operators)
+    return eval_trees(trees, X, operators)
+
+
 def eval_loss_trees(
     trees: TreeBatch,
     X: Array,
@@ -32,6 +54,7 @@ def eval_loss_trees(
     operators: OperatorSet,
     loss_fn: Callable,
     row_idx: Optional[Array] = None,
+    backend: str = "auto",
 ) -> Array:
     """Per-tree aggregated loss over all rows (or the row_idx minibatch).
 
@@ -41,7 +64,7 @@ def eval_loss_trees(
         X = X[:, row_idx]
         y = y[row_idx]
         weights = None if weights is None else weights[row_idx]
-    y_pred, ok = eval_trees(trees, X, operators)
+    y_pred, ok = dispatch_eval(trees, X, operators, backend)
     elem = loss_fn(y_pred, y)
     loss = aggregate_loss(elem, weights)
     loss = jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
@@ -68,7 +91,8 @@ def score_trees(
 ) -> Tuple[Array, Array]:
     """(score, loss) per tree — the batched `score_func`/`score_func_batch`."""
     loss = eval_loss_trees(
-        trees, X, y, weights, options.operators, options.elementwise_loss, row_idx
+        trees, X, y, weights, options.operators, options.elementwise_loss,
+        row_idx, backend=options.eval_backend,
     )
     complexity = compute_complexity(trees, options)
     score = loss_to_score(loss, baseline, complexity, options)

@@ -54,7 +54,7 @@ def _eval_single(
     binary_fns = operators.binary_fns
 
     def step(carry, node):
-        stack, sp, ok = carry  # stack: (depth, nrows)
+        stack, sp, bad = carry  # stack: (depth, nrows); bad: (nrows,) bool
         k, o, f, c = node
         a = stack[jnp.maximum(sp - 1, 0)]  # top: unary operand / right operand
         b = stack[jnp.maximum(sp - 2, 0)]  # second: left operand
@@ -75,17 +75,20 @@ def _eval_single(
         write = jnp.maximum(new_sp - 1, 0)
         v_final = jnp.where(k == PAD, stack[write], v)
         new_stack = jax.lax.dynamic_update_index_in_dim(stack, v_final, write, 0)
-        new_ok = ok & jnp.where(k == PAD, True, jnp.all(jnp.isfinite(v)))
-        return (new_stack, new_sp, new_ok), None
+        # elementwise NaN/Inf poison per row; reduced once at the end
+        # (cheaper than a per-step all-rows reduction, same semantics as the
+        # reference's early exit: any non-finite intermediate -> incomplete)
+        new_bad = bad | ((k != PAD) & ~jnp.isfinite(v))
+        return (new_stack, new_sp, new_bad), None
 
     init = (
         jnp.zeros((depth, nrows), X.dtype),
         jnp.int32(0),
-        jnp.bool_(True),
+        jnp.zeros((nrows,), jnp.bool_),
     )
-    (stack, sp, ok), _ = jax.lax.scan(step, init, (kind, op, feat, cval))
+    (stack, sp, bad), _ = jax.lax.scan(step, init, (kind, op, feat, cval))
     y = stack[0]
-    ok = ok & (length > 0)
+    ok = ~jnp.any(bad) & (length > 0)
     return y, ok
 
 

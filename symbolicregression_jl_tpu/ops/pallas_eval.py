@@ -1,0 +1,236 @@
+"""Pallas TPU kernel: batched postfix-tree interpreter with scalar dispatch.
+
+This is the hot kernel of the framework (SURVEY.md §7 decision 2) — the
+TPU-native replacement for DynamicExpressions' fused eval loops. Unlike the
+portable jnp path (ops/interpreter.py), which must compute EVERY operator on
+every node and select (vmap lockstep), this kernel reads each node's opcode
+from SMEM and executes exactly ONE operator per node via `lax.switch` on a
+scalar — the same work per node as the reference's native CPU loop, but on
+8x128 VPU lanes with the dataset resident in VMEM.
+
+Layout per grid cell (i, j):
+  trees block i : opcode/operand tables in SMEM (int32/f32, tiny),
+  rows block j  : X rows in VMEM,
+  stack         : (depth, R_BLK) f32 VMEM scratch, reused across the block's
+                  trees; per-row NaN/Inf poison is accumulated elementwise
+                  and reduced to a per-tree badness count.
+
+Short trees cost only `length` steps (dynamic fori_loop) — no padded work,
+unlike the jnp path.
+
+Opcodes are pre-fused into a single program code:
+  0 = PAD, 1 = CONST, 2 = VAR, 3..3+U-1 = unary ops, 3+U.. = binary ops.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.trees import BIN, CONST, PAD, UNA, VAR, TreeBatch
+from .operators import OperatorSet
+
+Array = jax.Array
+
+DEFAULT_T_BLOCK = 256
+DEFAULT_R_BLOCK = 1024
+
+
+def fuse_opcodes(trees: TreeBatch, operators: OperatorSet) -> Array:
+    """kind/op -> single program opcode (same shape as trees.kind)."""
+    U = operators.n_unary
+    return jnp.where(
+        trees.kind == PAD,
+        0,
+        jnp.where(
+            trees.kind == CONST,
+            1,
+            jnp.where(
+                trees.kind == VAR,
+                2,
+                jnp.where(trees.kind == UNA, 3 + trees.op, 3 + U + trees.op),
+            ),
+        ),
+    ).astype(jnp.int32)
+
+
+def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
+                 depth: int, max_len: int):
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    unary_fns = operators.unary_fns
+    binary_fns = operators.binary_fns
+    U = len(unary_fns)
+
+    def kernel(nrows_ref, pcode_ref, feat_ref, length_ref, cval_ref,  # SMEM
+               X_ref, out_ref, bad_ref,  # VMEM / SMEM out
+               stack_ref):  # scratch VMEM (depth, r_block)
+        # row-validity mask: padded tail rows must not poison the tree
+        col = jax.lax.broadcasted_iota(jnp.int32, (1, r_block), 1)
+        row_valid = (pl.program_id(1) * r_block + col) < nrows_ref[0]
+        valid_f = jnp.where(row_valid, 1.0, 0.0)
+
+        def tree_body(ti, _):
+            n = length_ref[ti, 0]
+
+            def slot_body(si, carry):
+                sp, bad = carry  # sp: int32; bad: (1, r_block) f32
+                code = pcode_ref[ti, si]
+
+                a_idx = jnp.maximum(sp - 1, 0)
+                b_idx = jnp.maximum(sp - 2, 0)
+
+                def br_pad():
+                    return stack_ref[pl.ds(a_idx, 1), :]
+
+                def br_const():
+                    return jnp.full(
+                        (1, r_block), cval_ref[ti, si], dtype=jnp.float32
+                    )
+
+                def br_var():
+                    f = feat_ref[ti, si]
+                    return X_ref[pl.ds(f, 1), :]
+
+                def mk_unary(fn):
+                    def br():
+                        a = stack_ref[pl.ds(a_idx, 1), :]
+                        return fn(a)
+
+                    return br
+
+                def mk_binary(fn):
+                    def br():
+                        a = stack_ref[pl.ds(a_idx, 1), :]  # right operand
+                        b = stack_ref[pl.ds(b_idx, 1), :]  # left operand
+                        return fn(b, a)
+
+                    return br
+
+                branches = (
+                    [br_pad, br_const, br_var]
+                    + [mk_unary(fn) for fn in unary_fns]
+                    + [mk_binary(fn) for fn in binary_fns]
+                )
+                v = jax.lax.switch(code, branches)
+
+                is_leaf = (code == 1) | (code == 2)
+                is_una = (code >= 3) & (code < 3 + U)
+                arity = jnp.where(is_leaf, 0, jnp.where(is_una, 1, 2))
+                new_sp = jnp.where(code == 0, sp, sp - arity + 1)
+                w = jnp.maximum(new_sp - 1, 0)
+                stack_ref[pl.ds(w, 1), :] = v
+                bad = jnp.maximum(
+                    bad, jnp.where(jnp.isfinite(v), 0.0, valid_f)
+                )
+                return new_sp, bad
+
+            bad0 = jnp.zeros((1, r_block), jnp.float32)
+            sp, bad = jax.lax.fori_loop(
+                0, n, slot_body, (jnp.int32(0), bad0)
+            )
+            out_ref[pl.ds(ti, 1), :] = stack_ref[0:1, :]
+            bad_ref[ti, 0] = jnp.sum(bad)
+            return 0
+
+        jax.lax.fori_loop(0, t_block, tree_body, 0)
+
+    return kernel, pl, pltpu
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("operators", "t_block", "r_block", "interpret"),
+)
+def eval_trees_pallas(
+    trees: TreeBatch,
+    X: Array,
+    operators: OperatorSet,
+    t_block: int = DEFAULT_T_BLOCK,
+    r_block: int = DEFAULT_R_BLOCK,
+    interpret: bool = False,
+) -> Tuple[Array, Array]:
+    """Evaluate a flat batch of trees over X (nfeat, nrows).
+
+    Returns (y (..., nrows), ok (...,)) with the same semantics as
+    interpreter.eval_trees. TPU only (or interpret=True anywhere)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    batch_shape = trees.length.shape
+    L = trees.max_len
+    flat = jax.tree_util.tree_map(
+        lambda x: x.reshape((-1,) + x.shape[len(batch_shape):]), trees
+    )
+    T = flat.length.shape[0]
+    nfeat, nrows = X.shape
+
+    t_block = min(t_block, max(T, 8))
+    r_block = min(r_block, _round_up(nrows, 128))
+    T_pad = _round_up(T, t_block)
+    R_pad = _round_up(nrows, r_block)
+
+    pcode = fuse_opcodes(flat, operators)
+    pcode = jnp.pad(pcode, ((0, T_pad - T), (0, 0)))
+    feat = jnp.pad(flat.feat, ((0, T_pad - T), (0, 0)))
+    length = jnp.pad(flat.length, (0, T_pad - T))[:, None]
+    cval = jnp.pad(
+        flat.cval.astype(jnp.float32), ((0, T_pad - T), (0, 0))
+    )
+    Xp = jnp.pad(X.astype(jnp.float32), ((0, 0), (0, R_pad - nrows)))
+    nrows_arr = jnp.asarray([nrows], jnp.int32)
+
+    depth = L // 2 + 2
+    kernel, _, _ = _make_kernel(operators, t_block, r_block, depth, L)
+
+    grid = (T_pad // t_block, R_pad // r_block)
+    y, bad = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # nrows scalar
+            pl.BlockSpec((t_block, L), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((t_block, L), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((t_block, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((t_block, L), lambda i, j: (i, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((nfeat, r_block), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((t_block, r_block), lambda i, j: (i, j)),
+            pl.BlockSpec((t_block, 1), lambda i, j: (i, j),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T_pad, R_pad), jnp.float32),
+            jax.ShapeDtypeStruct((T_pad, grid[1]), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((depth, r_block), jnp.float32)],
+        interpret=interpret,
+    )(nrows_arr, pcode, feat, length, cval, Xp)
+
+    y = y[:T, :nrows]
+    ok = (jnp.sum(bad[:T], axis=-1) == 0) & (flat.length > 0)
+    return (
+        y.reshape(batch_shape + (nrows,)),
+        ok.reshape(batch_shape),
+    )
+
+
+def pallas_available() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu",)
+    except Exception:  # pragma: no cover
+        return False

@@ -1,0 +1,83 @@
+"""Pallas kernel correctness vs the jnp interpreter (interpret mode on CPU;
+the same kernel runs compiled on TPU — exercised by bench.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.models.trees import encode_tree, stack_trees
+from symbolicregression_jl_tpu.ops.interpreter import eval_trees
+from symbolicregression_jl_tpu.ops.operators import make_operator_set
+from symbolicregression_jl_tpu.ops.pallas_eval import (
+    eval_trees_pallas,
+    fuse_opcodes,
+)
+from symbolicregression_jl_tpu.utils.random_exprs import random_expr_fixed_size
+
+OPS = make_operator_set(["+", "-", "*", "/"], ["cos", "exp", "sqrt", "log"])
+L = 24
+NFEAT = 4
+
+
+def batch(rng, n, max_size=14):
+    return stack_trees(
+        [
+            encode_tree(
+                random_expr_fixed_size(
+                    rng, OPS, NFEAT, int(rng.integers(1, max_size))
+                ),
+                L,
+            )
+            for _ in range(n)
+        ]
+    )
+
+
+def test_fuse_opcodes(rng):
+    trees = batch(rng, 8)
+    pcode = np.asarray(fuse_opcodes(trees, OPS))
+    kind = np.asarray(trees.kind)
+    op = np.asarray(trees.op)
+    U = OPS.n_unary
+    assert np.all(pcode[kind == 0] == 0)
+    assert np.all(pcode[kind == 1] == 1)
+    assert np.all(pcode[kind == 2] == 2)
+    assert np.all(pcode[kind == 3] == 3 + op[kind == 3])
+    assert np.all(pcode[kind == 4] == 3 + U + op[kind == 4])
+
+
+@pytest.mark.parametrize("n_trees,n_rows", [(10, 37), (3, 130), (17, 256)])
+def test_pallas_matches_jnp(rng, n_trees, n_rows):
+    trees = batch(rng, n_trees)
+    X = jnp.asarray(
+        (rng.standard_normal((NFEAT, n_rows)) * 2).astype(np.float32)
+    )
+    y_ref, ok_ref = eval_trees(trees, X, OPS)
+    y, ok = eval_trees_pallas(
+        trees, X, OPS, t_block=8, r_block=128, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+    ok_np = np.asarray(ok_ref)
+    np.testing.assert_allclose(
+        np.asarray(y)[ok_np],
+        np.asarray(y_ref)[ok_np],
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_pallas_row_padding_no_poison(rng):
+    """Padded rows must not mark a tree incomplete: sqrt(x0) with all-valid
+    rows positive stays ok even when padded region would be negative."""
+    ops = make_operator_set(["+"], ["sqrt"])
+    from symbolicregression_jl_tpu.models.trees import Expr
+
+    e = Expr.unary(0, Expr.var(0))
+    trees = stack_trees([encode_tree(e, L)])
+    X = jnp.asarray(np.full((1, 100), 4.0, np.float32))
+    y, ok = eval_trees_pallas(
+        trees, X, ops, t_block=8, r_block=128, interpret=True
+    )
+    assert bool(ok[0])
+    np.testing.assert_allclose(np.asarray(y)[0], 2.0, rtol=1e-6)
