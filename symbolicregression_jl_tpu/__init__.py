@@ -36,6 +36,13 @@ from .ops.interpreter import (
     eval_trees,
 )
 from .ops.losses import LOSS_REGISTRY
+from .utils.export import (
+    from_sympy,
+    sympy_simplify_tree,
+    to_callable,
+    to_latex,
+    to_sympy,
+)
 from .ops.operators import (
     OperatorSet,
     make_operator_set,
@@ -73,6 +80,11 @@ __all__ = [
     "register_unary",
     "register_binary",
     "LOSS_REGISTRY",
+    "to_sympy",
+    "from_sympy",
+    "to_latex",
+    "to_callable",
+    "sympy_simplify_tree",
     "equation_search",
     "EquationSearch",
     "EquationSearchResult",

@@ -89,18 +89,35 @@ class EquationSearchResult:
     def predict(
         self, X, output: int = 0, complexity: Optional[int] = None
     ):
-        cands = self.candidates[output]
-        if complexity is None:
-            cand = self.best(output)
-        else:
-            matches = [c for c in cands if c.complexity == complexity]
-            if not matches:
-                raise ValueError(f"No frontier member at complexity {complexity}")
-            cand = matches[0]
+        cand = self._pick(output, complexity)
         X = jnp.asarray(X, jnp.float32)
         tree = jax.tree_util.tree_map(jnp.asarray, cand.tree)
         y, ok = eval_tree(tree, X, self.options.operators)
         return np.asarray(y)
+
+    def sympy(self, output: int = 0, complexity: Optional[int] = None):
+        """Best (or complexity-matched) frontier member as a sympy
+        expression (analog of node_to_symbolic export)."""
+        from .utils.export import to_sympy
+
+        cand = self._pick(output, complexity)
+        return to_sympy(cand.tree, self.options, self.variable_names)
+
+    def latex(self, output: int = 0, complexity: Optional[int] = None) -> str:
+        from .utils.export import to_latex
+
+        cand = self._pick(output, complexity)
+        return to_latex(cand.tree, self.options, self.variable_names)
+
+    def _pick(self, output: int, complexity: Optional[int]) -> Candidate:
+        if complexity is None:
+            return self.best(output)
+        matches = [
+            c for c in self.candidates[output] if c.complexity == complexity
+        ]
+        if not matches:
+            raise ValueError(f"No frontier member at complexity {complexity}")
+        return matches[0]
 
     def __repr__(self):
         parts = []

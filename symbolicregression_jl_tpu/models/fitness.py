@@ -32,12 +32,20 @@ def dispatch_eval(
     trees: TreeBatch, X: Array, operators: OperatorSet, backend: str = "auto"
 ):
     """Choose the eval kernel. 'auto': the Pallas scalar-dispatch kernel for
-    large top-level batches on TPU (the bench / standalone-eval hot path);
-    the portable jnp lockstep interpreter otherwise (small per-island
-    batches inside the vmapped evolution step, CPU, grads)."""
+    large float32 top-level batches on TPU (the bench / standalone-eval hot
+    path); the portable jnp lockstep interpreter otherwise (small per-island
+    batches inside the vmapped evolution step, CPU, non-f32 dtypes).
+
+    The Pallas kernel is float32-only and has no VJP rule — differentiable
+    callers (constant optimization) must force backend='jnp' or call
+    eval_trees directly; 'auto' never changes dtype or breaks grads only
+    because the guards below route those cases to the jnp path."""
+    from ..ops.pallas_eval import pallas_available
+
     if backend == "pallas" or (
         backend == "auto"
-        and jax.default_backend() in ("tpu", "axon")
+        and pallas_available()
+        and X.dtype == jnp.float32
         and int(np.prod(trees.length.shape)) >= _PALLAS_MIN_BATCH
     ):
         from ..ops.pallas_eval import eval_trees_pallas

@@ -230,7 +230,9 @@ def eval_trees_pallas(
 
 
 def pallas_available() -> bool:
+    """Single source of truth for whether the TPU Pallas kernel can run
+    (used by models.fitness.dispatch_eval's 'auto' routing)."""
     try:
-        return jax.devices()[0].platform in ("tpu",)
+        return jax.default_backend() in ("tpu", "axon")
     except Exception:  # pragma: no cover
         return False

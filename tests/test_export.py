@@ -1,0 +1,148 @@
+"""Symbolic export round-trips.
+
+Parity target: reference test/test_simplification.jl:66-83 — tree -> symbolic
+-> tree round-trip must be eval-equivalent on random data within tolerance.
+"""
+
+import numpy as np
+import pytest
+
+sympy = pytest.importorskip("sympy")
+
+from symbolicregression_jl_tpu.models.trees import (
+    CONST,
+    Expr,
+    encode_tree,
+    parse_expression,
+    tree_to_string,
+)
+from symbolicregression_jl_tpu.ops.eval_numpy import eval_tree_numpy
+from symbolicregression_jl_tpu.ops.operators import make_operator_set
+from symbolicregression_jl_tpu.utils.export import (
+    from_sympy,
+    sympy_simplify_tree,
+    to_callable,
+    to_latex,
+    to_sympy,
+)
+
+OPS = make_operator_set(["+", "-", "*", "/", "^"], ["cos", "exp", "sqrt", "log"])
+MAX_LEN = 32
+
+
+def _expr(s):
+    return parse_expression(s, OPS)
+
+
+def _assert_eval_equivalent(rng, tree_a, tree_b, atol=1e-4, ops=OPS):
+    X = rng.uniform(0.5, 3.0, size=(3, 64)).astype(np.float32)
+    ya, oka = eval_tree_numpy(tree_a, X, ops)
+    yb, okb = eval_tree_numpy(tree_b, X, ops)
+    assert bool(oka) and bool(okb)
+    np.testing.assert_allclose(ya, yb, rtol=1e-3, atol=atol)
+
+
+@pytest.mark.parametrize(
+    "expr_str",
+    [
+        "((x0 + x1) * cos(x2))",
+        "(exp(x0) / (x1 + 1.5))",
+        "sqrt((x0 * x0))",
+        "(2.5 * (x0 + (x1 * x2)))",
+        "log((x0 + 2.0))",
+        "((x0 ^ 2.0) - (x1 / 3.0))",
+    ],
+)
+def test_sympy_roundtrip_eval_equivalent(rng, expr_str):
+    tree = encode_tree(_expr(expr_str), MAX_LEN)
+    s = to_sympy(tree, OPS)
+    back = encode_tree(from_sympy(s, OPS), MAX_LEN)
+    _assert_eval_equivalent(rng, tree, back)
+
+
+def test_sympy_form_is_correct():
+    tree = encode_tree(_expr("((x0 + x0) * cos(x1))"), MAX_LEN)
+    s = sympy.simplify(to_sympy(tree, OPS))
+    x0, x1 = sympy.symbols("x0 x1", real=True)
+    assert sympy.simplify(s - 2 * x0 * sympy.cos(x1)) == 0
+
+
+def test_simplify_tree_shrinks_redundancy(rng):
+    # x0 + x0 + x0 - x0 simplifies to 2*x0
+    tree = encode_tree(_expr("(((x0 + x0) + x0) - x0)"), MAX_LEN)
+    simp = sympy_simplify_tree(tree, OPS, max_len=MAX_LEN)
+    _assert_eval_equivalent(rng, tree, simp)
+    assert int(simp.length) <= int(tree.length)
+
+
+def test_simplify_falls_back_when_inexpressible(rng):
+    # sin not in the operator set: sympy may produce forms needing it; the
+    # helper must return an eval-equivalent tree regardless.
+    ops = make_operator_set(["+", "*"], ["cos"])
+    tree = encode_tree(parse_expression("(cos(x0) * cos(x0))", ops), MAX_LEN)
+    simp = sympy_simplify_tree(tree, ops, max_len=MAX_LEN)
+    _assert_eval_equivalent(rng, tree, simp, ops=ops)
+
+
+def test_variable_names():
+    tree = encode_tree(
+        parse_expression("(alpha + beta)", OPS, ["alpha", "beta"]), MAX_LEN
+    )
+    s = to_sympy(tree, OPS, ["alpha", "beta"])
+    assert {str(v) for v in s.free_symbols} == {"alpha", "beta"}
+    back = from_sympy(s, OPS, ["alpha", "beta"])
+    assert tree_to_string(encode_tree(back, MAX_LEN), OPS, ["alpha", "beta"]) in (
+        "(alpha + beta)",
+        "(beta + alpha)",
+    )
+
+
+def test_latex():
+    tree = encode_tree(_expr("(x0 / (x1 + 1.0))"), MAX_LEN)
+    tex = to_latex(tree, OPS)
+    assert "frac" in tex
+
+
+def test_to_callable(rng):
+    tree = encode_tree(_expr("((x0 * x0) + cos(x1))"), MAX_LEN)
+    f = to_callable(tree, OPS)
+    X = rng.normal(size=(2, 32)).astype(np.float32)
+    y = np.asarray(f(X))
+    np.testing.assert_allclose(
+        y, X[0] ** 2 + np.cos(X[1]), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_from_sympy_subtraction_without_mult(rng):
+    # sympy stores x0 - x1 as Add(x0, Mul(-1, x1)); conversion must use "-"
+    # rather than demanding "*" in the operator set.
+    ops = make_operator_set(["+", "-"], [])
+    x0, x1 = sympy.symbols("x0 x1", real=True)
+    e = from_sympy(x0 - x1, ops)
+    tree = encode_tree(e, MAX_LEN)
+    _assert_eval_equivalent(
+        rng, tree, encode_tree(parse_expression("(x0 - x1)", ops), MAX_LEN),
+        ops=ops,
+    )
+    # pure negation: -x0 with no "*" either
+    e2 = from_sympy(-x0, ops)
+    X = rng.normal(size=(1, 16)).astype(np.float32)
+    y, ok = eval_tree_numpy(encode_tree(e2, MAX_LEN), X, ops)
+    np.testing.assert_allclose(y, -X[0], rtol=1e-6)
+
+
+def test_from_sympy_inv_and_neg_preference():
+    ops = make_operator_set(["+", "*"], ["inv", "neg"])
+    x0 = sympy.Symbol("x0", real=True)
+    e = from_sympy(1 / x0, ops)
+    assert e.kind != CONST  # uses inv(x0)
+    assert ops.unary_names[e.op] == "inv"
+    e2 = from_sympy(-x0, ops)
+    assert ops.unary_names[e2.op] == "neg" or ops.binary_names[e2.op] == "*"
+
+
+def test_from_sympy_rejects_missing_operator():
+    ops = make_operator_set(["+", "*"], [])
+    x0 = sympy.Symbol("x0", real=True)
+    with pytest.raises(ValueError):
+        from_sympy(sympy.sin(x0), ops)
