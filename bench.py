@@ -70,9 +70,15 @@ def _time_backend(jax, jnp, options, device, n_trees, label, verbose):
         y = jnp.asarray(y_h)
         baseline = jnp.float32(float(np.var(y_h)))
 
-        fn = jax.jit(
-            lambda t, X, y, b: score_trees(t, X, y, None, b, options)
-        )
+        # The jitted step returns one scalar so each rep ends with a real
+        # device->host transfer: block_until_ready alone can return early on
+        # async transport backends, yielding bogus sub-ms timings.
+        def step(t, X, y, b):
+            scores, losses = score_trees(t, X, y, None, b, options)
+            finite = jnp.isfinite(scores)
+            return jnp.sum(jnp.where(finite, scores, 0.0)), jnp.sum(finite)
+
+        fn = jax.jit(step)
         n_chunks = max(1, n_trees // CHUNK)
         chunks = [
             jax.tree_util.tree_map(
@@ -81,15 +87,16 @@ def _time_backend(jax, jnp, options, device, n_trees, label, verbose):
             for i in range(n_chunks)
         ]
         # warmup / compile
-        out = fn(chunks[0], X, y, baseline)
-        jax.block_until_ready(out)
+        float(fn(chunks[0], X, y, baseline)[0])
 
-        best = np.inf
+        times = []
         for _ in range(REPS):
             t0 = time.perf_counter()
             outs = [fn(c, X, y, baseline) for c in chunks]
-            jax.block_until_ready(outs)
-            best = min(best, time.perf_counter() - t0)
+            total = sum(float(s) for s, _ in outs)  # forces full sync
+            times.append(time.perf_counter() - t0)
+        best = float(np.median(times))
+        assert np.isfinite(total)
 
     done_trees = n_chunks * min(CHUNK, n_trees)
     rate = done_trees * N_ROWS / best
@@ -124,14 +131,15 @@ def main(verbose=True):
         jax, jnp, options, main_dev, n_trees, f"main ({platform})", verbose
     )
 
-    # CPU anchor
+    # CPU anchor (dispatch_eval auto-routes to the jnp path under
+    # jax.default_device(cpu) — pallas_available honors the context)
     cpu_rate = None
     if platform != "cpu":
         try:
             cpu_dev = jax.devices("cpu")[0]
             cpu_rate = _time_backend(
-                jax, jnp, options, cpu_dev, min(n_trees, 8192), "cpu anchor",
-                verbose,
+                jax, jnp, options, cpu_dev, min(n_trees, 8192),
+                "cpu anchor", verbose,
             )
         except Exception as e:  # pragma: no cover
             if verbose:

@@ -30,6 +30,7 @@ from .models.trees import (
     tree_to_string,
 )
 from .ops.interpreter import (
+    eval_diff_tree,
     eval_grad_constants,
     eval_grad_variables,
     eval_tree,
@@ -73,6 +74,7 @@ __all__ = [
     "parse_expression",
     "eval_tree",
     "eval_trees",
+    "eval_diff_tree",
     "eval_grad_constants",
     "eval_grad_variables",
     "OperatorSet",

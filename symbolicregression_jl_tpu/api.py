@@ -46,6 +46,8 @@ from .parallel.mesh import make_mesh, shard_dataset, shard_island_states
 from .parallel.migration import merge_hofs_across_islands, migrate
 from .utils.output import Candidate, hof_to_candidates, pareto_table, save_hof_csv
 from .utils.preflight import preflight_checks
+from .utils.progress import ProgressBar, ResourceMonitor, SearchProgress
+from .utils.recorder import Recorder
 
 Array = jax.Array
 
@@ -265,10 +267,39 @@ def equation_search(
     results: List[List[Candidate]] = []
     out_states: List[SearchState] = []
     total_evals = 0.0
+    # The recorder materializes every island population on the host each
+    # iteration — single-controller only (multi-host shards are not
+    # addressable from one process).
+    record_here = options.recorder and is_primary_host()
+    if options.recorder and jax.process_count() > 1:
+        record_here = False
+    recorder = Recorder(options, variable_names) if record_here else None
+    total_its = niterations * max(ys.shape[0], 1)
+    progress = SearchProgress(total_its, options)
+    bar = ProgressBar(total_its)
+    monitor = ResourceMonitor()
+    global_it = 0  # host-loop iterations completed across all outputs
 
     for j in range(ys.shape[0]):
         ds = make_dataset(X, ys[j], weights, variable_names)
-        ds = update_baseline_loss(ds, options.elementwise_loss)
+        if options.loss_function is not None:
+            # Baseline = custom objective on the constant predictor avg_y
+            # (reference dispatches eval_loss -> loss_function for the
+            # baseline member too, src/LossFunctions.jl:60-67,122-126).
+            from .models.trees import Expr, encode_tree
+
+            const_tree = encode_tree(
+                Expr.const(float(ds.avg_y)), options.max_len
+            )
+            const_tree = jax.tree_util.tree_map(jnp.asarray, const_tree)
+            base = float(
+                options.loss_function(
+                    const_tree, ds.X, ds.y, ds.weights, options
+                )
+            )
+            ds.baseline_loss = base if np.isfinite(base) and base > 0 else 1.0
+        else:
+            ds = update_baseline_loss(ds, options.elementwise_loss)
         Xj, yj, wj = shard_dataset(ds.X, ds.y, ds.weights, mesh, options)
 
         master_key = jax.random.PRNGKey(options.seed + 7919 * j)
@@ -295,15 +326,31 @@ def equation_search(
             cm = jnp.int32(_curmaxsize(options, it, max(niterations, 1)))
             master_key, k_it = jax.random.split(master_key)
             baseline = jnp.float32(ds.baseline_loss)
+            t_dev = time.time()
             if wj is not None:
                 states, ghof = iteration_fn(
                     states, k_it, cm, Xj, yj, wj, baseline
                 )
             else:
                 states, ghof = iteration_fn(states, k_it, cm, Xj, yj, baseline)
+            jax.block_until_ready(ghof.losses)
+            t_host = time.time()
 
             # ---- host-side orchestration (off the hot path) ----
+            progress.note_iteration(I)
+            global_it += 1
             cands = hof_to_candidates(ghof, options, variable_names)
+            if recorder is not None:
+                recorder.record_hall_of_fame(j, it, cands)
+                for isl in range(I):
+                    recorder.record_population(
+                        j, isl, it,
+                        jax.tree_util.tree_map(
+                            lambda x: x[isl], states.pop.trees
+                        ),
+                        states.pop.scores[isl], states.pop.losses[isl],
+                        states.pop.birth[isl],
+                    )
             if options.output_file and is_primary_host():
                 path = options.output_file
                 if multi:
@@ -313,14 +360,17 @@ def equation_search(
             if options.verbosity > 0 and is_primary_host():
                 best_loss = min((c.loss for c in cands), default=float("inf"))
                 evals = float(jnp.sum(states.num_evals))
+                prefix = f"[output {j}] " if multi else ""
                 print(
-                    f"[output {j}] iter {it + 1}: best_loss={best_loss:.6g} "
-                    f"evals={evals:.3g} elapsed={time.time() - t_start:.1f}s"
+                    prefix
+                    + progress.status_line(global_it - 1, best_loss, evals)
                 )
                 if options.progress:
-                    print(pareto_table(cands))
+                    bar.update(global_it, pareto_table(cands))
             if on_iteration is not None:
                 on_iteration(j, it, cands)
+            monitor.note(t_host - t_dev, time.time() - t_host)
+            monitor.maybe_warn()
 
             # early stopping (reference src/SearchUtils.jl:109-141)
             if early_stop is not None and any(
@@ -342,6 +392,10 @@ def equation_search(
         out_states.append(
             SearchState(island_states=states, global_hof=ghof, iteration=it + 1)
         )
+
+    if recorder is not None:
+        recorder.record_final(total_evals, time.time() - t_start)
+        recorder.save()
 
     return EquationSearchResult(
         candidates=results,

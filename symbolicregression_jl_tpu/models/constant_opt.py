@@ -46,7 +46,19 @@ def _member_loss_fn(
     options: Options,
 ):
     """loss(cval) for one member over the full dataset
-    (reference opt objective src/ConstantOptimization.jl:11-19)."""
+    (reference opt objective src/ConstantOptimization.jl:11-19). Dispatches
+    to options.loss_function when set, like every other scoring path —
+    constants must be fitted to the same objective selection uses."""
+    if options.loss_function is not None:
+
+        def f_custom(cval: Array) -> Array:
+            loss = options.loss_function(
+                tree._replace(cval=cval), X, y, weights, options
+            )
+            return jnp.where(jnp.isfinite(loss), loss, jnp.inf)
+
+        return f_custom
+
     loss_fn = options.elementwise_loss
 
     def f(cval: Array) -> Array:

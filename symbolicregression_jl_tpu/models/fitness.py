@@ -88,6 +88,31 @@ def loss_to_score(
     return normalized + complexity.astype(loss.dtype) * options.parsimony
 
 
+def _custom_loss_trees(
+    trees: TreeBatch,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    options: Options,
+    row_idx: Optional[Array] = None,
+) -> Array:
+    """Custom full-tree objective, vmapped over the population (analog of the
+    reference's eval_loss dispatch to a user loss_function,
+    src/LossFunctions.jl:60-67)."""
+    if row_idx is not None:
+        X = X[:, row_idx]
+        y = y[row_idx]
+        weights = None if weights is None else weights[row_idx]
+    batch_shape = trees.length.shape
+    flat = jax.tree_util.tree_map(
+        lambda x: x.reshape((-1,) + x.shape[len(batch_shape):]), trees
+    )
+    fn = lambda t: options.loss_function(t, X, y, weights, options)
+    loss = jax.vmap(fn)(flat)
+    loss = jnp.where(jnp.isfinite(loss), loss, jnp.inf)
+    return loss.reshape(batch_shape)
+
+
 def score_trees(
     trees: TreeBatch,
     X: Array,
@@ -98,10 +123,13 @@ def score_trees(
     row_idx: Optional[Array] = None,
 ) -> Tuple[Array, Array]:
     """(score, loss) per tree — the batched `score_func`/`score_func_batch`."""
-    loss = eval_loss_trees(
-        trees, X, y, weights, options.operators, options.elementwise_loss,
-        row_idx, backend=options.eval_backend,
-    )
+    if options.loss_function is not None:
+        loss = _custom_loss_trees(trees, X, y, weights, options, row_idx)
+    else:
+        loss = eval_loss_trees(
+            trees, X, y, weights, options.operators, options.elementwise_loss,
+            row_idx, backend=options.eval_backend,
+        )
     complexity = compute_complexity(trees, options)
     score = loss_to_score(loss, baseline, complexity, options)
     score = jnp.where(jnp.isfinite(loss), score, jnp.inf)

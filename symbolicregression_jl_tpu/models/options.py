@@ -120,6 +120,11 @@ class Options:
     maxdepth: Optional[int] = None
     # --- loss / scoring ---
     loss: Union[str, Callable] = "L2DistLoss"
+    # Custom full-tree objective (reference `loss_function(tree, dataset,
+    # options)`, src/LossFunctions.jl:60-67): a jax-traceable callable
+    # (tree: TreeBatch, X, y, weights, options) -> scalar loss. Overrides
+    # the elementwise `loss` path entirely.
+    loss_function: Optional[Callable] = None
     parsimony: float = 0.0032
     alpha: float = 0.100000
     annealing: bool = False
@@ -278,6 +283,7 @@ class Options:
             self.optimizer_probability, self.optimizer_nrestarts,
             self.optimizer_iterations,
             str(self.loss) if not callable(self.loss) else id(self.loss),
+            None if self.loss_function is None else id(self.loss_function),
         )
 
     def __hash__(self):

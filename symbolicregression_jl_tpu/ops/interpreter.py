@@ -164,3 +164,18 @@ def eval_grad_variables(
 
     y, _ = eval_tree(tree, X, operators)
     return y, jax.grad(val)(X)
+
+
+def eval_diff_tree(
+    tree: TreeBatch, X: Array, operators: OperatorSet, direction: int
+) -> Tuple[Array, Array, Array]:
+    """Forward-mode derivative of the output w.r.t. ONE feature — the analog
+    of `eval_diff_tree_array(tree, X, options, direction)` (reference
+    src/InterfaceDynamicExpressions.jl:76-87). Returns (y, dy_dx, ok)."""
+
+    def val(Xv):
+        return eval_tree(tree, Xv, operators)
+
+    tangent = jnp.zeros_like(X).at[direction].set(1.0)
+    (y, ok), (dy, _) = jax.jvp(val, (X,), (tangent,))
+    return y, dy, ok

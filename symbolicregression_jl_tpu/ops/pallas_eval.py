@@ -9,7 +9,11 @@ scalar — the same work per node as the reference's native CPU loop, but on
 8x128 VPU lanes with the dataset resident in VMEM.
 
 Layout per grid cell (i, j):
-  trees block i : opcode/operand tables in SMEM (int32/f32, tiny),
+  trees block i : opcode/operand tables in SMEM (int32/f32, tiny). Tables
+                  are stored transposed, (L, t_block), because SMEM pads
+                  each major row to 1 KiB: with trees on the minor axis a
+                  (24, 256) table costs 24 KiB instead of the 256 KiB of
+                  its (256, 24) transpose (which OOMs the 1 MiB SMEM).
   rows block j  : X rows in VMEM,
   stack         : (depth, R_BLK) f32 VMEM scratch, reused across the block's
                   trees; per-row NaN/Inf poison is accumulated elementwise
@@ -70,17 +74,18 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
     def kernel(nrows_ref, pcode_ref, feat_ref, length_ref, cval_ref,  # SMEM
                X_ref, out_ref, bad_ref,  # VMEM / SMEM out
                stack_ref):  # scratch VMEM (depth, r_block)
+        # SMEM tables are transposed: [slot, tree] (see module docstring).
         # row-validity mask: padded tail rows must not poison the tree
         col = jax.lax.broadcasted_iota(jnp.int32, (1, r_block), 1)
         row_valid = (pl.program_id(1) * r_block + col) < nrows_ref[0]
         valid_f = jnp.where(row_valid, 1.0, 0.0)
 
         def tree_body(ti, _):
-            n = length_ref[ti, 0]
+            n = length_ref[0, ti]
 
             def slot_body(si, carry):
                 sp, bad = carry  # sp: int32; bad: (1, r_block) f32
-                code = pcode_ref[ti, si]
+                code = pcode_ref[si, ti]
 
                 a_idx = jnp.maximum(sp - 1, 0)
                 b_idx = jnp.maximum(sp - 2, 0)
@@ -90,11 +95,11 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
 
                 def br_const():
                     return jnp.full(
-                        (1, r_block), cval_ref[ti, si], dtype=jnp.float32
+                        (1, r_block), cval_ref[si, ti], dtype=jnp.float32
                     )
 
                 def br_var():
-                    f = feat_ref[ti, si]
+                    f = feat_ref[si, ti]
                     return X_ref[pl.ds(f, 1), :]
 
                 def mk_unary(fn):
@@ -135,7 +140,7 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                 0, n, slot_body, (jnp.int32(0), bad0)
             )
             out_ref[pl.ds(ti, 1), :] = stack_ref[0:1, :]
-            bad_ref[ti, 0] = jnp.sum(bad)
+            bad_ref[0, ti] = jnp.sum(bad)
             return 0
 
         jax.lax.fori_loop(0, t_block, tree_body, 0)
@@ -179,13 +184,15 @@ def eval_trees_pallas(
     T_pad = _round_up(T, t_block)
     R_pad = _round_up(nrows, r_block)
 
+    # tables transposed to (L, T_pad): SMEM pads each major row to 1 KiB,
+    # so the tree index must live on the minor axis (see module docstring)
     pcode = fuse_opcodes(flat, operators)
-    pcode = jnp.pad(pcode, ((0, T_pad - T), (0, 0)))
-    feat = jnp.pad(flat.feat, ((0, T_pad - T), (0, 0)))
-    length = jnp.pad(flat.length, (0, T_pad - T))[:, None]
+    pcode = jnp.pad(pcode, ((0, T_pad - T), (0, 0))).T
+    feat = jnp.pad(flat.feat, ((0, T_pad - T), (0, 0))).T
+    length = jnp.pad(flat.length, (0, T_pad - T))[None, :]
     cval = jnp.pad(
         flat.cval.astype(jnp.float32), ((0, T_pad - T), (0, 0))
-    )
+    ).T
     Xp = jnp.pad(X.astype(jnp.float32), ((0, 0), (0, R_pad - nrows)))
     nrows_arr = jnp.asarray([nrows], jnp.int32)
 
@@ -198,31 +205,31 @@ def eval_trees_pallas(
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # nrows scalar
-            pl.BlockSpec((t_block, L), lambda i, j: (i, 0),
+            pl.BlockSpec((L, t_block), lambda i, j: (0, i),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((t_block, L), lambda i, j: (i, 0),
+            pl.BlockSpec((L, t_block), lambda i, j: (0, i),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((t_block, 1), lambda i, j: (i, 0),
+            pl.BlockSpec((1, t_block), lambda i, j: (0, i),
                          memory_space=pltpu.SMEM),
-            pl.BlockSpec((t_block, L), lambda i, j: (i, 0),
+            pl.BlockSpec((L, t_block), lambda i, j: (0, i),
                          memory_space=pltpu.SMEM),
             pl.BlockSpec((nfeat, r_block), lambda i, j: (0, j)),
         ],
         out_specs=[
             pl.BlockSpec((t_block, r_block), lambda i, j: (i, j)),
-            pl.BlockSpec((t_block, 1), lambda i, j: (i, j),
+            pl.BlockSpec((1, t_block), lambda i, j: (j, i),
                          memory_space=pltpu.SMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((T_pad, R_pad), jnp.float32),
-            jax.ShapeDtypeStruct((T_pad, grid[1]), jnp.float32),
+            jax.ShapeDtypeStruct((grid[1], T_pad), jnp.float32),
         ],
         scratch_shapes=[pltpu.VMEM((depth, r_block), jnp.float32)],
         interpret=interpret,
     )(nrows_arr, pcode, feat, length, cval, Xp)
 
     y = y[:T, :nrows]
-    ok = (jnp.sum(bad[:T], axis=-1) == 0) & (flat.length > 0)
+    ok = (jnp.sum(bad[:, :T], axis=0) == 0) & (flat.length > 0)
     return (
         y.reshape(batch_shape + (nrows,)),
         ok.reshape(batch_shape),
@@ -231,8 +238,15 @@ def eval_trees_pallas(
 
 def pallas_available() -> bool:
     """Single source of truth for whether the TPU Pallas kernel can run
-    (used by models.fitness.dispatch_eval's 'auto' routing)."""
+    (used by models.fitness.dispatch_eval's 'auto' routing).
+
+    Honors an active `jax.default_device(...)` context: computations traced
+    under it run on that device's platform, not the process default — e.g.
+    a CPU-anchor benchmark on a TPU host must NOT route to the TPU kernel."""
     try:
+        dd = jax.config.jax_default_device
+        if dd is not None:
+            return getattr(dd, "platform", None) in ("tpu", "axon")
         return jax.default_backend() in ("tpu", "axon")
     except Exception:  # pragma: no cover
         return False

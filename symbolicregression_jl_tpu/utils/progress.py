@@ -1,0 +1,132 @@
+"""Progress reporting + runtime self-measurement.
+
+Analogs: the reference's 5-second state print with a 50-second moving
+average of "cycles per second" (src/SymbolicRegression.jl:869-897;
+src/SearchUtils.jl:233-268), the WrappedProgressBar (src/ProgressBars.jl,
+silenced when SYMBOLIC_REGRESSION_TEST=true), and the ResourceMonitor that
+estimates head-node occupation and warns above 20%
+(src/SearchUtils.jl:143-213).
+
+In the SPMD design there is no head node; the analog of "head occupation"
+is the fraction of wall time the host spends *outside* the jitted iteration
+(decoding, printing, checkpointing) while the device sits idle — measured
+here and warned about at the same 20% threshold.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from collections import deque
+from typing import Deque, Optional, Tuple
+
+
+def _quiet() -> bool:
+    return os.environ.get("SYMBOLIC_REGRESSION_TEST", "") == "true"
+
+
+class ResourceMonitor:
+    """Host-occupation estimator (ResourceMonitor analog,
+    reference src/SearchUtils.jl:143-213)."""
+
+    def __init__(self, warn_fraction: float = 0.2, max_samples: int = 100):
+        self.warn_fraction = warn_fraction
+        self.device_s = 0.0
+        self.host_s = 0.0
+        self._samples: Deque[Tuple[float, float]] = deque(maxlen=max_samples)
+        self._warned = False
+
+    def note(self, device_s: float, host_s: float) -> None:
+        self.device_s += device_s
+        self.host_s += host_s
+        self._samples.append((device_s, host_s))
+
+    @property
+    def host_occupation(self) -> float:
+        tot = self.device_s + self.host_s
+        return self.host_s / tot if tot > 0 else 0.0
+
+    def maybe_warn(self) -> None:
+        if (
+            not self._warned
+            and len(self._samples) >= 5
+            and self.host_occupation > self.warn_fraction
+            and not _quiet()
+        ):
+            self._warned = True
+            print(
+                f"Warning: the host spends {100 * self.host_occupation:.1f}% "
+                "of wall time on orchestration (decoding/printing/"
+                "checkpointing) while the device is idle. Consider "
+                "verbosity=0, progress=False, or a larger "
+                "ncycles_per_iteration.",
+                file=sys.stderr,
+            )
+
+
+class SearchProgress:
+    """Cycles/sec moving average + progress percentage.
+
+    The reference counts `num_equations += ncycles_per_iteration * npop / 10`
+    per finished island-iteration and averages over a 50 s window sampled
+    every 5 s (src/SymbolicRegression.jl:851,869-896). Here one sample is
+    recorded per host-loop iteration (= npopulations island-iterations)."""
+
+    WINDOW_S = 50.0
+
+    def __init__(self, total_iterations: int, options) -> None:
+        self.total = max(total_iterations, 1)
+        self.options = options
+        self.t0 = time.time()
+        self._samples: Deque[Tuple[float, float]] = deque()
+        self._equations = 0.0
+
+    def note_iteration(self, n_islands: int = 1) -> None:
+        self._equations += (
+            self.options.ncycles_per_iteration * self.options.npop / 10.0
+        ) * n_islands
+        now = time.time()
+        self._samples.append((now, self._equations))
+        while self._samples and now - self._samples[0][0] > self.WINDOW_S:
+            self._samples.popleft()
+
+    @property
+    def cycles_per_second(self) -> float:
+        if len(self._samples) < 2:
+            return 0.0
+        (t_a, e_a), (t_b, e_b) = self._samples[0], self._samples[-1]
+        return (e_b - e_a) / max(t_b - t_a, 1e-9)
+
+    def status_line(self, iteration: int, best_loss: float,
+                    num_evals: float) -> str:
+        pct = 100.0 * (iteration + 1) / self.total
+        return (
+            f"Cycles/second: {self.cycles_per_second:.3e}. "
+            f"Progress: {iteration + 1}/{self.total} ({pct:.0f}%). "
+            f"Best loss: {best_loss:.6g}. Evals: {num_evals:.3g}. "
+            f"Elapsed: {time.time() - self.t0:.1f}s."
+        )
+
+
+class ProgressBar:
+    """Minimal in-terminal bar with a multiline postfix (WrappedProgressBar
+    analog, reference src/ProgressBars.jl:11-37). Writes nothing when
+    SYMBOLIC_REGRESSION_TEST=true."""
+
+    def __init__(self, total: int, width: int = 40):
+        self.total = max(total, 1)
+        self.width = width
+        self._last_lines = 0
+
+    def update(self, done: int, postfix: str = "") -> None:
+        if _quiet():
+            return
+        frac = min(done / self.total, 1.0)
+        filled = int(frac * self.width)
+        bar = "#" * filled + "-" * (self.width - filled)
+        text = f"[{bar}] {done}/{self.total} ({100 * frac:.0f}%)"
+        if postfix:
+            text += "\n" + postfix
+        sys.stdout.write(text + "\n")
+        sys.stdout.flush()

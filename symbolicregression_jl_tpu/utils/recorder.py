@@ -1,0 +1,173 @@
+"""Search telemetry recorder — the analog of the reference's `@recorder`
+subsystem (reference src/Recorder.jl:6-20; enabled via `options.recorder`,
+default from env var PYSR_RECORDER, src/Options.jl:597-599).
+
+What the reference records (SURVEY.md §5): the options string, per-(output,
+island) per-iteration population snapshots (record_population,
+src/Population.jl:156-171), a mutation-lineage graph keyed by member `ref`
+ids, and the final hall of fame; merged head-side via recursive_merge
+(src/Utils.jl:41-51) and serialized to JSON with allow_inf at exit
+(src/SymbolicRegression.jl:923-927).
+
+TPU-native deviation: members live in device arrays without per-member ref
+ids (the hot loop is one fused XLA computation), so lineage is tracked at
+*snapshot* granularity: each member carries a content hash; a member's
+parent is inferred as the same-hash member of the previous snapshot
+(surviving member) or marked "new" (accepted mutation/crossover/migrant).
+This preserves the recorder's purpose — auditing how the population evolved
+— without forcing a host round-trip per mutation.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..models.options import Options
+from ..models.trees import TreeBatch, decode_tree, expr_to_string
+
+RecordType = Dict[str, Any]
+
+
+def recursive_merge(*dicts: RecordType) -> RecordType:
+    """Nested dict merge, later values win on conflicts at leaves
+    (reference src/Utils.jl:41-51)."""
+    out: RecordType = {}
+    for d in dicts:
+        for k, v in d.items():
+            if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+                out[k] = recursive_merge(out[k], v)
+            else:
+                out[k] = v
+    return out
+
+
+def _tree_hash(kind, op, feat, cval, length) -> str:
+    n = int(length)
+    h = hash(
+        (
+            tuple(np.asarray(kind[:n]).tolist()),
+            tuple(np.asarray(op[:n]).tolist()),
+            tuple(np.asarray(feat[:n]).tolist()),
+            tuple(np.round(np.asarray(cval[:n], np.float64), 12).tolist()),
+        )
+    )
+    return f"{h & 0xFFFFFFFFFFFFFFFF:016x}"
+
+
+class Recorder:
+    """Accumulates a RecordType dict; `save()` writes JSON (Infinity allowed,
+    mirroring the reference's allow_inf serialization)."""
+
+    def __init__(self, options: Options,
+                 variable_names: Optional[Sequence[str]] = None):
+        self.options = options
+        self.variable_names = variable_names
+        self.record: RecordType = {
+            "options": repr_options(options),
+            "start_time": time.time(),
+        }
+        # previous snapshot hashes per (output, island) for lineage inference
+        self._prev_hashes: Dict[str, set] = {}
+
+    # -- population snapshots ------------------------------------------------
+    def record_population(
+        self,
+        output: int,
+        island: int,
+        iteration: int,
+        trees: TreeBatch,
+        scores,
+        losses,
+        birth,
+    ) -> None:
+        """Analog of record_population (reference src/Population.jl:156-171),
+        plus snapshot-level lineage (survived / new)."""
+        key = f"out{output + 1}_pop{island + 1}"
+        npop = int(np.asarray(scores).shape[0])
+        scores = np.asarray(scores)
+        losses = np.asarray(losses)
+        birth = np.asarray(birth)
+        prev = self._prev_hashes.get(key, set())
+        members: List[RecordType] = []
+        cur: set = set()
+        for m in range(npop):
+            t = jax.tree_util.tree_map(lambda x: np.asarray(x[m]), trees)
+            ref = _tree_hash(t.kind, t.op, t.feat, t.cval, t.length)
+            eq = expr_to_string(
+                decode_tree(t), self.options.operators, self.variable_names
+            )
+            members.append(
+                {
+                    "ref": ref,
+                    "tree": eq,
+                    "score": float(scores[m]),
+                    "loss": float(losses[m]),
+                    "birth": int(birth[m]),
+                    # survivor of the previous snapshot keeps its ref;
+                    # otherwise an accepted mutation/crossover/migrant
+                    "parent": ref if ref in prev else "new",
+                }
+            )
+            cur.add(ref)
+        self._prev_hashes[key] = cur
+        self.record.setdefault(key, {})[f"iteration{iteration + 1}"] = {
+            "population": members,
+            "time": time.time(),
+        }
+
+    # -- hall of fame timeline ----------------------------------------------
+    def record_hall_of_fame(self, output: int, iteration: int,
+                            candidates) -> None:
+        key = f"out{output + 1}_hall_of_fame"
+        self.record.setdefault(key, {})[f"iteration{iteration + 1}"] = [
+            {
+                "complexity": c.complexity,
+                "loss": c.loss,
+                "score": c.score,
+                "equation": c.equation,
+            }
+            for c in candidates
+        ]
+
+    def record_final(self, num_evals: float, search_time_s: float) -> None:
+        self.record["num_evals"] = float(num_evals)
+        self.record["search_time_s"] = float(search_time_s)
+
+    def merge(self, other: RecordType) -> None:
+        self.record = recursive_merge(self.record, other)
+
+    def save(self, path: Optional[str] = None) -> str:
+        path = path or self.options.recorder_file
+        with open(path, "w") as f:
+            # json.dump emits bare Infinity/NaN tokens by default — the same
+            # non-strict JSON the reference writes with allow_inf
+            # (src/SymbolicRegression.jl:923-927).
+            json.dump(self.record, f)
+        return path
+
+
+def repr_options(options: Options) -> str:
+    """Stable single-line options string for the record header
+    (reference stores `"$(options)"`)."""
+    fields = []
+    for f in options.__dataclass_fields__:
+        v = getattr(options, f, None)
+        if callable(v):
+            v = getattr(v, "__name__", "<callable>")
+        fields.append(f"{f}={v!r}")
+    return "Options(" + ", ".join(fields) + ")"
+
+
+def find_iteration_from_record(key: str, record: RecordType) -> int:
+    """Highest recorded iteration index for a population key
+    (reference src/Recorder.jl:14-20)."""
+    i = 0
+    while f"iteration{i + 1}" in record.get(key, {}):
+        i += 1
+    return i

@@ -1,0 +1,139 @@
+"""Aux subsystems: recorder (analog of reference test/test_recorder.jl:24-46),
+progress/resource telemetry, custom full-tree loss_function
+(test/test_custom_objectives.jl:5-39), eval_diff_tree
+(test/test_derivatives.jl)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import symbolicregression_jl_tpu as sr
+from symbolicregression_jl_tpu.models.options import make_options
+from symbolicregression_jl_tpu.models.trees import encode_tree, parse_expression
+from symbolicregression_jl_tpu.ops.interpreter import eval_diff_tree, eval_tree
+from symbolicregression_jl_tpu.ops.operators import make_operator_set
+from symbolicregression_jl_tpu.utils.progress import (
+    ResourceMonitor,
+    SearchProgress,
+)
+from symbolicregression_jl_tpu.utils.recorder import (
+    Recorder,
+    find_iteration_from_record,
+    recursive_merge,
+)
+
+OPS = make_operator_set(["+", "-", "*", "/"], ["cos", "sin"])
+
+
+# --------------------------- recorder --------------------------------------
+
+
+def test_recorder_json_schema(tmp_path):
+    options = make_options(
+        binary_operators=["+", "*"], unary_operators=["cos"],
+        npop=8, npopulations=2, ncycles_per_iteration=8,
+        tournament_selection_n=4,
+        recorder=True, recorder_file=str(tmp_path / "rec.json"),
+        verbosity=0, progress=False,
+    )
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((2, 40)).astype(np.float32)
+    y = 2.0 * X[0]
+    sr.equation_search(X, y, options=options, niterations=2)
+    with open(options.recorder_file) as f:
+        rec = json.load(f)
+    assert "options" in rec
+    assert "out1_pop1" in rec and "iteration1" in rec["out1_pop1"]
+    members = rec["out1_pop1"]["iteration1"]["population"]
+    assert len(members) == options.npop
+    for m in members[:3]:
+        assert {"ref", "tree", "score", "loss", "birth", "parent"} <= set(m)
+    assert find_iteration_from_record("out1_pop1", rec) == 2
+    assert "out1_hall_of_fame" in rec
+    assert rec["num_evals"] > 0
+
+
+def test_recursive_merge():
+    a = {"x": {"p": 1}, "y": 2}
+    b = {"x": {"q": 3}, "z": 4}
+    m = recursive_merge(a, b)
+    assert m == {"x": {"p": 1, "q": 3}, "y": 2, "z": 4}
+
+
+# --------------------------- progress --------------------------------------
+
+
+def test_search_progress_cycles_per_second(monkeypatch):
+    options = make_options(binary_operators=["+"], npop=10,
+                           tournament_selection_n=5,
+                           ncycles_per_iteration=100)
+    prog = SearchProgress(10, options)
+    t = [1000.0]
+    monkeypatch.setattr("time.time", lambda: t[0])
+    prog.note_iteration()
+    t[0] += 2.0
+    prog.note_iteration()
+    # 100*10/10 = 100 equations per iteration; 100 per 2s = 50/s
+    assert prog.cycles_per_second == pytest.approx(50.0)
+    line = prog.status_line(1, 0.5, 123.0)
+    assert "Cycles/second" in line and "2/10" in line
+
+
+def test_resource_monitor_warns(capsys):
+    mon = ResourceMonitor(warn_fraction=0.2)
+    for _ in range(6):
+        mon.note(device_s=1.0, host_s=1.0)  # 50% host occupation
+    os.environ.pop("SYMBOLIC_REGRESSION_TEST", None)
+    try:
+        mon.maybe_warn()
+    finally:
+        os.environ["SYMBOLIC_REGRESSION_TEST"] = "true"
+    assert mon.host_occupation == pytest.approx(0.5)
+    assert "orchestration" in capsys.readouterr().err
+
+
+# --------------------------- custom loss_function ---------------------------
+
+
+def test_custom_loss_function_steers_search():
+    """Search with an objective rewarding f = 0.5*(x0 + x1)
+    (analog of reference test/test_custom_objectives.jl:5-39)."""
+
+    def loss_fn(tree, X, y, weights, options):
+        pred, ok = eval_tree(tree, X, options.operators)
+        target = 0.5 * (X[0] + X[1])
+        mse = jnp.mean((pred - target) ** 2)
+        return jnp.where(ok, mse, jnp.inf)
+
+    options = make_options(
+        binary_operators=["+", "*", "/"],
+        loss_function=loss_fn,
+        npop=24, npopulations=4, ncycles_per_iteration=60,
+        maxsize=12, verbosity=0, progress=False, seed=3,
+    )
+    rng = np.random.default_rng(0)
+    X = rng.uniform(-2, 2, (2, 64)).astype(np.float32)
+    y = np.zeros(64, np.float32)  # ignored by the custom objective
+    res = sr.equation_search(X, y, options=options, niterations=6)
+    assert res.best().loss < 1e-2
+
+
+# --------------------------- eval_diff -------------------------------------
+
+
+def test_eval_diff_matches_analytic():
+    expr = parse_expression("x0 * x0 + cos(x1)", OPS)
+    tree = jax.tree_util.tree_map(jnp.asarray, encode_tree(expr, 16))
+    rng = np.random.default_rng(1)
+    X = jnp.asarray(rng.standard_normal((2, 30)).astype(np.float32))
+    y, d0, ok = eval_diff_tree(tree, X, OPS, 0)
+    assert bool(ok)
+    np.testing.assert_allclose(np.asarray(d0), 2 * np.asarray(X[0]),
+                               rtol=1e-5)
+    _, d1, _ = eval_diff_tree(tree, X, OPS, 1)
+    np.testing.assert_allclose(np.asarray(d1), -np.sin(np.asarray(X[1])),
+                               rtol=1e-4, atol=1e-6)

@@ -1,0 +1,74 @@
+"""Loss library vs closed forms (analog of reference test/test_losses.jl:
+elementwise + weighted custom losses checked against closed-form values)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.ops.losses import (
+    LOSS_REGISTRY,
+    aggregate_loss,
+    resolve_loss,
+)
+
+
+def test_l2_closed_form():
+    f = LOSS_REGISTRY["L2DistLoss"]
+    pred = jnp.asarray([1.0, 2.0, 3.0])
+    targ = jnp.asarray([0.0, 2.0, 5.0])
+    np.testing.assert_allclose(np.asarray(f(pred, targ)), [1.0, 0.0, 4.0])
+
+
+def test_l1_closed_form():
+    f = LOSS_REGISTRY["L1DistLoss"]
+    pred = jnp.asarray([1.0, -2.0])
+    targ = jnp.asarray([0.0, 2.0])
+    np.testing.assert_allclose(np.asarray(f(pred, targ)), [1.0, 4.0])
+
+
+def test_huber_quadratic_then_linear():
+    f = LOSS_REGISTRY["HuberLoss"]  # delta=1
+    # |r|<=1: r^2/2 ; else delta*(|r| - delta/2)
+    r_small = np.asarray(f(jnp.asarray([0.5]), jnp.asarray([0.0])))
+    r_big = np.asarray(f(jnp.asarray([3.0]), jnp.asarray([0.0])))
+    np.testing.assert_allclose(r_small, [0.125])
+    np.testing.assert_allclose(r_big, [2.5])
+
+
+def test_quantile_pinball():
+    f = LOSS_REGISTRY["QuantileLoss"]  # tau = 0.5
+    over = np.asarray(f(jnp.asarray([2.0]), jnp.asarray([0.0])))
+    under = np.asarray(f(jnp.asarray([-2.0]), jnp.asarray([0.0])))
+    np.testing.assert_allclose(over, under)  # symmetric at tau=0.5
+
+
+def test_margin_losses_signs():
+    # margin losses consume agreement = pred*target
+    hinge = LOSS_REGISTRY["L1HingeLoss"]
+    assert float(hinge(jnp.asarray([2.0]), jnp.asarray([1.0]))[0]) == 0.0
+    assert float(hinge(jnp.asarray([-1.0]), jnp.asarray([1.0]))[0]) == 2.0
+
+
+def test_all_registered_losses_finite_on_generic_input():
+    pred = jnp.asarray([0.3, -1.2, 2.0, 0.0])
+    targ = jnp.asarray([1.0, -1.0, 1.0, -1.0])
+    for name, fn in LOSS_REGISTRY.items():
+        out = np.asarray(fn(pred, targ))
+        assert out.shape == (4,), name
+        assert np.all(np.isfinite(out)), name
+
+
+def test_weighted_aggregation():
+    elem = jnp.asarray([1.0, 3.0])
+    w = jnp.asarray([1.0, 3.0])
+    assert float(aggregate_loss(elem, None)) == pytest.approx(2.0)
+    assert float(aggregate_loss(elem, w)) == pytest.approx(2.5)
+
+
+def test_resolve_loss_accepts_callable_and_name():
+    fn = resolve_loss("L2DistLoss")
+    assert callable(fn)
+    custom = lambda p, t: (p - t) ** 4
+    assert resolve_loss(custom) is custom
+    with pytest.raises((KeyError, ValueError)):
+        resolve_loss("NoSuchLoss")

@@ -1,0 +1,92 @@
+"""Migration injects pool/HoF members into islands
+(analog of reference test/test_migration.jl:17-22)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from symbolicregression_jl_tpu.models.evolve import init_island_state
+from symbolicregression_jl_tpu.models.options import make_options
+from symbolicregression_jl_tpu.models.population import update_hall_of_fame
+from symbolicregression_jl_tpu.parallel.migration import (
+    merge_hofs_across_islands,
+    migrate,
+)
+
+
+def _states(options, nfeat=2, n_islands=3):
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((nfeat, 32)).astype(np.float32))
+    y = X[0] * 2.0
+    keys = jax.random.split(jax.random.PRNGKey(0), n_islands)
+    states = jax.vmap(
+        lambda k: init_island_state(k, options, nfeat, X, y, None, 1.0)
+    )(keys)
+    return states
+
+
+def test_migrate_replaces_expected_fraction():
+    options = make_options(
+        binary_operators=["+", "*"], npop=64, npopulations=3,
+        fraction_replaced=0.5, fraction_replaced_hof=0.0, topn=4,
+    )
+    states = _states(options)
+    ghof = merge_hofs_across_islands(states.hof)
+    before = np.asarray(states.pop.birth).copy()
+    out = migrate(jax.random.PRNGKey(1), states, ghof, options)
+    after = np.asarray(out.pop.birth)
+    frac = float((before != after).mean())
+    assert 0.3 < frac < 0.7  # ~Bernoulli(0.5)
+
+
+def test_migrated_members_come_from_pool():
+    options = make_options(
+        binary_operators=["+", "*"], npop=16, npopulations=2,
+        fraction_replaced=1.0, fraction_replaced_hof=0.0, topn=2,
+    )
+    states = _states(options, n_islands=2)
+    ghof = merge_hofs_across_islands(states.hof)
+    out = migrate(jax.random.PRNGKey(2), states, ghof, options)
+    # with fraction 1.0 every member must be one of the 2*topn pool members
+    pool_scores = []
+    for i in range(2):
+        order = np.argsort(np.asarray(states.pop.scores[i]))[:2]
+        pool_scores.extend(np.asarray(states.pop.scores[i])[order].tolist())
+    pool_scores = np.asarray([s for s in pool_scores if np.isfinite(s)])
+    new_scores = np.asarray(out.pop.scores).ravel()
+    finite = new_scores[np.isfinite(new_scores)]
+    dists = np.abs(finite[:, None] - pool_scores[None, :])
+    assert np.all(dists.min(axis=1) < 1e-5)
+
+
+def test_hof_migration_injects_frontier_members():
+    options = make_options(
+        binary_operators=["+", "*"], npop=16, npopulations=2,
+        fraction_replaced=0.0, fraction_replaced_hof=1.0,
+    )
+    states = _states(options, n_islands=2)
+    hofs = jax.vmap(
+        lambda h, t, s, l: update_hall_of_fame(h, t, s, l, options)
+    )(states.hof, states.pop.trees, states.pop.scores, states.pop.losses)
+    states = states._replace(hof=hofs)
+    ghof = merge_hofs_across_islands(states.hof)
+    assert bool(np.asarray(ghof.exists).any())
+    out = migrate(jax.random.PRNGKey(3), states, ghof, options)
+    hof_losses = np.asarray(ghof.losses)[np.asarray(ghof.exists)]
+    new_losses = np.asarray(out.pop.losses).ravel()
+    # every replaced slot carries a frontier loss value
+    dists = np.abs(new_losses[:, None] - hof_losses[None, :])
+    assert np.all(dists.min(axis=1) < 1e-5)
+
+
+def test_migration_disabled_is_identity():
+    options = make_options(
+        binary_operators=["+", "*"], npop=8, npopulations=2, migration=False,
+        tournament_selection_n=4,
+    )
+    states = _states(options, n_islands=2)
+    ghof = merge_hofs_across_islands(states.hof)
+    out = migrate(jax.random.PRNGKey(4), states, ghof, options)
+    np.testing.assert_array_equal(
+        np.asarray(out.pop.birth), np.asarray(states.pop.birth)
+    )

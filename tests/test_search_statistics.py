@@ -1,0 +1,56 @@
+"""Adaptive-parsimony window algebra
+(analog of reference test/test_search_statistics.jl:10-41)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from symbolicregression_jl_tpu.models.parsimony import (
+    init_search_statistics,
+    move_window,
+    normalize_frequencies,
+    update_frequencies,
+)
+
+
+def test_init_all_ones():
+    stats = init_search_statistics(10)
+    np.testing.assert_allclose(np.asarray(stats.frequencies), np.ones(10))
+
+
+def test_update_scatter_adds():
+    stats = init_search_statistics(5)
+    stats = update_frequencies(stats, jnp.asarray([1, 1, 3, 5]))
+    np.testing.assert_allclose(
+        np.asarray(stats.frequencies), [3.0, 1.0, 2.0, 1.0, 2.0]
+    )
+
+
+def test_update_drops_out_of_range():
+    stats = init_search_statistics(3)
+    stats = update_frequencies(stats, jnp.asarray([0, 4, -2, 2]))
+    np.testing.assert_allclose(np.asarray(stats.frequencies), [1.0, 2.0, 1.0])
+
+
+def test_move_window_preserves_total_at_cap():
+    stats = init_search_statistics(4)
+    stats = stats._replace(window_size=8.0)
+    for _ in range(5):
+        stats = update_frequencies(stats, jnp.asarray([2, 2, 2, 2]))
+    stats = move_window(stats)
+    assert float(jnp.sum(stats.frequencies)) == np.float32(8.0)
+    # bin 2 must remain the most frequent after the shave
+    f = np.asarray(stats.frequencies)
+    assert f[1] == f.max()
+
+
+def test_move_window_noop_below_cap():
+    stats = init_search_statistics(4)  # total 4 << window
+    before = np.asarray(stats.frequencies).copy()
+    after = np.asarray(move_window(stats).frequencies)
+    np.testing.assert_allclose(before, after)
+
+
+def test_normalized_sums_to_one():
+    stats = init_search_statistics(6)
+    stats = update_frequencies(stats, jnp.asarray([1, 2, 3]))
+    assert float(jnp.sum(normalize_frequencies(stats))) == np.float32(1.0)
