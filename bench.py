@@ -53,9 +53,32 @@ def _build_workload(jax, jnp, options, n_trees, n_feat):
     return trees
 
 
-def _time_backend(jax, jnp, options, device, n_trees, label, verbose):
+def _dispatch_overhead_s(jax, jnp, device):
+    """Fixed cost of one dispatch+fetch round trip on `device`. On tunneled
+    TPU transports this is tens of milliseconds and would otherwise dominate
+    any single-dispatch timing."""
+    with jax.default_device(device):
+        f = jax.jit(lambda x: jnp.sum(x * 2.0))
+        x = jnp.ones((8, 128), jnp.float32)
+        float(f(x))
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(f(x))
+            ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def _time_backend(jax, jnp, options, device, n_trees, n_inner, label,
+                  verbose):
     """Score n_trees random trees against the Feynman-I.6.2a dataset on
-    `device`; return trees-rows/sec."""
+    `device`; return trees-rows/sec.
+
+    The scoring step runs `n_inner` times INSIDE one jit (constants
+    perturbed per iteration so no computation can be reused) and the fixed
+    dispatch overhead — measured separately — is subtracted; a single
+    dispatch through a tunneled TPU transport costs ~70 ms, which would
+    swamp the kernel."""
     from symbolicregression_jl_tpu.models.fitness import score_trees
 
     n_feat = 1
@@ -64,46 +87,40 @@ def _time_backend(jax, jnp, options, device, n_trees, label, verbose):
     X_h = theta[None, :]
     y_h = (np.exp(-(theta**2) / 2.0) / np.sqrt(2 * np.pi)).astype(np.float32)
 
+    overhead = _dispatch_overhead_s(jax, jnp, device)
     with jax.default_device(device):
         trees = _build_workload(jax, jnp, options, n_trees, n_feat)
         X = jnp.asarray(X_h)
         y = jnp.asarray(y_h)
         baseline = jnp.float32(float(np.var(y_h)))
 
-        # The jitted step returns one scalar so each rep ends with a real
-        # device->host transfer: block_until_ready alone can return early on
-        # async transport backends, yielding bogus sub-ms timings.
-        def step(t, X, y, b):
-            scores, losses = score_trees(t, X, y, None, b, options)
-            finite = jnp.isfinite(scores)
-            return jnp.sum(jnp.where(finite, scores, 0.0)), jnp.sum(finite)
+        def body(i, acc):
+            t = trees._replace(cval=trees.cval + acc * 1e-12)
+            scores, _ = score_trees(t, X, y, None, baseline, options)
+            # bounded accumulator: keeps each iteration data-dependent on
+            # the last without ever overflowing f32
+            good = jnp.where(jnp.isfinite(scores), scores, 0.0)
+            return acc + jnp.clip(jnp.mean(good), 0.0, 1.0)
 
-        fn = jax.jit(step)
-        n_chunks = max(1, n_trees // CHUNK)
-        chunks = [
-            jax.tree_util.tree_map(
-                lambda x: x[i * CHUNK:(i + 1) * CHUNK], trees
-            )
-            for i in range(n_chunks)
-        ]
-        # warmup / compile
-        float(fn(chunks[0], X, y, baseline)[0])
+        fn = jax.jit(
+            lambda: jax.lax.fori_loop(0, n_inner, body, jnp.float32(0.0))
+        )
+        total = float(fn())  # warmup / compile
+        assert np.isfinite(total)
 
         times = []
         for _ in range(REPS):
             t0 = time.perf_counter()
-            outs = [fn(c, X, y, baseline) for c in chunks]
-            total = sum(float(s) for s, _ in outs)  # forces full sync
+            float(fn())  # scalar fetch forces a full sync
             times.append(time.perf_counter() - t0)
-        best = float(np.median(times))
-        assert np.isfinite(total)
+        per_iter = max((float(np.median(times)) - overhead) / n_inner, 1e-9)
 
-    done_trees = n_chunks * min(CHUNK, n_trees)
-    rate = done_trees * N_ROWS / best
+    rate = n_trees * N_ROWS / per_iter
     if verbose:
         print(
-            f"# {label}: {done_trees} trees x {N_ROWS} rows in {best*1e3:.1f} ms "
-            f"-> {rate:.3e} trees-rows/s",
+            f"# {label}: {n_trees} trees x {N_ROWS} rows x {n_inner} iters, "
+            f"{per_iter*1e3:.1f} ms/iter (dispatch overhead "
+            f"{overhead*1e3:.0f} ms subtracted) -> {rate:.3e} trees-rows/s",
             file=sys.stderr,
         )
     return rate
@@ -128,7 +145,8 @@ def main(verbose=True):
     n_trees = N_POPULATIONS * NPOP
 
     value = _time_backend(
-        jax, jnp, options, main_dev, n_trees, f"main ({platform})", verbose
+        jax, jnp, options, main_dev, min(n_trees, CHUNK), 20,
+        f"main ({platform})", verbose,
     )
 
     # CPU anchor (dispatch_eval auto-routes to the jnp path under
@@ -138,7 +156,7 @@ def main(verbose=True):
         try:
             cpu_dev = jax.devices("cpu")[0]
             cpu_rate = _time_backend(
-                jax, jnp, options, cpu_dev, min(n_trees, 8192),
+                jax, jnp, options, cpu_dev, min(n_trees, 8192), 1,
                 "cpu anchor", verbose,
             )
         except Exception as e:  # pragma: no cover

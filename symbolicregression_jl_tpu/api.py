@@ -270,9 +270,9 @@ def equation_search(
     # The recorder materializes every island population on the host each
     # iteration — single-controller only (multi-host shards are not
     # addressable from one process).
-    record_here = options.recorder and is_primary_host()
-    if options.recorder and jax.process_count() > 1:
-        record_here = False
+    record_here = (
+        options.recorder and is_primary_host() and jax.process_count() == 1
+    )
     recorder = Recorder(options, variable_names) if record_here else None
     total_its = niterations * max(ys.shape[0], 1)
     progress = SearchProgress(total_its, options)
@@ -282,24 +282,7 @@ def equation_search(
 
     for j in range(ys.shape[0]):
         ds = make_dataset(X, ys[j], weights, variable_names)
-        if options.loss_function is not None:
-            # Baseline = custom objective on the constant predictor avg_y
-            # (reference dispatches eval_loss -> loss_function for the
-            # baseline member too, src/LossFunctions.jl:60-67,122-126).
-            from .models.trees import Expr, encode_tree
-
-            const_tree = encode_tree(
-                Expr.const(float(ds.avg_y)), options.max_len
-            )
-            const_tree = jax.tree_util.tree_map(jnp.asarray, const_tree)
-            base = float(
-                options.loss_function(
-                    const_tree, ds.X, ds.y, ds.weights, options
-                )
-            )
-            ds.baseline_loss = base if np.isfinite(base) and base > 0 else 1.0
-        else:
-            ds = update_baseline_loss(ds, options.elementwise_loss)
+        ds = update_baseline_loss(ds, options)
         Xj, yj, wj = shard_dataset(ds.X, ds.y, ds.weights, mesh, options)
 
         master_key = jax.random.PRNGKey(options.seed + 7919 * j)

@@ -68,16 +68,42 @@ def make_dataset(
     return Dataset(X=X, y=y, weights=w, variable_names=names, avg_y=avg_y)
 
 
-def update_baseline_loss(dataset: Dataset, elementwise_loss) -> Dataset:
+def update_baseline_loss(dataset: Dataset, options_or_loss) -> Dataset:
     """Score the constant predictor avg_y
-    (reference src/LossFunctions.jl:122-126)."""
-    pred = jnp.full_like(dataset.y, dataset.avg_y)
-    elem = elementwise_loss(pred, dataset.y)
-    if dataset.weights is None:
-        base = float(jnp.mean(elem))
-    else:
-        base = float(
-            jnp.sum(elem * dataset.weights) / jnp.sum(dataset.weights)
+    (reference src/LossFunctions.jl:122-126).
+
+    Accepts either an elementwise loss callable or an Options; with an
+    Options whose loss_function is set, the baseline goes through the
+    custom full-tree objective on an encoded constant tree (the reference
+    dispatches eval_loss -> loss_function for the baseline member too,
+    src/LossFunctions.jl:60-67)."""
+    loss_function = getattr(options_or_loss, "loss_function", None)
+    if loss_function is not None:
+        from .trees import Expr, encode_tree
+
+        options = options_or_loss
+        const_tree = jax.tree_util.tree_map(
+            jnp.asarray,
+            encode_tree(Expr.const(float(dataset.avg_y)), options.max_len),
         )
+        base = float(
+            loss_function(
+                const_tree, dataset.X, dataset.y, dataset.weights, options
+            )
+        )
+    else:
+        elementwise_loss = (
+            options_or_loss.elementwise_loss
+            if hasattr(options_or_loss, "elementwise_loss")
+            else options_or_loss
+        )
+        pred = jnp.full_like(dataset.y, dataset.avg_y)
+        elem = elementwise_loss(pred, dataset.y)
+        if dataset.weights is None:
+            base = float(jnp.mean(elem))
+        else:
+            base = float(
+                jnp.sum(elem * dataset.weights) / jnp.sum(dataset.weights)
+            )
     dataset.baseline_loss = base if np.isfinite(base) and base > 0 else 1.0
     return dataset

@@ -1,26 +1,37 @@
-"""Pallas TPU kernel: batched postfix-tree interpreter with scalar dispatch.
+"""Pallas TPU kernel: batched postfix-tree interpreter.
 
 This is the hot kernel of the framework (SURVEY.md §7 decision 2) — the
-TPU-native replacement for DynamicExpressions' fused eval loops. Unlike the
-portable jnp path (ops/interpreter.py), which must compute EVERY operator on
-every node and select (vmap lockstep), this kernel reads each node's opcode
-from SMEM and executes exactly ONE operator per node via `lax.switch` on a
-scalar — the same work per node as the reference's native CPU loop, but on
-8x128 VPU lanes with the dataset resident in VMEM.
+TPU-native replacement for DynamicExpressions' fused eval loops (reference
+wraps them at src/InterfaceDynamicExpressions.jl:17-52).
 
-Layout per grid cell (i, j):
-  trees block i : opcode/operand tables in SMEM (int32/f32, tiny). Tables
-                  are stored transposed, (L, t_block), because SMEM pads
-                  each major row to 1 KiB: with trees on the minor axis a
-                  (24, 256) table costs 24 KiB instead of the 256 KiB of
-                  its (256, 24) transpose (which OOMs the 1 MiB SMEM).
-  rows block j  : X rows in VMEM,
-  stack         : (depth, R_BLK) f32 VMEM scratch, reused across the block's
-                  trees; per-row NaN/Inf poison is accumulated elementwise
-                  and reduced to a per-tree badness count.
+Design, in order of what made it fast on real hardware:
 
-Short trees cost only `length` steps (dynamic fori_loop) — no padded work,
-unlike the jnp path.
+1. **Precomputed operand schedule.** A postfix stack machine carries a
+   scalar stack pointer from slot to slot — a scalar dependency chain that
+   Mosaic cannot pipeline (measured ~800 ns/slot with `lax.switch`
+   dispatch). But the stack layout is fully determined by the opcodes, so
+   the wrapper precomputes, per (tree, slot), WHERE that slot's operands
+   live (`lidx`/`ridx` into a value array) with a vectorized jnp scan.
+   The kernel step then has no carried scalars at all: read operands at
+   SMEM-supplied indices, compute, write slot value.
+2. **Branchless op dispatch.** Instead of `lax.switch` (real branches,
+   pipeline flushes), every operator is computed on the operands and the
+   result selected by a chain of scalar-predicate `where`s — ~n_ops vector
+   ops per slot, all pipelineable. (The lockstep jnp interpreter pays the
+   same n_ops factor but on *padded* slots; here short trees stop at their
+   own length.)
+3. **Full-vreg row tiles.** Rows live on BOTH sublanes and lanes as
+   (r_sub, 128) tiles, so each op runs on full 8x128 vregs.
+4. **SMEM table transpose.** Per-tree tables are (L, t_block), trees on
+   the minor axis: SMEM pads each major row to 1 KiB, so the transposed
+   layout costs 24 KiB per table instead of 256 KiB (which OOMs the 1 MiB
+   SMEM on v5e).
+
+Layout per grid cell (i, j): trees block i (SMEM tables), rows block j
+(VMEM (r_sub, 128) tiles), values scratch (L, r_sub, 128) VMEM reused
+across the block's trees. Per-row NaN/Inf poison is accumulated elementwise
+and reduced to a per-tree badness count (the analog of the reference's
+`complete=false` early exit).
 
 Opcodes are pre-fused into a single program code:
   0 = PAD, 1 = CONST, 2 = VAR, 3..3+U-1 = unary ops, 3+U.. = binary ops.
@@ -62,90 +73,106 @@ def fuse_opcodes(trees: TreeBatch, operators: OperatorSet) -> Array:
     ).astype(jnp.int32)
 
 
+def operand_schedule(kind: Array):
+    """Per-slot operand locations for the postfix program.
+
+    Simulates the evaluation stack over the slot axis with a vectorized
+    scan (int ops only, batched over trees): returns (lidx, ridx), the
+    value-array slots holding each node's left/right operand (unary ops use
+    ridx; leaves ignore both). This hoists ALL stack bookkeeping out of the
+    TPU kernel, whose steps then carry no scalar state.
+
+    kind: (..., L) int32. Returns int32 arrays of the same shape."""
+    from ..models.trees import ARITY
+
+    arity = jnp.asarray(ARITY)[kind]  # (..., L)
+    L = kind.shape[-1]
+    depth = L // 2 + 2
+
+    def step(stack_sp, inputs):
+        stack, sp = stack_sp  # stack: (..., depth) int32, sp: (...,) int32
+        si, ar = inputs
+        top = jnp.clip(sp - 1, 0, depth - 1)
+        sec = jnp.clip(sp - 2, 0, depth - 1)
+        ridx = jnp.take_along_axis(stack, top[..., None], axis=-1)[..., 0]
+        lidx = jnp.take_along_axis(stack, sec[..., None], axis=-1)[..., 0]
+        is_pad = ar < 0
+        new_sp = jnp.where(is_pad, sp, sp - jnp.maximum(ar, 0) + 1)
+        w = jnp.clip(new_sp - 1, 0, depth - 1)
+        new_stack = jnp.where(
+            (jnp.arange(depth) == w[..., None]) & ~is_pad[..., None],
+            si[..., None],
+            stack,
+        )
+        return (new_stack, new_sp), (lidx, ridx)
+
+    batch_shape = kind.shape[:-1]
+    init = (
+        jnp.zeros(batch_shape + (depth,), jnp.int32),
+        jnp.zeros(batch_shape, jnp.int32),
+    )
+    sis = jnp.arange(L, dtype=jnp.int32)
+    # PAD gets arity -1 so the stack is left untouched
+    ar_seq = jnp.moveaxis(jnp.where(kind == PAD, -1, arity), -1, 0)
+    si_seq = jnp.broadcast_to(
+        sis.reshape((L,) + (1,) * len(batch_shape)), (L,) + batch_shape
+    )
+    _, (lidx, ridx) = jax.lax.scan(step, init, (si_seq, ar_seq))
+    return jnp.moveaxis(lidx, 0, -1), jnp.moveaxis(ridx, 0, -1)
+
+
 def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
-                 depth: int, max_len: int):
+                 max_len: int):
     from jax.experimental import pallas as pl  # noqa: PLC0415
-    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
 
     unary_fns = operators.unary_fns
     binary_fns = operators.binary_fns
     U = len(unary_fns)
+    r_sub = r_block // 128
 
-    def kernel(nrows_ref, pcode_ref, feat_ref, length_ref, cval_ref,  # SMEM
-               X_ref, out_ref, bad_ref,  # VMEM / SMEM out
-               stack_ref):  # scratch VMEM (depth, r_block)
-        # SMEM tables are transposed: [slot, tree] (see module docstring).
+    def kernel(nrows_ref, pcode_ref, feat_ref, length_ref, cval_ref,
+               lidx_ref, ridx_ref,  # SMEM, transposed (L, t_block)
+               X_ref, out_ref, bad_ref,  # VMEM in / VMEM out / SMEM out
+               val_ref):  # scratch VMEM (max_len, r_sub, 128)
         # row-validity mask: padded tail rows must not poison the tree
-        col = jax.lax.broadcasted_iota(jnp.int32, (1, r_block), 1)
-        row_valid = (pl.program_id(1) * r_block + col) < nrows_ref[0]
-        valid_f = jnp.where(row_valid, 1.0, 0.0)
+        sub = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 0)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 1)
+        row = (pl.program_id(1) * r_sub + sub) * 128 + lane
+        valid_f = jnp.where(row < nrows_ref[0], 1.0, 0.0)
 
         def tree_body(ti, _):
             n = length_ref[0, ti]
-
-            def slot_body(si, carry):
-                sp, bad = carry  # sp: int32; bad: (1, r_block) f32
+            # Fully-unrolled static slot loop: straight-line code with no
+            # per-slot branch lets the compiler overlap SMEM loads and
+            # vector ops across slots. PAD slots (code 0) execute but are
+            # masked out of the poison flag and never read as operands.
+            bad = jnp.zeros((r_sub, 128), jnp.float32)
+            for si in range(max_len):
                 code = pcode_ref[si, ti]
-
-                a_idx = jnp.maximum(sp - 1, 0)
-                b_idx = jnp.maximum(sp - 2, 0)
-
-                def br_pad():
-                    return stack_ref[pl.ds(a_idx, 1), :]
-
-                def br_const():
-                    return jnp.full(
-                        (1, r_block), cval_ref[si, ti], dtype=jnp.float32
-                    )
-
-                def br_var():
-                    f = feat_ref[si, ti]
-                    return X_ref[pl.ds(f, 1), :]
-
-                def mk_unary(fn):
-                    def br():
-                        a = stack_ref[pl.ds(a_idx, 1), :]
-                        return fn(a)
-
-                    return br
-
-                def mk_binary(fn):
-                    def br():
-                        a = stack_ref[pl.ds(a_idx, 1), :]  # right operand
-                        b = stack_ref[pl.ds(b_idx, 1), :]  # left operand
-                        return fn(b, a)
-
-                    return br
-
-                branches = (
-                    [br_pad, br_const, br_var]
-                    + [mk_unary(fn) for fn in unary_fns]
-                    + [mk_binary(fn) for fn in binary_fns]
+                a = val_ref[ridx_ref[si, ti]]  # top of stack: right operand
+                b = val_ref[lidx_ref[si, ti]]  # second: left operand
+                x = X_ref[feat_ref[si, ti]]
+                v = jnp.where(
+                    code == 1,
+                    jnp.full((r_sub, 128), cval_ref[si, ti], jnp.float32),
+                    x,
                 )
-                v = jax.lax.switch(code, branches)
-
-                is_leaf = (code == 1) | (code == 2)
-                is_una = (code >= 3) & (code < 3 + U)
-                arity = jnp.where(is_leaf, 0, jnp.where(is_una, 1, 2))
-                new_sp = jnp.where(code == 0, sp, sp - arity + 1)
-                w = jnp.maximum(new_sp - 1, 0)
-                stack_ref[pl.ds(w, 1), :] = v
+                for k, fn in enumerate(unary_fns):
+                    v = jnp.where(code == 3 + k, fn(a), v)
+                for k, fn in enumerate(binary_fns):
+                    v = jnp.where(code == 3 + U + k, fn(b, a), v)
+                val_ref[si] = v
                 bad = jnp.maximum(
-                    bad, jnp.where(jnp.isfinite(v), 0.0, valid_f)
+                    bad,
+                    jnp.where(jnp.isfinite(v) | (code == 0), 0.0, valid_f),
                 )
-                return new_sp, bad
-
-            bad0 = jnp.zeros((1, r_block), jnp.float32)
-            sp, bad = jax.lax.fori_loop(
-                0, n, slot_body, (jnp.int32(0), bad0)
-            )
-            out_ref[pl.ds(ti, 1), :] = stack_ref[0:1, :]
+            out_ref[ti] = val_ref[jnp.maximum(n - 1, 0)]
             bad_ref[0, ti] = jnp.sum(bad)
             return 0
 
         jax.lax.fori_loop(0, t_block, tree_body, 0)
 
-    return kernel, pl, pltpu
+    return kernel
 
 
 def _round_up(x: int, m: int) -> int:
@@ -181,54 +208,60 @@ def eval_trees_pallas(
 
     t_block = min(t_block, max(T, 8))
     r_block = min(r_block, _round_up(nrows, 128))
+    r_sub = r_block // 128
     T_pad = _round_up(T, t_block)
     R_pad = _round_up(nrows, r_block)
+    NR = R_pad // 128  # row tiles of 128 lanes
 
-    # tables transposed to (L, T_pad): SMEM pads each major row to 1 KiB,
-    # so the tree index must live on the minor axis (see module docstring)
-    pcode = fuse_opcodes(flat, operators)
-    pcode = jnp.pad(pcode, ((0, T_pad - T), (0, 0))).T
-    feat = jnp.pad(flat.feat, ((0, T_pad - T), (0, 0))).T
+    # tables transposed to (L, T_pad) — see module docstring point 4
+    def padT(x, fill=0):
+        return jnp.pad(x, ((0, T_pad - T), (0, 0)),
+                       constant_values=fill).T
+
+    pcode = padT(fuse_opcodes(flat, operators))
+    feat = padT(flat.feat)
+    lidx, ridx = operand_schedule(flat.kind)
+    lidx, ridx = padT(lidx), padT(ridx)
     length = jnp.pad(flat.length, (0, T_pad - T))[None, :]
-    cval = jnp.pad(
-        flat.cval.astype(jnp.float32), ((0, T_pad - T), (0, 0))
-    ).T
+    cval = padT(flat.cval.astype(jnp.float32))
+    # rows folded to (..., NR, 128) tiles — see module docstring point 3
     Xp = jnp.pad(X.astype(jnp.float32), ((0, 0), (0, R_pad - nrows)))
+    Xp = Xp.reshape(nfeat, NR, 128)
     nrows_arr = jnp.asarray([nrows], jnp.int32)
 
-    depth = L // 2 + 2
-    kernel, _, _ = _make_kernel(operators, t_block, r_block, depth, L)
+    kernel = _make_kernel(operators, t_block, r_block, L)
 
-    grid = (T_pad // t_block, R_pad // r_block)
+    grid = (T_pad // t_block, NR // r_sub)
+    smem_spec = lambda shape, imap: pl.BlockSpec(
+        shape, imap, memory_space=pltpu.SMEM
+    )
+    tree_tbl = lambda: smem_spec((L, t_block), lambda i, j: (0, i))
     y, bad = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # nrows scalar
-            pl.BlockSpec((L, t_block), lambda i, j: (0, i),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((L, t_block), lambda i, j: (0, i),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, t_block), lambda i, j: (0, i),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((L, t_block), lambda i, j: (0, i),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((nfeat, r_block), lambda i, j: (0, j)),
+            tree_tbl(),  # pcode
+            tree_tbl(),  # feat
+            smem_spec((1, t_block), lambda i, j: (0, i)),  # length
+            tree_tbl(),  # cval
+            tree_tbl(),  # lidx
+            tree_tbl(),  # ridx
+            pl.BlockSpec((nfeat, r_sub, 128), lambda i, j: (0, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((t_block, r_block), lambda i, j: (i, j)),
-            pl.BlockSpec((1, t_block), lambda i, j: (j, i),
-                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((t_block, r_sub, 128), lambda i, j: (i, j, 0)),
+            smem_spec((1, t_block), lambda i, j: (j, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((T_pad, R_pad), jnp.float32),
+            jax.ShapeDtypeStruct((T_pad, NR, 128), jnp.float32),
             jax.ShapeDtypeStruct((grid[1], T_pad), jnp.float32),
         ],
-        scratch_shapes=[pltpu.VMEM((depth, r_block), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((L, r_sub, 128), jnp.float32)],
         interpret=interpret,
-    )(nrows_arr, pcode, feat, length, cval, Xp)
+    )(nrows_arr, pcode, feat, length, cval, lidx, ridx, Xp)
 
-    y = y[:T, :nrows]
+    y = y.reshape(T_pad, R_pad)[:T, :nrows]
     ok = (jnp.sum(bad[:, :T], axis=0) == 0) & (flat.length > 0)
     return (
         y.reshape(batch_shape + (nrows,)),

@@ -110,9 +110,10 @@ class SearchProgress:
 
 
 class ProgressBar:
-    """Minimal in-terminal bar with a multiline postfix (WrappedProgressBar
-    analog, reference src/ProgressBars.jl:11-37). Writes nothing when
-    SYMBOLIC_REGRESSION_TEST=true."""
+    """In-terminal bar with a multiline postfix (WrappedProgressBar analog,
+    reference src/ProgressBars.jl:11-37). Rewinds and overwrites its
+    previous output on TTYs; appends plainly when piped. Writes nothing
+    when SYMBOLIC_REGRESSION_TEST=true."""
 
     def __init__(self, total: int, width: int = 40):
         self.total = max(total, 1)
@@ -128,5 +129,9 @@ class ProgressBar:
         text = f"[{bar}] {done}/{self.total} ({100 * frac:.0f}%)"
         if postfix:
             text += "\n" + postfix
+        if self._last_lines and sys.stdout.isatty():
+            # move up over the previous render and clear each line
+            sys.stdout.write(f"\x1b[{self._last_lines}F\x1b[0J")
         sys.stdout.write(text + "\n")
         sys.stdout.flush()
+        self._last_lines = text.count("\n") + 1

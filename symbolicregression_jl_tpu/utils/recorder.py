@@ -89,15 +89,17 @@ class Recorder:
         """Analog of record_population (reference src/Population.jl:156-171),
         plus snapshot-level lineage (survived / new)."""
         key = f"out{output + 1}_pop{island + 1}"
-        npop = int(np.asarray(scores).shape[0])
+        # one device->host transfer for the whole island, sliced on host
+        trees_np = jax.tree_util.tree_map(np.asarray, trees)
         scores = np.asarray(scores)
         losses = np.asarray(losses)
         birth = np.asarray(birth)
+        npop = int(scores.shape[0])
         prev = self._prev_hashes.get(key, set())
         members: List[RecordType] = []
         cur: set = set()
         for m in range(npop):
-            t = jax.tree_util.tree_map(lambda x: np.asarray(x[m]), trees)
+            t = jax.tree_util.tree_map(lambda x: x[m], trees_np)
             ref = _tree_hash(t.kind, t.op, t.feat, t.cval, t.length)
             eq = expr_to_string(
                 decode_tree(t), self.options.operators, self.variable_names
