@@ -171,8 +171,8 @@ def main(verbose=True):
             {
                 "metric": (
                     "population fitness-eval throughput, Feynman-I.6.2a "
-                    f"(64x1000 trees, {N_ROWS} rows, maxsize {MAXSIZE}, "
-                    f"platform {platform})"
+                    f"({min(n_trees, CHUNK)} trees/batch x {N_ROWS} rows, "
+                    f"maxsize {MAXSIZE}, platform {platform})"
                 ),
                 "value": round(value, 1),
                 "unit": "trees-rows/sec/chip",

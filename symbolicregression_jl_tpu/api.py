@@ -22,6 +22,7 @@ reference's per-output populations (src/SymbolicRegression.jl:308-315).
 from __future__ import annotations
 
 import dataclasses
+import sys
 import time
 from typing import Any, Callable, List, Optional, Sequence, Union
 
@@ -34,8 +35,8 @@ from .models.dataset import Dataset, make_dataset, update_baseline_loss
 from .models.evolve import (
     IslandState,
     init_island_state,
-    s_r_cycle,
-    simplify_population,
+    s_r_cycle_islands,
+    simplify_population_islands,
 )
 from .models.options import Options, make_options
 from .models.population import HallOfFame, update_hall_of_fame
@@ -92,7 +93,7 @@ class EquationSearchResult:
         self, X, output: int = 0, complexity: Optional[int] = None
     ):
         cand = self._pick(output, complexity)
-        X = jnp.asarray(X, jnp.float32)
+        X = jnp.asarray(X, self.options.dtype)
         tree = jax.tree_util.tree_map(jnp.asarray, cand.tree)
         y, ok = eval_tree(tree, X, self.options.operators)
         return np.asarray(y)
@@ -148,16 +149,14 @@ def _make_iteration_fn(options: Options, has_weights: bool):
         baseline: Array,
     ):
         k_mig, k_opt = jax.random.split(key)
-        states = jax.vmap(
-            lambda st: s_r_cycle(
-                st, curmaxsize, X, y, weights, baseline, options
-            )
-        )(states)
-        states = jax.vmap(
-            lambda st: simplify_population(
-                st, curmaxsize, X, y, weights, baseline, options
-            )
-        )(states)
+        # all-island fused forms: one interpreter call per cycle across the
+        # whole archipelago (Pallas-sized batches on TPU)
+        states = s_r_cycle_islands(
+            states, curmaxsize, X, y, weights, baseline, options
+        )
+        states = simplify_population_islands(
+            states, curmaxsize, X, y, weights, baseline, options
+        )
         if options.should_optimize_constants and options.optimizer_probability > 0:
             I = states.birth_counter.shape[0]
             okeys = jax.random.split(k_opt, I)
@@ -192,7 +191,8 @@ def _make_init_fn(options: Options, nfeatures: int, has_weights: bool):
     def init(keys, X, y, weights, baseline):
         return jax.vmap(
             lambda k: init_island_state(
-                k, options, nfeatures, X, y, weights, baseline
+                k, options, nfeatures, X, y, weights, baseline,
+                dtype=options.dtype,
             )
         )(keys)
 
@@ -243,8 +243,18 @@ def equation_search(
     elif option_kwargs:
         raise ValueError("Pass either options= or option kwargs, not both")
 
-    X = np.asarray(X, np.float32)
-    y = np.asarray(y, np.float32)
+    if options.precision == "float64" and not jax.config.jax_enable_x64:
+        # The reference's Float64 mode. jax_enable_x64 is process-global and
+        # intentionally NOT restored afterwards: the returned trees/arrays
+        # (and result.predict) are float64 and need it to stay on.
+        print(
+            "precision='float64': enabling jax_enable_x64 for this process",
+            file=sys.stderr,
+        )
+        jax.config.update("jax_enable_x64", True)
+    host_dtype = np.float64 if options.precision == "float64" else np.float32
+    X = np.asarray(X, host_dtype)
+    y = np.asarray(y, host_dtype)
     if X.ndim != 2:
         raise ValueError("X must be (nfeatures, n)")
     multi = y.ndim == 2
@@ -281,7 +291,9 @@ def equation_search(
     global_it = 0  # host-loop iterations completed across all outputs
 
     for j in range(ys.shape[0]):
-        ds = make_dataset(X, ys[j], weights, variable_names)
+        ds = make_dataset(
+            X, ys[j], weights, variable_names, dtype=options.dtype
+        )
         ds = update_baseline_loss(ds, options)
         Xj, yj, wj = shard_dataset(ds.X, ds.y, ds.weights, mesh, options)
 
@@ -294,7 +306,7 @@ def equation_search(
             k_init, master_key = jax.random.split(master_key)
             init_keys = jax.random.split(k_init, I)
             init_fn = _make_init_fn(options, nfeatures, wj is not None)
-            bl = jnp.float32(ds.baseline_loss)
+            bl = jnp.asarray(ds.baseline_loss, options.dtype)
             if wj is not None:
                 states = init_fn(init_keys, Xj, yj, wj, bl)
             else:
@@ -308,7 +320,7 @@ def equation_search(
             it = start_iter + step
             cm = jnp.int32(_curmaxsize(options, it, max(niterations, 1)))
             master_key, k_it = jax.random.split(master_key)
-            baseline = jnp.float32(ds.baseline_loss)
+            baseline = jnp.asarray(ds.baseline_loss, options.dtype)
             t_dev = time.time()
             if wj is not None:
                 states, ghof = iteration_fn(

@@ -36,6 +36,7 @@ from .constraints import check_constraints_single
 from .fitness import sample_batch_idx, score_trees
 from .mutate_device import (
     append_random_op,
+    combine_operators,
     crossover_trees,
     delete_random_op,
     gen_random_tree_fixed_size,
@@ -152,6 +153,10 @@ def _apply_mutation(
         return delete_random_op(k, tree, nfeatures, ops)
 
     def b_simplify(k):
+        # constant folding only — the full operator-combining pass runs once
+        # per iteration in simplify_population_islands; inlining its
+        # while_loop here (inside vmap x retry-loop x cycle-scan) explodes
+        # compile time for no search benefit
         t, _ = simplify_tree(tree, ops)
         return t, jnp.bool_(True)
 
@@ -159,7 +164,7 @@ def _apply_mutation(
         ka, kb = jax.random.split(k)
         # size ~ U{1..curmaxsize} (reference src/Mutate.jl randomize path)
         hi = jnp.minimum(jnp.maximum(curmaxsize, 1), tree.max_len) + 1
-        size = jax.random.randint(ka, (), 1, hi)
+        size = jax.random.randint(ka, (), 1, hi, dtype=jnp.int32)
         t = gen_random_tree_fixed_size(
             kb, size, nfeatures, ops, tree.max_len, tree.cval.dtype
         )
@@ -283,27 +288,37 @@ def _crossover_pair(
 
 
 # ---------------------------------------------------------------------------
-# One batched steady-state cycle
+# One batched steady-state cycle, split into propose -> score -> integrate
+# so multi-island callers can fuse ALL islands' scoring into ONE interpreter
+# call (the Pallas kernel needs large flat batches to pay off).
 # ---------------------------------------------------------------------------
 
 
-def reg_evol_cycle(
+class _Proposed(NamedTuple):
+    """Per-island child proposals awaiting scoring."""
+
+    children: TreeBatch  # (B, ...)
+    parents: TreeBatch  # (B, ...)
+    parent_idx: Array  # (B,)
+    parent_scores: Array  # (B,)
+    was_mutated: Array  # (B,) bool
+    always_accept: Array  # (B,) bool
+    use_cross: Array  # (B,) bool
+    accept_keys: Array  # (B, 2) PRNG keys
+    next_key: Array
+
+
+def _propose_children(
     state: IslandState,
     temperature: Array,
     curmaxsize: Array,
-    X: Array,
-    y: Array,
-    weights: Optional[Array],
-    baseline: float,
+    nfeatures: int,
     options: Options,
-    row_idx: Optional[Array] = None,
-) -> IslandState:
-    """B parallel tournaments -> mutate/crossover -> score -> accept ->
-    replace B oldest (reference src/RegularizedEvolution.jl:14-159,
-    batched)."""
+) -> _Proposed:
+    """Tournaments + mutation/crossover for one island
+    (the pre-scoring half of reference src/RegularizedEvolution.jl:14-159)."""
     B = options.n_parallel_tournaments
     B += B % 2  # paired slots for crossover
-    nfeatures = X.shape[0]
     pop, stats = state.pop, state.stats
 
     key, k_tour, k_mut, k_acc, k_cross, k_coin = jax.random.split(state.key, 6)
@@ -351,35 +366,56 @@ def reg_evol_cycle(
         cross_trees,
         mut_trees,
     )
-
-    # one batched scoring call for all B children
-    child_scores, child_losses = score_trees(
-        children, X, y, weights, baseline, options, row_idx
+    return _Proposed(
+        children=children,
+        parents=parents,
+        parent_idx=parent_idx,
+        parent_scores=parent_scores,
+        was_mutated=was_mutated,
+        always_accept=always_accept,
+        use_cross=use_cross,
+        accept_keys=jax.random.split(k_acc, B),
+        next_key=key,
     )
+
+
+def _integrate_children(
+    state: IslandState,
+    prop: _Proposed,
+    child_scores: Array,
+    child_losses: Array,
+    temperature: Array,
+    n_rows: int,
+    options: Options,
+) -> IslandState:
+    """Acceptance + replace-oldest + statistics for one island
+    (the post-scoring half of reference src/RegularizedEvolution.jl)."""
+    pop, stats = state.pop, state.stats
+    B = child_scores.shape[0]
 
     # acceptance (mutation slots only; crossover children always enter,
     # reference src/Mutate.jl:285-341 has no annealing gate for crossover)
-    akeys = jax.random.split(k_acc, B)
     accept = jax.vmap(
         lambda k, ot, nt, os, ns: _accept_mutation(
             k, ot, nt, os, ns, temperature, stats.frequencies, options
         )
-    )(akeys, parents, children, parent_scores, child_scores)
+    )(prop.accept_keys, prop.parents, prop.children, prop.parent_scores,
+      child_scores)
     # simplify is value-preserving: always accepted (reference early return,
     # src/Mutate.jl:107-140)
-    accept = accept | use_cross | (always_accept & ~use_cross)
+    accept = accept | prop.use_cross | (prop.always_accept & ~prop.use_cross)
     # slots whose child == parent (do_nothing / failed mutation) keep parent
-    accept = jnp.where(was_mutated | use_cross, accept, False)
+    accept = jnp.where(prop.was_mutated | prop.use_cross, accept, False)
 
     final_trees = jax.tree_util.tree_map(
         lambda c, p: jnp.where(
             jnp.reshape(accept, accept.shape + (1,) * (c.ndim - 1)), c, p
         ),
-        children,
-        parents,
+        prop.children,
+        prop.parents,
     )
-    final_scores = jnp.where(accept, child_scores, parent_scores)
-    final_losses = jnp.where(accept, child_losses, pop.losses[parent_idx])
+    final_scores = jnp.where(accept, child_scores, prop.parent_scores)
+    final_losses = jnp.where(accept, child_losses, pop.losses[prop.parent_idx])
 
     # replace the B oldest members (reference replace-oldest-by-birth,
     # src/RegularizedEvolution.jl:101,134)
@@ -409,21 +445,138 @@ def reg_evol_cycle(
     )
 
     eval_fraction = (
-        options.batch_size / X.shape[1] if options.batching else 1.0
+        options.batch_size / n_rows if options.batching else 1.0
     )
     return IslandState(
         pop=new_pop,
         stats=new_stats,
         hof=new_hof,
-        key=key,
+        key=prop.next_key,
         birth_counter=state.birth_counter + B,
         num_evals=state.num_evals + B * eval_fraction,
     )
 
 
+def reg_evol_cycle(
+    state: IslandState,
+    temperature: Array,
+    curmaxsize: Array,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    options: Options,
+    row_idx: Optional[Array] = None,
+) -> IslandState:
+    """B parallel tournaments -> mutate/crossover -> score -> accept ->
+    replace B oldest (reference src/RegularizedEvolution.jl:14-159,
+    batched). Single-island form; multi-island callers use
+    reg_evol_cycle_islands for fused scoring."""
+    nfeatures = X.shape[0]
+    prop = _propose_children(state, temperature, curmaxsize, nfeatures,
+                             options)
+    child_scores, child_losses = score_trees(
+        prop.children, X, y, weights, baseline, options, row_idx
+    )
+    return _integrate_children(
+        state, prop, child_scores, child_losses, temperature, X.shape[1],
+        options,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Multi-island fused cycle: all islands' children scored in ONE flat
+# interpreter call. Tree surgery stays vmapped per island (cheap int ops);
+# the expensive (trees x rows) evaluation gets the large flat batch the
+# Pallas kernel needs. This is the TPU answer to the reference's
+# one-task-per-island scheduling (SURVEY.md §2.3).
+# ---------------------------------------------------------------------------
+
+
+def _flatten2(tree_batch: TreeBatch) -> TreeBatch:
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((-1,) + x.shape[2:]), tree_batch
+    )
+
+
+def reg_evol_cycle_islands(
+    states: IslandState,  # leading (I,)
+    temperature: Array,
+    curmaxsize: Array,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    options: Options,
+    row_idx: Optional[Array] = None,
+) -> IslandState:
+    nfeatures = X.shape[0]
+    I = states.birth_counter.shape[0]
+    props = jax.vmap(
+        lambda st: _propose_children(
+            st, temperature, curmaxsize, nfeatures, options
+        )
+    )(states)
+    flat_children = _flatten2(props.children)  # (I*B, ...)
+    s, l = score_trees(
+        flat_children, X, y, weights, baseline, options, row_idx
+    )
+    B = props.parent_scores.shape[1]
+    return jax.vmap(
+        lambda st, pr, cs, cl: _integrate_children(
+            st, pr, cs, cl, temperature, X.shape[1], options
+        )
+    )(states, props, s.reshape(I, B), l.reshape(I, B))
+
+
 # ---------------------------------------------------------------------------
 # s_r_cycle: the per-iteration hot loop as one lax.scan
 # ---------------------------------------------------------------------------
+
+
+def s_r_cycle_islands(
+    states: IslandState,  # leading (I,)
+    curmaxsize: Array,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    options: Options,
+    ncycles: Optional[int] = None,
+) -> IslandState:
+    """ncycles fused evolution cycles over the annealing temperature
+    schedule LinRange(1, 0) (reference src/SingleIteration.jl:17-61), all
+    islands advancing together with one scoring call per cycle.
+
+    Batching note: the reference draws an independent minibatch per
+    score_func_batch call (per island); here one minibatch per cycle is
+    shared by all islands so the fused scoring call slices X once. Same
+    stochastic-minibatch semantics, coarser sharing."""
+    ncycles = ncycles or options.ncycles_per_iteration
+    if options.annealing and ncycles > 1:
+        temperatures = jnp.linspace(1.0, 0.0, ncycles)
+    else:
+        temperatures = jnp.ones((ncycles,))
+
+    n_rows = X.shape[1]
+
+    def step(carry, inputs):
+        sts, key = carry
+        temperature = inputs
+        if options.batching:
+            kb, key = jax.random.split(key)
+            row_idx = sample_batch_idx(kb, n_rows, options.batch_size)
+        else:
+            row_idx = None
+        sts = reg_evol_cycle_islands(
+            sts, temperature, curmaxsize, X, y, weights, baseline, options,
+            row_idx,
+        )
+        return (sts, key), None
+
+    batch_key = jax.random.fold_in(states.key[0], 0x5F3759DF)
+    (states, _), _ = jax.lax.scan(step, (states, batch_key), temperatures)
+    return states._replace(stats=jax.vmap(move_window)(states.stats))
 
 
 def s_r_cycle(
@@ -436,33 +589,49 @@ def s_r_cycle(
     options: Options,
     ncycles: Optional[int] = None,
 ) -> IslandState:
-    """ncycles batched evolution cycles over the annealing temperature
-    schedule LinRange(1, 0) (reference src/SingleIteration.jl:17-61)."""
-    ncycles = ncycles or options.ncycles_per_iteration
-    if options.annealing and ncycles > 1:
-        temperatures = jnp.linspace(1.0, 0.0, ncycles)
-    else:
-        temperatures = jnp.ones((ncycles,))
+    """Single-island s_r_cycle (tests / simple drivers): the I=1 special
+    case of s_r_cycle_islands."""
+    states = jax.tree_util.tree_map(lambda x: x[None], state)
+    states = s_r_cycle_islands(
+        states, curmaxsize, X, y, weights, baseline, options, ncycles
+    )
+    return jax.tree_util.tree_map(lambda x: x[0], states)
 
-    n_rows = X.shape[1]
 
-    def step(carry, temperature):
-        st = carry
-        if options.batching:
-            kb, key = jax.random.split(st.key)
-            st = st._replace(key=key)
-            row_idx = sample_batch_idx(kb, n_rows, options.batch_size)
-        else:
-            row_idx = None
-        st = reg_evol_cycle(
-            st, temperature, curmaxsize, X, y, weights, baseline, options,
-            row_idx,
-        )
-        return st, None
+def simplify_population_islands(
+    states: IslandState,  # leading (I,)
+    curmaxsize: Array,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    options: Options,
+) -> IslandState:
+    """Simplify every member of every island then rescore on the full
+    dataset in one fused call (the simplify + finalize_scores parts of
+    optimize_and_simplify_population, reference src/SingleIteration.jl:63-127;
+    constant optimization is applied separately by constant_opt.py)."""
+    I = states.birth_counter.shape[0]
+    npop = states.pop.scores.shape[1]
+    def _simp(t):
+        t, _ = simplify_tree(t, options.operators)
+        t, _ = combine_operators(t, options.operators)
+        return t
 
-    state, _ = jax.lax.scan(step, state, temperatures)
-    state = state._replace(stats=move_window(state.stats))
-    return state
+    trees = jax.vmap(jax.vmap(_simp))(states.pop.trees)
+    s, l = score_trees(
+        _flatten2(trees), X, y, weights, baseline, options
+    )
+    scores, losses = s.reshape(I, npop), l.reshape(I, npop)
+    new_pop = states.pop._replace(trees=trees, scores=scores, losses=losses)
+    new_hofs = jax.vmap(
+        lambda h, t, sc, lo: update_hall_of_fame(h, t, sc, lo, options)
+    )(states.hof, trees, scores, losses)
+    return states._replace(
+        pop=new_pop,
+        hof=new_hofs,
+        num_evals=states.num_evals + npop,
+    )
 
 
 def simplify_population(
@@ -474,24 +643,12 @@ def simplify_population(
     baseline: float,
     options: Options,
 ) -> IslandState:
-    """Simplify every member then rescore on the full dataset
-    (the simplify + finalize_scores parts of
-    optimize_and_simplify_population, reference src/SingleIteration.jl:63-127;
-    constant optimization is applied separately by constant_opt.py)."""
-    trees, _ = jax.vmap(lambda t: simplify_tree(t, options.operators))(
-        state.pop.trees
+    """Single-island form of simplify_population_islands."""
+    states = jax.tree_util.tree_map(lambda x: x[None], state)
+    states = simplify_population_islands(
+        states, curmaxsize, X, y, weights, baseline, options
     )
-    scores, losses = score_trees(trees, X, y, weights, baseline, options)
-    # guard: if a simplified tree somehow scores worse (numerical edge),
-    # keep it anyway — value-preserving by construction.
-    new_pop = state.pop._replace(trees=trees, scores=scores, losses=losses)
-    new_hof = update_hall_of_fame(state.hof, trees, scores, losses, options)
-    eval_fraction = 1.0
-    return state._replace(
-        pop=new_pop,
-        hof=new_hof,
-        num_evals=state.num_evals + state.pop.npop * eval_fraction,
-    )
+    return jax.tree_util.tree_map(lambda x: x[0], states)
 
 
 def init_island_state(

@@ -139,4 +139,4 @@ def score_trees(
 def sample_batch_idx(key: Array, n_rows: int, batch_size: int) -> Array:
     """Minibatch rows sampled with replacement
     (reference src/LossFunctions.jl:100-103)."""
-    return jax.random.randint(key, (batch_size,), 0, n_rows)
+    return jax.random.randint(key, (batch_size,), 0, n_rows, dtype=jnp.int32)

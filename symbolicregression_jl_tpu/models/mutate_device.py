@@ -63,15 +63,15 @@ def valid_mask(tree: TreeBatch) -> Array:
 
 
 def make_random_leaf(
-    key: Array, nfeatures: int
+    key: Array, nfeatures: int, dtype=jnp.float32
 ) -> Tuple[Array, Array, Array, Array]:
     """50/50 constant (randn) / feature leaf
     (reference src/MutationFunctions.jl:151-157). Returns scalar fields."""
     k1, k2, k3 = jax.random.split(key, 3)
     is_const = jax.random.bernoulli(k1)
     kind = jnp.where(is_const, CONST, VAR)
-    feat = jax.random.randint(k2, (), 0, nfeatures)
-    cval = jax.random.normal(k3)
+    feat = jax.random.randint(k2, (), 0, nfeatures, dtype=jnp.int32)
+    cval = jax.random.normal(k3, (), jnp.float32).astype(dtype)
     return kind.astype(jnp.int32), jnp.int32(0), jnp.where(is_const, feat * 0, feat), cval
 
 
@@ -204,8 +204,8 @@ def mutate_operator(
     n_bin = max(operators.n_binary, 1)
     new_op = jnp.where(
         is_una,
-        jax.random.randint(k2, (), 0, n_una),
-        jax.random.randint(k2, (), 0, n_bin),
+        jax.random.randint(k2, (), 0, n_una, dtype=jnp.int32),
+        jax.random.randint(k2, (), 0, n_bin, dtype=jnp.int32),
     )
     new = tree._replace(op=jnp.where(ok, tree.op.at[idx].set(new_op), tree.op))
     return new, ok
@@ -216,10 +216,10 @@ def _random_op_donor(key: Array, use_unary: Array, nfeatures: int,
     """Donor [leaf, op] (unary, d_len=2) or [leaf, leaf, op] (binary,
     d_len=3) with fresh random leaves."""
     k1, k2, k3, k4 = jax.random.split(key, 4)
-    lk1, lo1, lf1, lc1 = make_random_leaf(k1, nfeatures)
-    lk2, lo2, lf2, lc2 = make_random_leaf(k2, nfeatures)
-    op_u = jax.random.randint(k3, (), 0, max(operators.n_unary, 1))
-    op_b = jax.random.randint(k4, (), 0, max(operators.n_binary, 1))
+    lk1, lo1, lf1, lc1 = make_random_leaf(k1, nfeatures, dtype)
+    lk2, lo2, lf2, lc2 = make_random_leaf(k2, nfeatures, dtype)
+    op_u = jax.random.randint(k3, (), 0, max(operators.n_unary, 1), dtype=jnp.int32)
+    op_b = jax.random.randint(k4, (), 0, max(operators.n_binary, 1), dtype=jnp.int32)
     zero = jnp.int32(0)
     zf = jnp.zeros((), dtype)
     # unary layout: [leaf1, OP, -, -]; binary layout: [leaf1, leaf2, OP, -]
@@ -293,9 +293,9 @@ def insert_random_op(
 
     use_unary = _choose_unary(k2, operators)
     as_left = jax.random.bernoulli(k3)
-    op_u = jax.random.randint(k4, (), 0, max(operators.n_unary, 1))
-    op_b = jax.random.randint(k5, (), 0, max(operators.n_binary, 1))
-    lk, lo, lf, lc = make_random_leaf(k6, nfeatures)
+    op_u = jax.random.randint(k4, (), 0, max(operators.n_unary, 1), dtype=jnp.int32)
+    op_b = jax.random.randint(k5, (), 0, max(operators.n_binary, 1), dtype=jnp.int32)
+    lk, lo, lf, lc = make_random_leaf(k6, nfeatures, tree.cval.dtype)
     zero = jnp.int32(0)
     zf = jnp.zeros((), tree.cval.dtype)
     dtype = tree.cval.dtype
@@ -368,7 +368,7 @@ def delete_random_op(
     ok = any_op & fit
 
     # single-leaf fallback: fresh random leaf (reference :198-205)
-    lk, lo, lf, lc = make_random_leaf(k3, nfeatures)
+    lk, lo, lf, lc = make_random_leaf(k3, nfeatures, tree.cval.dtype)
     leaf_tree = TreeBatch(
         kind=jnp.zeros_like(tree.kind).at[0].set(lk),
         op=jnp.zeros_like(tree.op),
@@ -401,7 +401,7 @@ def gen_random_tree_fixed_size(
     (reference gen_random_tree_fixed_size src/MutationFunctions.jl:248-263).
     Fully on-device: a fori_loop of masked append_random_op steps."""
     k0, kloop = jax.random.split(key)
-    lk, lo, lf, lc = make_random_leaf(k0, nfeatures)
+    lk, lo, lf, lc = make_random_leaf(k0, nfeatures, dtype)
     tree = TreeBatch(
         kind=jnp.zeros(max_len, jnp.int32).at[0].set(lk),
         op=jnp.zeros(max_len, jnp.int32),
@@ -580,3 +580,186 @@ def simplify_tree(
     changed = n_new < tree.length
     out = jax.tree_util.tree_map(lambda n, o: jnp.where(changed, n, o), new, tree)
     return out, changed
+
+
+# ---------------------------------------------------------------------------
+# Operator combining (reference `combine_operators` from DynamicExpressions,
+# applied at src/SingleIteration.jl:73-74): rebalance constant chains so
+# constant folding can collapse them — (x + c1) + c2 -> x + (c1+c2),
+# (x*c1)/c2 -> x*(c1/c2), etc.
+# ---------------------------------------------------------------------------
+
+
+def _binop_idx(operators: OperatorSet, name: str) -> int:
+    try:
+        return operators.binary_names.index(name)
+    except ValueError:
+        return -1
+
+
+def _combine_fold_table(operators: OperatorSet):
+    """Static (inner_op, outer_op) -> (fold, result_op) rules for the
+    postfix window [c1, inner, c2, outer]: (L inner c1) outer c2."""
+    p = _binop_idx(operators, "+")
+    m = _binop_idx(operators, "-")
+    t = _binop_idx(operators, "*")
+    d = _binop_idx(operators, "/")
+    add = lambda a, b: a + b
+    sub_ = lambda a, b: a - b
+    mul = lambda a, b: a * b
+    div_ = lambda a, b: a / b
+    rules = []
+    if p >= 0:
+        rules.append((p, p, add, p))  # (L+c1)+c2 = L+(c1+c2)
+    if p >= 0 and m >= 0:
+        rules.append((p, m, sub_, p))  # (L+c1)-c2 = L+(c1-c2)
+        rules.append((m, p, sub_, m))  # (L-c1)+c2 = L-(c1-c2)
+    if m >= 0:
+        rules.append((m, m, add, m))  # (L-c1)-c2 = L-(c1+c2)
+    if t >= 0:
+        rules.append((t, t, mul, t))  # (L*c1)*c2 = L*(c1*c2)
+    if t >= 0 and d >= 0:
+        rules.append((t, d, div_, t))  # (L*c1)/c2 = L*(c1/c2)
+        rules.append((d, t, div_, d))  # (L/c1)*c2 = L/(c1/c2)
+    if d >= 0:
+        rules.append((d, d, mul, d))  # (L/c1)/c2 = L/(c1*c2)
+    return rules
+
+
+def _combine_pass(tree: TreeBatch, operators: OperatorSet):
+    """One combining step: apply at most one constant-chain fold and one
+    commutative rotation (constant left child moved to the right) — lowest
+    slot first. Returns (tree', changed)."""
+    L = tree.max_len
+    i = jnp.arange(L)
+    live = valid_mask(tree)
+    kind, op, cval = tree.kind, tree.op, tree.cval
+    rules = _combine_fold_table(operators)
+
+    # ---- fold: window [u-3]=CONST c1, [u-2]=BIN inner, [u-1]=CONST c2,
+    #      [u]=BIN outer  (by postfix layout u-1 is outer's right child,
+    #      u-2 its left child, u-3 inner's right child)
+    changed = jnp.bool_(False)
+    if rules:
+        sh = lambda a, k: jnp.roll(a, k)  # sh(a,1)[u] = a[u-1]
+        win = (
+            live
+            & (kind == BIN)
+            & (sh(kind, 1) == CONST)
+            & (sh(kind, 2) == BIN)
+            & (sh(kind, 3) == CONST)
+            & (i >= 3)
+        )
+        c1 = sh(cval, 3)
+        c2 = sh(cval, 1)
+        inner = sh(op, 2)
+        fold_ok = jnp.zeros(L, jnp.bool_)
+        fold_val = jnp.zeros(L, cval.dtype)
+        fold_op = jnp.zeros(L, jnp.int32)
+        for (op_in, op_out, fold, res_op) in rules:
+            match = win & (inner == op_in) & (op == op_out)
+            v = fold(c1, c2)
+            match = match & jnp.isfinite(v)
+            fold_ok = fold_ok | match
+            fold_val = jnp.where(match, v, fold_val)
+            fold_op = jnp.where(match, res_op, fold_op)
+        u = jnp.argmax(fold_ok)  # first applicable window
+        do_fold = jnp.any(fold_ok)
+        # rewrite: cval[u-3] = fold_val[u]; op[u-2] = fold_op[u];
+        # delete slots u-1 and u
+        cval = jnp.where(
+            do_fold & (i == u - 3), fold_val[u], cval
+        )
+        op = jnp.where(do_fold & (i == u - 2), fold_op[u], op)
+        keep = ~(do_fold & ((i == u - 1) | (i == u))) & live
+        pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+        tgt = jnp.where(keep, pos, L)
+
+        def scatter(src, fill):
+            out = jnp.full((L,), fill, src.dtype)
+            return out.at[tgt].set(src, mode="drop")
+
+        n_new = jnp.sum(keep.astype(jnp.int32))
+        folded = TreeBatch(
+            kind=scatter(kind, PAD),
+            op=scatter(op, 0),
+            feat=scatter(tree.feat, 0),
+            cval=scatter(cval, jnp.zeros((), cval.dtype)),
+            length=n_new.astype(jnp.int32),
+        )
+        tree = jax.tree_util.tree_map(
+            lambda n, o: jnp.where(do_fold, n, o),
+            folded,
+            tree._replace(op=op, cval=cval),
+        )
+        tree = tree._replace(
+            length=jnp.where(do_fold, n_new, tree.length).astype(jnp.int32)
+        )
+        changed = changed | do_fold
+
+    # ---- canonicalize: commutative op with CONST left child and
+    #      non-const right child -> rotate [c, R..., op] to [R..., c, op]
+    comm = [x for x in (_binop_idx(operators, "+"), _binop_idx(operators, "*"))
+            if x >= 0]
+    if comm:
+        live = valid_mask(tree)
+        sizes = subtree_sizes(tree.kind, tree.length)
+        is_comm = jnp.zeros(L, jnp.bool_)
+        for cidx in comm:
+            is_comm = is_comm | (tree.op == cidx)
+        r_root = jnp.clip(i - 1, 0, L - 1)
+        size_r = sizes[r_root]
+        l_root = jnp.clip(i - 1 - size_r, 0, L - 1)
+        rot = (
+            live
+            & (tree.kind == BIN)
+            & is_comm
+            & (tree.kind[l_root] == CONST)
+            & (tree.kind[r_root] != CONST)
+            & (i >= 2)
+        )
+        u = jnp.argmax(rot)
+        do_rot = jnp.any(rot)
+        p = jnp.clip(u - 1 - sizes[jnp.clip(u - 1, 0, L - 1)], 0, L - 1)
+        # src index for cyclic rotate of span [p, u-1] by one
+        src = jnp.where(
+            (i >= p) & (i < u - 1), i + 1, jnp.where(i == u - 1, p, i)
+        )
+        src = jnp.clip(src, 0, L - 1)
+
+        def rotate(a):
+            return jnp.where(do_rot, a[src], a)
+
+        tree = tree._replace(
+            kind=rotate(tree.kind),
+            op=rotate(tree.op),
+            feat=rotate(tree.feat),
+            cval=rotate(tree.cval),
+        )
+        changed = changed | do_rot
+
+    return tree, changed
+
+
+def combine_operators(
+    tree: TreeBatch, operators: OperatorSet
+) -> Tuple[TreeBatch, Array]:
+    """Iterated constant-chain combining to a fixed point (bounded passes).
+
+    Covers the reference's (x op c1) op c2 family over +,-,*,/ plus
+    commutative canonicalization of constant left children; constant
+    subtree folding itself is simplify_tree's job."""
+    def body(carry):
+        t, _, any_ch, n = carry
+        t2, ch = _combine_pass(t, operators)
+        return t2, ch, any_ch | ch, n + 1
+
+    def cond(carry):
+        _, ch, _, n = carry
+        return ch & (n < tree.max_len)
+
+    t0, ch0 = _combine_pass(tree, operators)
+    t, _, changed, _ = jax.lax.while_loop(
+        cond, body, (t0, ch0, ch0, jnp.int32(1))
+    )
+    return t, changed

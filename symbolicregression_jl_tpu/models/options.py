@@ -173,6 +173,10 @@ class Options:
     # --- TPU-native knobs (no reference analog; replace Distributed.jl) ---
     n_parallel_tournaments: int = 0  # 0 => npop // tournament_selection_n
     eval_backend: str = "auto"  # "jnp" | "pallas" | "auto"
+    # Working dtype for X/y/constants/losses (the reference's Float16/32/64
+    # type parameter T). "float64" flips on jax_enable_x64 at search start;
+    # "bfloat16" is the TPU-native half precision (the Pallas kernel itself
+    # is float32-only — dispatch_eval routes other dtypes to the jnp path).
     precision: str = "float32"
     island_axis: str = "islands"
     row_axis: str = "rows"
@@ -206,6 +210,10 @@ class Options:
                         for k, val in sorted(v.items())
                     ),
                 )
+        if self.precision not in ("float32", "float64", "bfloat16", "float16"):
+            raise ValueError(
+                "precision must be one of float32/float64/bfloat16/float16"
+            )
         if not 0 < self.tournament_selection_p <= 1:
             raise ValueError("tournament_selection_p must be in (0, 1]")
         if self.tournament_selection_n > self.npop:
@@ -223,6 +231,17 @@ class Options:
     @property
     def elementwise_loss(self) -> Callable:
         return resolve_loss(self.loss)
+
+    @property
+    def dtype(self):
+        import jax.numpy as jnp
+
+        return {
+            "float32": jnp.float32,
+            "float64": jnp.float64,
+            "bfloat16": jnp.bfloat16,
+            "float16": jnp.float16,
+        }[self.precision]
 
     @property
     def actual_maxsize(self) -> int:

@@ -74,7 +74,7 @@ def migrate(
     replace_pool = jax.random.bernoulli(
         k1, options.fraction_replaced, (I, npop)
     )
-    choice_pool = jax.random.randint(k2, (I, npop), 0, pool_size)
+    choice_pool = jax.random.randint(k2, (I, npop), 0, pool_size, dtype=jnp.int32)
 
     # hall-of-fame migration: sample only from existing Pareto-front slots
     # (reference hofMigration uses the dominating curve,

@@ -215,3 +215,74 @@ def test_mutations_under_vmap(rng):
     for i in range(16):
         if bool(ok[i]):
             assert is_valid_postfix(t2[i])
+
+
+# --------------------------- combine_operators ------------------------------
+# (reference combine_operators applied at src/SingleIteration.jl:73-74)
+
+from symbolicregression_jl_tpu.models.mutate_device import combine_operators
+from symbolicregression_jl_tpu.ops.interpreter import eval_tree as _eval_tree
+
+
+def _enc(s, ops, L=24):
+    import jax.numpy as _jnp
+    from symbolicregression_jl_tpu.models.trees import encode_tree, parse_expression
+    return jax.tree_util.tree_map(
+        _jnp.asarray, encode_tree(parse_expression(s, ops), L)
+    )
+
+
+def test_combine_constant_add_chain():
+    ops = make_operator_set(["+", "-", "*", "/"], [])
+    t = _enc("(x0 + 1.0) + 2.0", ops)
+    t2, changed = combine_operators(t, ops)
+    assert bool(changed)
+    assert int(t2.length) == 3  # x0, 3.0, +
+    d = decode_tree(jax.tree_util.tree_map(np.asarray, t2))
+    s = expr_to_string(d, ops)
+    assert "3" in s and "x0" in s
+
+
+def test_combine_handles_left_constants_commutative():
+    ops = make_operator_set(["+", "-", "*", "/"], [])
+    t = _enc("2.0 * (3.0 * x0)", ops)  # needs rotation then fold
+    t2, _ = combine_operators(t, ops)
+    assert int(t2.length) == 3  # x0 * 6 in some order
+    X = jnp.asarray(np.linspace(-2, 2, 7, dtype=np.float32)[None])
+    y1, _ = _eval_tree(t, X, ops)
+    y2, _ = _eval_tree(t2, X, ops)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
+
+
+def test_combine_sub_div_identities():
+    ops = make_operator_set(["+", "-", "*", "/"], [])
+    for expr in ["(x0 - 1.5) - 2.5", "(x0 + 1.0) - 3.0", "(x0 / 2.0) / 4.0",
+                 "(x0 * 2.0) / 8.0", "(x0 - 1.0) + 5.0", "(x0 / 3.0) * 6.0"]:
+        t = _enc(expr, ops)
+        t2, changed = combine_operators(t, ops)
+        assert bool(changed), expr
+        assert int(t2.length) == 3, expr
+        X = jnp.asarray(np.linspace(-2, 2, 9, dtype=np.float32)[None])
+        y1, _ = _eval_tree(t, X, ops)
+        y2, _ = _eval_tree(t2, X, ops)
+        np.testing.assert_allclose(
+            np.asarray(y1), np.asarray(y2), rtol=1e-5, atol=1e-6,
+            err_msg=expr,
+        )
+
+
+def test_combine_preserves_random_tree_values(rng):
+    ops = make_operator_set(["+", "-", "*", "/"], ["cos"])
+    X = jnp.asarray((rng.standard_normal((3, 40)) * 2).astype(np.float32))
+    from symbolicregression_jl_tpu.models.trees import encode_tree, stack_trees
+    from symbolicregression_jl_tpu.utils.random_exprs import random_expr_fixed_size
+    for _ in range(20):
+        e = random_expr_fixed_size(rng, ops, 3, int(rng.integers(3, 18)))
+        t = jax.tree_util.tree_map(jnp.asarray, encode_tree(e, 24))
+        t2, _ = combine_operators(t, ops)
+        y1, ok1 = _eval_tree(t, X, ops)
+        y2, ok2 = _eval_tree(t2, X, ops)
+        if bool(ok1):
+            np.testing.assert_allclose(
+                np.asarray(y1), np.asarray(y2), rtol=2e-4, atol=1e-4
+            )

@@ -1,0 +1,61 @@
+"""Precision sweeps (analog of the reference's Float16/32/64 type-parameter
+tests, e.g. test/test_nan_detection.jl:5-47 and test_mixed.jl dtype axes)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import symbolicregression_jl_tpu as sr
+
+
+def _tiny_search(precision):
+    rng = np.random.default_rng(0)
+    X = (rng.standard_normal((2, 40)) * 2).astype("f4")
+    y = X[0] * X[0]
+    return sr.equation_search(
+        X, y, niterations=2, binary_operators=["+", "*"],
+        npop=16, npopulations=2, ncycles_per_iteration=20,
+        tournament_selection_n=6, precision=precision,
+        verbosity=0, progress=False, maxsize=10, seed=0,
+    )
+
+
+@pytest.mark.parametrize("precision", ["float32", "bfloat16"])
+def test_search_runs_at_precision(precision):
+    res = _tiny_search(precision)
+    tol = 1e-2 if precision == "bfloat16" else 1e-4
+    assert res.best().loss < tol
+
+
+def test_invalid_precision_rejected():
+    with pytest.raises(ValueError):
+        sr.make_options(binary_operators=["+"], precision="float8")
+
+
+@pytest.mark.slow
+def test_float64_in_subprocess():
+    """x64 mode flips a global jax flag; run isolated."""
+    code = (
+        "import jax; jax.config.update('jax_platforms','cpu')\n"
+        "import numpy as np\n"
+        "import symbolicregression_jl_tpu as sr\n"
+        "rng = np.random.default_rng(0)\n"
+        "X = (rng.standard_normal((2, 40))*2).astype('f8'); y = X[0]*X[0]\n"
+        "res = sr.equation_search(X, y, niterations=2,\n"
+        "    binary_operators=['+','*'], npop=16, npopulations=2,\n"
+        "    ncycles_per_iteration=20, tournament_selection_n=6,\n"
+        "    precision='float64', verbosity=0, progress=False, maxsize=10)\n"
+        "assert res.best().loss < 1e-8, res.best().loss\n"
+        "print('OK')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=env, timeout=280, cwd=os.path.dirname(os.path.dirname(__file__)),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "OK" in out.stdout
