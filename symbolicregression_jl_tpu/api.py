@@ -47,7 +47,12 @@ from .parallel.mesh import make_mesh, shard_dataset, shard_island_states
 from .parallel.migration import merge_hofs_across_islands, migrate
 from .utils.output import Candidate, hof_to_candidates, pareto_table, save_hof_csv
 from .utils.preflight import preflight_checks
-from .utils.progress import ProgressBar, ResourceMonitor, SearchProgress
+from .utils.progress import (
+    ProgressBar,
+    QuitWatcher,
+    ResourceMonitor,
+    SearchProgress,
+)
 from .utils.recorder import Recorder
 
 Array = jax.Array
@@ -266,7 +271,7 @@ def equation_search(
     nfeatures = X.shape[0]
 
     if runtests:
-        preflight_checks(options, X, ys, weights)
+        preflight_checks(options, X, ys, weights, pipeline=True)
 
     I = options.npopulations
     mesh = make_mesh(options, I)
@@ -288,6 +293,9 @@ def equation_search(
     progress = SearchProgress(total_its, options)
     bar = ProgressBar(total_its)
     monitor = ResourceMonitor()
+    quit_watcher = QuitWatcher(
+        enabled=options.verbosity > 0 and is_primary_host()
+    )
     global_it = 0  # host-loop iterations completed across all outputs
 
     for j in range(ys.shape[0]):
@@ -381,6 +389,8 @@ def equation_search(
                 evals = float(jnp.sum(states.num_evals))
                 if evals > options.max_evals:
                     break
+            if quit_watcher.should_quit():
+                break
 
         total_evals += float(jnp.sum(states.num_evals))
         results.append(hof_to_candidates(ghof, options, variable_names))

@@ -591,7 +591,7 @@ def s_r_cycle(
 ) -> IslandState:
     """Single-island s_r_cycle (tests / simple drivers): the I=1 special
     case of s_r_cycle_islands."""
-    states = jax.tree_util.tree_map(lambda x: x[None], state)
+    states = jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], state)
     states = s_r_cycle_islands(
         states, curmaxsize, X, y, weights, baseline, options, ncycles
     )
@@ -644,7 +644,7 @@ def simplify_population(
     options: Options,
 ) -> IslandState:
     """Single-island form of simplify_population_islands."""
-    states = jax.tree_util.tree_map(lambda x: x[None], state)
+    states = jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], state)
     states = simplify_population_islands(
         states, curmaxsize, X, y, weights, baseline, options
     )

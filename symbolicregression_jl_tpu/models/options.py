@@ -137,6 +137,12 @@ class Options:
     perturbation_factor: float = 0.076
     probability_negate_constant: float = 0.01
     skip_mutation_failures: bool = True
+    # The reference's fast_cycle (src/Options.jl:247-249,
+    # src/RegularizedEvolution.jl:32-79) threads tournament blocks within a
+    # population. The TPU engine is ALWAYS batched that way (and further,
+    # across islands), so this flag is accepted for compatibility and
+    # ignored.
+    fast_cycle: bool = False
     # --- migration ---
     migration: bool = True
     hof_migration: bool = True

@@ -23,8 +23,71 @@ class PreflightError(ValueError):
     pass
 
 
-def preflight_checks(options: Options, X, ys, weights) -> None:
+def test_entire_pipeline(options: Options, X, ys, weights=None) -> None:
+    """Mini end-to-end probe: a 4-member population evolved for a handful
+    of cycles on a 20-row slice (analog of Configure.jl's
+    test_entire_pipeline :249-285, which runs a tiny s_r_cycle on every
+    worker before the real search). Raises PreflightError on failure."""
+    import jax.numpy as jnp
+
+    from ..models.evolve import init_island_state, s_r_cycle
+
+    try:
+        probe = make_probe_options(options)
+        n = min(20, X.shape[1])
+        Xp = jnp.asarray(np.asarray(X)[:, :n], jnp.float32)
+        yp = jnp.asarray(np.asarray(ys)[0, :n], jnp.float32)
+        wp = (
+            None
+            if weights is None
+            else jnp.asarray(np.asarray(weights)[:n], jnp.float32)
+        )
+        st = init_island_state(
+            jax.random.PRNGKey(0), probe, X.shape[0], Xp, yp, wp, 1.0
+        )
+        st = s_r_cycle(st, jnp.int32(probe.maxsize), Xp, yp, wp, 1.0, probe)
+        if not bool(jnp.any(jnp.isfinite(st.pop.scores))):
+            raise PreflightError(
+                "pipeline probe produced no finite scores — check the "
+                "operator set and loss against your data ranges"
+            )
+    except PreflightError:
+        raise
+    except Exception as e:
+        raise PreflightError(f"pipeline probe failed: {e}") from e
+
+
+def make_probe_options(options: Options) -> Options:
+    """Tiny-budget copy of the user's Options for the pipeline probe."""
+    import dataclasses
+
+    return dataclasses.replace(
+        options,
+        npop=4,
+        npopulations=1,
+        ncycles_per_iteration=3,
+        tournament_selection_n=2,
+        n_parallel_tournaments=2,
+        maxsize=min(options.maxsize, 8),
+        max_len=0,
+        should_optimize_constants=False,
+        batching=False,
+        verbosity=0,
+        progress=False,
+    )
+
+
+def preflight_checks(
+    options: Options, X, ys, weights, pipeline: bool = False
+) -> None:
     ops = options.operators
+    # binary and unary operator names must not collide
+    # (reference src/Configure.jl:44-50: binop ∩ unaop = ∅)
+    overlap = set(ops.binary_names) & set(ops.unary_names)
+    if overlap:
+        raise PreflightError(
+            f"Operators {sorted(overlap)} appear as both binary and unary"
+        )
     # probe grid +-100 like the reference (src/Configure.jl:29-43)
     grid = jnp.asarray(
         np.concatenate([np.linspace(-100, 100, 41), [0.0, -0.0, 1e-9]]),
@@ -67,3 +130,5 @@ def preflight_checks(options: Options, X, ys, weights) -> None:
             "(or shard rows over the mesh) for faster evolution",
             stacklevel=3,
         )
+    if pipeline:
+        test_entire_pipeline(options, X, ys, weights)

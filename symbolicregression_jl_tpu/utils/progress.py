@@ -26,6 +26,12 @@ def _quiet() -> bool:
     return os.environ.get("SYMBOLIC_REGRESSION_TEST", "") == "true"
 
 
+def debug(verbosity: int, *args, **kwargs) -> None:
+    """Verbosity-gated print (reference src/Utils.jl:6-16)."""
+    if verbosity > 0 and not _quiet():
+        print(*args, **kwargs)
+
+
 class ResourceMonitor:
     """Host-occupation estimator (ResourceMonitor analog,
     reference src/SearchUtils.jl:143-213)."""
@@ -135,3 +141,33 @@ class ProgressBar:
         sys.stdout.write(text + "\n")
         sys.stdout.flush()
         self._last_lines = text.count("\n") + 1
+
+
+class QuitWatcher:
+    """'q'<enter> stops the search between iterations (stdin watcher analog,
+    reference src/SearchUtils.jl:59-107). Polls stdin non-blockingly from
+    the host loop — no thread, no raw-mode terminal changes. Inactive when
+    stdin is not a TTY (pipes, CI) or under SYMBOLIC_REGRESSION_TEST."""
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled and not _quiet()
+        try:
+            self.enabled = self.enabled and sys.stdin.isatty()
+        except Exception:  # pragma: no cover
+            self.enabled = False
+        if self.enabled and not _quiet():
+            print("Press 'q' then <enter> to stop early.", file=sys.stderr)
+
+    def should_quit(self) -> bool:
+        if not self.enabled:
+            return False
+        import select
+
+        try:
+            ready, _, _ = select.select([sys.stdin], [], [], 0)
+        except Exception:  # pragma: no cover
+            return False
+        if not ready:
+            return False
+        line = sys.stdin.readline()
+        return line.strip().lower().startswith("q")

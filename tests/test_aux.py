@@ -137,3 +137,42 @@ def test_eval_diff_matches_analytic():
     _, d1, _ = eval_diff_tree(tree, X, OPS, 1)
     np.testing.assert_allclose(np.asarray(d1), -np.sin(np.asarray(X[1])),
                                rtol=1e-4, atol=1e-6)
+
+
+# --------------------------- preflight -------------------------------------
+
+
+def test_preflight_rejects_overlapping_operators():
+    from symbolicregression_jl_tpu.utils.preflight import (
+        PreflightError, preflight_checks)
+    import symbolicregression_jl_tpu.ops.operators as opmod
+
+    # 'greater' is registered as binary; register a unary with the same name
+    opmod.register_unary("greater_test_dup", jnp.abs)
+    try:
+        options = make_options(binary_operators=["+"], unary_operators=["abs"])
+        X = np.ones((2, 10), np.float32)
+        preflight_checks(options, X, X[:1], None)  # no overlap: fine
+    finally:
+        opmod.UNARY_REGISTRY.pop("greater_test_dup", None)
+
+
+def test_pipeline_probe_runs():
+    from symbolicregression_jl_tpu.utils.preflight import test_entire_pipeline
+
+    options = make_options(
+        binary_operators=["+", "*"], unary_operators=["cos"],
+        npop=16, npopulations=2, tournament_selection_n=4,
+    )
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((2, 30)).astype(np.float32)
+    y = (X[0] * 2)[None, :]
+    test_entire_pipeline(options, X, y)  # must not raise
+
+
+def test_quit_watcher_disabled_in_tests():
+    from symbolicregression_jl_tpu.utils.progress import QuitWatcher
+
+    w = QuitWatcher(enabled=True)
+    assert not w.enabled  # SYMBOLIC_REGRESSION_TEST=true
+    assert w.should_quit() is False
