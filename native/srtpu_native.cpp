@@ -1,0 +1,957 @@
+// srtpu_native — C++ host runtime for symbolicregression_jl_tpu.
+//
+// The TPU compute path (fitness evaluation, evolution, BFGS) is JAX/XLA/
+// Pallas; this library is the *host* runtime around it — the pointer-chasing
+// work the reference keeps in Julia/DynamicExpressions (linked Node{T}
+// trees, `string_tree`, `simplify_tree`/`combine_operators`, dataset IO):
+//
+//   * infix expression parser      (analog of parsing in the reference's
+//                                   SymbolicUtils round-trip)
+//   * batched postfix -> infix     (string_tree, reference
+//     printer                       src/InterfaceDynamicExpressions.jl:132-153;
+//                                   hot for the recorder, which stringifies
+//                                   whole populations every iteration)
+//   * simplifier: constant folding (simplify_tree + combine_operators,
+//     + operator-chain combining    applied at src/SingleIteration.jl:73-74)
+//   * multithreaded batched postfix (the reference's CPU eval path:
+//     evaluator                     DynamicExpressions eval_tree_array —
+//                                   used as preflight oracle + CPU anchor)
+//   * CSV dataset loader           (host IO off the Python interpreter)
+//
+// Expression encoding matches models/trees.py exactly: flat postfix slots
+// (kind, op, feat, cval) + length, kind in {PAD=0, CONST=1, VAR=2, UNA=3,
+// BIN=4}. Operator *semantics* (NaN-safe domains) match ops/operators.py —
+// the Python wrapper maps each OperatorSet name to a native opcode via
+// srt_op_id() and refuses to route custom (Python-registered) operators
+// here.
+//
+// Pure C ABI (ctypes-friendly): no exceptions across the boundary, caller
+// owns all buffers.
+
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int KPAD = 0, KCONST = 1, KVAR = 2, KUNA = 3, KBIN = 4;
+
+// ---------------------------------------------------------------------------
+// Operator table (semantics mirror ops/operators.py NaN-safe definitions)
+// ---------------------------------------------------------------------------
+
+enum UnaOp : int32_t {
+  U_COS, U_SIN, U_TAN, U_EXP, U_LOG, U_LOG2, U_LOG10, U_LOG1P, U_SQRT,
+  U_ABS, U_SQUARE, U_CUBE, U_NEG, U_RELU, U_SINH, U_COSH, U_TANH,
+  U_ASIN, U_ACOS, U_ATAN, U_ASINH, U_ACOSH, U_ATANH_CLIP, U_ERF, U_ERFC,
+  U_GAMMA, U_SIGMOID, U_GAUSS, U_INV, U_SIGN, U_IDENTITY,
+  U_COUNT
+};
+
+enum BinOp : int32_t {
+  B_ADD, B_SUB, B_MUL, B_DIV, B_POW, B_MOD, B_MAX, B_MIN, B_GREATER,
+  B_LOGICAL_OR, B_LOGICAL_AND, B_ATAN2,
+  B_COUNT
+};
+
+const char* kUnaNames[U_COUNT] = {
+  "cos", "sin", "tan", "exp", "log", "log2", "log10", "log1p", "sqrt",
+  "abs", "square", "cube", "neg", "relu", "sinh", "cosh", "tanh",
+  "asin", "acos", "atan", "asinh", "acosh", "atanh", "erf", "erfc",
+  "gamma", "sigmoid", "gauss", "inv", "sign", "identity",
+};
+
+const char* kBinNames[B_COUNT] = {
+  "+", "-", "*", "/", "^", "mod", "max", "min", "greater",
+  "logical_or", "logical_and", "atan2",
+};
+
+const double kNaN = std::nan("");
+
+inline double apply_una(int32_t o, double a) {
+  switch (o) {
+    case U_COS: return std::cos(a);
+    case U_SIN: return std::sin(a);
+    case U_TAN: return std::tan(a);
+    case U_EXP: return std::exp(a);
+    case U_LOG: return a > 0 ? std::log(a) : kNaN;
+    case U_LOG2: return a > 0 ? std::log2(a) : kNaN;
+    case U_LOG10: return a > 0 ? std::log10(a) : kNaN;
+    case U_LOG1P: return a > -1 ? std::log1p(a) : kNaN;
+    case U_SQRT: return a >= 0 ? std::sqrt(a) : kNaN;
+    case U_ABS: return std::fabs(a);
+    case U_SQUARE: return a * a;
+    case U_CUBE: return a * a * a;
+    case U_NEG: return -a;
+    case U_RELU: return a > 0 ? a : 0.0;
+    case U_SINH: return std::sinh(a);
+    case U_COSH: return std::cosh(a);
+    case U_TANH: return std::tanh(a);
+    case U_ASIN: return std::fabs(a) <= 1 ? std::asin(a) : kNaN;
+    case U_ACOS: return std::fabs(a) <= 1 ? std::acos(a) : kNaN;
+    case U_ATAN: return std::atan(a);
+    case U_ASINH: return std::asinh(a);
+    case U_ACOSH: return a >= 1 ? std::acosh(a) : kNaN;
+    case U_ATANH_CLIP: {
+      // atanh of x wrapped into (-1,1): jnp.mod semantics (result sign of
+      // divisor, i.e. non-negative for divisor 2).
+      double m = std::fmod(a + 1.0, 2.0);
+      if (m < 0) m += 2.0;
+      return std::atanh(m - 1.0);
+    }
+    case U_ERF: return std::erf(a);
+    case U_ERFC: return std::erfc(a);
+    case U_GAMMA: {
+      double g = std::tgamma(a);
+      bool pole = a <= 0 && a == std::round(a);
+      return (pole || !std::isfinite(g)) ? kNaN : g;
+    }
+    case U_SIGMOID: return 1.0 / (1.0 + std::exp(-a));
+    case U_GAUSS: return std::exp(-(a * a));
+    case U_INV: return 1.0 / a;
+    case U_SIGN: return (a > 0) - (a < 0);
+    case U_IDENTITY: return a;
+    default: return kNaN;
+  }
+}
+
+inline double apply_bin(int32_t o, double a, double b) {
+  switch (o) {
+    case B_ADD: return a + b;
+    case B_SUB: return a - b;
+    case B_MUL: return a * b;
+    case B_DIV: return a / b;
+    case B_POW: {
+      // safe_pow (ops/operators.py:38-47 / reference src/Operators.jl:38-46)
+      bool bad = (a < 0 && b != std::round(b)) || (a == 0 && b < 0);
+      return bad ? kNaN : std::pow(a, b);
+    }
+    case B_MOD: {
+      double m = std::fmod(a, b);
+      if (m != 0 && ((m < 0) != (b < 0))) m += b;  // jnp.mod semantics
+      return m;
+    }
+    case B_MAX: return std::fmax(a, b);
+    case B_MIN: return std::fmin(a, b);
+    case B_GREATER: return a > b ? 1.0 : 0.0;
+    case B_LOGICAL_OR: return (a > 0 || b > 0) ? 1.0 : 0.0;
+    case B_LOGICAL_AND: return (a > 0 && b > 0) ? 1.0 : 0.0;
+    case B_ATAN2: return std::atan2(a, b);
+    default: return kNaN;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Helpers
+// ---------------------------------------------------------------------------
+
+std::vector<std::string> split_lines(const char* joined) {
+  std::vector<std::string> out;
+  if (!joined || !*joined) return out;
+  const char* p = joined;
+  while (*p) {
+    const char* q = std::strchr(p, '\n');
+    if (!q) { out.emplace_back(p); break; }
+    out.emplace_back(p, q - p);
+    p = q + 1;
+  }
+  return out;
+}
+
+void set_err(char* err, int cap, const std::string& msg) {
+  if (err && cap > 0) {
+    std::snprintf(err, static_cast<size_t>(cap), "%s", msg.c_str());
+  }
+}
+
+struct Node { int32_t kind, op, feat; double cval; int32_t l, r; };
+
+// postfix slots -> node array with child links; returns root index or -1
+int build_nodes(const int32_t* kind, const int32_t* op, const int32_t* feat,
+                const float* cval, int32_t n, std::vector<Node>& nodes) {
+  nodes.clear();
+  nodes.reserve(n);
+  std::vector<int32_t> stack;
+  for (int32_t i = 0; i < n; ++i) {
+    Node nd{kind[i], op[i], feat[i], static_cast<double>(cval[i]), -1, -1};
+    if (nd.kind == KUNA) {
+      if (stack.empty()) return -1;
+      nd.l = stack.back(); stack.pop_back();
+    } else if (nd.kind == KBIN) {
+      if (stack.size() < 2) return -1;
+      nd.r = stack.back(); stack.pop_back();
+      nd.l = stack.back(); stack.pop_back();
+    } else if (nd.kind != KCONST && nd.kind != KVAR) {
+      return -1;  // PAD inside valid region
+    }
+    nodes.push_back(nd);
+    stack.push_back(i);
+  }
+  if (stack.size() != 1) return -1;
+  return stack[0];
+}
+
+// re-emit postfix from node graph; returns length or -1 if it exceeds L
+int32_t emit_postfix(const std::vector<Node>& nodes, int root, int32_t L,
+                     int32_t* kind, int32_t* op, int32_t* feat, float* cval) {
+  std::vector<int32_t> order;
+  order.reserve(nodes.size());
+  // iterative postorder
+  std::vector<std::pair<int32_t, bool>> st;
+  st.push_back({static_cast<int32_t>(root), false});
+  while (!st.empty()) {
+    auto [idx, visited] = st.back();
+    st.pop_back();
+    if (visited) { order.push_back(idx); continue; }
+    st.push_back({idx, true});
+    const Node& nd = nodes[idx];
+    if (nd.r >= 0) st.push_back({nd.r, false});
+    if (nd.l >= 0) st.push_back({nd.l, false});
+  }
+  if (static_cast<int32_t>(order.size()) > L) return -1;
+  for (size_t i = 0; i < order.size(); ++i) {
+    const Node& nd = nodes[order[i]];
+    kind[i] = nd.kind;
+    op[i] = nd.kind == KUNA || nd.kind == KBIN ? nd.op : 0;
+    feat[i] = nd.kind == KVAR ? nd.feat : 0;
+    cval[i] = nd.kind == KCONST ? static_cast<float>(nd.cval) : 0.0f;
+  }
+  for (int32_t i = static_cast<int32_t>(order.size()); i < L; ++i) {
+    kind[i] = KPAD; op[i] = 0; feat[i] = 0; cval[i] = 0.0f;
+  }
+  return static_cast<int32_t>(order.size());
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t srt_abi_version() { return 1; }
+
+// name -> native opcode (or -1). is_binary selects the table.
+int32_t srt_op_id(const char* name, int32_t is_binary) {
+  if (is_binary) {
+    for (int32_t i = 0; i < B_COUNT; ++i)
+      if (!std::strcmp(name, kBinNames[i])) return i;
+  } else {
+    for (int32_t i = 0; i < U_COUNT; ++i)
+      if (!std::strcmp(name, kUnaNames[i])) return i;
+  }
+  return -1;
+}
+
+// ---------------------------------------------------------------------------
+// Batched printer (analog of string_tree). Strings are written NUL-terminated
+// back-to-back into `out`; offsets[t] = byte offset of tree t. Returns total
+// bytes used, or -(needed) if out_cap is too small (caller retries), or 0 on
+// malformed input.
+// ---------------------------------------------------------------------------
+
+int64_t srt_print_batch(int64_t T, int32_t L,
+                        const int32_t* kind, const int32_t* op,
+                        const int32_t* feat, const float* cval,
+                        const int32_t* length,
+                        const char* una_names_joined,
+                        const char* bin_names_joined,
+                        const char* var_names_joined,
+                        const uint8_t* bin_infix,
+                        char* out, int64_t out_cap, int64_t* offsets) {
+  auto unames = split_lines(una_names_joined);
+  auto bnames = split_lines(bin_names_joined);
+  auto vnames = split_lines(var_names_joined);
+  std::string buf;
+  buf.reserve(static_cast<size_t>(T) * 32);
+  char tmp[64];
+  for (int64_t t = 0; t < T; ++t) {
+    offsets[t] = static_cast<int64_t>(buf.size());
+    const int32_t* k = kind + t * L;
+    const int32_t* o = op + t * L;
+    const int32_t* f = feat + t * L;
+    const float* c = cval + t * L;
+    int32_t n = length[t];
+    if (n <= 0 || n > L) { buf += '\0'; continue; }
+    // stack of rendered sub-strings
+    std::vector<std::string> st;
+    bool ok = true;
+    for (int32_t i = 0; i < n && ok; ++i) {
+      switch (k[i]) {
+        case KCONST:
+          // %.6g matches models/trees.py _format_const
+          std::snprintf(tmp, sizeof tmp, "%.6g",
+                        static_cast<double>(c[i]));
+          st.emplace_back(tmp);
+          break;
+        case KVAR:
+          if (f[i] >= 0 && f[i] < static_cast<int32_t>(vnames.size())) {
+            st.push_back(vnames[f[i]]);
+          } else {
+            std::snprintf(tmp, sizeof tmp, "x%d", f[i]);
+            st.emplace_back(tmp);
+          }
+          break;
+        case KUNA: {
+          if (st.empty() ||
+              o[i] >= static_cast<int32_t>(unames.size())) { ok = false; break; }
+          std::string a = std::move(st.back()); st.pop_back();
+          st.push_back(unames[o[i]] + "(" + a + ")");
+          break;
+        }
+        case KBIN: {
+          if (st.size() < 2 ||
+              o[i] >= static_cast<int32_t>(bnames.size())) { ok = false; break; }
+          std::string b = std::move(st.back()); st.pop_back();
+          std::string a = std::move(st.back()); st.pop_back();
+          const std::string& nm = bnames[o[i]];
+          if (bin_infix[o[i]]) {
+            st.push_back("(" + a + " " + nm + " " + b + ")");
+          } else {
+            st.push_back(nm + "(" + a + ", " + b + ")");
+          }
+          break;
+        }
+        default:
+          ok = false;
+      }
+    }
+    if (ok && st.size() == 1) buf += st[0];
+    buf += '\0';
+  }
+  int64_t needed = static_cast<int64_t>(buf.size());
+  if (needed > out_cap) return -needed;
+  std::memcpy(out, buf.data(), static_cast<size_t>(needed));
+  return needed;
+}
+
+// ---------------------------------------------------------------------------
+// Infix parser (grammar of models/trees.py parse_expression): + - * / ^ with
+// precedence, right-assoc ^, unary minus, f(x), f(x, y), floats, variables
+// (names list or x<k>). Returns postfix length, or -1 with err filled.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Parser {
+  std::vector<std::string> toks;
+  size_t pos = 0;
+  const std::vector<std::string>& unames;
+  const std::vector<std::string>& bnames;
+  const std::vector<std::string>& vnames;
+  std::vector<Node>& nodes;
+  std::string err;
+
+  Parser(const std::string& s, const std::vector<std::string>& u,
+         const std::vector<std::string>& b, const std::vector<std::string>& v,
+         std::vector<Node>& nd)
+      : unames(u), bnames(b), vnames(v), nodes(nd) {
+    size_t i = 0;
+    while (i < s.size()) {
+      char ch = s[i];
+      if (std::isspace(static_cast<unsigned char>(ch))) { ++i; continue; }
+      if (std::isalpha(static_cast<unsigned char>(ch)) || ch == '_') {
+        size_t j = i;
+        while (j < s.size() &&
+               (std::isalnum(static_cast<unsigned char>(s[j])) || s[j] == '_'))
+          ++j;
+        toks.push_back(s.substr(i, j - i));
+        i = j;
+      } else if (std::isdigit(static_cast<unsigned char>(ch)) || ch == '.') {
+        size_t j = i;
+        while (j < s.size() &&
+               (std::isdigit(static_cast<unsigned char>(s[j])) || s[j] == '.'))
+          ++j;
+        if (j < s.size() && (s[j] == 'e' || s[j] == 'E')) {
+          size_t j2 = j + 1;
+          if (j2 < s.size() && (s[j2] == '+' || s[j2] == '-')) ++j2;
+          if (j2 < s.size() && std::isdigit(static_cast<unsigned char>(s[j2]))) {
+            while (j2 < s.size() &&
+                   std::isdigit(static_cast<unsigned char>(s[j2])))
+              ++j2;
+            j = j2;
+          }
+        }
+        toks.push_back(s.substr(i, j - i));
+        i = j;
+      } else {
+        toks.push_back(std::string(1, ch));
+        ++i;
+      }
+    }
+  }
+
+  const std::string* peek() const { return pos < toks.size() ? &toks[pos] : nullptr; }
+  std::string take() { return toks[pos++]; }
+  bool fail(const std::string& m) { if (err.empty()) err = m; return false; }
+
+  int32_t add(Node nd) {
+    nodes.push_back(nd);
+    return static_cast<int32_t>(nodes.size() - 1);
+  }
+
+  int find(const std::vector<std::string>& v, const std::string& s) const {
+    for (size_t i = 0; i < v.size(); ++i)
+      if (v[i] == s) return static_cast<int>(i);
+    return -1;
+  }
+
+  bool is_number(const std::string& t) const {
+    return !t.empty() &&
+           (std::isdigit(static_cast<unsigned char>(t[0])) || t[0] == '.');
+  }
+
+  bool expect(const char* tok) {
+    if (pos >= toks.size() || toks[pos] != tok)
+      return fail(std::string("expected '") + tok + "'");
+    ++pos;
+    return true;
+  }
+
+  bool primary(int32_t* out) {
+    if (pos >= toks.size()) return fail("unexpected end of expression");
+    std::string t = take();
+    if (t == "(") {
+      if (!sum(out)) return false;
+      return expect(")");
+    }
+    if (t == "-") {
+      int32_t child;
+      if (!primary(&child)) return false;
+      if (nodes[child].kind == KCONST && nodes[child].l < 0) {
+        nodes[child].cval = -nodes[child].cval;
+        *out = child;
+        return true;
+      }
+      int ni = find(unames, "neg");
+      if (ni >= 0) {
+        *out = add({KUNA, ni, 0, 0.0, child, -1});
+        return true;
+      }
+      int bi = find(bnames, "-");
+      if (bi < 0) return fail("no neg/'-' operator for unary minus");
+      int32_t zero = add({KCONST, 0, 0, 0.0, -1, -1});
+      *out = add({KBIN, bi, 0, 0.0, zero, child});
+      return true;
+    }
+    if (is_number(t)) {
+      *out = add({KCONST, 0, 0, std::strtod(t.c_str(), nullptr), -1, -1});
+      return true;
+    }
+    // identifier
+    if (peek() && *peek() == "(") {
+      take();
+      std::vector<int32_t> args;
+      int32_t a;
+      if (!sum(&a)) return false;
+      args.push_back(a);
+      while (peek() && *peek() == ",") {
+        take();
+        if (!sum(&a)) return false;
+        args.push_back(a);
+      }
+      if (!expect(")")) return false;
+      if (args.size() == 1) {
+        int ui = find(unames, t);
+        if (ui < 0) return fail("unknown unary operator '" + t + "'");
+        *out = add({KUNA, ui, 0, 0.0, args[0], -1});
+        return true;
+      }
+      if (args.size() == 2) {
+        int bi = find(bnames, t);
+        if (bi < 0) return fail("unknown binary operator '" + t + "'");
+        *out = add({KBIN, bi, 0, 0.0, args[0], args[1]});
+        return true;
+      }
+      return fail("operators take 1 or 2 arguments");
+    }
+    int vi = find(vnames, t);
+    if (vi < 0 && vnames.empty() && t.size() > 1 && t[0] == 'x') {
+      bool digits = true;
+      for (size_t i = 1; i < t.size(); ++i)
+        digits = digits && std::isdigit(static_cast<unsigned char>(t[i]));
+      if (digits) vi = std::atoi(t.c_str() + 1);
+    }
+    if (vi < 0) return fail("unknown identifier '" + t + "'");
+    *out = add({KVAR, 0, vi, 0.0, -1, -1});
+    return true;
+  }
+
+  bool power(int32_t* out) {
+    if (!primary(out)) return false;
+    if (peek() && *peek() == "^") {
+      take();
+      int32_t rhs;
+      if (!power(&rhs)) return false;  // right-assoc
+      int bi = find(bnames, "^");
+      if (bi < 0) return fail("'^' not in operator set");
+      *out = add({KBIN, bi, 0, 0.0, *out, rhs});
+    }
+    return true;
+  }
+
+  bool product(int32_t* out) {
+    if (!power(out)) return false;
+    while (peek() && (*peek() == "*" || *peek() == "/")) {
+      std::string t = take();
+      int32_t rhs;
+      if (!power(&rhs)) return false;
+      int bi = find(bnames, t);
+      if (bi < 0) return fail("'" + t + "' not in operator set");
+      *out = add({KBIN, bi, 0, 0.0, *out, rhs});
+    }
+    return true;
+  }
+
+  bool sum(int32_t* out) {
+    if (!product(out)) return false;
+    while (peek() && (*peek() == "+" || *peek() == "-")) {
+      std::string t = take();
+      int32_t rhs;
+      if (!product(&rhs)) return false;
+      int bi = find(bnames, t);
+      if (bi < 0) return fail("'" + t + "' not in operator set");
+      *out = add({KBIN, bi, 0, 0.0, *out, rhs});
+    }
+    return true;
+  }
+};
+
+}  // namespace
+
+int32_t srt_parse(const char* s,
+                  const char* una_names_joined, const char* bin_names_joined,
+                  const char* var_names_joined, int32_t L,
+                  int32_t* kind, int32_t* op, int32_t* feat, float* cval,
+                  char* err, int32_t err_cap) {
+  auto unames = split_lines(una_names_joined);
+  auto bnames = split_lines(bin_names_joined);
+  auto vnames = split_lines(var_names_joined);
+  std::vector<Node> nodes;
+  Parser p(s ? s : "", unames, bnames, vnames, nodes);
+  int32_t root;
+  if (!p.sum(&root) || p.pos != p.toks.size()) {
+    set_err(err, err_cap,
+            p.err.empty() ? std::string("trailing tokens") : p.err);
+    return -1;
+  }
+  int32_t n = emit_postfix(nodes, root, L, kind, op, feat, cval);
+  if (n < 0) {
+    set_err(err, err_cap, "expression exceeds max_len");
+    return -1;
+  }
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// Simplifier: combine_operators + constant folding to a fixed point.
+// Semantics mirror models/mutate_device.py simplify_tree/_combine_pass:
+//   fold:    any operator subtree whose value is a finite constant collapses
+//   combine: (Lc1 in) out c2 rules over {+,-,*,/}; commutative rotation of
+//            constant left children for + and *
+// Arrays are modified in place. Returns number of trees changed, or -1.
+// una_map/bin_map translate the tree's op indices to native opcodes.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct CombineTables {
+  // [inner][outer] -> result op (or -1); fold value computed by rule id
+  int fold_rule[4][4];  // indices: 0=+,1=-,2=*,3=/ within set or -1
+  int set_idx[4];       // operator-set index of +,-,*,/ (or -1)
+  int native_of_set(int set_op, const int32_t* bin_map, int n_bin) const {
+    return set_op >= 0 && set_op < n_bin ? bin_map[set_op] : -1;
+  }
+};
+
+// returns arithmetic family slot for a native binary opcode (or -1)
+inline int fam(int32_t native) {
+  switch (native) {
+    case B_ADD: return 0;
+    case B_SUB: return 1;
+    case B_MUL: return 2;
+    case B_DIV: return 3;
+    default: return -1;
+  }
+}
+
+// (L in c1) out c2  =  L res (fold(c1, c2)); families 0..3 = + - * /
+// rules from models/mutate_device.py _combine_fold_table
+inline bool combine_rule(int in_f, int out_f, double c1, double c2,
+                         int* res_f, double* v) {
+  if (in_f == 0 && out_f == 0) { *res_f = 0; *v = c1 + c2; return true; }
+  if (in_f == 0 && out_f == 1) { *res_f = 0; *v = c1 - c2; return true; }
+  if (in_f == 1 && out_f == 0) { *res_f = 1; *v = c1 - c2; return true; }
+  if (in_f == 1 && out_f == 1) { *res_f = 1; *v = c1 + c2; return true; }
+  if (in_f == 2 && out_f == 2) { *res_f = 2; *v = c1 * c2; return true; }
+  if (in_f == 2 && out_f == 3) { *res_f = 2; *v = c1 / c2; return true; }
+  if (in_f == 3 && out_f == 2) { *res_f = 3; *v = c1 / c2; return true; }
+  if (in_f == 3 && out_f == 3) { *res_f = 3; *v = c1 * c2; return true; }
+  return false;
+}
+
+// Simplify one tree in node form (all rewrites mutate nodes in place, so
+// the root index never changes). Returns true if anything changed.
+bool simplify_nodes(std::vector<Node>& nodes, int root,
+                    const int32_t* una_map, int n_una,
+                    const int32_t* bin_map, int n_bin,
+                    bool do_fold, bool do_combine) {
+  // operator-set index per family (+,-,*,/) for rewriting combine results
+  int set_of_fam[4] = {-1, -1, -1, -1};
+  for (int i = 0; i < n_bin; ++i) {
+    int f = fam(bin_map[i]);
+    if (f >= 0) set_of_fam[f] = i;
+  }
+  bool changed_any = false;
+  for (int pass = 0; pass < 64; ++pass) {
+    bool changed = false;
+    // bottom-up walk via explicit stack (postorder on current graph)
+    std::vector<int32_t> order;
+    {
+      std::vector<std::pair<int32_t, bool>> st{{root, false}};
+      while (!st.empty()) {
+        auto [idx, vis] = st.back();
+        st.pop_back();
+        if (vis) { order.push_back(idx); continue; }
+        st.push_back({idx, true});
+        if (nodes[idx].r >= 0) st.push_back({nodes[idx].r, false});
+        if (nodes[idx].l >= 0) st.push_back({nodes[idx].l, false});
+      }
+    }
+    for (int32_t idx : order) {
+      Node& nd = nodes[idx];
+      if (do_fold && nd.kind == KUNA && nodes[nd.l].kind == KCONST) {
+        int32_t nat = nd.op < n_una ? una_map[nd.op] : -1;
+        if (nat >= 0) {
+          double v = apply_una(nat, nodes[nd.l].cval);
+          if (std::isfinite(v)) {
+            nd = {KCONST, 0, 0, v, -1, -1};
+            changed = true;
+            continue;
+          }
+        }
+      }
+      if (nd.kind != KBIN) continue;
+      Node& lc = nodes[nd.l];
+      Node& rc = nodes[nd.r];
+      int32_t nat = nd.op < n_bin ? bin_map[nd.op] : -1;
+      if (do_fold && nat >= 0 && lc.kind == KCONST && rc.kind == KCONST) {
+        double v = apply_bin(nat, lc.cval, rc.cval);
+        if (std::isfinite(v)) {
+          nd = {KCONST, 0, 0, v, -1, -1};
+          changed = true;
+          continue;
+        }
+      }
+      if (!do_combine || nat < 0) continue;
+      int out_f = fam(nat);
+      if (out_f < 0) continue;
+      // combine: right child const, left child BIN with right child const
+      if (rc.kind == KCONST && lc.kind == KBIN && lc.op < n_bin) {
+        int in_f = fam(bin_map[lc.op]);
+        if (in_f >= 0 && nodes[lc.r].kind == KCONST) {
+          int res_f;
+          double v;
+          if (combine_rule(in_f, out_f, nodes[lc.r].cval, rc.cval,
+                           &res_f, &v) &&
+              std::isfinite(v) && set_of_fam[res_f] >= 0) {
+            // nd := (lc.l  res_f  v)
+            rc = {KCONST, 0, 0, v, -1, -1};
+            nd.op = set_of_fam[res_f];
+            nd.l = lc.l;
+            changed = true;
+            continue;
+          }
+        }
+      }
+      // commutative rotation: const left, non-const right (for + and *)
+      if ((out_f == 0 || out_f == 2) && lc.kind == KCONST &&
+          rc.kind != KCONST) {
+        std::swap(nd.l, nd.r);
+        changed = true;
+      }
+    }
+    if (!changed) break;
+    changed_any = true;
+  }
+  return changed_any;
+}
+
+}  // namespace
+
+int64_t srt_simplify_batch(int64_t T, int32_t L,
+                           int32_t* kind, int32_t* op, int32_t* feat,
+                           float* cval, int32_t* length,
+                           const int32_t* una_map, int32_t n_una,
+                           const int32_t* bin_map, int32_t n_bin,
+                           int32_t do_fold, int32_t do_combine) {
+  int64_t n_changed = 0;
+  std::vector<Node> nodes;
+  for (int64_t t = 0; t < T; ++t) {
+    int32_t* k = kind + t * L;
+    int32_t* o = op + t * L;
+    int32_t* f = feat + t * L;
+    float* c = cval + t * L;
+    int32_t n = length[t];
+    if (n <= 0 || n > L) continue;
+    int root = build_nodes(k, o, f, c, n, nodes);
+    if (root < 0) continue;
+    if (!simplify_nodes(nodes, root, una_map, n_una, bin_map, n_bin,
+                        do_fold != 0, do_combine != 0))
+      continue;
+    int32_t n2 = emit_postfix(nodes, root, L, k, o, f, c);
+    if (n2 > 0) {
+      length[t] = n2;
+      ++n_changed;
+    }
+  }
+  return n_changed;
+}
+
+// ---------------------------------------------------------------------------
+// Multithreaded batched evaluator — the reference's CPU path
+// (DynamicExpressions eval_tree_array over a multithreaded population).
+// X row-major (nfeat, n) f32; y out (T, n) f32; ok out (T,) u8.
+// ---------------------------------------------------------------------------
+
+int32_t srt_eval_batch(int64_t T, int32_t L,
+                       const int32_t* kind, const int32_t* op,
+                       const int32_t* feat, const float* cval,
+                       const int32_t* length,
+                       const float* X, int32_t nfeat, int64_t n,
+                       const int32_t* una_map, int32_t n_una,
+                       const int32_t* bin_map, int32_t n_bin,
+                       float* y, uint8_t* ok, int32_t n_threads) {
+  if (n_threads <= 0) {
+    n_threads = static_cast<int32_t>(std::thread::hardware_concurrency());
+    if (n_threads <= 0) n_threads = 1;
+  }
+  n_threads = static_cast<int32_t>(
+      std::min<int64_t>(n_threads, std::max<int64_t>(T, 1)));
+  std::vector<uint8_t> valid_ops(static_cast<size_t>(T), 1);
+
+  auto worker = [&](int64_t t0, int64_t t1) {
+    constexpr int64_t RB = 512;  // row block: keeps the stack in L1
+    std::vector<double> stack(static_cast<size_t>(L / 2 + 2) * RB);
+    for (int64_t t = t0; t < t1; ++t) {
+      const int32_t* k = kind + t * L;
+      const int32_t* o = op + t * L;
+      const int32_t* f = feat + t * L;
+      const float* c = cval + t * L;
+      int32_t len = length[t];
+      float* yt = y + t * n;
+      bool good = len > 0 && len <= L;
+      if (good) {  // validate structure + op indices once per tree
+        int32_t sp = 0;
+        for (int32_t i = 0; i < len && good; ++i) {
+          switch (k[i]) {
+            case KCONST: case KVAR: ++sp; break;
+            case KUNA:
+              good = sp >= 1 && o[i] < n_una && una_map[o[i]] >= 0;
+              break;
+            case KBIN:
+              good = sp >= 2 && o[i] < n_bin && bin_map[o[i]] >= 0;
+              --sp;
+              break;
+            default: good = false;
+          }
+          good = good && (k[i] != KVAR || (f[i] >= 0 && f[i] < nfeat));
+        }
+        good = good && sp == 1;
+      }
+      if (!good) {
+        for (int64_t r = 0; r < n; ++r) yt[r] = std::nanf("");
+        ok[t] = 0;
+        continue;
+      }
+      bool finite = true;
+      for (int64_t r0 = 0; r0 < n; r0 += RB) {
+        int64_t rb = std::min(RB, n - r0);
+        int32_t sp = 0;
+        for (int32_t i = 0; i < len; ++i) {
+          double* out_row = &stack[static_cast<size_t>(sp) * RB];
+          switch (k[i]) {
+            case KCONST: {
+              double v = c[i];
+              for (int64_t r = 0; r < rb; ++r) out_row[r] = v;
+              ++sp;
+              break;
+            }
+            case KVAR: {
+              const float* xr = X + static_cast<int64_t>(f[i]) * n + r0;
+              for (int64_t r = 0; r < rb; ++r) out_row[r] = xr[r];
+              ++sp;
+              break;
+            }
+            case KUNA: {
+              double* a = &stack[static_cast<size_t>(sp - 1) * RB];
+              int32_t nat = una_map[o[i]];
+              for (int64_t r = 0; r < rb; ++r) a[r] = apply_una(nat, a[r]);
+              break;
+            }
+            case KBIN: {
+              double* a = &stack[static_cast<size_t>(sp - 2) * RB];
+              double* b = &stack[static_cast<size_t>(sp - 1) * RB];
+              int32_t nat = bin_map[o[i]];
+              for (int64_t r = 0; r < rb; ++r)
+                a[r] = apply_bin(nat, a[r], b[r]);
+              --sp;
+              break;
+            }
+          }
+        }
+        const double* res = &stack[0];
+        for (int64_t r = 0; r < rb; ++r) {
+          float v = static_cast<float>(res[r]);
+          yt[r0 + r] = v;
+          finite = finite && std::isfinite(v);
+        }
+      }
+      ok[t] = finite ? 1 : 0;
+    }
+  };
+
+  if (n_threads == 1) {
+    worker(0, T);
+  } else {
+    std::vector<std::thread> threads;
+    int64_t chunk = (T + n_threads - 1) / n_threads;
+    for (int32_t i = 0; i < n_threads; ++i) {
+      int64_t t0 = i * chunk, t1 = std::min<int64_t>(T, t0 + chunk);
+      if (t0 >= t1) break;
+      threads.emplace_back(worker, t0, t1);
+    }
+    for (auto& th : threads) th.join();
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// CSV loader (host IO). Two-phase: probe shape, then fill a caller buffer.
+// Accepts an optional header row (detected: any field that fails to parse as
+// a float). Delimiter auto-detect among [',', '\t', ';', ' '] when delim=0.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+char detect_delim(const std::string& line) {
+  // space is a last resort: header names may themselves contain spaces
+  const char cands[] = {',', '\t', ';'};
+  char best = ',';
+  size_t best_n = 0;
+  for (char d : cands) {
+    size_t cnt = 0;
+    for (char ch : line) cnt += ch == d;
+    if (cnt > best_n) { best_n = cnt; best = d; }
+  }
+  if (best_n == 0) return ' ';
+  return best;
+}
+
+std::vector<std::string> split_fields(const std::string& line, char d) {
+  std::vector<std::string> out;
+  size_t i = 0;
+  while (i <= line.size()) {
+    size_t j = line.find(d, i);
+    if (j == std::string::npos) j = line.size();
+    std::string fld = line.substr(i, j - i);
+    // trim
+    size_t a = fld.find_first_not_of(" \t\r");
+    size_t b = fld.find_last_not_of(" \t\r");
+    out.push_back(a == std::string::npos ? "" : fld.substr(a, b - a + 1));
+    i = j + 1;
+    if (j == line.size()) break;
+  }
+  // drop trailing empties caused by space-delimited runs
+  while (out.size() > 1 && out.back().empty()) out.pop_back();
+  return out;
+}
+
+bool parse_field(const std::string& s, double* v) {
+  if (s.empty()) return false;
+  char* end = nullptr;
+  *v = std::strtod(s.c_str(), &end);
+  return end && *end == '\0';
+}
+
+}  // namespace
+
+int32_t srt_csv_probe(const char* path, char delim, int64_t* rows,
+                      int64_t* cols, int32_t* has_header,
+                      char* header_out, int64_t header_cap) {
+  FILE* fp = std::fopen(path, "r");
+  if (!fp) return -1;
+  std::string line;
+  char buf[1 << 16];
+  int64_t r = 0, c = 0;
+  int hdr = -1;
+  char d = delim;
+  while (std::fgets(buf, sizeof buf, fp)) {
+    line.assign(buf);
+    while (!line.empty() && (line.back() == '\n' || line.back() == '\r'))
+      line.pop_back();
+    if (line.empty()) continue;
+    if (!d) d = detect_delim(line);
+    auto fields = split_fields(line, d);
+    if (hdr < 0) {
+      double v;
+      hdr = 0;
+      for (const auto& f : fields)
+        if (!parse_field(f, &v)) { hdr = 1; break; }
+      c = static_cast<int64_t>(fields.size());
+      if (hdr == 1 && header_out && header_cap > 0) {
+        std::string joined;
+        for (size_t i = 0; i < fields.size(); ++i) {
+          if (i) joined += '\n';
+          joined += fields[i];
+        }
+        std::snprintf(header_out, static_cast<size_t>(header_cap), "%s",
+                      joined.c_str());
+      }
+      if (hdr == 1) continue;  // header row doesn't count
+    }
+    ++r;
+  }
+  std::fclose(fp);
+  *rows = r;
+  *cols = c;
+  *has_header = hdr == 1;
+  return 0;
+}
+
+int32_t srt_csv_read(const char* path, char delim, int32_t skip_header,
+                     double* out, int64_t rows, int64_t cols) {
+  FILE* fp = std::fopen(path, "r");
+  if (!fp) return -1;
+  std::string line;
+  char buf[1 << 16];
+  char d = delim;
+  int64_t r = 0;
+  bool first = true;
+  int rc = 0;
+  while (std::fgets(buf, sizeof buf, fp) && r < rows) {
+    line.assign(buf);
+    while (!line.empty() && (line.back() == '\n' || line.back() == '\r'))
+      line.pop_back();
+    if (line.empty()) continue;
+    if (!d) d = detect_delim(line);
+    if (first && skip_header) { first = false; continue; }
+    first = false;
+    auto fields = split_fields(line, d);
+    if (static_cast<int64_t>(fields.size()) != cols) { rc = -2; break; }
+    for (int64_t c = 0; c < cols; ++c) {
+      double v;
+      if (!parse_field(fields[static_cast<size_t>(c)], &v)) { rc = -3; break; }
+      out[r * cols + c] = v;
+    }
+    if (rc) break;
+    ++r;
+  }
+  std::fclose(fp);
+  if (rc) return rc;
+  return r == rows ? 0 : -4;
+}
+
+}  // extern "C"

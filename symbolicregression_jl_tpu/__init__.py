@@ -14,7 +14,12 @@ Layout:
   utils/     printing, export, checkpointing, recorder, progress
 """
 
-from .models.dataset import Dataset, make_dataset, update_baseline_loss
+from .models.dataset import (
+    Dataset,
+    load_csv_dataset,
+    make_dataset,
+    update_baseline_loss,
+)
 from .models.options import (
     ComplexityMapping,
     MutationWeights,
@@ -60,6 +65,7 @@ EquationSearch = equation_search
 
 __all__ = [
     "Dataset",
+    "load_csv_dataset",
     "make_dataset",
     "update_baseline_loss",
     "Options",

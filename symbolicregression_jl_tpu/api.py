@@ -293,8 +293,10 @@ def equation_search(
     progress = SearchProgress(total_its, options)
     bar = ProgressBar(total_its)
     monitor = ResourceMonitor()
+    # 'q'-to-quit is single-controller only: on multi-host SPMD a break taken
+    # on host 0 alone would desync the collective-issuing host loops.
     quit_watcher = QuitWatcher(
-        enabled=options.verbosity > 0 and is_primary_host()
+        enabled=options.verbosity > 0 and jax.process_count() == 1
     )
     global_it = 0  # host-loop iterations completed across all outputs
 

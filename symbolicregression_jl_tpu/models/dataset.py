@@ -107,3 +107,81 @@ def update_baseline_loss(dataset: Dataset, options_or_loss) -> Dataset:
             )
     dataset.baseline_loss = base if np.isfinite(base) and base > 0 else 1.0
     return dataset
+
+
+def load_csv_dataset(
+    path: str,
+    target: "str | int" = -1,
+    delimiter: Optional[str] = None,
+    weights_column: "Optional[str | int]" = None,
+    dtype=jnp.float32,
+) -> Dataset:
+    """Load a Dataset from a numeric CSV/TSV file.
+
+    Rows are samples, columns are features; `target` picks the y column by
+    header name or index (default: last column). Parsing goes through the
+    C++ host runtime (native/srtpu_native.cpp srt_csv_*) when built, with a
+    numpy fallback. Column names become variable_names.
+    """
+    from .. import native
+
+    data = None
+    names = None
+    loaded = native.load_csv(path, delimiter) if native.native_available() else None
+    if loaded is not None:
+        data, names = loaded
+    else:
+        # numpy fallback: sniff a header line
+        with open(path) as f:
+            first = f.readline()
+        delim = delimiter
+        if delim is None:
+            # space is a last resort: header names may contain spaces
+            delim = max(",;\t", key=first.count) if first else ","
+            if first.count(delim) == 0:
+                delim = " "
+        fields = [c.strip() for c in first.strip().split(delim)]
+
+        def _is_num(s):
+            try:
+                float(s)
+                return True
+            except ValueError:
+                return False
+
+        has_header = any(not _is_num(c) for c in fields if c)
+        if has_header:
+            # keep positional alignment with data columns; name blanks
+            names = [c if c else f"col{i}" for i, c in enumerate(fields)]
+        data = np.loadtxt(
+            path, delimiter=None if delim == " " else delim,
+            skiprows=1 if has_header else 0,
+        )
+        if data.ndim == 1:
+            data = data[:, None]
+
+    ncols = data.shape[1]
+
+    def _col_index(sel, what: str) -> int:
+        if isinstance(sel, str):
+            if names is None or sel not in names:
+                raise ValueError(f"No column named {sel!r} in {path!r}")
+            return names.index(sel)
+        if not -ncols <= sel < ncols:
+            raise ValueError(
+                f"{what} index {sel} out of range for {ncols} columns"
+            )
+        return sel % ncols
+
+    t_idx = _col_index(target, "target")
+    w_idx = (
+        _col_index(weights_column, "weights_column")
+        if weights_column is not None
+        else None
+    )
+    feat_idx = [i for i in range(ncols) if i != t_idx and i != w_idx]
+    X = data[:, feat_idx].T
+    y = data[:, t_idx]
+    w = data[:, w_idx] if w_idx is not None else None
+    var_names = [names[i] for i in feat_idx] if names is not None else None
+    return make_dataset(X, y, w, var_names, dtype=dtype)

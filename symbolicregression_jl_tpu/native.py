@@ -1,0 +1,356 @@
+"""ctypes bindings for the C++ host runtime (native/srtpu_native.cpp).
+
+The TPU compute path is JAX/XLA/Pallas; this module exposes the native host
+runtime around it — batched tree printing, infix parsing, host-side
+simplification (constant folding + operator combining), a multithreaded CPU
+evaluator (the analog of the reference's DynamicExpressions CPU eval path),
+and a CSV dataset loader.
+
+Every entry point has a pure-Python fallback in the package (trees.py /
+mutate_device.py / interpreter.py), so the framework works without the
+shared library; when `libsrtpu_native.so` is present (built by
+`make -C native`, attempted automatically once per process) the fast paths
+are used. Custom Python-registered operators are never routed here —
+`op_maps()` returns None for unknown names and callers fall back.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .ops.operators import INFIX, OperatorSet
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "_lib", "libsrtpu_native.so")
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+_i32p = ctypes.POINTER(ctypes.c_int32)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+_f32p = ctypes.POINTER(ctypes.c_float)
+_f64p = ctypes.POINTER(ctypes.c_double)
+
+
+def _try_build() -> None:
+    """Build the .so from source if missing/stale and a toolchain exists."""
+    src = os.path.join(_SRC_DIR, "srtpu_native.cpp")
+    if not os.path.exists(src):
+        return
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src):
+        return
+    try:
+        subprocess.run(
+            ["make", "-C", _SRC_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except Exception:
+        pass
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        _try_build()
+        if not os.path.exists(_LIB_PATH):
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        if lib.srt_abi_version() != 1:
+            return None
+
+        lib.srt_op_id.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+        lib.srt_op_id.restype = ctypes.c_int32
+        lib.srt_print_batch.argtypes = [
+            ctypes.c_int64, ctypes.c_int32,
+            _i32p, _i32p, _i32p, _f32p, _i32p,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_char_p, ctypes.c_int64, _i64p,
+        ]
+        lib.srt_print_batch.restype = ctypes.c_int64
+        lib.srt_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int32,
+            _i32p, _i32p, _i32p, _f32p,
+            ctypes.c_char_p, ctypes.c_int32,
+        ]
+        lib.srt_parse.restype = ctypes.c_int32
+        lib.srt_simplify_batch.argtypes = [
+            ctypes.c_int64, ctypes.c_int32,
+            _i32p, _i32p, _i32p, _f32p, _i32p,
+            _i32p, ctypes.c_int32, _i32p, ctypes.c_int32,
+            ctypes.c_int32, ctypes.c_int32,
+        ]
+        lib.srt_simplify_batch.restype = ctypes.c_int64
+        lib.srt_eval_batch.argtypes = [
+            ctypes.c_int64, ctypes.c_int32,
+            _i32p, _i32p, _i32p, _f32p, _i32p,
+            _f32p, ctypes.c_int32, ctypes.c_int64,
+            _i32p, ctypes.c_int32, _i32p, ctypes.c_int32,
+            _f32p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32,
+        ]
+        lib.srt_eval_batch.restype = ctypes.c_int32
+        lib.srt_csv_probe.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, _i64p, _i64p, _i32p,
+            ctypes.c_char_p, ctypes.c_int64,
+        ]
+        lib.srt_csv_probe.restype = ctypes.c_int32
+        lib.srt_csv_read.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_int32, _f64p,
+            ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.srt_csv_read.restype = ctypes.c_int32
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def op_maps(operators: OperatorSet) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(una_map, bin_map): operator-set index -> native opcode, or None if
+    any operator has no native implementation (custom Python op)."""
+    lib = _load()
+    if lib is None:
+        return None
+    una = np.array(
+        [lib.srt_op_id(n.encode(), 0) for n in operators.unary_names],
+        np.int32,
+    )
+    bina = np.array(
+        [lib.srt_op_id(n.encode(), 1) for n in operators.binary_names],
+        np.int32,
+    )
+    if (len(una) and una.min() < 0) or (len(bina) and bina.min() < 0):
+        return None
+    return una, bina
+
+
+def _as_c(tree_field, dtype) -> np.ndarray:
+    arr = np.ascontiguousarray(np.asarray(tree_field), dtype)
+    return arr
+
+
+def _names_blob(names: Sequence[str]) -> bytes:
+    return "\n".join(names).encode()
+
+
+def trees_to_strings(
+    kind, op, feat, cval, length,
+    operators: OperatorSet,
+    variable_names: Optional[Sequence[str]] = None,
+) -> Optional[List[str]]:
+    """Batched postfix -> infix strings; None if native path unavailable.
+
+    Output is identical to models.trees.tree_to_string (same %.6g constant
+    formatting, same infix/call forms)."""
+    lib = _load()
+    if lib is None:
+        return None
+    kind = _as_c(kind, np.int32)
+    T = int(np.prod(kind.shape[:-1])) if kind.ndim > 1 else 1
+    L = kind.shape[-1]
+    kind = kind.reshape(T, L)
+    op = _as_c(op, np.int32).reshape(T, L)
+    feat = _as_c(feat, np.int32).reshape(T, L)
+    cval = _as_c(cval, np.float32).reshape(T, L)
+    length = _as_c(length, np.int32).reshape(T)
+    infix = np.array(
+        [1 if n in INFIX else 0 for n in operators.binary_names], np.uint8
+    )
+    offsets = np.zeros(T, np.int64)
+    cap = 64 * T + 1024
+    for _ in range(3):
+        out = ctypes.create_string_buffer(cap)
+        used = lib.srt_print_batch(
+            T, L,
+            kind.ctypes.data_as(_i32p), op.ctypes.data_as(_i32p),
+            feat.ctypes.data_as(_i32p), cval.ctypes.data_as(_f32p),
+            length.ctypes.data_as(_i32p),
+            _names_blob(operators.unary_names),
+            _names_blob(operators.binary_names),
+            _names_blob(variable_names or ()),
+            infix.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out, cap, offsets.ctypes.data_as(_i64p),
+        )
+        if used >= 0:
+            raw = out.raw[:used]
+            return [
+                raw[offsets[t]: raw.index(b"\0", offsets[t])].decode()
+                for t in range(T)
+            ]
+        cap = int(-used) + 1024
+    return None
+
+
+def parse_to_arrays(
+    s: str,
+    operators: OperatorSet,
+    max_len: int,
+    variable_names: Optional[Sequence[str]] = None,
+):
+    """Parse infix -> (kind, op, feat, cval, length) numpy arrays.
+
+    Returns None if the native library is unavailable; raises ValueError on
+    a parse error (same contract as models.trees.parse_expression)."""
+    lib = _load()
+    if lib is None:
+        return None
+    kind = np.zeros(max_len, np.int32)
+    op = np.zeros(max_len, np.int32)
+    feat = np.zeros(max_len, np.int32)
+    cval = np.zeros(max_len, np.float32)
+    err = ctypes.create_string_buffer(256)
+    n = lib.srt_parse(
+        s.encode(),
+        _names_blob(operators.unary_names),
+        _names_blob(operators.binary_names),
+        _names_blob(variable_names or ()),
+        max_len,
+        kind.ctypes.data_as(_i32p), op.ctypes.data_as(_i32p),
+        feat.ctypes.data_as(_i32p), cval.ctypes.data_as(_f32p),
+        err, 256,
+    )
+    if n < 0:
+        raise ValueError(f"parse error in {s!r}: {err.value.decode()}")
+    return kind, op, feat, cval, np.int32(n)
+
+
+def simplify_arrays(
+    kind, op, feat, cval, length,
+    operators: OperatorSet,
+    fold: bool = True,
+    combine: bool = True,
+):
+    """Host-side simplify (fold + combine) on postfix arrays.
+
+    Returns (kind, op, feat, cval, length, n_changed) or None if native
+    unavailable / custom operators present."""
+    maps = op_maps(operators)
+    if maps is None:
+        return None
+    una_map, bin_map = maps
+    lib = _load()
+    kind = _as_c(kind, np.int32).copy()
+    shape = kind.shape
+    T = int(np.prod(shape[:-1])) if kind.ndim > 1 else 1
+    L = shape[-1]
+    kind = kind.reshape(T, L)
+    op = _as_c(op, np.int32).copy().reshape(T, L)
+    feat = _as_c(feat, np.int32).copy().reshape(T, L)
+    cval = _as_c(cval, np.float32).copy().reshape(T, L)
+    length = _as_c(length, np.int32).copy().reshape(T)
+    n_changed = lib.srt_simplify_batch(
+        T, L,
+        kind.ctypes.data_as(_i32p), op.ctypes.data_as(_i32p),
+        feat.ctypes.data_as(_i32p), cval.ctypes.data_as(_f32p),
+        length.ctypes.data_as(_i32p),
+        una_map.ctypes.data_as(_i32p), len(una_map),
+        bin_map.ctypes.data_as(_i32p), len(bin_map),
+        int(fold), int(combine),
+    )
+    batch = shape[:-1]
+    return (
+        kind.reshape(shape), op.reshape(shape), feat.reshape(shape),
+        cval.reshape(shape), length.reshape(batch), int(n_changed),
+    )
+
+
+def eval_batch(
+    kind, op, feat, cval, length,
+    X,
+    operators: OperatorSet,
+    n_threads: int = 0,
+):
+    """Multithreaded CPU evaluation of T trees over X (nfeat, n).
+
+    Returns (y (T, n) float32, ok (T,) bool) or None if unavailable. The
+    reference's CPU hot path (DynamicExpressions eval_tree_array) — used as
+    the honest CPU anchor in benchmarks and as a host-side oracle."""
+    maps = op_maps(operators)
+    if maps is None:
+        return None
+    una_map, bin_map = maps
+    lib = _load()
+    kind = _as_c(kind, np.int32)
+    shape = kind.shape
+    T = int(np.prod(shape[:-1])) if kind.ndim > 1 else 1
+    L = shape[-1]
+    kind = kind.reshape(T, L)
+    op = _as_c(op, np.int32).reshape(T, L)
+    feat = _as_c(feat, np.int32).reshape(T, L)
+    cval = _as_c(cval, np.float32).reshape(T, L)
+    length = _as_c(length, np.int32).reshape(T)
+    X = np.ascontiguousarray(np.asarray(X), np.float32)
+    nfeat, n = X.shape
+    y = np.empty((T, n), np.float32)
+    ok = np.empty(T, np.uint8)
+    rc = lib.srt_eval_batch(
+        T, L,
+        kind.ctypes.data_as(_i32p), op.ctypes.data_as(_i32p),
+        feat.ctypes.data_as(_i32p), cval.ctypes.data_as(_f32p),
+        length.ctypes.data_as(_i32p),
+        X.ctypes.data_as(_f32p), nfeat, n,
+        una_map.ctypes.data_as(_i32p), len(una_map),
+        bin_map.ctypes.data_as(_i32p), len(bin_map),
+        y.ctypes.data_as(_f32p),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        n_threads,
+    )
+    if rc != 0:
+        return None
+    batch = shape[:-1]
+    return y.reshape(batch + (n,)), ok.astype(bool).reshape(batch)
+
+
+def load_csv(path: str, delimiter: Optional[str] = None):
+    """Load a numeric CSV (optional header) -> (data (rows, cols) float64,
+    column_names or None). None if native unavailable; raises OSError /
+    ValueError on IO or format errors."""
+    lib = _load()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    has_header = ctypes.c_int32()
+    header = ctypes.create_string_buffer(1 << 16)
+    d = (delimiter or "\0").encode()[:1]
+    rc = lib.srt_csv_probe(
+        path.encode(), d, ctypes.byref(rows), ctypes.byref(cols),
+        ctypes.byref(has_header), header, len(header),
+    )
+    if rc != 0:
+        raise OSError(f"Cannot read CSV {path!r}")
+    if rows.value <= 0 or cols.value <= 0:
+        raise ValueError(f"Empty CSV {path!r}")
+    data = np.empty((rows.value, cols.value), np.float64)
+    rc = lib.srt_csv_read(
+        path.encode(), d, int(has_header.value),
+        data.ctypes.data_as(_f64p), rows.value, cols.value,
+    )
+    if rc != 0:
+        raise ValueError(f"Malformed CSV {path!r} (code {rc})")
+    names = None
+    if has_header.value:
+        # positional alignment with data columns; name blank fields col<i>
+        names = [
+            c if c else f"col{i}"
+            for i, c in enumerate(header.value.decode().split("\n"))
+        ]
+    return data, names

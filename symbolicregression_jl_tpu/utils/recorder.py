@@ -96,12 +96,22 @@ class Recorder:
         birth = np.asarray(birth)
         npop = int(scores.shape[0])
         prev = self._prev_hashes.get(key, set())
+        # whole-island stringification through the native batch printer when
+        # available (C++ host runtime); per-member Python decode otherwise
+        from .. import native
+
+        eqs = None
+        if native.op_maps(self.options.operators) is not None:
+            eqs = native.trees_to_strings(
+                trees_np.kind, trees_np.op, trees_np.feat, trees_np.cval,
+                trees_np.length, self.options.operators, self.variable_names,
+            )
         members: List[RecordType] = []
         cur: set = set()
         for m in range(npop):
             t = jax.tree_util.tree_map(lambda x: x[m], trees_np)
             ref = _tree_hash(t.kind, t.op, t.feat, t.cval, t.length)
-            eq = expr_to_string(
+            eq = eqs[m] if eqs is not None else expr_to_string(
                 decode_tree(t), self.options.operators, self.variable_names
             )
             members.append(

@@ -143,18 +143,33 @@ def test_eval_diff_matches_analytic():
 
 
 def test_preflight_rejects_overlapping_operators():
+    import dataclasses
+
+    import pytest
+
+    from symbolicregression_jl_tpu.ops.operators import OperatorSet
     from symbolicregression_jl_tpu.utils.preflight import (
         PreflightError, preflight_checks)
-    import symbolicregression_jl_tpu.ops.operators as opmod
 
-    # 'greater' is registered as binary; register a unary with the same name
-    opmod.register_unary("greater_test_dup", jnp.abs)
-    try:
-        options = make_options(binary_operators=["+"], unary_operators=["abs"])
-        X = np.ones((2, 10), np.float32)
-        preflight_checks(options, X, X[:1], None)  # no overlap: fine
-    finally:
-        opmod.UNARY_REGISTRY.pop("greater_test_dup", None)
+    options = make_options(binary_operators=["+"], unary_operators=["abs"])
+    X = np.ones((2, 10), np.float32)
+    preflight_checks(options, X, X[:1], None)  # no overlap: fine
+
+    # make_operator_set rejects overlap at construction, so smuggle an
+    # overlapping set past it to exercise preflight's own check
+    # (reference src/Configure.jl:44-50)
+    overlapping = dataclasses.replace(
+        options.operators,
+        unary_names=("abs", "max"),
+        binary_names=("+", "max"),
+    )
+
+    class Opts:
+        operators = overlapping
+        batching = options.batching
+
+    with pytest.raises(PreflightError, match="both binary and unary"):
+        preflight_checks(Opts(), X, X[:1], None)
 
 
 def test_pipeline_probe_runs():

@@ -1,0 +1,314 @@
+"""Tests for the C++ host runtime (native/srtpu_native.cpp via native.py).
+
+Each native entry point is checked against its pure-Python/JAX counterpart:
+printer vs models.trees.tree_to_string, parser vs parse_expression,
+simplifier vs eval-equivalence (and vs the device simplifier's shrinkage),
+evaluator vs ops.interpreter.eval_trees, CSV loader vs numpy parsing.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from symbolicregression_jl_tpu import native
+from symbolicregression_jl_tpu.models.mutate_device import (
+    gen_random_tree_fixed_size,
+)
+from symbolicregression_jl_tpu.models.trees import (
+    TreeBatch,
+    encode_tree,
+    parse_expression,
+    tree_to_string,
+)
+from symbolicregression_jl_tpu.ops.interpreter import eval_trees
+from symbolicregression_jl_tpu.ops.operators import make_operator_set
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="native library not built"
+)
+
+OPS = make_operator_set(
+    binary_operators=["+", "-", "*", "/", "^"],
+    unary_operators=["cos", "exp", "log", "sqrt", "neg"],
+)
+MAX_LEN = 32
+
+
+def random_trees(n, nfeat=3, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), n)
+    sizes = jax.random.randint(jax.random.PRNGKey(seed + 1), (n,), 1, 16)
+    return jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(k, s, nfeat, OPS, MAX_LEN)
+    )(keys, sizes)
+
+
+def to_np(trees):
+    return tuple(np.asarray(x) for x in trees)
+
+
+class TestPrinter:
+    def test_matches_python_printer(self):
+        trees = random_trees(100)
+        kind, op, feat, cval, length = to_np(trees)
+        got = native.trees_to_strings(kind, op, feat, cval, length, OPS)
+        assert got is not None
+        for t in range(100):
+            want = tree_to_string(trees[t], OPS)
+            assert got[t] == want
+
+    def test_variable_names(self):
+        trees = random_trees(10, nfeat=2, seed=3)
+        kind, op, feat, cval, length = to_np(trees)
+        names = ("alpha", "beta")
+        got = native.trees_to_strings(
+            kind, op, feat, cval, length, OPS, names
+        )
+        for t in range(10):
+            assert got[t] == tree_to_string(trees[t], OPS, names)
+
+    def test_large_batch_buffer_growth(self):
+        trees = random_trees(2000, seed=7)
+        kind, op, feat, cval, length = to_np(trees)
+        got = native.trees_to_strings(kind, op, feat, cval, length, OPS)
+        assert len(got) == 2000
+        assert all(isinstance(s, str) and s for s in got)
+
+
+class TestParser:
+    @pytest.mark.parametrize(
+        "s",
+        [
+            "x0 + x1",
+            "(x0 + 1.5) * cos(x2)",
+            "x0 - x1 - x2",
+            "x0 / x1 / x2",
+            "2 ^ x0 ^ 2",
+            "-x0 + exp(-2.5)",
+            "sqrt(log(x1 + 3))",
+            "1e-3 * x0",
+            "neg(x2) * (x0 - 0.5)",
+        ],
+    )
+    def test_roundtrip_matches_python_parser(self, s):
+        ref = encode_tree(parse_expression(s, OPS), MAX_LEN)
+        got = native.parse_to_arrays(s, OPS, MAX_LEN)
+        assert got is not None
+        kind, op, feat, cval, length = got
+        assert int(length) == int(ref.length)
+        np.testing.assert_array_equal(kind, np.asarray(ref.kind))
+        np.testing.assert_array_equal(op, np.asarray(ref.op))
+        np.testing.assert_array_equal(feat, np.asarray(ref.feat))
+        np.testing.assert_allclose(cval, np.asarray(ref.cval), rtol=1e-6)
+
+    def test_parse_error(self):
+        with pytest.raises(ValueError):
+            native.parse_to_arrays("x0 + unknown_fn(x1)", OPS, MAX_LEN)
+        with pytest.raises(ValueError):
+            native.parse_to_arrays("x0 + ", OPS, MAX_LEN)
+
+    def test_print_parse_roundtrip(self):
+        trees = random_trees(50, seed=11)
+        kind, op, feat, cval, length = to_np(trees)
+        strings = native.trees_to_strings(kind, op, feat, cval, length, OPS)
+        X = np.random.default_rng(0).normal(size=(3, 16)).astype(np.float32)
+        y0, ok0 = eval_trees(trees, jnp.asarray(X), OPS)
+        for t in range(50):
+            k2, o2, f2, c2, n2 = native.parse_to_arrays(
+                strings[t], OPS, MAX_LEN
+            )
+            tb = TreeBatch(
+                kind=jnp.asarray(k2), op=jnp.asarray(o2),
+                feat=jnp.asarray(f2), cval=jnp.asarray(c2),
+                length=jnp.asarray(n2),
+            )
+            y1, _ = eval_trees(tb, jnp.asarray(X), OPS)
+            if bool(ok0[t]):
+                np.testing.assert_allclose(
+                    np.asarray(y1), np.asarray(y0[t]), rtol=1e-3, atol=1e-4
+                )
+
+
+class TestEval:
+    def test_matches_interpreter(self):
+        trees = random_trees(200, seed=5)
+        X = np.random.default_rng(1).normal(size=(3, 100)).astype(np.float32)
+        y_ref, ok_ref = eval_trees(trees, jnp.asarray(X), OPS)
+        kind, op, feat, cval, length = to_np(trees)
+        out = native.eval_batch(kind, op, feat, cval, length, X, OPS)
+        assert out is not None
+        y, ok = out
+        y_ref = np.asarray(y_ref)
+        ok_ref = np.asarray(ok_ref)
+        np.testing.assert_array_equal(ok, ok_ref)
+        # native evaluates in double then casts to f32; the interpreter is
+        # f32 throughout, so deep trees accumulate ~1e-4 relative drift
+        mask = ok_ref
+        np.testing.assert_allclose(
+            y[mask], y_ref[mask], rtol=1e-3, atol=1e-4
+        )
+
+    def test_nan_propagation(self):
+        # log of a negative constant poisons the tree -> ok=False
+        expr = parse_expression("log(0 - 2) + x0", OPS)
+        t = encode_tree(expr, MAX_LEN)
+        kind, op, feat, cval, length = to_np(t)
+        X = np.ones((1, 8), np.float32)
+        y, ok = native.eval_batch(
+            kind[None], op[None], feat[None], cval[None],
+            np.asarray([length]), X, OPS,
+        )
+        assert not ok[0]
+        assert np.isnan(y[0]).all()
+
+    def test_multithreaded_matches_single(self):
+        trees = random_trees(64, seed=9)
+        X = np.random.default_rng(2).normal(size=(3, 64)).astype(np.float32)
+        kind, op, feat, cval, length = to_np(trees)
+        y1, ok1 = native.eval_batch(
+            kind, op, feat, cval, length, X, OPS, n_threads=1
+        )
+        y8, ok8 = native.eval_batch(
+            kind, op, feat, cval, length, X, OPS, n_threads=8
+        )
+        np.testing.assert_array_equal(ok1, ok8)
+        np.testing.assert_array_equal(y1, y8)
+
+
+class TestSimplify:
+    def _simplify_one(self, s, fold=True, combine=True):
+        t = encode_tree(parse_expression(s, OPS), MAX_LEN)
+        kind, op, feat, cval, length = to_np(t)
+        out = native.simplify_arrays(
+            kind[None], op[None], feat[None], cval[None],
+            np.asarray([length]), OPS, fold=fold, combine=combine,
+        )
+        assert out is not None
+        k, o, f, c, n, changed = out
+        tb = TreeBatch(
+            kind=jnp.asarray(k[0]), op=jnp.asarray(o[0]),
+            feat=jnp.asarray(f[0]), cval=jnp.asarray(c[0]),
+            length=jnp.asarray(n[0]),
+        )
+        return tb, changed
+
+    def test_constant_folding(self):
+        tb, changed = self._simplify_one("(1 + 2) * x0")
+        assert changed == 1
+        assert int(tb.length) == 3  # [3, x0, *]
+        assert tree_to_string(tb, OPS) == "(3 * x0)" or tree_to_string(
+            tb, OPS
+        ) == "(x0 * 3)"
+
+    def test_combine_chain(self):
+        # (x0 + 1) + 2 -> x0 + 3
+        tb, changed = self._simplify_one("(x0 + 1) + 2")
+        assert changed == 1
+        assert int(tb.length) == 3
+        assert "3" in tree_to_string(tb, OPS)
+
+    def test_eval_equivalence_random(self):
+        trees = random_trees(150, seed=21)
+        X = np.random.default_rng(3).uniform(0.5, 2.0, (3, 50)).astype(
+            np.float32
+        )
+        y_ref, ok_ref = eval_trees(trees, jnp.asarray(X), OPS)
+        kind, op, feat, cval, length = to_np(trees)
+        out = native.simplify_arrays(
+            kind, op, feat, cval, length, OPS
+        )
+        k, o, f, c, n, _ = out
+        tb = TreeBatch(
+            kind=jnp.asarray(k), op=jnp.asarray(o), feat=jnp.asarray(f),
+            cval=jnp.asarray(c), length=jnp.asarray(n),
+        )
+        y2, ok2 = eval_trees(tb, jnp.asarray(X), OPS)
+        # simplified trees never grow
+        assert np.all(np.asarray(n) <= np.asarray(length))
+        both = np.asarray(ok_ref) & np.asarray(ok2)
+        np.testing.assert_allclose(
+            np.asarray(y2)[both], np.asarray(y_ref)[both],
+            rtol=1e-3, atol=1e-4,
+        )
+
+    def test_nan_not_folded(self):
+        # log(-2) must NOT be folded into a NaN constant
+        tb, changed = self._simplify_one("log(0 - 2) + x0")
+        s = tree_to_string(tb, OPS)
+        assert "log" in s
+
+
+class TestCSV:
+    def test_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(0)
+        data = rng.normal(size=(50, 4))
+        path = tmp_path / "d.csv"
+        header = "a,b,c,target"
+        np.savetxt(path, data, delimiter=",", header=header, comments="")
+        out = native.load_csv(str(path))
+        assert out is not None
+        got, names = out
+        assert names == ["a", "b", "c", "target"]
+        np.testing.assert_allclose(got, data, rtol=1e-6)
+
+    def test_no_header_tab(self, tmp_path):
+        data = np.arange(12.0).reshape(4, 3)
+        path = tmp_path / "d.tsv"
+        np.savetxt(path, data, delimiter="\t")
+        got, names = native.load_csv(str(path))
+        assert names is None
+        np.testing.assert_allclose(got, data)
+
+    def test_missing_file(self):
+        with pytest.raises(OSError):
+            native.load_csv("/nonexistent/file.csv")
+
+
+class TestOpMaps:
+    def test_known_ops_mapped(self):
+        maps = native.op_maps(OPS)
+        assert maps is not None
+        una, bina = maps
+        assert (una >= 0).all() and (bina >= 0).all()
+
+    def test_custom_op_rejected(self):
+        from symbolicregression_jl_tpu.ops.operators import (
+            OperatorSet,
+            register_unary,
+        )
+
+        register_unary("my_custom_native_test", lambda x: x + 1)
+        ops = OperatorSet(
+            unary_names=("my_custom_native_test",), binary_names=("+",)
+        )
+        assert native.op_maps(ops) is None
+
+
+class TestLoadCsvDataset:
+    def test_load_with_target_name(self, tmp_path):
+        import symbolicregression_jl_tpu as sr
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(30, 2))
+        y = X[:, 0] * 2 + 1
+        path = tmp_path / "ds.csv"
+        np.savetxt(
+            path, np.column_stack([X, y]), delimiter=",",
+            header="a,b,target", comments="",
+        )
+        ds = sr.load_csv_dataset(str(path), target="target")
+        assert ds.X.shape == (2, 30)
+        assert ds.variable_names == ("a", "b")
+        np.testing.assert_allclose(np.asarray(ds.y), y, rtol=1e-5)
+
+    def test_default_last_column_and_weights(self, tmp_path):
+        import symbolicregression_jl_tpu as sr
+
+        data = np.arange(24.0).reshape(6, 4)
+        path = tmp_path / "ds2.csv"
+        np.savetxt(path, data, delimiter=",")
+        ds = sr.load_csv_dataset(str(path), weights_column=2)
+        assert ds.X.shape == (2, 6)
+        np.testing.assert_allclose(np.asarray(ds.weights), data[:, 2])
+        np.testing.assert_allclose(np.asarray(ds.y), data[:, 3])
