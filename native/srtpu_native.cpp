@@ -437,7 +437,11 @@ struct Parser {
       return true;
     }
     if (is_number(t)) {
-      *out = add({KCONST, 0, 0, std::strtod(t.c_str(), nullptr), -1, -1});
+      char* end = nullptr;
+      double v = std::strtod(t.c_str(), &end);
+      if (!end || *end != '\0')  // e.g. '1.2.3' tokenizes as one number
+        return fail("malformed number '" + t + "'");
+      *out = add({KCONST, 0, 0, v, -1, -1});
       return true;
     }
     // identifier

@@ -60,6 +60,10 @@ __version__ = "0.1.0"
 
 # Populated lazily to avoid importing heavy modules at package import:
 from .api import EquationSearchResult, equation_search  # noqa: E402
+from .utils.precompile import (  # noqa: E402
+    do_precompilation,
+    enable_compilation_cache,
+)
 
 EquationSearch = equation_search
 
@@ -96,4 +100,6 @@ __all__ = [
     "equation_search",
     "EquationSearch",
     "EquationSearchResult",
+    "do_precompilation",
+    "enable_compilation_cache",
 ]

@@ -121,6 +121,128 @@ def _bfgs_single(
     return x, f
 
 
+def _nelder_mead_single(
+    loss_f, x0: Array, cmask: Array, n_iters: int
+) -> Tuple[Array, Array]:
+    """Fixed-iteration Nelder-Mead on the masked constant subspace
+    (reference Optim.NelderMead branch, src/ConstantOptimization.jl:33-43).
+
+    Batched-TPU variant: the simplex has L+1 vertices (offsets only on
+    constant slots; the duplicate vertices of non-constant dims are inert),
+    and the rare full-simplex shrink is replaced by pulling the worst vertex
+    toward the best (one eval instead of L+1, keeps every vmapped instance
+    in lockstep)."""
+    L = x0.shape[0]
+    # Initial simplex: x0 plus L offset vertices. Active (constant) dims get
+    # the classic per-coordinate offset; rows belonging to inactive dims
+    # would be duplicates of x0 (the offset is masked away), which stalls
+    # NM — give them deterministic pseudo-random offsets across the ACTIVE
+    # dims instead, so every vertex is distinct within the active subspace
+    # (NM only ever moves inside the simplex's affine hull, so the search
+    # stays in that subspace automatically).
+    # relative + absolute spread, like Optim.jl's AffineSimplexer
+    # (x*(1+0.025) + 0.5): pure-relative offsets stall from near-zero starts
+    base = 0.05 * x0 + 0.5
+    i_idx = jnp.arange(L)[:, None]
+    j_idx = jnp.arange(L)[None, :]
+    pattern = (((i_idx * 31 + j_idx * 17) % 7) - 3).astype(x0.dtype) / 3.0
+    offs = jnp.where(
+        jnp.eye(L, dtype=bool), jnp.diag(base), pattern * base[None, :]
+    ) * cmask[None, :]
+    verts = jnp.concatenate([x0[None, :], x0[None, :] + offs])
+    fs = jax.vmap(loss_f)(verts)
+
+    def body(i, carry):
+        verts, fs = carry
+        order = jnp.argsort(fs)
+        verts = verts[order]
+        fs = fs[order]
+        best, worst = verts[0], verts[-1]
+        f_best, f_second, f_worst = fs[0], fs[-2], fs[-1]
+        centroid = jnp.mean(verts[:-1], axis=0)
+        xr = centroid + (centroid - worst)      # reflection
+        xe = centroid + 2.0 * (centroid - worst)  # expansion
+        xc = centroid + 0.5 * (worst - centroid)  # contraction
+        xs = best + 0.5 * (worst - best)          # worst -> best pull
+        cand = jnp.stack([xr, xe, xc, xs])
+        fr, fe, fc, fsh = jax.vmap(loss_f)(cand)
+        # standard NM acceptance, vectorized over the 4 candidates
+        new_x = jnp.where(
+            (fr < f_best) & (fe < fr), xe,
+            jnp.where(
+                fr < f_second, xr,
+                jnp.where(fc < f_worst, xc, xs),
+            ),
+        )
+        new_f = jnp.where(
+            (fr < f_best) & (fe < fr), fe,
+            jnp.where(
+                fr < f_second, fr,
+                jnp.where(fc < f_worst, fc, fsh),
+            ),
+        )
+        accept = new_f < f_worst
+        verts = verts.at[-1].set(jnp.where(accept, new_x, worst))
+        fs = fs.at[-1].set(jnp.where(accept, new_f, f_worst))
+        return verts, fs
+
+    verts, fs = jax.lax.fori_loop(0, n_iters * 3, body, (verts, fs))
+    k = jnp.argmin(fs)
+    return verts[k], fs[k]
+
+
+def _newton_single(
+    loss_f, x0: Array, cmask: Array, n_iters: int
+) -> Tuple[Array, Array]:
+    """Per-coordinate Newton with gradient fallback (reference uses
+    Optim.Newton when a tree has a single constant,
+    src/ConstantOptimization.jl:33-37). Steps along diag(H)^-1 grad with a
+    backtracking line search; with one active constant that IS the Newton
+    step, with several it is Jacobi-preconditioned gradient descent."""
+    grad_f = jax.grad(loss_f)
+
+    def masked_grad(x):
+        g = grad_f(x) * cmask
+        return jnp.where(jnp.isfinite(g), g, 0.0)
+
+    def hdiag(x):
+        h = jnp.diagonal(jax.jacfwd(masked_grad)(x))
+        return jnp.where(jnp.isfinite(h), h, 0.0)
+
+    def body(i, carry):
+        x, f = carry
+        g = masked_grad(x)
+        h = hdiag(x)
+        step = jnp.where(jnp.abs(h) > 1e-8, g / jnp.abs(h), g)
+        ts = 2.0 ** -jnp.arange(_LS_STEPS, dtype=x.dtype)
+        cand = x[None, :] - ts[:, None] * step[None, :]
+        fs = jax.vmap(loss_f)(cand)
+        k = jnp.argmin(fs)
+        improved = fs[k] < f
+        x = jnp.where(improved, cand[k], x)
+        f = jnp.where(improved, fs[k], f)
+        return x, f
+
+    return jax.lax.fori_loop(0, n_iters, body, (x0, loss_f(x0)))
+
+
+# name -> (fn, evals_per_member(L, n_iters)) for num_evals accounting
+_OPTIMIZERS = {
+    "BFGS": (
+        _bfgs_single,
+        lambda L, it: 1 + it * (_LS_STEPS + 1),
+    ),
+    "NelderMead": (
+        _nelder_mead_single,
+        lambda L, it: (L + 1) + 3 * it * 4,
+    ),
+    "Newton": (
+        _newton_single,
+        lambda L, it: 1 + it * (_LS_STEPS + 2),
+    ),
+}
+
+
 def optimize_constants_population(
     key: Array,
     pop: Population,
@@ -169,9 +291,16 @@ def optimize_constants_population(
         (sub_trees.kind == CONST) & (idx < sub_trees.length[:, None])
     ).astype(pop.trees.cval.dtype)
 
+    if options.optimizer_algorithm not in _OPTIMIZERS:
+        raise ValueError(
+            f"optimizer_algorithm {options.optimizer_algorithm!r} not in "
+            f"{sorted(_OPTIMIZERS)}"
+        )
+    optimizer, evals_per_member = _OPTIMIZERS[options.optimizer_algorithm]
+
     def run_one(tree, x0, cm):
         f = _member_loss_fn(tree, X, y, weights, options)
-        return _bfgs_single(f, x0, cm, options.optimizer_iterations)
+        return optimizer(f, x0, cm, options.optimizer_iterations)
 
     # vmap over restarts then members
     run_members = jax.vmap(run_one)
@@ -198,8 +327,7 @@ def optimize_constants_population(
     n_evals = (
         jnp.sum(eligible.astype(jnp.float32))
         * n_starts
-        * options.optimizer_iterations
-        * (_LS_STEPS + 1)
+        * evals_per_member(L, options.optimizer_iterations)
     )
     return (
         Population(

@@ -155,10 +155,8 @@ def load_csv_dataset(
             names = [c if c else f"col{i}" for i, c in enumerate(fields)]
         data = np.loadtxt(
             path, delimiter=None if delim == " " else delim,
-            skiprows=1 if has_header else 0,
+            skiprows=1 if has_header else 0, ndmin=2,
         )
-        if data.ndim == 1:
-            data = data[:, None]
 
     ncols = data.shape[1]
 

@@ -149,6 +149,12 @@ def logit_margin_loss(pred: Array, target: Array) -> Array:
     return jnp.log1p(jnp.exp(-target * pred))
 
 
+def log_cosh_loss(pred: Array, target: Array) -> Array:
+    # numerically-stable log(cosh(d)) = |d| + log1p(exp(-2|d|)) - log 2
+    d = jnp.abs(pred - target)
+    return d + jnp.log1p(jnp.exp(-2.0 * d)) - jnp.log(2.0)
+
+
 # Name table mirroring the reference's re-export list
 # (src/SymbolicRegression.jl:87-113). Parameterized losses are exposed as
 # factories; the bare name maps to the default-parameter instance.
@@ -177,6 +183,8 @@ LOSS_REGISTRY: Dict[str, Callable[[Array, Array], Array]] = {
     "SigmoidLoss": sigmoid_loss,
     "DWDMarginLoss": dwd_margin_loss(1.0),
     "LogitMarginLoss": logit_margin_loss,
+    "LogCoshLoss": log_cosh_loss,
+    "LPDistLoss": lp_dist_loss(2.0),
 }
 
 

@@ -22,6 +22,11 @@ Design, in order of what made it fast on real hardware:
    own length.)
 3. **Full-vreg row tiles.** Rows live on BOTH sublanes and lanes as
    (r_sub, 128) tiles, so each op runs on full 8x128 vregs.
+3b. **Length-bounded slot loop.** Each tree runs ceil(length/4) dynamic
+   loop steps of a 4-slot unrolled body — short trees skip their padded
+   tail (avg tree fills ~half of max_len) while compiled code stays small
+   (a full static unroll, or lax.cond block specializations, multiply
+   Mosaic compile time past usability).
 4. **SMEM table transpose.** Per-tree tables are (L, t_block), trees on
    the minor axis: SMEM pads each major row to 1 KiB, so the transposed
    layout costs 24 KiB per table instead of 256 KiB (which OOMs the 1 MiB
@@ -121,6 +126,9 @@ def operand_schedule(kind: Array):
     return jnp.moveaxis(lidx, 0, -1), jnp.moveaxis(ridx, 0, -1)
 
 
+_SLOT_UNROLL = 4  # slots per dynamic loop step
+
+
 def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                  max_len: int):
     from jax.experimental import pallas as pl  # noqa: PLC0415
@@ -130,8 +138,8 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
     U = len(unary_fns)
     r_sub = r_block // 128
 
-    def kernel(nrows_ref, pcode_ref, feat_ref, length_ref, cval_ref,
-               lidx_ref, ridx_ref,  # SMEM, transposed (L, t_block)
+    def kernel(nrows_ref, pcode_ref, feat_ref, length_ref,
+               cval_ref, lidx_ref, ridx_ref,  # SMEM, transposed (L, t_block)
                X_ref, out_ref, bad_ref,  # VMEM in / VMEM out / SMEM out
                val_ref):  # scratch VMEM (max_len, r_sub, 128)
         # row-validity mask: padded tail rows must not poison the tree
@@ -141,31 +149,45 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
         valid_f = jnp.where(row < nrows_ref[0], 1.0, 0.0)
 
         def tree_body(ti, _):
+            # Dynamic slot loop bounded by THIS tree's length (avg tree
+            # fills ~half of max_len, so padded tails are skipped), with a
+            # statically-unrolled 4-slot body: straight-line code inside a
+            # group lets the compiler overlap SMEM loads and vector ops,
+            # while keeping compiled code size at 4 slot bodies (a full
+            # max_len unroll triples Mosaic compile time, and per-block
+            # lax.cond specializations blow it up by >10x). Trailing PAD
+            # slots inside the last group execute harmlessly: code 0 is
+            # masked out of the poison flag, writes land in dead val_ref
+            # slots, and operand indices are stack-clipped by construction.
             n = length_ref[0, ti]
-            # Fully-unrolled static slot loop: straight-line code with no
-            # per-slot branch lets the compiler overlap SMEM loads and
-            # vector ops across slots. PAD slots (code 0) execute but are
-            # masked out of the poison flag and never read as operands.
-            bad = jnp.zeros((r_sub, 128), jnp.float32)
-            for si in range(max_len):
-                code = pcode_ref[si, ti]
-                a = val_ref[ridx_ref[si, ti]]  # top of stack: right operand
-                b = val_ref[lidx_ref[si, ti]]  # second: left operand
-                x = X_ref[feat_ref[si, ti]]
-                v = jnp.where(
-                    code == 1,
-                    jnp.full((r_sub, 128), cval_ref[si, ti], jnp.float32),
-                    x,
-                )
-                for k, fn in enumerate(unary_fns):
-                    v = jnp.where(code == 3 + k, fn(a), v)
-                for k, fn in enumerate(binary_fns):
-                    v = jnp.where(code == 3 + U + k, fn(b, a), v)
-                val_ref[si] = v
-                bad = jnp.maximum(
-                    bad,
-                    jnp.where(jnp.isfinite(v) | (code == 0), 0.0, valid_f),
-                )
+
+            def slot_group(g, bad):
+                for k in range(_SLOT_UNROLL):
+                    si = g * _SLOT_UNROLL + k
+                    code = pcode_ref[si, ti]
+                    a = val_ref[ridx_ref[si, ti]]  # top of stack: right arg
+                    b = val_ref[lidx_ref[si, ti]]  # second: left arg
+                    x = X_ref[feat_ref[si, ti]]
+                    v = jnp.where(
+                        code == 1,
+                        jnp.full((r_sub, 128), cval_ref[si, ti], jnp.float32),
+                        x,
+                    )
+                    for j, fn in enumerate(unary_fns):
+                        v = jnp.where(code == 3 + j, fn(a), v)
+                    for j, fn in enumerate(binary_fns):
+                        v = jnp.where(code == 3 + U + j, fn(b, a), v)
+                    val_ref[si] = v
+                    bad = jnp.maximum(
+                        bad,
+                        jnp.where(jnp.isfinite(v) | (code == 0), 0.0, valid_f),
+                    )
+                return bad
+
+            n_groups = (n + _SLOT_UNROLL - 1) // _SLOT_UNROLL
+            bad = jax.lax.fori_loop(
+                0, n_groups, slot_group, jnp.zeros((r_sub, 128), jnp.float32)
+            )
             out_ref[ti] = val_ref[jnp.maximum(n - 1, 0)]
             bad_ref[0, ti] = jnp.sum(bad)
             return 0
@@ -199,10 +221,22 @@ def eval_trees_pallas(
     from jax.experimental.pallas import tpu as pltpu
 
     batch_shape = trees.length.shape
-    L = trees.max_len
     flat = jax.tree_util.tree_map(
         lambda x: x.reshape((-1,) + x.shape[len(batch_shape):]), trees
     )
+    # slot axis padded to a multiple of the kernel's 4-slot loop groups —
+    # the last group of a length-L tree may touch slots up to
+    # round_up(L, 4)-1 (PAD slots, harmless but they must exist)
+    L = _round_up(trees.max_len, _SLOT_UNROLL)
+    if L != trees.max_len:
+        dl = L - trees.max_len
+        flat = TreeBatch(
+            kind=jnp.pad(flat.kind, ((0, 0), (0, dl))),
+            op=jnp.pad(flat.op, ((0, 0), (0, dl))),
+            feat=jnp.pad(flat.feat, ((0, 0), (0, dl))),
+            cval=jnp.pad(flat.cval, ((0, 0), (0, dl))),
+            length=flat.length,
+        )
     T = flat.length.shape[0]
     nfeat, nrows = X.shape
 

@@ -1,0 +1,79 @@
+"""Compilation warm-up — the analog of the reference's SnoopPrecompile
+workload (reference src/precompile.jl:34-79, which runs a full 3-iteration
+search for Float32 + Float64 at module load so user searches start hot).
+
+XLA's equivalent of Julia's precompile cache is the persistent compilation
+cache: `do_precompilation()` enables it (if not already configured) and
+traces + compiles the search's hot programs — the fused iteration function
+and the fitness kernel — on tiny shapes, so the first real
+`equation_search` of a matching Options reuses the cached executables.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
+    """Point JAX's persistent compilation cache at `cache_dir`.
+
+    An explicit `cache_dir` always wins; otherwise an already-configured
+    cache (jax.config / JAX_COMPILATION_CACHE_DIR) is left untouched, and
+    only a fully-unconfigured process gets the package default
+    (~/.cache/symbolicregression_jl_tpu)."""
+    import jax
+
+    existing = jax.config.jax_compilation_cache_dir
+    if cache_dir is None:
+        if existing is not None:
+            return existing
+        cache_dir = os.path.join(
+            os.path.expanduser("~"), ".cache", "symbolicregression_jl_tpu"
+        )
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    return cache_dir
+
+
+def do_precompilation(
+    mode: str = "compile",
+    cache_dir: Optional[str] = None,
+    **option_kwargs,
+) -> None:
+    """Warm the compile caches like the reference's precompile workload
+    (src/precompile.jl:34-79; `mode=:compile` variant used by its tests).
+
+    mode="compile": trace + compile the iteration program on tiny shapes
+    (no search). mode="search": additionally run a real 3-iteration search,
+    matching the reference's full workload. Extra kwargs are forwarded to
+    the Options used for warming (warm the configs you will search with —
+    compiled programs are Options-specific)."""
+    if mode not in ("compile", "search"):
+        raise ValueError("mode must be 'compile' or 'search'")
+    enable_compilation_cache(cache_dir)
+
+    from ..api import equation_search
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((5, 32)).astype(np.float32)
+    y = 2.0 * np.cos(X[4]) + X[1] ** 2 - 2.0
+    kwargs = dict(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp"],
+        npop=8,
+        npopulations=2,
+        tournament_selection_n=4,
+        ncycles_per_iteration=3,
+        maxsize=10,
+        verbosity=0,
+        progress=False,
+    )
+    kwargs.update(option_kwargs)
+    niterations = 3 if mode == "search" else 1
+    equation_search(
+        X, y, niterations=niterations, runtests=False, **kwargs
+    )

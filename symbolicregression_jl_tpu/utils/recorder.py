@@ -97,11 +97,13 @@ class Recorder:
         npop = int(scores.shape[0])
         prev = self._prev_hashes.get(key, set())
         # whole-island stringification through the native batch printer when
-        # available (C++ host runtime); per-member Python decode otherwise
+        # available (C++ host runtime); per-member Python decode otherwise.
+        # The printer renders by operator NAME, so custom Python-registered
+        # operators work here too — only library presence gates the path.
         from .. import native
 
         eqs = None
-        if native.op_maps(self.options.operators) is not None:
+        if native.native_available():
             eqs = native.trees_to_strings(
                 trees_np.kind, trees_np.op, trees_np.feat, trees_np.cval,
                 trees_np.length, self.options.operators, self.variable_names,

@@ -191,3 +191,25 @@ def test_quit_watcher_disabled_in_tests():
     w = QuitWatcher(enabled=True)
     assert not w.enabled  # SYMBOLIC_REGRESSION_TEST=true
     assert w.should_quit() is False
+
+
+# --------------------------- precompile ------------------------------------
+
+
+def test_do_precompilation_compile_mode(tmp_path):
+    import symbolicregression_jl_tpu as sr
+
+    sr.do_precompilation(mode="compile", cache_dir=str(tmp_path))
+    # the cache dir was created and the jit programs compiled without error
+    import os
+
+    assert os.path.isdir(str(tmp_path))
+
+
+def test_do_precompilation_bad_mode():
+    import pytest
+
+    import symbolicregression_jl_tpu as sr
+
+    with pytest.raises(ValueError):
+        sr.do_precompilation(mode="everything")

@@ -72,3 +72,21 @@ def test_resolve_loss_accepts_callable_and_name():
     assert resolve_loss(custom) is custom
     with pytest.raises((KeyError, ValueError)):
         resolve_loss("NoSuchLoss")
+
+
+def test_log_cosh_loss_matches_naive():
+    f = LOSS_REGISTRY["LogCoshLoss"]
+    d = np.array([-30.0, -2.0, -0.1, 0.0, 0.1, 2.0, 30.0], np.float32)
+    got = np.asarray(f(jnp.asarray(d), jnp.zeros_like(jnp.asarray(d))))
+    # naive log(cosh) overflows beyond |d| ~ 88; compare where it doesn't
+    want = np.log(np.cosh(d.astype(np.float64)))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_lp_dist_loss_default_is_squared():
+    f = LOSS_REGISTRY["LPDistLoss"]
+    p = jnp.asarray([1.0, -3.0])
+    t = jnp.asarray([0.5, 1.0])
+    np.testing.assert_allclose(
+        np.asarray(f(p, t)), [0.25, 16.0], rtol=1e-6
+    )

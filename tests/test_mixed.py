@@ -1,0 +1,124 @@
+"""Mixed-configuration end-to-end searches.
+
+Analog of the reference's test/test_mixed.jl:7-146 matrix, which sweeps
+{batching, weighted, multi-output, precision, crossover, frequency modes,
+optimizer algorithm, warmup, progress} and asserts the target equation is
+recovered (best.loss < 1e-2) with held-out prediction match (:129-141).
+
+Each config searches for y = x0^2 + 2*cos(x2) (the reference's
+2cos(x4)+x1^2-2 family) with a small-but-sufficient budget.
+"""
+
+import numpy as np
+import pytest
+
+import symbolicregression_jl_tpu as sr
+
+BUDGET = dict(
+    niterations=14,
+    npop=48,
+    npopulations=4,
+    ncycles_per_iteration=150,
+    maxsize=14,
+    verbosity=0,
+    progress=False,
+    early_stop_condition=1e-6,
+)
+OPSET = dict(binary_operators=["+", "-", "*"], unary_operators=["cos"])
+
+
+def make_data(rng, n=80):
+    X = (rng.standard_normal((3, n)) * 2).astype(np.float32)
+    y = X[0] * X[0] + 2.0 * np.cos(X[2])
+    return X, y
+
+
+def check(res, X_test, y_test, atol=0.15):
+    best = res.best()
+    assert best.loss < 1e-2, f"loss {best.loss} (eq: {best.equation})"
+    pred = res.predict(X_test)
+    np.testing.assert_allclose(pred, y_test, atol=atol)
+
+
+@pytest.mark.slow
+def test_batching_annealing(rng):
+    X, y = make_data(rng, n=400)
+    res = sr.equation_search(
+        X, y, seed=3, batching=True, batch_size=50, annealing=True,
+        **OPSET, **BUDGET,
+    )
+    Xt, yt = make_data(np.random.default_rng(99))
+    check(res, Xt, yt)
+
+
+@pytest.mark.slow
+def test_weighted_search_recovers(rng):
+    X, y = make_data(rng)
+    w = rng.uniform(0.5, 2.0, y.shape[0]).astype(np.float32)
+    res = sr.equation_search(X, y, weights=w, seed=4, **OPSET, **BUDGET)
+    Xt, yt = make_data(np.random.default_rng(98))
+    check(res, Xt, yt)
+
+
+@pytest.mark.slow
+def test_crossover_heavy(rng):
+    X, y = make_data(rng)
+    res = sr.equation_search(
+        X, y, seed=5, crossover_probability=0.3, **OPSET, **BUDGET
+    )
+    Xt, yt = make_data(np.random.default_rng(97))
+    check(res, Xt, yt)
+
+
+@pytest.mark.slow
+def test_no_frequency_with_warmup(rng):
+    X, y = make_data(rng)
+    res = sr.equation_search(
+        X, y, seed=6, use_frequency=False, use_frequency_in_tournament=False,
+        warmup_maxsize_by=0.5, **OPSET, **BUDGET,
+    )
+    Xt, yt = make_data(np.random.default_rng(96))
+    check(res, Xt, yt)
+
+
+@pytest.mark.slow
+def test_nelder_mead_search(rng):
+    """Constant-bearing target forces the optimizer path: y has the
+    irrational constants the mutations alone rarely hit."""
+    X = (rng.standard_normal((2, 80)) * 2).astype(np.float32)
+    y = 2.5382 * np.cos(X[1]) + X[0] * X[0] - 0.5
+    res = sr.equation_search(
+        X, y, seed=7,
+        optimizer_algorithm="NelderMead",
+        optimizer_probability=0.3,
+        **OPSET, **BUDGET,
+    )
+    best = res.best()
+    assert best.loss < 1e-2, f"loss {best.loss} (eq: {best.equation})"
+
+
+@pytest.mark.slow
+def test_custom_elementwise_loss(rng):
+    X, y = make_data(rng)
+    res = sr.equation_search(
+        X, y, seed=8, loss=lambda p, t: (p - t) ** 2, **OPSET, **BUDGET
+    )
+    Xt, yt = make_data(np.random.default_rng(95))
+    check(res, Xt, yt)
+
+
+def test_multi_output_distinct_targets(rng):
+    """Per-output hall of fame, like the reference's y::Matrix dispatch
+    (src/SymbolicRegression.jl:308-315)."""
+    X = (rng.standard_normal((2, 60)) * 2).astype(np.float32)
+    Y = np.stack([X[0] * X[0], 3.0 * np.cos(X[1])])
+    res = sr.equation_search(
+        X, Y, seed=9,
+        niterations=6, npop=33, npopulations=2, ncycles_per_iteration=80,
+        maxsize=10, verbosity=0, progress=False,
+        early_stop_condition=1e-6, **OPSET,
+    )
+    assert res.multi_output and len(res.candidates) == 2
+    for j in range(2):
+        best = res.best(output=j)
+        assert best.loss < 1e-1, f"output {j}: {best.equation} {best.loss}"

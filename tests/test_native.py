@@ -312,3 +312,8 @@ class TestLoadCsvDataset:
         assert ds.X.shape == (2, 6)
         np.testing.assert_allclose(np.asarray(ds.weights), data[:, 2])
         np.testing.assert_allclose(np.asarray(ds.y), data[:, 3])
+
+
+def test_parse_rejects_malformed_number():
+    with pytest.raises(ValueError, match="number"):
+        native.parse_to_arrays("1.2.3 * x0", OPS, MAX_LEN)

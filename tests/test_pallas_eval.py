@@ -81,3 +81,28 @@ def test_pallas_row_padding_no_poison(rng):
     )
     assert bool(ok[0])
     np.testing.assert_allclose(np.asarray(y)[0], 2.0, rtol=1e-6)
+
+
+def test_interpret_max_len_not_multiple_of_unroll():
+    """max_len % 4 != 0 must not index past the slot tables (regression:
+    the 4-slot loop groups round the per-tree bound up to a multiple of 4).
+    """
+    import numpy as np
+
+    from symbolicregression_jl_tpu.models.trees import encode_tree, parse_expression
+    from symbolicregression_jl_tpu.ops.interpreter import eval_trees
+
+    s = "((x0 + 1.5) * x0) + ((x0 - 0.5) * (x0 + 2))"  # size 13
+    expr = parse_expression(s, OPS)
+    L = 14  # not a multiple of 4, barely fits the tree
+    tree = encode_tree(expr, L)
+    trees = jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], tree)
+    X = jnp.asarray(
+        np.random.default_rng(0).standard_normal((1, 50)).astype(np.float32)
+    )
+    y_ref, ok_ref = eval_trees(trees, X, OPS)
+    y, ok = eval_trees_pallas(trees, X, OPS, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(y_ref), rtol=1e-5, atol=1e-6
+    )
