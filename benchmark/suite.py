@@ -1,0 +1,170 @@
+"""Benchmark suite mirroring the reference's benchmark infrastructure
+(reference benchmark/benchmarks.jl:1-29 `SUITE["evaluation"]` and
+benchmark/single_eval.jl:1-28), plus the framework's own batched-population
+shapes. Prints one JSON line per entry.
+
+Usage:
+    python benchmark/suite.py            # run on the default backend
+    JAX_PLATFORMS=cpu python benchmark/suite.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _median_time(fn, reps=5):
+    fn()  # warmup/compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def bench_eval_fixed_tree():
+    """The reference's SUITE["evaluation"]: a fixed 15-node tree over
+    X = 5x1000, Float32/Float64 (BigFloat has no TPU analog; bfloat16 is
+    the TPU-native third precision)."""
+    import jax
+    import jax.numpy as jnp
+
+    import symbolicregression_jl_tpu as sr
+
+    ops = sr.make_operator_set(["+", "-", "/", "*"], ["cos", "exp"])
+    # same topology as benchmark/benchmarks.jl:7-19:
+    # (cos(1.0+x1)*exp(-1.0) stacked into +/- and * / branches over x2/x3)
+    s = ("((cos(1 + x1) * exp(-1)) - (x2 / x3)) + "
+         "((cos(1 + x1) * exp(-1)) * (x2 / x3))")
+    expr = sr.parse_expression(s, ops)
+    rng = np.random.default_rng(0)
+    X_h = rng.standard_normal((5, 1000))
+
+    out = []
+    for dtype_name, dtype in [
+        ("float32", jnp.float32),
+        ("bfloat16", jnp.bfloat16),
+    ]:
+        tree = jax.tree_util.tree_map(
+            jnp.asarray, sr.encode_tree(expr, 24)
+        )
+        tree = tree._replace(cval=tree.cval.astype(dtype))
+        X = jnp.asarray(X_h, dtype)
+        f = jax.jit(lambda t, X: sr.eval_tree(t, X, ops))
+        y, ok = f(tree, X)
+        dt = _median_time(lambda: jax.block_until_ready(f(tree, X)))
+        out.append(
+            {
+                "suite": "evaluation",
+                "case": dtype_name,
+                "tree_nodes": int(tree.length),
+                "rows": 1000,
+                "median_s": dt,
+            }
+        )
+    return out
+
+
+def bench_single_eval_48_nodes():
+    """The reference's single_eval.jl micro: 48-node tree on 3x200."""
+    import jax
+    import jax.numpy as jnp
+
+    import symbolicregression_jl_tpu as sr
+
+    ops = sr.make_operator_set(["+", "*", "/", "-"], ["cos", "sin"])
+    s = (
+        "((x1 + x1) * ((-0.5982493 / x0) / -0.54734415)) + "
+        "(sin(cos(sin(1.2926733 - 1.6606787) / "
+        "sin(((0.14577048 * x0) + ((0.111149654 + x0) - -0.8298334)) "
+        "- -1.2071426)) * (cos(x2 - 2.3201916) + ((x0 - (x0 * x1)) / x1)))"
+        " / (0.14854191 - ((cos(x1) * -1.6047639) - 0.023943262)))"
+    )
+    expr = sr.parse_expression(s, ops)
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((3, 200)), jnp.float32)
+    tree = jax.tree_util.tree_map(jnp.asarray, sr.encode_tree(expr, 56))
+    f = jax.jit(lambda t, X: sr.eval_tree(t, X, ops))
+    f(tree, X)
+    dt = _median_time(lambda: jax.block_until_ready(f(tree, X)))
+    return [
+        {
+            "suite": "single_eval",
+            "case": "48_nodes_3x200",
+            "tree_nodes": int(tree.length),
+            "median_s": dt,
+        }
+    ]
+
+
+def bench_population_scoring():
+    """This framework's own shape: whole-population fused scoring (the
+    per-cycle hot call of the evolution engine)."""
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.models.fitness import score_trees
+    from symbolicregression_jl_tpu.models.mutate_device import (
+        gen_random_tree_fixed_size,
+    )
+    from symbolicregression_jl_tpu.models.options import make_options
+
+    options = make_options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp"],
+        maxsize=20,
+    )
+    n_trees, n_rows = 4096, 1000
+    sizes = jax.random.randint(jax.random.PRNGKey(1), (n_trees,), 3, 20)
+    trees = jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(
+            k, s, 5, options.operators, options.max_len
+        )
+    )(jax.random.split(jax.random.PRNGKey(0), n_trees), sizes)
+    X = jax.random.normal(jax.random.PRNGKey(2), (5, n_rows), jnp.float32)
+    y = 2.0 * jnp.cos(X[4]) + X[1] ** 2 - 2.0
+
+    f = jax.jit(
+        lambda t: score_trees(t, X, y, None, jnp.float32(1.0), options)
+    )
+    f(trees)
+    dt = _median_time(lambda: jax.block_until_ready(f(trees)))
+    return [
+        {
+            "suite": "population_scoring",
+            "case": f"{n_trees}x{n_rows}",
+            "median_s": dt,
+            "trees_rows_per_s": n_trees * n_rows / dt,
+        }
+    ]
+
+
+def main():
+    import jax
+
+    platform = jax.devices()[0].platform
+    results = []
+    for fn in (
+        bench_eval_fixed_tree,
+        bench_single_eval_48_nodes,
+        bench_population_scoring,
+    ):
+        try:
+            results.extend(fn())
+        except Exception as e:  # pragma: no cover
+            print(f"# {fn.__name__} failed: {e}", file=sys.stderr)
+    for r in results:
+        r["platform"] = platform
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -231,7 +231,10 @@ int32_t emit_postfix(const std::vector<Node>& nodes, int root, int32_t L,
 
 extern "C" {
 
-int32_t srt_abi_version() { return 1; }
+// Bump on EVERY exported-signature change: the Python wrapper refuses to
+// load a library whose version it wasn't built against (a stale .so with
+// the old srt_eval_batch signature would silently return garbage losses).
+int32_t srt_abi_version() { return 2; }
 
 // name -> native opcode (or -1). is_binary selects the table.
 int32_t srt_op_id(const char* name, int32_t is_binary) {
@@ -720,6 +723,10 @@ int64_t srt_simplify_batch(int64_t T, int32_t L,
 // X row-major (nfeat, n) f32; y out (T, n) f32; ok out (T,) u8.
 // ---------------------------------------------------------------------------
 
+// y_target/loss_out are optional (may be NULL): when given, each tree also
+// gets its mean-squared-error against y_target (the reference's
+// score_func = eval + loss reduction, src/LossFunctions.jl:86-92) — used
+// for honest CPU-anchor benchmarking of the full scoring path.
 int32_t srt_eval_batch(int64_t T, int32_t L,
                        const int32_t* kind, const int32_t* op,
                        const int32_t* feat, const float* cval,
@@ -727,7 +734,8 @@ int32_t srt_eval_batch(int64_t T, int32_t L,
                        const float* X, int32_t nfeat, int64_t n,
                        const int32_t* una_map, int32_t n_una,
                        const int32_t* bin_map, int32_t n_bin,
-                       float* y, uint8_t* ok, int32_t n_threads) {
+                       float* y, uint8_t* ok, int32_t n_threads,
+                       const float* y_target, float* loss_out) {
   if (n_threads <= 0) {
     n_threads = static_cast<int32_t>(std::thread::hardware_concurrency());
     if (n_threads <= 0) n_threads = 1;
@@ -768,9 +776,11 @@ int32_t srt_eval_batch(int64_t T, int32_t L,
       if (!good) {
         for (int64_t r = 0; r < n; ++r) yt[r] = std::nanf("");
         ok[t] = 0;
+        if (loss_out) loss_out[t] = std::nanf("");
         continue;
       }
       bool finite = true;
+      double loss_acc = 0.0;
       for (int64_t r0 = 0; r0 < n; r0 += RB) {
         int64_t rb = std::min(RB, n - r0);
         int32_t sp = 0;
@@ -812,8 +822,18 @@ int32_t srt_eval_batch(int64_t T, int32_t L,
           yt[r0 + r] = v;
           finite = finite && std::isfinite(v);
         }
+        if (y_target) {
+          for (int64_t r = 0; r < rb; ++r) {
+            double d = res[r] - y_target[r0 + r];
+            loss_acc += d * d;
+          }
+        }
       }
       ok[t] = finite ? 1 : 0;
+      if (loss_out) {
+        loss_out[t] = finite ? static_cast<float>(loss_acc / n)
+                             : std::nanf("");
+      }
     }
   };
 

@@ -70,7 +70,7 @@ def _load() -> Optional[ctypes.CDLL]:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
             return None
-        if lib.srt_abi_version() != 1:
+        if lib.srt_abi_version() != 2:
             return None
 
         lib.srt_op_id.argtypes = [ctypes.c_char_p, ctypes.c_int32]
@@ -103,6 +103,7 @@ def _load() -> Optional[ctypes.CDLL]:
             _f32p, ctypes.c_int32, ctypes.c_int64,
             _i32p, ctypes.c_int32, _i32p, ctypes.c_int32,
             _f32p, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int32,
+            _f32p, _f32p,  # optional y_target, loss_out
         ]
         lib.srt_eval_batch.restype = ctypes.c_int32
         lib.srt_csv_probe.argtypes = [
@@ -277,12 +278,15 @@ def eval_batch(
     X,
     operators: OperatorSet,
     n_threads: int = 0,
+    y_target=None,
 ):
     """Multithreaded CPU evaluation of T trees over X (nfeat, n).
 
-    Returns (y (T, n) float32, ok (T,) bool) or None if unavailable. The
-    reference's CPU hot path (DynamicExpressions eval_tree_array) — used as
-    the honest CPU anchor in benchmarks and as a host-side oracle."""
+    Returns (y (T, n) float32, ok (T,) bool) — plus per-tree MSE losses
+    against `y_target` when given (the reference's score_func = eval + loss
+    reduction) — or None if unavailable. The reference's CPU hot path
+    (DynamicExpressions eval_tree_array) — used as the honest CPU anchor in
+    benchmarks and as a host-side oracle."""
     maps = op_maps(operators)
     if maps is None:
         return None
@@ -301,6 +305,13 @@ def eval_batch(
     nfeat, n = X.shape
     y = np.empty((T, n), np.float32)
     ok = np.empty(T, np.uint8)
+    yt = None
+    losses = None
+    if y_target is not None:
+        yt = np.ascontiguousarray(np.asarray(y_target), np.float32)
+        if yt.shape != (n,):
+            raise ValueError(f"y_target must be ({n},), got {yt.shape}")
+        losses = np.empty(T, np.float32)
     rc = lib.srt_eval_batch(
         T, L,
         kind.ctypes.data_as(_i32p), op.ctypes.data_as(_i32p),
@@ -312,11 +323,17 @@ def eval_batch(
         y.ctypes.data_as(_f32p),
         ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         n_threads,
+        yt.ctypes.data_as(_f32p) if yt is not None else None,
+        losses.ctypes.data_as(_f32p) if losses is not None else None,
     )
     if rc != 0:
         return None
     batch = shape[:-1]
-    return y.reshape(batch + (n,)), ok.astype(bool).reshape(batch)
+    out_y = y.reshape(batch + (n,))
+    out_ok = ok.astype(bool).reshape(batch)
+    if losses is not None:
+        return out_y, out_ok, losses.reshape(batch)
+    return out_y, out_ok
 
 
 def load_csv(path: str, delimiter: Optional[str] = None):

@@ -130,8 +130,13 @@ _SLOT_UNROLL = 4  # slots per dynamic loop step
 
 
 def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
-                 max_len: int):
+                 max_len: int, slot_loop: str = "dynamic"):
     from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    if slot_loop not in ("dynamic", "unrolled"):
+        raise ValueError(
+            f"slot_loop must be 'dynamic' or 'unrolled', got {slot_loop!r}"
+        )
 
     unary_fns = operators.unary_fns
     binary_fns = operators.binary_fns
@@ -148,46 +153,56 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
         row = (pl.program_id(1) * r_sub + sub) * 128 + lane
         valid_f = jnp.where(row < nrows_ref[0], 1.0, 0.0)
 
-        def tree_body(ti, _):
-            # Dynamic slot loop bounded by THIS tree's length (avg tree
-            # fills ~half of max_len, so padded tails are skipped), with a
-            # statically-unrolled 4-slot body: straight-line code inside a
-            # group lets the compiler overlap SMEM loads and vector ops,
-            # while keeping compiled code size at 4 slot bodies (a full
-            # max_len unroll triples Mosaic compile time, and per-block
-            # lax.cond specializations blow it up by >10x). Trailing PAD
-            # slots inside the last group execute harmlessly: code 0 is
-            # masked out of the poison flag, writes land in dead val_ref
-            # slots, and operand indices are stack-clipped by construction.
-            n = length_ref[0, ti]
+        def slot_body(si, ti, bad):
+            """One postfix slot: branchless dispatch over the operator set.
 
-            def slot_group(g, bad):
-                for k in range(_SLOT_UNROLL):
-                    si = g * _SLOT_UNROLL + k
-                    code = pcode_ref[si, ti]
-                    a = val_ref[ridx_ref[si, ti]]  # top of stack: right arg
-                    b = val_ref[lidx_ref[si, ti]]  # second: left arg
-                    x = X_ref[feat_ref[si, ti]]
-                    v = jnp.where(
-                        code == 1,
-                        jnp.full((r_sub, 128), cval_ref[si, ti], jnp.float32),
-                        x,
-                    )
-                    for j, fn in enumerate(unary_fns):
-                        v = jnp.where(code == 3 + j, fn(a), v)
-                    for j, fn in enumerate(binary_fns):
-                        v = jnp.where(code == 3 + U + j, fn(b, a), v)
-                    val_ref[si] = v
-                    bad = jnp.maximum(
-                        bad,
-                        jnp.where(jnp.isfinite(v) | (code == 0), 0.0, valid_f),
-                    )
-                return bad
-
-            n_groups = (n + _SLOT_UNROLL - 1) // _SLOT_UNROLL
-            bad = jax.lax.fori_loop(
-                0, n_groups, slot_group, jnp.zeros((r_sub, 128), jnp.float32)
+            PAD slots execute harmlessly: code 0 is masked out of the
+            poison flag, writes land in dead val_ref slots, and operand
+            indices are stack-clipped by construction."""
+            code = pcode_ref[si, ti]
+            a = val_ref[ridx_ref[si, ti]]  # top of stack: right arg
+            b = val_ref[lidx_ref[si, ti]]  # second: left arg
+            x = X_ref[feat_ref[si, ti]]
+            v = jnp.where(
+                code == 1,
+                jnp.full((r_sub, 128), cval_ref[si, ti], jnp.float32),
+                x,
             )
+            for j, fn in enumerate(unary_fns):
+                v = jnp.where(code == 3 + j, fn(a), v)
+            for j, fn in enumerate(binary_fns):
+                v = jnp.where(code == 3 + U + j, fn(b, a), v)
+            val_ref[si] = v
+            return jnp.maximum(
+                bad,
+                jnp.where(jnp.isfinite(v) | (code == 0), 0.0, valid_f),
+            )
+
+        def tree_body(ti, _):
+            n = length_ref[0, ti]
+            zero = jnp.zeros((r_sub, 128), jnp.float32)
+            if slot_loop == "dynamic":
+                # Slot loop bounded by THIS tree's length (avg tree fills
+                # ~half of max_len, so padded tails are skipped), with a
+                # statically-unrolled 4-slot body: straight-line code
+                # inside a group lets the compiler overlap SMEM loads and
+                # vector ops, while keeping compiled code size at 4 slot
+                # bodies (per-block lax.cond specializations of a full
+                # unroll blow Mosaic compile time past usability).
+                def slot_group(g, bad):
+                    for k in range(_SLOT_UNROLL):
+                        bad = slot_body(g * _SLOT_UNROLL + k, ti, bad)
+                    return bad
+
+                n_groups = (n + _SLOT_UNROLL - 1) // _SLOT_UNROLL
+                bad = jax.lax.fori_loop(0, n_groups, slot_group, zero)
+            else:
+                # Full static unroll: every slot executes for every tree —
+                # more straight-line overlap, no loop overhead, but pays
+                # for padded tails and compiles slower. (A/B alternative.)
+                bad = zero
+                for si in range(max_len):
+                    bad = slot_body(si, ti, bad)
             out_ref[ti] = val_ref[jnp.maximum(n - 1, 0)]
             bad_ref[0, ti] = jnp.sum(bad)
             return 0
@@ -203,7 +218,8 @@ def _round_up(x: int, m: int) -> int:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("operators", "t_block", "r_block", "interpret"),
+    static_argnames=("operators", "t_block", "r_block", "interpret",
+                     "slot_loop"),
 )
 def eval_trees_pallas(
     trees: TreeBatch,
@@ -212,6 +228,7 @@ def eval_trees_pallas(
     t_block: int = DEFAULT_T_BLOCK,
     r_block: int = DEFAULT_R_BLOCK,
     interpret: bool = False,
+    slot_loop: str = "dynamic",
 ) -> Tuple[Array, Array]:
     """Evaluate a flat batch of trees over X (nfeat, nrows).
 
@@ -263,7 +280,7 @@ def eval_trees_pallas(
     Xp = Xp.reshape(nfeat, NR, 128)
     nrows_arr = jnp.asarray([nrows], jnp.int32)
 
-    kernel = _make_kernel(operators, t_block, r_block, L)
+    kernel = _make_kernel(operators, t_block, r_block, L, slot_loop)
 
     grid = (T_pad // t_block, NR // r_sub)
     smem_spec = lambda shape, imap: pl.BlockSpec(

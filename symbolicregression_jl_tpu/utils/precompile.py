@@ -42,25 +42,37 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
 def do_precompilation(
     mode: str = "compile",
     cache_dir: Optional[str] = None,
+    nfeatures: int = 5,
+    n_rows: int = 32,
     **option_kwargs,
 ) -> None:
     """Warm the compile caches like the reference's precompile workload
     (src/precompile.jl:34-79; `mode=:compile` variant used by its tests).
 
-    mode="compile": trace + compile the iteration program on tiny shapes
-    (no search). mode="search": additionally run a real 3-iteration search,
-    matching the reference's full workload. Extra kwargs are forwarded to
-    the Options used for warming (warm the configs you will search with —
-    compiled programs are Options-specific)."""
+    mode="compile": trace + compile the iteration program (no real search).
+    mode="search": additionally run a real 3-iteration search, matching the
+    reference's full workload.
+
+    XLA executables are keyed on BOTH the Options and the data shapes, so
+    warm with the `nfeatures`/`n_rows` of the dataset you will search and
+    pass the same option kwargs (operators, npop, ...) — a warm-up on
+    different shapes or options compiles different programs and the real
+    search will still compile cold."""
     if mode not in ("compile", "search"):
         raise ValueError("mode must be 'compile' or 'search'")
+    for reserved in ("niterations", "runtests"):
+        if reserved in option_kwargs:
+            raise ValueError(
+                f"{reserved!r} is fixed by do_precompilation; only Options "
+                "kwargs can be forwarded"
+            )
     enable_compilation_cache(cache_dir)
 
     from ..api import equation_search
 
     rng = np.random.default_rng(0)
-    X = rng.standard_normal((5, 32)).astype(np.float32)
-    y = 2.0 * np.cos(X[4]) + X[1] ** 2 - 2.0
+    X = rng.standard_normal((nfeatures, n_rows)).astype(np.float32)
+    y = np.cos(X[nfeatures - 1]) + X[0] ** 2 - 2.0
     kwargs = dict(
         binary_operators=["+", "-", "*", "/"],
         unary_operators=["cos", "exp"],

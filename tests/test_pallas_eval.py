@@ -106,3 +106,29 @@ def test_interpret_max_len_not_multiple_of_unroll():
     np.testing.assert_allclose(
         np.asarray(y), np.asarray(y_ref), rtol=1e-5, atol=1e-6
     )
+
+
+def test_interpret_unrolled_slot_loop_variant():
+    """The A/B 'unrolled' slot-loop variant must agree with 'dynamic'."""
+    import numpy as np
+
+    from symbolicregression_jl_tpu.models.mutate_device import (
+        gen_random_tree_fixed_size,
+    )
+
+    n = 32
+    sizes = jax.random.randint(jax.random.PRNGKey(3), (n,), 1, 14)
+    trees = jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(k, s, 2, OPS, 16)
+    )(jax.random.split(jax.random.PRNGKey(4), n), sizes)
+    X = jnp.asarray(
+        np.random.default_rng(1).standard_normal((2, 40)).astype(np.float32)
+    )
+    y_d, ok_d = eval_trees_pallas(trees, X, OPS, interpret=True)
+    y_u, ok_u = eval_trees_pallas(
+        trees, X, OPS, interpret=True, slot_loop="unrolled"
+    )
+    np.testing.assert_array_equal(np.asarray(ok_d), np.asarray(ok_u))
+    np.testing.assert_allclose(
+        np.asarray(y_d), np.asarray(y_u), rtol=1e-6, atol=1e-7
+    )
