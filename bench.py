@@ -167,6 +167,46 @@ def _native_cpu_anchor(jax, options, n_trees, verbose):
     return rate
 
 
+def _mse_parity(jax, jnp, options, device, n_check, verbose):
+    """North-star requires MSE *parity*, not just throughput: the TPU
+    kernel's per-tree losses must match the CPU reference interpreter's.
+    Returns max relative |loss_dev - loss_cpu| over finite-on-both trees."""
+    from symbolicregression_jl_tpu.models.fitness import score_trees
+
+    X_h, y_h = _feynman_data()
+    baseline = float(np.var(y_h))
+
+    def losses_on(dev):
+        # identical workload on both devices (same PRNG keys); 'auto'
+        # dispatch routes to the Pallas kernel on TPU and the jnp lockstep
+        # interpreter under a CPU default_device
+        with jax.default_device(dev):
+            trees = _build_workload(jax, jnp, options, n_check, 1)
+            _, losses = score_trees(
+                trees, jnp.asarray(X_h), jnp.asarray(y_h), None,
+                jnp.float32(baseline), options,
+            )
+            return np.asarray(jax.device_get(losses))
+
+    l_dev = losses_on(device)
+    l_cpu = losses_on(jax.devices("cpu")[0])
+    both = np.isfinite(l_dev) & np.isfinite(l_cpu)
+    agree_finite = float(np.mean(np.isfinite(l_dev) == np.isfinite(l_cpu)))
+    rel = np.abs(l_dev[both] - l_cpu[both]) / np.maximum(
+        np.abs(l_cpu[both]), 1e-6
+    )
+    # a parity verdict over too few mutually-finite trees is vacuous
+    max_rel = float(rel.max()) if rel.size >= 100 else float("inf")
+    if verbose:
+        print(
+            f"# MSE parity vs CPU interpreter: {int(both.sum())} trees, "
+            f"max rel dev {max_rel:.2e}, finite-mask agreement "
+            f"{agree_finite:.4f}",
+            file=sys.stderr,
+        )
+    return max_rel, agree_finite
+
+
 def main(verbose=True):
     import jax
     import jax.numpy as jnp
@@ -189,6 +229,18 @@ def main(verbose=True):
         jax, jnp, options, main_dev, min(n_trees, CHUNK), 20,
         f"main ({platform})", verbose,
     )
+
+    parity = ""
+    if platform != "cpu":
+        try:
+            max_rel, agree = _mse_parity(
+                jax, jnp, options, main_dev, 2048, verbose
+            )
+            ok = max_rel < 1e-3 and agree > 0.999
+            parity = f"; MSE parity vs CPU: {'OK' if ok else 'MISMATCH'}"
+        except Exception as e:  # pragma: no cover
+            if verbose:
+                print(f"# parity check failed: {e}", file=sys.stderr)
 
     # Preferred anchor: native multithreaded C++ score path (the analog of
     # the reference's compiled-Julia CPU throughput). Fallback: XLA-CPU
@@ -225,7 +277,7 @@ def main(verbose=True):
                     "population fitness-eval throughput, Feynman-I.6.2a "
                     f"({min(n_trees, CHUNK)} trees/batch x {N_ROWS} rows, "
                     f"maxsize {MAXSIZE}, platform {platform}; baseline = "
-                    f"{anchor} score throughput)"
+                    f"{anchor} score throughput{parity})"
                 ),
                 "value": round(value, 1),
                 "unit": "trees-rows/sec/chip",

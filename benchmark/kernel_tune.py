@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""A/B harness for Pallas tree-interpreter kernel variants on real TPU.
+
+Sweeps (dispatch, tree_unroll, sort_trees, slot_loop, t_block) on the
+bench.py workload shape (8192 trees x 1000 rows, maxsize 20) and prints
+trees-rows/sec for each, highest last. Timing matches bench.py: n_inner
+iterations inside one jit with the constant-perturbation trick, tunnel
+dispatch overhead subtracted.
+
+Usage: python benchmark/kernel_tune.py [n_inner]
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    # workload and timing methodology MUST stay in lockstep with the
+    # headline benchmark — import its builders rather than copying them
+    from bench import (
+        N_ROWS,
+        _build_workload,
+        _dispatch_overhead_s,
+        _feynman_data,
+    )
+    from symbolicregression_jl_tpu.models.options import make_options
+    from symbolicregression_jl_tpu.ops.pallas_eval import eval_trees_pallas
+
+    args = sys.argv[1:]
+    tail_n = None
+    if "--tail" in args:  # single up-front parse of the flag and its value
+        i = args.index("--tail")
+        tail_n = int(args[i + 1])
+        args = args[:i] + args[i + 2:]
+    n_inner = int(args[0]) if args else 20
+    N_TREES, MAXSIZE = 8192, 20
+
+    options = make_options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp"],
+        maxsize=MAXSIZE,
+    )
+    ops = options.operators
+    dev = jax.devices()[0]
+    print(f"# device: {dev} ({dev.platform})", file=sys.stderr)
+
+    trees = _build_workload(jax, jnp, options, N_TREES, 1)
+    X = jnp.asarray(_feynman_data()[0])
+
+    overhead = _dispatch_overhead_s(jax, jnp, dev)
+    print(f"# dispatch overhead: {overhead*1e3:.1f} ms", file=sys.stderr)
+
+    def run_variant(**kw):
+        def body(i, acc):
+            t = trees._replace(cval=trees.cval + acc * 1e-12)
+            y, ok = eval_trees_pallas(t, X, ops, **kw)
+            s = jnp.where(ok, jnp.mean(y, axis=-1), 0.0)
+            return acc + jnp.clip(jnp.mean(s), 0.0, 1.0)
+
+        fn = jax.jit(
+            lambda: jax.lax.fori_loop(0, n_inner, body, jnp.float32(0.0))
+        )
+        t_c0 = time.perf_counter()
+        total = float(fn())
+        compile_s = time.perf_counter() - t_c0
+        assert np.isfinite(total), kw
+        ts = [_timeit(lambda: float(fn())) for _ in range(3)]
+        per_iter = max((float(np.median(ts)) - overhead) / n_inner, 1e-9)
+        rate = N_TREES * N_ROWS / per_iter
+        return rate, per_iter, compile_s
+
+    results = []
+    grid = []
+    for dispatch, unroll, sort in itertools.product(
+        ["chain", "mux"], [1, 2, 4], [True, False]
+    ):
+        if not sort and unroll == 4:
+            continue  # unsorted+wide group is strictly worse, skip
+        grid.append(dict(dispatch=dispatch, tree_unroll=unroll,
+                         sort_trees=sort))
+    # plus: the full-unroll slot loop with the best-looking combos
+    grid.append(dict(dispatch="mux", tree_unroll=2, sort_trees=True,
+                     slot_loop="unrolled"))
+    grid.append(dict(dispatch="chain", tree_unroll=1, sort_trees=False,
+                     slot_loop="unrolled"))
+    # t_block sweep on the default variant
+    for tb in (128, 512):
+        grid.append(dict(dispatch="mux", tree_unroll=2, sort_trees=True,
+                         t_block=tb))
+    grid.append(dict(dispatch="mux", tree_unroll=8, sort_trees=True))
+    grid.append(dict(dispatch="mux", tree_unroll=8, sort_trees=True,
+                     t_block=512))
+    grid.append(dict(dispatch="mux", tree_unroll=4, sort_trees=True,
+                     t_block=512))
+    grid.append(dict(dispatch="mux", tree_unroll=4, sort_trees=True,
+                     r_block=2048))
+
+    if tail_n is not None:  # only the last N grid entries (quick probes)
+        grid = grid[-tail_n:]
+
+    for kw in grid:
+        try:
+            rate, per_iter, compile_s = run_variant(**kw)
+        except Exception as e:
+            print(f"FAIL {kw}: {type(e).__name__}: {e}", file=sys.stderr)
+            continue
+        results.append((rate, kw))
+        print(
+            f"{rate:.3e} t-r/s  {per_iter*1e3:7.2f} ms/iter  "
+            f"(compile {compile_s:.0f}s)  {kw}",
+            flush=True,
+        )
+
+    results.sort(key=lambda x: x[0])
+    if results:
+        best_rate, best_kw = results[-1]
+        print(f"\nBEST: {best_rate:.3e} trees-rows/s  {best_kw}")
+
+
+def _timeit(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+if __name__ == "__main__":
+    main()

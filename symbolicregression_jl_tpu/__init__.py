@@ -32,6 +32,7 @@ from .models.trees import (
     decode_tree,
     encode_tree,
     parse_expression,
+    tree_hash,
     tree_to_string,
 )
 from .ops.interpreter import (
@@ -81,6 +82,7 @@ __all__ = [
     "encode_tree",
     "decode_tree",
     "tree_to_string",
+    "tree_hash",
     "parse_expression",
     "eval_tree",
     "eval_trees",

@@ -425,3 +425,40 @@ def get_constants(tree: TreeBatch) -> Tuple[Array, Array]:
 def set_constants(tree: TreeBatch, cval: Array) -> TreeBatch:
     _, mask = get_constants(tree)
     return tree._replace(cval=jnp.where(mask, cval, tree.cval))
+
+
+def tree_hash(tree: TreeBatch) -> "np.ndarray":
+    """Content hash of the program(s) — the analog of Node hashing in the
+    reference's expression engine (exercised by its test/test_hash.jl).
+
+    Only the `length` live slots (plus length itself) feed the digest, so
+    two encodings of the same program hash equal regardless of padded-tail
+    garbage AND of the encoding's max_len (the flat encoding's version of
+    pointer-identity-free structural hashing). Works on a single tree
+    (returns a 0-d uint64 array) or any batch shape. Host-side (numpy);
+    not jittable."""
+    kind = np.ascontiguousarray(tree.kind, dtype=np.int32)
+    op = np.ascontiguousarray(tree.op, dtype=np.int32)
+    feat = np.ascontiguousarray(tree.feat, dtype=np.int32)
+    cval = np.asarray(tree.cval, dtype=np.float64)
+    length = np.asarray(tree.length, dtype=np.int32)
+
+    # leaf/unary slots: op/feat fields that the node kind ignores are noise
+    op = np.where(kind >= UNA, op, 0).astype(np.int32)
+    feat = np.where(kind == VAR, feat, 0).astype(np.int32)
+    cval = np.where(kind == CONST, cval, 0.0)
+
+    import hashlib
+
+    flat_shape = kind.shape[:-1]
+    out = np.empty(flat_shape, dtype=np.uint64)
+    for i in np.ndindex(flat_shape):
+        n = int(length[i])
+        h = hashlib.blake2b(digest_size=8)
+        h.update(np.int32(n).tobytes())
+        h.update(kind[i][:n].tobytes())
+        h.update(op[i][:n].tobytes())
+        h.update(feat[i][:n].tobytes())
+        h.update(cval[i][:n].tobytes())
+        out[i] = np.frombuffer(h.digest(), dtype=np.uint64)[0]
+    return out[()] if flat_shape == () else out

@@ -16,10 +16,18 @@ Design, in order of what made it fast on real hardware:
    SMEM-supplied indices, compute, write slot value.
 2. **Branchless op dispatch.** Instead of `lax.switch` (real branches,
    pipeline flushes), every operator is computed on the operands and the
-   result selected by a chain of scalar-predicate `where`s — ~n_ops vector
-   ops per slot, all pipelineable. (The lockstep jnp interpreter pays the
-   same n_ops factor but on *padded* slots; here short trees stop at their
-   own length.)
+   result selected without branching — ~n_ops vector ops per slot, all
+   pipelineable. (The lockstep jnp interpreter pays the same n_ops factor
+   but on *padded* slots; here short trees stop at their own length.)
+   Two selection shapes (`dispatch=`): "chain" = serial `where` chain
+   (n_ops dependent selects on the critical path), "mux" (default) = a
+   balanced log2(n_ops)-deep select tree on opcode ranges.
+2b. **Tree interleaving** (`tree_unroll`, default 4). A single tree's slot
+   stream is a serial write→read chain through its value scratch; two
+   independent trees advanced in lockstep give the pipeline parallel work
+   at every step. The wrapper sorts trees by length (`sort_trees`) so
+   interleaved groups finish together (the group loop runs to the max
+   length in the group).
 3. **Full-vreg row tiles.** Rows live on BOTH sublanes and lanes as
    (r_sub, 128) tiles, so each op runs on full 8x128 vregs.
 3b. **Length-bounded slot loop.** Each tree runs ceil(length/4) dynamic
@@ -130,30 +138,38 @@ _SLOT_UNROLL = 4  # slots per dynamic loop step
 
 
 def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
-                 max_len: int, slot_loop: str = "dynamic"):
+                 max_len: int, slot_loop: str, dispatch: str,
+                 tree_unroll: int):
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
     if slot_loop not in ("dynamic", "unrolled"):
         raise ValueError(
             f"slot_loop must be 'dynamic' or 'unrolled', got {slot_loop!r}"
         )
+    if dispatch not in ("mux", "chain"):
+        raise ValueError(f"dispatch must be 'mux' or 'chain', got {dispatch!r}")
+    if tree_unroll not in (1, 2, 4, 8) or t_block % tree_unroll:
+        raise ValueError(
+            f"tree_unroll must be 1/2/4/8 and divide t_block, got {tree_unroll}"
+        )
 
     unary_fns = operators.unary_fns
     binary_fns = operators.binary_fns
     U = len(unary_fns)
+    n_codes = 3 + U + len(binary_fns)
     r_sub = r_block // 128
 
     def kernel(nrows_ref, pcode_ref, feat_ref, length_ref,
                cval_ref, lidx_ref, ridx_ref,  # SMEM, transposed (L, t_block)
                X_ref, out_ref, bad_ref,  # VMEM in / VMEM out / SMEM out
-               val_ref):  # scratch VMEM (max_len, r_sub, 128)
+               *val_refs):  # scratch VMEM (max_len, r_sub, 128) x tree_unroll
         # row-validity mask: padded tail rows must not poison the tree
         sub = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 0)
         lane = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 1)
         row = (pl.program_id(1) * r_sub + sub) * 128 + lane
         valid_f = jnp.where(row < nrows_ref[0], 1.0, 0.0)
 
-        def slot_body(si, ti, bad):
+        def slot_body(si, ti, bad, val_ref):
             """One postfix slot: branchless dispatch over the operator set.
 
             PAD slots execute harmlessly: code 0 is masked out of the
@@ -163,51 +179,82 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
             a = val_ref[ridx_ref[si, ti]]  # top of stack: right arg
             b = val_ref[lidx_ref[si, ti]]  # second: left arg
             x = X_ref[feat_ref[si, ti]]
-            v = jnp.where(
-                code == 1,
-                jnp.full((r_sub, 128), cval_ref[si, ti], jnp.float32),
-                x,
-            )
-            for j, fn in enumerate(unary_fns):
-                v = jnp.where(code == 3 + j, fn(a), v)
-            for j, fn in enumerate(binary_fns):
-                v = jnp.where(code == 3 + U + j, fn(b, a), v)
+            cv = jnp.full((r_sub, 128), cval_ref[si, ti], jnp.float32)
+            if dispatch == "chain":
+                # serial select chain: n_codes dependent `where`s
+                v = jnp.where(code == 1, cv, x)
+                for j, fn in enumerate(unary_fns):
+                    v = jnp.where(code == 3 + j, fn(a), v)
+                for j, fn in enumerate(binary_fns):
+                    v = jnp.where(code == 3 + U + j, fn(b, a), v)
+            else:
+                # balanced mux: all candidates computed in parallel, then a
+                # log2(n_codes)-deep select tree on code ranges — shortens
+                # the slot's serial critical path (the chain above is
+                # n_codes dependent selects; stack writes/reads already
+                # serialize consecutive slots, so path length is what the
+                # pipeline sees)
+                cands = [x, cv, x]  # PAD (dead), CONST, VAR
+                cands += [fn(a) for fn in unary_fns]
+                cands += [fn(b, a) for fn in binary_fns]
+
+                def mux(lo, hi):
+                    if hi - lo == 1:
+                        return cands[lo]
+                    mid = (lo + hi) // 2
+                    return jnp.where(code < mid, mux(lo, mid), mux(mid, hi))
+
+                v = mux(0, n_codes)
             val_ref[si] = v
             return jnp.maximum(
                 bad,
                 jnp.where(jnp.isfinite(v) | (code == 0), 0.0, valid_f),
             )
 
-        def tree_body(ti, _):
-            n = length_ref[0, ti]
-            zero = jnp.zeros((r_sub, 128), jnp.float32)
-            if slot_loop == "dynamic":
-                # Slot loop bounded by THIS tree's length (avg tree fills
-                # ~half of max_len, so padded tails are skipped), with a
-                # statically-unrolled 4-slot body: straight-line code
-                # inside a group lets the compiler overlap SMEM loads and
-                # vector ops, while keeping compiled code size at 4 slot
-                # bodies (per-block lax.cond specializations of a full
-                # unroll blow Mosaic compile time past usability).
-                def slot_group(g, bad):
-                    for k in range(_SLOT_UNROLL):
-                        bad = slot_body(g * _SLOT_UNROLL + k, ti, bad)
-                    return bad
+        zero = jnp.zeros((r_sub, 128), jnp.float32)
 
-                n_groups = (n + _SLOT_UNROLL - 1) // _SLOT_UNROLL
-                bad = jax.lax.fori_loop(0, n_groups, slot_group, zero)
+        def tree_group_body(p, _):
+            """tree_unroll independent trees advanced in lockstep: their
+            slot streams have no data dependencies on each other, so the
+            pipeline overlaps them (each single tree is a serial
+            write-then-read chain through its val scratch). Padded slots of
+            the shorter trees execute harmlessly (PAD semantics above);
+            the wrapper sorts trees by length so group members match."""
+            tis = [p * tree_unroll + k for k in range(tree_unroll)]
+            ns = [length_ref[0, ti] for ti in tis]
+            if slot_loop == "dynamic":
+                n_max = ns[0]
+                for n in ns[1:]:
+                    n_max = jnp.maximum(n_max, n)
+
+                def slot_group(g, bads):
+                    bads = list(bads)
+                    for k in range(_SLOT_UNROLL):
+                        si = g * _SLOT_UNROLL + k
+                        for t in range(tree_unroll):
+                            bads[t] = slot_body(
+                                si, tis[t], bads[t], val_refs[t]
+                            )
+                    return tuple(bads)
+
+                n_groups = (n_max + _SLOT_UNROLL - 1) // _SLOT_UNROLL
+                bads = jax.lax.fori_loop(
+                    0, n_groups, slot_group, (zero,) * tree_unroll
+                )
             else:
                 # Full static unroll: every slot executes for every tree —
                 # more straight-line overlap, no loop overhead, but pays
                 # for padded tails and compiles slower. (A/B alternative.)
-                bad = zero
+                bads = [zero] * tree_unroll
                 for si in range(max_len):
-                    bad = slot_body(si, ti, bad)
-            out_ref[ti] = val_ref[jnp.maximum(n - 1, 0)]
-            bad_ref[0, ti] = jnp.sum(bad)
+                    for t in range(tree_unroll):
+                        bads[t] = slot_body(si, tis[t], bads[t], val_refs[t])
+            for t in range(tree_unroll):
+                out_ref[tis[t]] = val_refs[t][jnp.maximum(ns[t] - 1, 0)]
+                bad_ref[0, tis[t]] = jnp.sum(bads[t])
             return 0
 
-        jax.lax.fori_loop(0, t_block, tree_body, 0)
+        jax.lax.fori_loop(0, t_block // tree_unroll, tree_group_body, 0)
 
     return kernel
 
@@ -219,7 +266,7 @@ def _round_up(x: int, m: int) -> int:
 @functools.partial(
     jax.jit,
     static_argnames=("operators", "t_block", "r_block", "interpret",
-                     "slot_loop"),
+                     "slot_loop", "dispatch", "tree_unroll", "sort_trees"),
 )
 def eval_trees_pallas(
     trees: TreeBatch,
@@ -229,6 +276,9 @@ def eval_trees_pallas(
     r_block: int = DEFAULT_R_BLOCK,
     interpret: bool = False,
     slot_loop: str = "dynamic",
+    dispatch: str = "mux",
+    tree_unroll: int = 4,
+    sort_trees: bool = True,
 ) -> Tuple[Array, Array]:
     """Evaluate a flat batch of trees over X (nfeat, nrows).
 
@@ -241,6 +291,15 @@ def eval_trees_pallas(
     flat = jax.tree_util.tree_map(
         lambda x: x.reshape((-1,) + x.shape[len(batch_shape):]), trees
     )
+    # Sort by length so (a) tree_unroll groups advance trees of matching
+    # length (the group's dynamic slot loop runs to the max of the group)
+    # and (b) grid blocks are length-homogeneous. Gather here, inverse
+    # gather on the (T,) outputs — O(T·L) int work, dwarfed by the kernel.
+    perm = inv_perm = None
+    if sort_trees and flat.length.shape[0] > 1:
+        perm = jnp.argsort(flat.length)
+        inv_perm = jnp.argsort(perm)
+        flat = jax.tree_util.tree_map(lambda x: x[perm], flat)
     # slot axis padded to a multiple of the kernel's 4-slot loop groups —
     # the last group of a length-L tree may touch slots up to
     # round_up(L, 4)-1 (PAD slots, harmless but they must exist)
@@ -257,7 +316,7 @@ def eval_trees_pallas(
     T = flat.length.shape[0]
     nfeat, nrows = X.shape
 
-    t_block = min(t_block, max(T, 8))
+    t_block = min(t_block, _round_up(max(T, 8), tree_unroll))
     r_block = min(r_block, _round_up(nrows, 128))
     r_sub = r_block // 128
     T_pad = _round_up(T, t_block)
@@ -280,7 +339,8 @@ def eval_trees_pallas(
     Xp = Xp.reshape(nfeat, NR, 128)
     nrows_arr = jnp.asarray([nrows], jnp.int32)
 
-    kernel = _make_kernel(operators, t_block, r_block, L, slot_loop)
+    kernel = _make_kernel(operators, t_block, r_block, L, slot_loop,
+                          dispatch, tree_unroll)
 
     grid = (T_pad // t_block, NR // r_sub)
     smem_spec = lambda shape, imap: pl.BlockSpec(
@@ -308,12 +368,18 @@ def eval_trees_pallas(
             jax.ShapeDtypeStruct((T_pad, NR, 128), jnp.float32),
             jax.ShapeDtypeStruct((grid[1], T_pad), jnp.float32),
         ],
-        scratch_shapes=[pltpu.VMEM((L, r_sub, 128), jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((L, r_sub, 128), jnp.float32)
+            for _ in range(tree_unroll)
+        ],
         interpret=interpret,
     )(nrows_arr, pcode, feat, length, cval, lidx, ridx, Xp)
 
     y = y.reshape(T_pad, R_pad)[:T, :nrows]
     ok = (jnp.sum(bad[:, :T], axis=0) == 0) & (flat.length > 0)
+    if inv_perm is not None:
+        y = y[inv_perm]
+        ok = ok[inv_perm]
     return (
         y.reshape(batch_shape + (nrows,)),
         ok.reshape(batch_shape),

@@ -23,7 +23,15 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
     An explicit `cache_dir` always wins; otherwise an already-configured
     cache (jax.config / JAX_COMPILATION_CACHE_DIR) is left untouched, and
     only a fully-unconfigured process gets the package default
-    (~/.cache/symbolicregression_jl_tpu)."""
+    (~/.cache/symbolicregression_jl_tpu).
+
+    Two process-global caveats: (1) once any compile has used the cache,
+    JAX keeps the initialized cache singleton even if the config is later
+    pointed elsewhere — call jax._src.compilation_cache.reset_cache() to
+    truly detach; (2) on some jaxlib builds `executable.serialize()` can
+    crash for certain large CPU executables, killing the process from
+    inside the cache write — if that happens, leave the cache disabled for
+    CPU runs (TPU executables are unaffected)."""
     import jax
 
     existing = jax.config.jax_compilation_cache_dir

@@ -47,17 +47,6 @@ def recursive_merge(*dicts: RecordType) -> RecordType:
     return out
 
 
-def _tree_hash(kind, op, feat, cval, length) -> str:
-    n = int(length)
-    h = hash(
-        (
-            tuple(np.asarray(kind[:n]).tolist()),
-            tuple(np.asarray(op[:n]).tolist()),
-            tuple(np.asarray(feat[:n]).tolist()),
-            tuple(np.round(np.asarray(cval[:n], np.float64), 12).tolist()),
-        )
-    )
-    return f"{h & 0xFFFFFFFFFFFFFFFF:016x}"
 
 
 class Recorder:
@@ -108,13 +97,16 @@ class Recorder:
                 trees_np.kind, trees_np.op, trees_np.feat, trees_np.cval,
                 trees_np.length, self.options.operators, self.variable_names,
             )
+        from ..models.trees import tree_hash
+
+        refs = [f"{int(h):016x}" for h in np.atleast_1d(tree_hash(trees_np))]
         members: List[RecordType] = []
         cur: set = set()
         for m in range(npop):
-            t = jax.tree_util.tree_map(lambda x: x[m], trees_np)
-            ref = _tree_hash(t.kind, t.op, t.feat, t.cval, t.length)
+            ref = refs[m]
             eq = eqs[m] if eqs is not None else expr_to_string(
-                decode_tree(t), self.options.operators, self.variable_names
+                decode_tree(jax.tree_util.tree_map(lambda x: x[m], trees_np)),
+                self.options.operators, self.variable_names,
             )
             members.append(
                 {

@@ -129,6 +129,21 @@ def test_deterministic_same_seed(rng):
     ] or r3.best().loss != r1.best().loss
 
 
+def test_timeout_stops_early(rng):
+    """timeout_in_seconds ends the search after the current iteration
+    (analog of reference test/test_stop_on_clock.jl:9-14)."""
+    X, y = make_data(rng, n=40)
+    its = []
+    res = sr.equation_search(
+        X, y, niterations=50, runtests=False, seed=5,
+        timeout_in_seconds=1e-3, on_iteration=lambda j, it, c: its.append(it),
+        **TINY
+    )
+    # the loop checks the clock after each iteration: only the first ran
+    assert len(its) == 1
+    assert res.search_time_s < 60.0
+
+
 def test_option_validation(rng):
     X, y = make_data(rng)
     with pytest.raises(ValueError):

@@ -197,13 +197,30 @@ def test_quit_watcher_disabled_in_tests():
 
 
 def test_do_precompilation_compile_mode(tmp_path):
+    import jax
+
     import symbolicregression_jl_tpu as sr
 
-    sr.do_precompilation(mode="compile", cache_dir=str(tmp_path))
-    # the cache dir was created and the jit programs compiled without error
-    import os
+    # jax_compilation_cache_dir is process-global; leaving it on after this
+    # test would make LATER tests write persistent-cache entries, and on
+    # this image executable.serialize() segfaults on some CPU executables
+    # (see conftest.py). Restore whatever was configured before.
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        sr.do_precompilation(mode="compile", cache_dir=str(tmp_path))
+        # cache dir was created and the jit programs compiled without error
+        import os
 
-    assert os.path.isdir(str(tmp_path))
+        assert os.path.isdir(str(tmp_path))
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        # restoring the config is NOT enough: the cache is a process-global
+        # singleton that stays initialized (and keeps writing entries) once
+        # the first compile used it — reset it so later tests' compiles
+        # don't reach the crashing serializer
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
 
 
 def test_do_precompilation_bad_mode():

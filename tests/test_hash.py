@@ -1,0 +1,70 @@
+"""Structural tree hashing (analog of reference test/test_hash.jl:
+hash(tree) is content-based, insensitive to storage identity — here,
+insensitive to padded-tail garbage in the flat encoding)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from symbolicregression_jl_tpu.models.trees import (
+    encode_tree,
+    parse_expression,
+    stack_trees,
+    tree_hash,
+)
+from symbolicregression_jl_tpu.ops.operators import make_operator_set
+
+OPS = make_operator_set(["+", "-", "*", "/"], ["cos", "exp"])
+
+
+def _t(s, max_len=20):
+    return encode_tree(parse_expression(s, OPS), max_len)
+
+
+def test_equal_programs_equal_hashes():
+    assert tree_hash(_t("(x0 + 1.5) * cos(x1)")) == tree_hash(
+        _t("(x0 + 1.5) * cos(x1)")
+    )
+
+
+def test_padding_garbage_ignored():
+    a = _t("x0 + 1.0", max_len=8)
+    b = _t("x0 + 1.0", max_len=8)
+    # poison the padded tail of b: same program, different storage bytes
+    b = b._replace(
+        kind=b.kind.at[5:].set(4),
+        op=b.op.at[5:].set(3),
+        cval=b.cval.at[5:].set(99.0),
+    )
+    assert tree_hash(a) == tree_hash(b)
+
+
+def test_dead_fields_ignored():
+    """op on leaves and feat on consts are dead fields — not program
+    content."""
+    a = _t("x0 + 1.0", max_len=8)
+    b = a._replace(op=a.op.at[0].set(3))  # x0 is VAR: op slot is dead
+    assert tree_hash(a) == tree_hash(b)
+
+
+def test_different_programs_differ():
+    hs = {
+        int(tree_hash(_t(s)))
+        for s in [
+            "x0 + 1.5",
+            "x0 - 1.5",
+            "x0 + 1.6",
+            "x1 + 1.5",
+            "cos(x0) + 1.5",
+            "(x0 + 1.5) * x1",
+        ]
+    }
+    assert len(hs) == 6
+
+
+def test_batched_hashing():
+    batch = stack_trees([_t("x0 + 1.0", 12), _t("cos(x1)", 12)])
+    hs = tree_hash(batch)
+    assert hs.shape == (2,)
+    assert hs[0] != hs[1]
+    assert hs[0] == tree_hash(_t("x0 + 1.0", 12))

@@ -132,3 +132,25 @@ def test_interpret_unrolled_slot_loop_variant():
     np.testing.assert_allclose(
         np.asarray(y_d), np.asarray(y_u), rtol=1e-6, atol=1e-7
     )
+
+
+@pytest.mark.parametrize("dispatch", ["mux", "chain"])
+@pytest.mark.parametrize("tree_unroll", [1, 2, 4])
+@pytest.mark.parametrize("sort_trees", [True, False])
+def test_kernel_variants_agree(rng, dispatch, tree_unroll, sort_trees):
+    """Every (dispatch, tree_unroll, sort) kernel variant must produce the
+    jnp interpreter's results bit-for-bit in ok and numerically in y."""
+    trees = batch(rng, 13)  # odd count: exercises group padding
+    X = jnp.asarray(
+        (rng.standard_normal((NFEAT, 50)) * 2).astype(np.float32)
+    )
+    y_ref, ok_ref = eval_trees(trees, X, OPS)
+    y, ok = eval_trees_pallas(
+        trees, X, OPS, t_block=8, r_block=128, interpret=True,
+        dispatch=dispatch, tree_unroll=tree_unroll, sort_trees=sort_trees,
+    )
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+    m = np.asarray(ok_ref)
+    np.testing.assert_allclose(
+        np.asarray(y)[m], np.asarray(y_ref)[m], rtol=1e-5, atol=1e-5
+    )
