@@ -195,8 +195,10 @@ def _mse_parity(jax, jnp, options, device, n_check, verbose):
     rel = np.abs(l_dev[both] - l_cpu[both]) / np.maximum(
         np.abs(l_cpu[both]), 1e-6
     )
-    # a parity verdict over too few mutually-finite trees is vacuous
-    max_rel = float(rel.max()) if rel.size >= 100 else float("inf")
+    # a parity verdict over too few mutually-finite trees is vacuous —
+    # report that as its own state, not as a numerical mismatch
+    enough = rel.size >= 100
+    max_rel = float(rel.max()) if enough else float("nan")
     if verbose:
         print(
             f"# MSE parity vs CPU interpreter: {int(both.sum())} trees, "
@@ -204,7 +206,7 @@ def _mse_parity(jax, jnp, options, device, n_check, verbose):
             f"{agree_finite:.4f}",
             file=sys.stderr,
         )
-    return max_rel, agree_finite
+    return (max_rel if enough else None), agree_finite
 
 
 def main(verbose=True):
@@ -236,8 +238,13 @@ def main(verbose=True):
             max_rel, agree = _mse_parity(
                 jax, jnp, options, main_dev, 2048, verbose
             )
-            ok = max_rel < 1e-3 and agree > 0.999
-            parity = f"; MSE parity vs CPU: {'OK' if ok else 'MISMATCH'}"
+            if max_rel is None:
+                verdict = "INSUFFICIENT-SAMPLE"
+            elif max_rel < 1e-3 and agree > 0.999:
+                verdict = "OK"
+            else:
+                verdict = "MISMATCH"
+            parity = f"; MSE parity vs CPU: {verdict}"
         except Exception as e:  # pragma: no cover
             if verbose:
                 print(f"# parity check failed: {e}", file=sys.stderr)

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Feynman-equation recovery benchmark: full `equation_search` runs on
+synthetic datasets generated from Feynman-symbolic-regression formulas
+(the reference's north-star workload family — BASELINE.json configs 1-2),
+reporting per-case solved/loss/time as one JSON line each.
+
+Quality metric = normalized loss of the best frontier member (loss /
+var(y)); a case counts as solved below 1e-4. Usage:
+
+    python benchmark/feynman.py [--fast] [--seed N]
+
+--fast shrinks the search budget (CI smoke); default budget aims at
+recovery on every case on a single chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# (name, n_vars, formula, sampling ranges)
+CASES = [
+    (
+        "I.6.2a",  # exp(-theta^2/2)/sqrt(2*pi)
+        1,
+        lambda v: np.exp(-(v[0] ** 2) / 2.0) / np.sqrt(2 * np.pi),
+        [(1.0, 3.0)],
+    ),
+    (
+        "I.12.5",  # q2 * Ef
+        2,
+        lambda v: v[0] * v[1],
+        [(1.0, 5.0), (1.0, 5.0)],
+    ),
+    (
+        "I.29.4",  # omega / c
+        2,
+        lambda v: v[0] / v[1],
+        [(1.0, 10.0), (1.0, 10.0)],
+    ),
+    (
+        "I.39.1",  # 3/2 * pr * V
+        2,
+        lambda v: 1.5 * v[0] * v[1],
+        [(1.0, 5.0), (1.0, 5.0)],
+    ),
+    (
+        "II.8.31",  # epsilon * Ef^2 / 2
+        2,
+        lambda v: v[0] * v[1] ** 2 / 2.0,
+        [(1.0, 5.0), (1.0, 5.0)],
+    ),
+    (
+        "I.25.13",  # q / C
+        2,
+        lambda v: v[0] / v[1],
+        [(1.0, 10.0), (1.0, 10.0)],
+    ),
+]
+
+
+def main():
+    import symbolicregression_jl_tpu as sr
+
+    fast = "--fast" in sys.argv
+    seed = 0
+    if "--seed" in sys.argv:
+        seed = int(sys.argv[sys.argv.index("--seed") + 1])
+
+    budget = dict(
+        niterations=4 if fast else 12,
+        npop=33,
+        npopulations=4 if fast else 16,
+        ncycles_per_iteration=60 if fast else 300,
+        maxsize=16,
+    )
+    n_rows = 256
+
+    solved = 0
+    for name, n_vars, fn, ranges in CASES:
+        rng = np.random.default_rng(seed)
+        X = np.stack(
+            [rng.uniform(lo, hi, n_rows) for lo, hi in ranges]
+        ).astype(np.float32)
+        y = fn(X).astype(np.float32)
+        var = float(np.var(y))
+
+        t0 = time.time()
+        res = sr.equation_search(
+            X,
+            y,
+            binary_operators=["+", "-", "*", "/"],
+            unary_operators=["cos", "exp", "sqrt"],
+            seed=seed,
+            verbosity=0,
+            progress=False,
+            runtests=False,
+            early_stop_condition=1e-6 * var,
+            **budget,
+        )
+        dt = time.time() - t0
+        best = res.best()
+        norm_loss = best.loss / max(var, 1e-12)
+        ok = norm_loss < 1e-4
+        solved += ok
+        print(
+            json.dumps(
+                {
+                    "case": name,
+                    "solved": bool(ok),
+                    "norm_loss": float(f"{norm_loss:.3e}"),
+                    "complexity": best.complexity,
+                    "equation": best.equation,
+                    "seconds": round(dt, 1),
+                    "num_evals": round(res.num_evals),
+                }
+            ),
+            flush=True,
+        )
+    print(
+        json.dumps({"suite": "feynman", "solved": solved, "of": len(CASES)}),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

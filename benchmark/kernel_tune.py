@@ -7,7 +7,10 @@ trees-rows/sec for each, highest last. Timing matches bench.py: n_inner
 iterations inside one jit with the constant-perturbation trick, tunnel
 dispatch overhead subtracted.
 
-Usage: python benchmark/kernel_tune.py [n_inner]
+Usage: python benchmark/kernel_tune.py [n_inner] [--tail N]
+
+--tail N runs only the last N grid entries (quick probes of newly added
+variants without re-sweeping the full grid).
 """
 
 from __future__ import annotations
@@ -41,6 +44,8 @@ def main():
     tail_n = None
     if "--tail" in args:  # single up-front parse of the flag and its value
         i = args.index("--tail")
+        if i + 1 >= len(args):
+            sys.exit("--tail requires a value: kernel_tune.py [n_inner] --tail N")
         tail_n = int(args[i + 1])
         args = args[:i] + args[i + 2:]
     n_inner = int(args[0]) if args else 20

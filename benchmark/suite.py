@@ -147,6 +147,64 @@ def bench_population_scoring():
     ]
 
 
+def bench_search_iteration():
+    """Full-search throughput: one jitted evolution iteration (s_r_cycle +
+    simplify + constant-opt + HoF merge + migration) over all islands —
+    the analog of the reference's 'cycles per second' runtime print
+    (src/SymbolicRegression.jl:869-896). Reported as candidate evaluations
+    per second: ncycles x n_parallel_tournaments x islands / time."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from symbolicregression_jl_tpu.api import _make_init_fn, _make_iteration_fn
+    from symbolicregression_jl_tpu.models.options import make_options
+
+    options = make_options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp"],
+        npop=33,
+        npopulations=15,
+        ncycles_per_iteration=100,
+        maxsize=20,
+    )
+    n_feat, n_rows = 5, 256
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((n_feat, n_rows)), jnp.float32)
+    y = 2.0 * jnp.cos(X[4]) + X[1] ** 2 - 2.0
+    baseline = jnp.float32(float(jnp.var(y)))
+
+    init_fn = _make_init_fn(options, n_feat, False)
+    states = init_fn(
+        jax.random.split(jax.random.PRNGKey(0), options.npopulations),
+        X, y, baseline,
+    )
+    it_fn = _make_iteration_fn(options, False)
+    cm = jnp.int32(options.maxsize)
+
+    def run():
+        s2, ghof = it_fn(states, jax.random.PRNGKey(1), cm, X, y, baseline)
+        jax.block_until_ready(ghof.losses)
+
+    dt = _median_time(run, reps=3)
+    cand_evals = (
+        options.ncycles_per_iteration
+        * options.n_parallel_tournaments
+        * options.npopulations
+    )
+    return [
+        {
+            "suite": "search_iteration",
+            "case": (
+                f"islands{options.npopulations}_npop{options.npop}_"
+                f"cycles{options.ncycles_per_iteration}_rows{n_rows}"
+            ),
+            "median_s": dt,
+            "candidate_evals_per_s": cand_evals / dt,
+        }
+    ]
+
+
 def main():
     import jax
 
@@ -156,6 +214,7 @@ def main():
         bench_eval_fixed_tree,
         bench_single_eval_48_nodes,
         bench_population_scoring,
+        bench_search_iteration,
     ):
         try:
             results.extend(fn())

@@ -329,6 +329,13 @@ def make_options(**kwargs) -> Options:
         if k2 in remapped:
             raise ValueError(f"Duplicate kwarg {k2!r}")
         remapped[k2] = v
+    # The reference's SIMD knob (src/Options.jl:250-252): here the
+    # accelerated eval path is the Pallas TPU kernel, so turbo=True maps to
+    # eval_backend="auto" (kernel on TPU, interpreter elsewhere) and
+    # turbo=False pins the portable interpreter.
+    if "turbo" in remapped:
+        turbo = remapped.pop("turbo")
+        remapped.setdefault("eval_backend", "auto" if turbo else "jnp")
     if isinstance(remapped.get("mutation_weights"), (list, tuple)):
         remapped["mutation_weights"] = MutationWeights(*remapped["mutation_weights"])
     elif isinstance(remapped.get("mutation_weights"), dict):

@@ -298,7 +298,9 @@ def eval_trees_pallas(
     perm = inv_perm = None
     if sort_trees and flat.length.shape[0] > 1:
         perm = jnp.argsort(flat.length)
-        inv_perm = jnp.argsort(perm)
+        inv_perm = jnp.zeros_like(perm).at[perm].set(
+            jnp.arange(perm.shape[0], dtype=perm.dtype)
+        )
         flat = jax.tree_util.tree_map(lambda x: x[perm], flat)
     # slot axis padded to a multiple of the kernel's 4-slot loop groups —
     # the last group of a length-L tree may touch slots up to

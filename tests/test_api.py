@@ -144,6 +144,17 @@ def test_timeout_stops_early(rng):
     assert res.search_time_s < 60.0
 
 
+def test_turbo_and_fast_cycle_knobs():
+    """Reference compatibility knobs: turbo maps to the eval-backend
+    switch (the Pallas kernel is this framework's SIMD analog,
+    src/Options.jl:250-252); fast_cycle is accepted as a no-op (the
+    engine is always fully batched)."""
+    o1 = make_options(binary_operators=["+"], turbo=True, fast_cycle=True)
+    assert o1.eval_backend == "auto"
+    o2 = make_options(binary_operators=["+"], turbo=False)
+    assert o2.eval_backend == "jnp"
+
+
 def test_option_validation(rng):
     X, y = make_data(rng)
     with pytest.raises(ValueError):

@@ -135,7 +135,7 @@ def test_interpret_unrolled_slot_loop_variant():
 
 
 @pytest.mark.parametrize("dispatch", ["mux", "chain"])
-@pytest.mark.parametrize("tree_unroll", [1, 2, 4])
+@pytest.mark.parametrize("tree_unroll", [1, 2, 4, 8])
 @pytest.mark.parametrize("sort_trees", [True, False])
 def test_kernel_variants_agree(rng, dispatch, tree_unroll, sort_trees):
     """Every (dispatch, tree_unroll, sort) kernel variant must produce the
