@@ -30,16 +30,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .models.constant_opt import optimize_constants_population
 from .models.dataset import Dataset, make_dataset, update_baseline_loss
 from .models.evolve import (
     IslandState,
     init_island_state,
+    optimize_island_constants,
     s_r_cycle_islands,
     simplify_population_islands,
 )
 from .models.options import Options, make_options
-from .models.population import HallOfFame, update_hall_of_fame
+from .models.population import HallOfFame
 from .models.trees import TreeBatch
 from .ops.interpreter import eval_tree
 from .parallel.distributed import is_primary_host
@@ -165,19 +165,11 @@ def _make_iteration_fn(options: Options, has_weights: bool):
         if options.should_optimize_constants and options.optimizer_probability > 0:
             I = states.birth_counter.shape[0]
             okeys = jax.random.split(k_opt, I)
-
-            def opt_island(k, st: IslandState) -> IslandState:
-                pop2, n_evals = optimize_constants_population(
-                    k, st.pop, X, y, weights, baseline, options
+            states = jax.vmap(
+                lambda k, st: optimize_island_constants(
+                    k, st, X, y, weights, baseline, options
                 )
-                hof2 = update_hall_of_fame(
-                    st.hof, pop2.trees, pop2.scores, pop2.losses, options
-                )
-                return st._replace(
-                    pop=pop2, hof=hof2, num_evals=st.num_evals + n_evals
-                )
-
-            states = jax.vmap(opt_island)(okeys, states)
+            )(okeys, states)
         ghof = merge_hofs_across_islands(states.hof)
         states = migrate(k_mig, states, ghof, options)
         return states, ghof

@@ -1,6 +1,17 @@
 """End-to-end: a single island recovers the reference's precompile workload
 target 2*cos(x4) + x1^2 - 2 with loss < 1e-2
-(parity: reference test/test_mixed.jl:129-141 quality bar, BASELINE.md)."""
+(parity: reference test/test_mixed.jl:129-141 quality bar, BASELINE.md).
+
+The iteration here is the full single-island analog of the reference's
+worker step — s_r_cycle THEN simplify THEN constant optimization
+(src/SingleIteration.jl:17-127): the target's constants (2, -2) are found
+by BFGS, not by constant-perturbation mutations alone. A single island is
+diversity-limited, so recovery is seed-dependent either way (the robust
+multi-island path is covered by test_api/test_mixed); with the optimizer
+the test seed converges in ~3 iterations instead of skirting the
+threshold, which is what keeps this deterministic engine-level test
+stable under PRNG-stream changes.
+"""
 
 import jax
 import jax.numpy as jnp
@@ -9,6 +20,7 @@ import pytest
 
 from symbolicregression_jl_tpu.models.evolve import (
     init_island_state,
+    optimize_island_constants,
     s_r_cycle,
     simplify_population,
 )
@@ -33,15 +45,19 @@ def test_recovers_synthetic_target(rng):
         jax.random.PRNGKey(1), opt, 5, Xj, yj, None, baseline
     )
     cm = jnp.int32(opt.maxsize)
-    step = jax.jit(
-        lambda st: simplify_population(
-            s_r_cycle(st, cm, Xj, yj, None, baseline, opt),
-            cm, Xj, yj, None, baseline, opt,
-        )
-    )
+
+    def one_iteration(st, k):
+        st = s_r_cycle(st, cm, Xj, yj, None, baseline, opt)
+        st = simplify_population(st, cm, Xj, yj, None, baseline, opt)
+        # same helper the production iteration uses (api.py)
+        return optimize_island_constants(k, st, Xj, yj, None, baseline, opt)
+
+    step = jax.jit(one_iteration)
+    master = jax.random.PRNGKey(7)
     best = np.inf
     for it in range(12):
-        state = step(state)
+        master, k_opt = jax.random.split(master)
+        state = step(state, k_opt)
         hl, he = np.asarray(state.hof.losses), np.asarray(state.hof.exists)
         best = hl[he].min()
         if best < 1e-2:
