@@ -61,6 +61,7 @@ __version__ = "0.1.0"
 
 # Populated lazily to avoid importing heavy modules at package import:
 from .api import EquationSearchResult, equation_search  # noqa: E402
+from .sklearn import SymbolicRegressor  # noqa: E402
 from .utils.precompile import (  # noqa: E402
     do_precompilation,
     enable_compilation_cache,
@@ -100,6 +101,7 @@ __all__ = [
     "to_callable",
     "sympy_simplify_tree",
     "equation_search",
+    "SymbolicRegressor",
     "EquationSearch",
     "EquationSearchResult",
     "do_precompilation",

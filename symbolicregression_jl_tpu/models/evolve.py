@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from .complexity import compute_complexity
+from .constant_opt import optimize_constants_population
 from .constraints import check_constraints_single
 from .fitness import sample_batch_idx, score_trees
 from .mutate_device import (
@@ -670,8 +671,6 @@ def optimize_island_constants(
     optimize_and_simplify_population, src/SingleIteration.jl:63-127).
     Single source for both the production iteration (api.py) and
     engine-level tests."""
-    from .constant_opt import optimize_constants_population
-
     pop2, n_evals = optimize_constants_population(
         key, state.pop, X, y, weights, baseline, options
     )

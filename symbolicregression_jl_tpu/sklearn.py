@@ -1,0 +1,123 @@
+"""Scikit-learn-style estimator facade over `equation_search`.
+
+The reference is the search engine behind PySR's `PySRRegressor`; users
+coming from that ecosystem expect a fit/predict estimator with
+`(n_samples, n_features)` data layout. This wraps the functional API
+(`api.equation_search`, which uses the reference's `(nfeatures, n)`
+layout from src/Dataset.jl) in that convention. No sklearn dependency —
+duck-typed `get_params`/`set_params` follow the estimator protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+from .api import EquationSearchResult, equation_search
+
+
+class SymbolicRegressor:
+    """Evolutionary symbolic regression estimator.
+
+    Parameters are `equation_search` / `make_options` kwargs (e.g.
+    binary_operators, unary_operators, npop, npopulations, maxsize,
+    parsimony, ...) plus `niterations`. Data is `(n_samples, n_features)`
+    like any sklearn estimator; it is transposed to the engine's
+    `(nfeatures, n)` layout internally.
+
+    After `fit`: `equations_` (per-output Pareto frontier),
+    `best_equation_`, `result_` (the full EquationSearchResult);
+    `predict`/`score` evaluate the chosen frontier member.
+    """
+
+    def __init__(self, niterations: int = 10, **options: Any):
+        self.niterations = niterations
+        self.options = options
+        self.result_: Optional[EquationSearchResult] = None
+
+    # -- sklearn estimator protocol ------------------------------------
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        return {"niterations": self.niterations, **self.options}
+
+    def set_params(self, **params: Any) -> "SymbolicRegressor":
+        self.niterations = params.pop("niterations", self.niterations)
+        self.options.update(params)
+        return self
+
+    # -- fitting -------------------------------------------------------
+    def fit(
+        self,
+        X,
+        y,
+        *,
+        weights=None,
+        variable_names: Optional[Sequence[str]] = None,
+    ) -> "SymbolicRegressor":
+        X = np.asarray(X)
+        if X.ndim != 2:
+            raise ValueError("X must be (n_samples, n_features)")
+        y = np.asarray(y)
+        yt = y.T if y.ndim == 2 else y
+        self.result_ = equation_search(
+            X.T,
+            yt,
+            weights=weights,
+            variable_names=variable_names,
+            niterations=self.niterations,
+            **self.options,
+        )
+        self.n_features_in_ = X.shape[1]
+        return self
+
+    def _fitted(self) -> EquationSearchResult:
+        if self.result_ is None:
+            raise RuntimeError("SymbolicRegressor is not fitted; call fit()")
+        return self.result_
+
+    # -- inference -----------------------------------------------------
+    @property
+    def equations_(self):
+        return self._fitted().candidates
+
+    @property
+    def best_equation_(self) -> str:
+        return self._fitted().best().equation
+
+    def predict(self, X, output: int = 0, complexity: Optional[int] = None):
+        X = np.asarray(X)
+        if X.ndim != 2 or X.shape[1] != getattr(self, "n_features_in_", X.shape[1]):
+            raise ValueError(
+                f"X must be (n_samples, {getattr(self, 'n_features_in_', '?')})"
+            )
+        return self._fitted().predict(X.T, output=output, complexity=complexity)
+
+    def score(self, X, y, output: int = 0) -> float:
+        """R^2 of the best equation (sklearn regressor convention). For
+        multi-output fits pass the full (n_samples, n_outputs) y and pick
+        the column with `output`."""
+        y = np.asarray(y)
+        if y.ndim == 2:
+            y = y[:, output]
+        y = y.ravel()
+        y_pred = np.asarray(self.predict(X, output=output)).ravel()
+        if y.shape != y_pred.shape:
+            raise ValueError(
+                f"y has {y.shape[0]} samples, predictions have "
+                f"{y_pred.shape[0]}"
+            )
+        ss_res = float(np.sum((y - y_pred) ** 2))
+        ss_tot = float(np.sum((y - np.mean(y)) ** 2))
+        return 1.0 - ss_res / max(ss_tot, 1e-30)
+
+    def sympy(self, output: int = 0, complexity: Optional[int] = None):
+        return self._fitted().sympy(output=output, complexity=complexity)
+
+    def latex(self, output: int = 0, complexity: Optional[int] = None) -> str:
+        return self._fitted().latex(output=output, complexity=complexity)
+
+    def __repr__(self) -> str:
+        if self.result_ is None:
+            opts = ", ".join(f"{k}={v!r}" for k, v in self.get_params().items())
+            return f"SymbolicRegressor({opts})"
+        return repr(self.result_)

@@ -19,7 +19,10 @@ os.environ.setdefault("SYMBOLIC_REGRESSION_TEST", "true")
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+# SRTPU_TPU_TESTS=1 leaves the platform alone so tests/test_tpu_hardware.py
+# can run against the real chip; everything else always runs on CPU.
+if os.environ.get("SRTPU_TPU_TESTS", "") != "1":
+    jax.config.update("jax_platforms", "cpu")
 
 # NOTE: the persistent compilation cache (jax_compilation_cache_dir) is
 # deliberately NOT enabled: on this image `executable.serialize()` segfaults

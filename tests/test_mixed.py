@@ -112,9 +112,13 @@ def test_multi_output_distinct_targets(rng):
     (src/SymbolicRegression.jl:308-315)."""
     X = (rng.standard_normal((2, 60)) * 2).astype(np.float32)
     Y = np.stack([X[0] * X[0], 3.0 * np.cos(X[1])])
+    # 4 islands: a 2-island archipelago can collapse to a cos-family local
+    # optimum on output 0 for many seeds (diversity, not plumbing — this
+    # test is about the per-output HoF); with 4 islands every nearby seed
+    # recovers both outputs exactly
     res = sr.equation_search(
         X, Y, seed=9,
-        niterations=6, npop=33, npopulations=2, ncycles_per_iteration=80,
+        niterations=8, npop=33, npopulations=4, ncycles_per_iteration=80,
         maxsize=10, verbosity=0, progress=False,
         early_stop_condition=1e-6, **OPSET,
     )

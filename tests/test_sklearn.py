@@ -1,0 +1,46 @@
+"""SymbolicRegressor estimator facade: fit/predict/score round trip with
+sklearn (n_samples, n_features) data layout."""
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.sklearn import SymbolicRegressor
+
+TINY = dict(
+    binary_operators=["+", "-", "*"],
+    unary_operators=["cos"],
+    npop=24,
+    npopulations=2,
+    ncycles_per_iteration=40,
+    maxsize=10,
+    verbosity=0,
+    progress=False,
+    runtests=False,
+)
+
+
+@pytest.mark.slow
+def test_fit_predict_score(rng):
+    n = 80
+    Xs = (rng.standard_normal((n, 2)) * 2).astype(np.float32)  # sklearn layout
+    y = Xs[:, 0] * Xs[:, 1]
+    est = SymbolicRegressor(niterations=6, seed=0, **TINY)
+    assert est.get_params()["npop"] == 24
+    est.fit(Xs, y)
+    assert est.n_features_in_ == 2
+    assert len(est.equations_) == 1 and est.best_equation_
+    y_pred = est.predict(Xs)
+    assert y_pred.shape == (n,)
+    r2 = est.score(Xs, y)
+    assert r2 > 0.95, f"R^2 {r2} too low; best {est.best_equation_}"
+
+
+def test_unfitted_and_bad_shapes(rng):
+    est = SymbolicRegressor(niterations=1, **TINY)
+    with pytest.raises(RuntimeError):
+        est.predict(np.zeros((3, 2)))
+    with pytest.raises(ValueError):
+        est.fit(np.zeros(5), np.zeros(5))
+    est.set_params(niterations=3, npop=16)
+    assert est.get_params()["niterations"] == 3
+    assert est.get_params()["npop"] == 16

@@ -1,0 +1,96 @@
+"""Real-TPU validation (skipped unless TPU hardware is reachable).
+
+Run manually with the default env (JAX_PLATFORMS=axon) and the conftest
+CPU pin disabled:
+    SRTPU_TPU_TESTS=1 python -m pytest tests/test_tpu_hardware.py -q -m tpu
+
+These duplicate interpret-mode coverage ON HARDWARE: Mosaic compilation
+can diverge from interpret mode, so the compiled kernel gets its own
+oracle comparison here."""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+
+def _tpu_available():
+    # tests/conftest.py pins jax_platforms=cpu for the main suite; this
+    # module only makes sense in a separate process with the TPU env
+    import jax
+
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+@pytest.fixture(scope="module")
+def tpu_ready():
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu" or not _tpu_available():
+        pytest.skip("no TPU reachable")
+
+
+def test_compiled_kernel_matches_interpreter(tpu_ready):
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.models.mutate_device import (
+        gen_random_tree_fixed_size,
+    )
+    from symbolicregression_jl_tpu.ops.interpreter import eval_trees
+    from symbolicregression_jl_tpu.ops.operators import make_operator_set
+    from symbolicregression_jl_tpu.ops.pallas_eval import eval_trees_pallas
+
+    ops = make_operator_set(["+", "-", "*", "/"], ["cos", "exp", "sqrt", "log"])
+    n, L = 1024, 24
+    sizes = jax.random.randint(jax.random.PRNGKey(1), (n,), 1, 20)
+    trees = jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(k, s, 4, ops, L)
+    )(jax.random.split(jax.random.PRNGKey(0), n), sizes)
+    X = jax.random.normal(jax.random.PRNGKey(2), (4, 1000), jnp.float32) * 2
+
+    y_ref, ok_ref = jax.device_get(eval_trees(trees, X, ops))
+    y, ok = jax.device_get(eval_trees_pallas(trees, X, ops))
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+    m = np.asarray(ok_ref)
+    np.testing.assert_allclose(
+        np.asarray(y)[m], np.asarray(y_ref)[m], rtol=1e-4, atol=1e-4
+    )
+
+
+def test_compiled_kernel_variants_match(tpu_ready):
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.models.mutate_device import (
+        gen_random_tree_fixed_size,
+    )
+    from symbolicregression_jl_tpu.ops.operators import make_operator_set
+    from symbolicregression_jl_tpu.ops.pallas_eval import eval_trees_pallas
+
+    ops = make_operator_set(["+", "-", "*", "/"], ["cos", "exp"])
+    n, L = 512, 24
+    sizes = jax.random.randint(jax.random.PRNGKey(1), (n,), 1, 20)
+    trees = jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(k, s, 3, ops, L)
+    )(jax.random.split(jax.random.PRNGKey(0), n), sizes)
+    X = jax.random.normal(jax.random.PRNGKey(2), (3, 500), jnp.float32)
+
+    y0, ok0 = jax.device_get(
+        eval_trees_pallas(trees, X, ops, dispatch="chain", tree_unroll=1,
+                          sort_trees=False)
+    )
+    for kw in (
+        dict(dispatch="mux", tree_unroll=4, sort_trees=True),
+        dict(dispatch="mux", tree_unroll=8, sort_trees=True),
+    ):
+        y, ok = jax.device_get(eval_trees_pallas(trees, X, ops, **kw))
+        np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok0))
+        m = np.asarray(ok0)
+        np.testing.assert_allclose(
+            np.asarray(y)[m], np.asarray(y0)[m], rtol=1e-5, atol=1e-5,
+            err_msg=str(kw),
+        )
