@@ -347,6 +347,7 @@ def equation_search(
                         ),
                         states.pop.scores[isl], states.pop.losses[isl],
                         states.pop.birth[isl],
+                        mut_counts=states.mut_counts[isl],
                     )
             if options.output_file and is_primary_host():
                 path = options.output_file

@@ -76,6 +76,24 @@ from .trees import TreeBatch
 Array = jax.Array
 
 
+# rows of IslandState.mut_counts: the 9 mutation kinds in MutationWeights
+# order, plus crossover (the reference recorder logs per-event
+# mutate/crossover accept/reject — here the batched engine keeps aggregate
+# counters instead, src/Mutate.jl passim / src/RegularizedEvolution.jl:103-132)
+MUTATION_NAMES = (
+    "mutate_constant",
+    "mutate_operator",
+    "add_node",
+    "insert_node",
+    "delete_node",
+    "simplify",
+    "randomize",
+    "do_nothing",
+    "optimize",
+    "crossover",
+)
+
+
 class IslandState(NamedTuple):
     """Everything one island owns. vmap/shard_map over a leading axis of
     these gives multi-island search."""
@@ -86,6 +104,7 @@ class IslandState(NamedTuple):
     key: Array
     birth_counter: Array  # int32 scalar
     num_evals: Array  # float32 scalar
+    mut_counts: Array  # (len(MUTATION_NAMES), 2) int32: proposed / accepted
 
 
 # ---------------------------------------------------------------------------
@@ -202,11 +221,11 @@ def _mutate_member(
     curmaxsize: Array,
     nfeatures: int,
     options: Options,
-) -> Tuple[TreeBatch, Array, Array]:
+) -> Tuple[TreeBatch, Array, Array, Array]:
     """Sample a mutation kind and apply it with <=10 constraint retries.
-    Returns (tree', was_mutated, always_accept); acceptance happens later
-    (needs score), except always_accept (successful simplify) which skips
-    the annealing gate.
+    Returns (tree', was_mutated, always_accept, kind); acceptance happens
+    later (needs score), except always_accept (successful simplify) which
+    skips the annealing gate.
 
     The retries run as ONE vmapped batch and the first success is taken —
     identical distribution to the reference's sequential retry loop
@@ -231,7 +250,7 @@ def _mutate_member(
     )
     was_mutated = success & (kind != DO_NOTHING) & (kind != OPTIMIZE)
     always_accept = (kind == SIMPLIFY) & success
-    return result, was_mutated, always_accept
+    return result, was_mutated, always_accept, kind
 
 
 def _accept_mutation(
@@ -310,6 +329,7 @@ class _Proposed(NamedTuple):
     was_mutated: Array  # (B,) bool
     always_accept: Array  # (B,) bool
     use_cross: Array  # (B,) bool
+    kind: Array  # (B,) sampled mutation kind (ignored on crossover slots)
     accept_keys: Array  # (B, 2) PRNG keys
     next_key: Array
 
@@ -339,7 +359,7 @@ def _propose_children(
 
     # mutation path
     mkeys = jax.random.split(k_mut, B)
-    mut_trees, was_mutated, always_accept = jax.vmap(
+    mut_trees, was_mutated, always_accept, kinds = jax.vmap(
         lambda k, t, s: _mutate_member(
             k, t, s, temperature, stats.frequencies, curmaxsize, nfeatures,
             options,
@@ -380,6 +400,7 @@ def _propose_children(
         was_mutated=was_mutated,
         always_accept=always_accept,
         use_cross=use_cross,
+        kind=kinds,
         accept_keys=jax.random.split(k_acc, B),
         next_key=key,
     )
@@ -453,6 +474,26 @@ def _integrate_children(
     eval_fraction = (
         options.batch_size / n_rows if options.batching else 1.0
     )
+
+    # aggregate mutation telemetry: proposed/accepted per kind + crossover
+    # (batched analog of the reference recorder's per-event mutation log)
+    n_kinds = len(MUTATION_NAMES)
+    cross_row = n_kinds - 1
+    row = jnp.where(prop.use_cross, cross_row, prop.kind)
+    ones = jnp.ones_like(row)
+    # do_nothing/optimize slots keep the parent BY DESIGN — the reference
+    # logs them as accepted (src/Mutate.jl early returns), so the counter
+    # does too; only annealing-rejected and constraint-failed children
+    # count as not accepted
+    noop = ~prop.use_cross & (
+        (prop.kind == DO_NOTHING) | (prop.kind == OPTIMIZE)
+    )
+    proposed = jnp.zeros((n_kinds,), jnp.int32).at[row].add(ones)
+    accepted = jnp.zeros((n_kinds,), jnp.int32).at[row].add(
+        jnp.where(accept | noop, 1, 0)
+    )
+    new_counts = state.mut_counts + jnp.stack([proposed, accepted], axis=-1)
+
     return IslandState(
         pop=new_pop,
         stats=new_stats,
@@ -460,6 +501,7 @@ def _integrate_children(
         key=prop.next_key,
         birth_counter=state.birth_counter + B,
         num_evals=state.num_evals + B * eval_fraction,
+        mut_counts=new_counts,
     )
 
 
@@ -707,4 +749,5 @@ def init_island_state(
         key=k2,
         birth_counter=jnp.int32(pop.npop),
         num_evals=jnp.float32(pop.npop),
+        mut_counts=jnp.zeros((len(MUTATION_NAMES), 2), jnp.int32),
     )

@@ -85,12 +85,11 @@ class SymbolicRegressor:
         return self._fitted().best().equation
 
     def predict(self, X, output: int = 0, complexity: Optional[int] = None):
+        result = self._fitted()
         X = np.asarray(X)
-        if X.ndim != 2 or X.shape[1] != getattr(self, "n_features_in_", X.shape[1]):
-            raise ValueError(
-                f"X must be (n_samples, {getattr(self, 'n_features_in_', '?')})"
-            )
-        return self._fitted().predict(X.T, output=output, complexity=complexity)
+        if X.ndim != 2 or X.shape[1] != self.n_features_in_:
+            raise ValueError(f"X must be (n_samples, {self.n_features_in_})")
+        return result.predict(X.T, output=output, complexity=complexity)
 
     def score(self, X, y, output: int = 0) -> float:
         """R^2 of the best equation (sklearn regressor convention). For

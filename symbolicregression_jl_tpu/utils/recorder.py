@@ -74,9 +74,13 @@ class Recorder:
         scores,
         losses,
         birth,
+        mut_counts=None,
     ) -> None:
         """Analog of record_population (reference src/Population.jl:156-171),
-        plus snapshot-level lineage (survived / new)."""
+        plus snapshot-level lineage (survived / new) and, when given,
+        cumulative proposed/accepted counters per mutation kind (the
+        batched engine's aggregate stand-in for the reference recorder's
+        per-event mutation log)."""
         key = f"out{output + 1}_pop{island + 1}"
         # one device->host transfer for the whole island, sliced on host
         trees_np = jax.tree_util.tree_map(np.asarray, trees)
@@ -122,10 +126,22 @@ class Recorder:
             )
             cur.add(ref)
         self._prev_hashes[key] = cur
-        self.record.setdefault(key, {})[f"iteration{iteration + 1}"] = {
+        entry: RecordType = {
             "population": members,
             "time": time.time(),
         }
+        if mut_counts is not None:
+            from ..models.evolve import MUTATION_NAMES
+
+            counts = np.asarray(mut_counts)
+            entry["mutation_counts"] = {
+                name: {
+                    "proposed": int(counts[i, 0]),
+                    "accepted": int(counts[i, 1]),
+                }
+                for i, name in enumerate(MUTATION_NAMES)
+            }
+        self.record.setdefault(key, {})[f"iteration{iteration + 1}"] = entry
 
     # -- hall of fame timeline ----------------------------------------------
     def record_hall_of_fame(self, output: int, iteration: int,

@@ -55,6 +55,16 @@ def test_recorder_json_schema(tmp_path):
     assert find_iteration_from_record("out1_pop1", rec) == 2
     assert "out1_hall_of_fame" in rec
     assert rec["num_evals"] > 0
+    # aggregate mutation telemetry: cumulative, accepted <= proposed
+    from symbolicregression_jl_tpu.models.evolve import MUTATION_NAMES
+
+    mc1 = rec["out1_pop1"]["iteration1"]["mutation_counts"]
+    mc2 = rec["out1_pop1"]["iteration2"]["mutation_counts"]
+    assert set(mc1) == set(MUTATION_NAMES)
+    assert sum(v["proposed"] for v in mc1.values()) > 0
+    for name in MUTATION_NAMES:
+        assert 0 <= mc1[name]["accepted"] <= mc1[name]["proposed"]
+        assert mc2[name]["proposed"] >= mc1[name]["proposed"]
 
 
 def test_recursive_merge():
