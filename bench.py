@@ -209,7 +209,56 @@ def _mse_parity(jax, jnp, options, device, n_check, verbose):
     return (max_rel if enough else None), agree_finite
 
 
+def _devices_or_cpu_fallback(verbose):
+    """jax.devices() with a watchdog: the axon TPU tunnel, when unhealthy,
+    HANGS backend init indefinitely (observed for 8+ hours on 2026-07-30)
+    rather than erroring. If init doesn't finish in time, re-exec this
+    script pinned to CPU so the benchmark still records a result.
+
+    Shared by every benchmark entry point (suite.py, feynman.py,
+    kernel_tune.py import it from here)."""
+    import threading
+
+    if os.environ.get("_SRTPU_BENCH_CPU_FALLBACK") != "1":
+        import jax
+
+        box = {}
+
+        def probe():
+            try:
+                box["devices"] = jax.devices()
+            except Exception as e:
+                box["error"] = e
+
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        t.join(240.0)
+        if "devices" in box:
+            return box["devices"]
+        if verbose:
+            why = box.get("error", "backend init timed out")
+            print(
+                f"# TPU backend unavailable ({why}); re-running on CPU",
+                file=sys.stderr,
+            )
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["_SRTPU_BENCH_CPU_FALLBACK"] = "1"
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    import jax
+
+    # NOT redundant with the env var above: this image's sitecustomize
+    # rewrites JAX_PLATFORMS=cpu back to "axon,cpu"; the in-process config
+    # update is the pin that actually sticks (popping the axon pool IP
+    # also disables the tunnel, so this is belt and braces).
+    jax.config.update("jax_platforms", "cpu")
+    return jax.devices()
+
+
 def main(verbose=True):
+    devices = _devices_or_cpu_fallback(verbose)
+
     import jax
     import jax.numpy as jnp
 
@@ -222,7 +271,6 @@ def main(verbose=True):
         loss="L2DistLoss",
     )
 
-    devices = jax.devices()
     main_dev = devices[0]
     platform = main_dev.platform
     n_trees = N_POPULATIONS * NPOP

@@ -66,6 +66,10 @@ CASES = [
 
 
 def main():
+    from bench import _devices_or_cpu_fallback
+
+    _devices_or_cpu_fallback(verbose=True)  # hung-tunnel watchdog
+
     import symbolicregression_jl_tpu as sr
 
     fast = "--fast" in sys.argv

@@ -34,9 +34,12 @@ def main():
     from bench import (
         N_ROWS,
         _build_workload,
+        _devices_or_cpu_fallback,
         _dispatch_overhead_s,
         _feynman_data,
     )
+
+    _devices_or_cpu_fallback(verbose=True)  # hung-tunnel watchdog
     from symbolicregression_jl_tpu.models.options import make_options
     from symbolicregression_jl_tpu.ops.pallas_eval import eval_trees_pallas
 

@@ -206,9 +206,10 @@ def bench_search_iteration():
 
 
 def main():
-    import jax
+    from bench import _devices_or_cpu_fallback
 
-    platform = jax.devices()[0].platform
+    devices = _devices_or_cpu_fallback(verbose=True)  # hung-tunnel watchdog
+    platform = devices[0].platform
     results = []
     for fn in (
         bench_eval_fixed_tree,
