@@ -209,51 +209,294 @@ def _mse_parity(jax, jnp, options, device, n_check, verbose):
     return (max_rel if enough else None), agree_finite
 
 
-def _devices_or_cpu_fallback(verbose):
-    """jax.devices() with a watchdog: the axon TPU tunnel, when unhealthy,
-    HANGS backend init indefinitely (observed for 8+ hours on 2026-07-30)
-    rather than erroring. If init doesn't finish in time, re-exec this
-    script pinned to CPU so the benchmark still records a result.
+# Acquisition diagnostics for the output JSON, filled by
+# _devices_or_cpu_fallback: list of {"sleep_s", "probe_s", "result"} per
+# attempt, plus the final tunnel verdict ("up" / "down").
+ACQUISITION = {"attempts": [], "tunnel_state": "unknown"}
 
-    Shared by every benchmark entry point (suite.py, feynman.py,
-    kernel_tune.py import it from here)."""
+# Sleep before each TPU probe attempt (seconds). Spread over ~10 minutes:
+# the axon tunnel has been observed to recover on that timescale, and a
+# benchmark that permanently pins to CPU after one failed probe throws the
+# round's headline number away.
+def _parse_schedule(raw):
+    try:
+        vals = tuple(
+            max(0, int(x)) for x in raw.split(",") if x.strip()
+        )
+    except ValueError:
+        return (0, 20, 40, 80, 160, 300)
+    return vals or (0,)
+
+
+_PROBE_BACKOFFS = _parse_schedule(
+    os.environ.get("SRTPU_BENCH_PROBE_SCHEDULE", "0,20,40,80,160,300")
+)
+try:
+    _PROBE_TIMEOUT = float(
+        os.environ.get("SRTPU_BENCH_PROBE_TIMEOUT", "75")
+    )
+except ValueError:
+    _PROBE_TIMEOUT = 75.0
+_INIT_TIMEOUT = 240.0  # in-process backend init watchdog
+
+
+def _probe_tpu_subprocess(timeout):
+    """Try `jax.devices()` in a throwaway subprocess (killed on timeout, so
+    a hung tunnel can't poison this process's backend state). Returns the
+    platform string, or None on hang/error."""
+    import subprocess
+
+    import signal
+
+    code = "import jax; print('PLAT=' + jax.devices()[0].platform)"
+    # start_new_session + killpg: the axon plugin may spawn tunnel helper
+    # processes that inherit the pipes; killing only the direct child would
+    # leave communicate() blocked on pipe EOF forever
+    p = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        out, err = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except Exception:
+            p.kill()
+        try:
+            p.communicate(timeout=10)
+        except Exception:  # pragma: no cover
+            pass
+        return None, "hang"
+    if p.returncode != 0:
+        tail = (err or "").strip().splitlines()
+        return None, "error: " + (
+            tail[-1][:120] if tail else f"rc={p.returncode}"
+        )
+    for line in out.splitlines():
+        if line.startswith("PLAT="):
+            return line[len("PLAT="):].strip(), "ok"
+    return None, "no-platform-line"
+
+
+def _init_backend_with_watchdog(timeout):
+    """In-process jax.devices() guarded by a watchdog thread (the tunnel
+    can pass a subprocess probe and still hang a moment later). Returns
+    (devices, None) on success, (None, reason) on error or hang."""
     import threading
 
-    if os.environ.get("_SRTPU_BENCH_CPU_FALLBACK") != "1":
-        import jax
-
-        box = {}
-
-        def probe():
-            try:
-                box["devices"] = jax.devices()
-            except Exception as e:
-                box["error"] = e
-
-        t = threading.Thread(target=probe, daemon=True)
-        t.start()
-        t.join(240.0)
-        if "devices" in box:
-            return box["devices"]
-        if verbose:
-            why = box.get("error", "backend init timed out")
-            print(
-                f"# TPU backend unavailable ({why}); re-running on CPU",
-                file=sys.stderr,
-            )
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        env["_SRTPU_BENCH_CPU_FALLBACK"] = "1"
-        os.execve(sys.executable, [sys.executable] + sys.argv, env)
     import jax
 
-    # NOT redundant with the env var above: this image's sitecustomize
-    # rewrites JAX_PLATFORMS=cpu back to "axon,cpu"; the in-process config
-    # update is the pin that actually sticks (popping the axon pool IP
-    # also disables the tunnel, so this is belt and braces).
-    jax.config.update("jax_platforms", "cpu")
-    return jax.devices()
+    box = {}
+
+    def probe():
+        try:
+            box["devices"] = jax.devices()
+        except Exception as e:  # pragma: no cover
+            box["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout)
+    if "devices" in box:
+        return box["devices"], None
+    if "error" in box:
+        return None, f"init-error: {str(box['error'])[:120]}"
+    return None, "init-hung"
+
+
+_MEMO_PATH = "/tmp/srtpu_tunnel_memo.json"
+_MEMO_TTL = 900.0  # seconds a recorded tunnel verdict stays trustworthy
+
+
+def _write_memo(state):
+    try:
+        with open(_MEMO_PATH, "w") as f:
+            json.dump({"state": state, "t": time.time()}, f)
+    except OSError:  # pragma: no cover
+        pass
+
+
+def _read_memo():
+    try:
+        with open(_MEMO_PATH) as f:
+            memo = json.load(f)
+        if time.time() - float(memo["t"]) < _MEMO_TTL:
+            return memo["state"]
+    except Exception:
+        pass
+    return None
+
+
+def _fallback_to_cpu(verbose):
+    """Re-exec this script pinned to CPU, carrying the diagnostics."""
+    if verbose:
+        print(
+            f"# TPU backend unavailable after "
+            f"{len(ACQUISITION['attempts'])} acquisition attempts; "
+            "re-running on CPU",
+            file=sys.stderr,
+        )
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["_SRTPU_BENCH_CPU_FALLBACK"] = "1"
+    env["_SRTPU_BENCH_ACQ"] = json.dumps(ACQUISITION)
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+def _devices_or_cpu_fallback(verbose, use_memo=False):
+    """Acquire the accelerator with bounded retry/backoff; fall back to CPU
+    only after the full probe schedule fails.
+
+    The axon TPU tunnel, when unhealthy, HANGS backend init indefinitely
+    (observed for 8+ hours on 2026-07-30) rather than erroring. Strategy:
+    try the in-process init once under a watchdog (the healthy-tunnel fast
+    path — no throwaway subprocess); if it hangs, re-exec into a probe
+    loop where every attempt runs `jax.devices()` in a killable subprocess
+    first, and only a successful probe earns another in-process init. On
+    total failure, re-exec pinned to CPU so the benchmark still records a
+    result. Per-attempt diagnostics land in ACQUISITION for the JSON.
+
+    `use_memo=True` (the auxiliary entry points — suite.py, feynman.py,
+    kernel_tune.py) trusts a recent verdict from another process instead
+    of re-running the whole schedule against a dead tunnel. bench.py
+    itself never trusts the memo: the round's official number must fight
+    the full schedule.
+    """
+    # restore diagnostics from a prior exec of this acquisition loop
+    try:
+        ACQUISITION.update(
+            json.loads(os.environ.get("_SRTPU_BENCH_ACQ", "{}"))
+        )
+    except Exception:
+        pass
+
+    if os.environ.get("_SRTPU_BENCH_CPU_FALLBACK") == "1":
+        ACQUISITION["tunnel_state"] = "down"
+        import jax
+
+        # NOT redundant with the env var set before re-exec: this image's
+        # sitecustomize rewrites JAX_PLATFORMS=cpu back to "axon,cpu"; the
+        # in-process config update is the pin that actually sticks (popping
+        # the axon pool IP also disables the tunnel, belt and braces).
+        jax.config.update("jax_platforms", "cpu")
+        return jax.devices()
+
+    resumed = "_SRTPU_BENCH_RESUME_AT" in os.environ
+    start = int(os.environ.get("_SRTPU_BENCH_RESUME_AT", "0"))
+
+    if use_memo and not resumed and _read_memo() == "down":
+        ACQUISITION["attempts"].append(
+            {"sleep_s": 0, "probe_s": 0.0, "result": "memo-down"}
+        )
+        _fallback_to_cpu(verbose)
+
+    if not resumed:
+        # fast path: healthy tunnel inits in well under the watchdog
+        # timeout, and we pay no throwaway probe subprocess
+        t0 = time.perf_counter()
+        devices, init_why = _init_backend_with_watchdog(_INIT_TIMEOUT)
+        rec = {
+            "sleep_s": 0,
+            "probe_s": round(time.perf_counter() - t0, 1),
+            "result": "direct-init-ok" if devices else f"direct-{init_why}",
+        }
+        ACQUISITION["attempts"].append(rec)
+        if devices is not None:
+            if devices[0].platform != "cpu":
+                ACQUISITION["tunnel_state"] = "up"
+                _write_memo("up")
+            else:
+                # no accelerator registered at all — nothing to wait for
+                ACQUISITION["tunnel_state"] = "absent"
+            return devices
+        if init_why == "init-hung":
+            # the hung watchdog thread is stuck inside xla_bridge's
+            # one-shot backend init holding its lock; nothing in this
+            # process can init a backend again — continue in a fresh one
+            env = dict(os.environ)
+            env["_SRTPU_BENCH_ACQ"] = json.dumps(ACQUISITION)
+            env["_SRTPU_BENCH_RESUME_AT"] = "0"
+            os.execve(sys.executable, [sys.executable] + sys.argv, env)
+        # init *error* completed → this process may retry via the loop
+
+    n = len(_PROBE_BACKOFFS)
+    i = start
+    streak_jumped = False
+    while i < n:
+        backoff = _PROBE_BACKOFFS[i]
+        if backoff:
+            time.sleep(backoff)
+        t0 = time.perf_counter()
+        plat, why = _probe_tpu_subprocess(_PROBE_TIMEOUT)
+        rec = {
+            "sleep_s": backoff,
+            "probe_s": round(time.perf_counter() - t0, 1),
+            "result": plat or why,
+        }
+        ACQUISITION["attempts"].append(rec)
+        if plat is not None and plat != "cpu":
+            devices, init_why = _init_backend_with_watchdog(_INIT_TIMEOUT)
+            if devices is not None:
+                ACQUISITION["tunnel_state"] = "up"
+                _write_memo("up")
+                return devices
+            rec["result"] = f"probe-ok-{init_why}"
+            # as in the fast path: a hang poisons this process's backend
+            # init forever; an init error is retryable in-process
+            if init_why == "init-hung" and i + 1 < n:
+                env = dict(os.environ)
+                env["_SRTPU_BENCH_ACQ"] = json.dumps(ACQUISITION)
+                env["_SRTPU_BENCH_RESUME_AT"] = str(i + 1)
+                os.execve(
+                    sys.executable, [sys.executable] + sys.argv, env
+                )
+        elif plat == "cpu":
+            # no accelerator plugged in at all — nothing to wait for; pin
+            # cpu so the in-process init can't race a tunnel that comes
+            # back in its hang state
+            ACQUISITION["tunnel_state"] = "absent"
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+            return jax.devices()
+        # A hang may heal with time. Three identical fast errors in a row
+        # usually won't — but the error text can't distinguish "plugin
+        # broken" from "single tunnel slot busy", so instead of giving up,
+        # jump straight to the final (longest-wait) attempt: one late shot
+        # at recovery without burning the middle of the schedule.
+        tail = [a["result"] for a in ACQUISITION["attempts"][-3:]]
+        if (
+            not streak_jumped
+            and i + 1 < n - 1
+            and len(tail) == 3
+            and len(set(tail)) == 1
+            and tail[0].startswith("error")
+        ):
+            streak_jumped = True
+            if verbose:
+                print(
+                    f"# TPU probe attempt {i + 1}/{n}: {rec['result']} "
+                    f"(3rd identical error); skipping to final attempt "
+                    f"in {_PROBE_BACKOFFS[n - 1]}s",
+                    file=sys.stderr,
+                )
+            i = n - 1
+            continue
+        if verbose and i + 1 < n:
+            print(
+                f"# TPU probe attempt {i + 1}/{n}: {rec['result']}; "
+                f"retrying in {_PROBE_BACKOFFS[i + 1]}s",
+                file=sys.stderr,
+            )
+        i += 1
+
+    _write_memo("down")
+    _fallback_to_cpu(verbose)
 
 
 def main(verbose=True):
@@ -325,6 +568,10 @@ def main(verbose=True):
         else:
             cpu_rate = value
 
+    try:
+        n_cores = len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover
+        n_cores = os.cpu_count()
     print(
         json.dumps(
             {
@@ -337,6 +584,10 @@ def main(verbose=True):
                 "value": round(value, 1),
                 "unit": "trees-rows/sec/chip",
                 "vs_baseline": round(value / cpu_rate, 3),
+                "platform": platform,
+                "tunnel_state": ACQUISITION["tunnel_state"],
+                "attempts": ACQUISITION["attempts"],
+                "anchor_cpu_cores": n_cores,
             }
         )
     )

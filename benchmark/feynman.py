@@ -68,7 +68,7 @@ CASES = [
 def main():
     from bench import _devices_or_cpu_fallback
 
-    _devices_or_cpu_fallback(verbose=True)  # hung-tunnel watchdog
+    _devices_or_cpu_fallback(verbose=True, use_memo=True)  # hung-tunnel watchdog
 
     import symbolicregression_jl_tpu as sr
 

@@ -39,7 +39,7 @@ def main():
         _feynman_data,
     )
 
-    _devices_or_cpu_fallback(verbose=True)  # hung-tunnel watchdog
+    _devices_or_cpu_fallback(verbose=True, use_memo=True)  # hung-tunnel watchdog
     from symbolicregression_jl_tpu.models.options import make_options
     from symbolicregression_jl_tpu.ops.pallas_eval import eval_trees_pallas
 

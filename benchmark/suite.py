@@ -208,7 +208,7 @@ def bench_search_iteration():
 def main():
     from bench import _devices_or_cpu_fallback
 
-    devices = _devices_or_cpu_fallback(verbose=True)  # hung-tunnel watchdog
+    devices = _devices_or_cpu_fallback(verbose=True, use_memo=True)  # hung-tunnel watchdog
     platform = devices[0].platform
     results = []
     for fn in (
