@@ -109,7 +109,7 @@ def main():
             **budget,
         )
         dt = time.time() - t0
-        best = res.best()
+        best = res.best_loss()
         norm_loss = best.loss / max(var, 1e-12)
         ok = norm_loss < 1e-4
         solved += ok

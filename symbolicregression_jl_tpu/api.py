@@ -22,6 +22,7 @@ reference's per-output populations (src/SymbolicRegression.jl:308-315).
 from __future__ import annotations
 
 import dataclasses
+import os
 import sys
 import time
 from typing import Any, Callable, List, Optional, Sequence, Union
@@ -33,16 +34,21 @@ import numpy as np
 from .models.dataset import Dataset, make_dataset, update_baseline_loss
 from .models.evolve import (
     IslandState,
+    expected_optimize_count,
     init_island_state,
     optimize_island_constants,
     s_r_cycle_islands,
     simplify_population_islands,
 )
 from .models.options import Options, make_options
-from .models.population import HallOfFame
+from .models.population import (
+    HallOfFame,
+    init_hall_of_fame,
+    update_hall_of_fame,
+)
 from .models.trees import TreeBatch
 from .ops.interpreter import eval_tree
-from .parallel.distributed import is_primary_host
+from .parallel.distributed import initialize_multihost, is_primary_host
 from .parallel.mesh import make_mesh, shard_dataset, shard_island_states
 from .parallel.migration import merge_hofs_across_islands, migrate
 from .utils.output import Candidate, hof_to_candidates, pareto_table, save_hof_csv
@@ -87,8 +93,16 @@ class EquationSearchResult:
         return self.candidates[output]
 
     def best(self, output: int = 0) -> Candidate:
-        """Highest-score frontier member (reference picks best trade-off via
-        the score column; we return the min-loss among top-score ties)."""
+        """Best trade-off frontier member by the score column
+        -Δlog(loss)/Δcomplexity (the reference's printed selection,
+        src/HallOfFame.jl:136-139); ties broken by lower loss."""
+        front = self.candidates[output]
+        if not front:
+            raise ValueError("Search produced no valid equations")
+        return max(front, key=lambda c: (c.score, -c.loss))
+
+    def best_loss(self, output: int = 0) -> Candidate:
+        """Minimum-loss frontier member (usually the most complex)."""
         front = self.candidates[output]
         if not front:
             raise ValueError("Search produced no valid equations")
@@ -97,11 +111,26 @@ class EquationSearchResult:
     def predict(
         self, X, output: int = 0, complexity: Optional[int] = None
     ):
+        """Evaluate the selected equation on X. Rows where evaluation left
+        the operator domain (the reference's `complete=false` flag from
+        eval_tree_array) surface as a warning — the returned array may
+        contain NaN/Inf there."""
         cand = self._pick(output, complexity)
         X = jnp.asarray(X, self.options.dtype)
         tree = jax.tree_util.tree_map(jnp.asarray, cand.tree)
         y, ok = eval_tree(tree, X, self.options.operators)
-        return np.asarray(y)
+        y = np.asarray(y)
+        if not bool(np.asarray(ok)):
+            import warnings
+
+            warnings.warn(
+                "predict: equation evaluation hit NaN/Inf on this input "
+                "(operator domain violation); output contains non-finite "
+                "values",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+        return y
 
     def sympy(self, output: int = 0, complexity: Optional[int] = None):
         """Best (or complexity-matched) frontier member as a sympy
@@ -142,7 +171,10 @@ import functools
 def _make_iteration_fn(options: Options, has_weights: bool):
     """One jitted function per Options; X/y/weights/baseline are traced
     arguments so multi-output searches (and repeated equation_search calls
-    with equal Options) reuse the compilation."""
+    with equal Options) reuse the compilation.
+
+    With options.recorder the returned function yields a third output:
+    the per-cycle MutationEvents for the lineage recorder."""
 
     def one_iteration(
         states: IslandState,
@@ -153,12 +185,19 @@ def _make_iteration_fn(options: Options, has_weights: bool):
         weights,
         baseline: Array,
     ):
-        k_mig, k_opt = jax.random.split(key)
+        k_mig, k_opt, k_opt_mut = jax.random.split(key, 3)
         # all-island fused forms: one interpreter call per cycle across the
         # whole archipelago (Pallas-sized batches on TPU)
-        states = s_r_cycle_islands(
-            states, curmaxsize, X, y, weights, baseline, options
-        )
+        events = None
+        if options.recorder:
+            states, events = s_r_cycle_islands(
+                states, curmaxsize, X, y, weights, baseline, options,
+                collect_events=True,
+            )
+        else:
+            states = s_r_cycle_islands(
+                states, curmaxsize, X, y, weights, baseline, options
+            )
         states = simplify_population_islands(
             states, curmaxsize, X, y, weights, baseline, options
         )
@@ -170,8 +209,24 @@ def _make_iteration_fn(options: Options, has_weights: bool):
                     k, st, X, y, weights, baseline, options
                 )
             )(okeys, states)
+        # the `optimize` mutation (reference src/Mutate.jl:142-168): one
+        # iteration-level pass sized to the expected number of sampled
+        # optimize slots, instead of BFGS inside the cycle scan
+        n_opt_mut = expected_optimize_count(options)
+        if n_opt_mut > 0:
+            p_sel = min(1.0, n_opt_mut / options.npop)
+            I = states.birth_counter.shape[0]
+            okeys2 = jax.random.split(k_opt_mut, I)
+            states = jax.vmap(
+                lambda k, st: optimize_island_constants(
+                    k, st, X, y, weights, baseline, options,
+                    probability=p_sel, count_optimize_telemetry=True,
+                )
+            )(okeys2, states)
         ghof = merge_hofs_across_islands(states.hof)
         states = migrate(k_mig, states, ghof, options)
+        if options.recorder:
+            return states, ghof, events
         return states, ghof
 
     if has_weights:
@@ -200,6 +255,82 @@ def _make_init_fn(options: Options, nfeatures: int, has_weights: bool):
     )
 
 
+def _saved_state_compatible(
+    state: "SearchState", options: Options, I: int
+) -> Tuple[bool, bool]:
+    """Shape-compatibility of a saved state with the current Options:
+    (populations ok, hall-of-fame ok). The reference recreates any saved
+    population whose npop mismatches, with a warning
+    (src/SymbolicRegression.jl:532-573)."""
+    try:
+        pop = state.island_states.pop
+        ok_pop = (
+            pop.scores.shape[0] == I
+            and pop.scores.shape[1] == options.npop
+            and pop.trees.kind.shape[-1] == options.max_len
+            and state.island_states.hof.losses.shape[-1]
+            == options.actual_maxsize
+        )
+    except Exception:
+        ok_pop = False
+    try:
+        ghof = state.global_hof
+        ok_hof = (
+            ghof.losses.shape[0] == options.actual_maxsize
+            and ghof.trees.kind.shape[-1] == options.max_len
+        )
+    except Exception:
+        ok_hof = False
+    return ok_pop, ok_hof
+
+
+def _seed_hof_islands(
+    states: IslandState, source: HallOfFame, options: Options
+) -> IslandState:
+    """Fold a saved/loaded hall of fame into every island's HoF table
+    (non-existing source slots carry inf loss and never insert)."""
+    seeded = jax.vmap(
+        lambda h: update_hall_of_fame(
+            h, source.trees, source.scores, source.losses, options
+        )
+    )(states.hof)
+    return states._replace(hof=seeded)
+
+
+def _warm_start_hof(
+    path: str, options: Options, variable_names, Xj, yj, wj, baseline
+) -> Optional[HallOfFame]:
+    """Load a hall-of-fame CSV checkpoint and rescore its equations on the
+    current dataset, producing a HoF to seed the search (the analog of
+    load_saved_hall_of_fame, reference src/SearchUtils.jl:275-301)."""
+    import warnings
+
+    from .models.fitness import score_trees
+    from .utils.output import load_hof_csv
+
+    try:
+        cands = load_hof_csv(path, options, variable_names)
+    except (OSError, ValueError) as e:
+        warnings.warn(f"warm start: could not load {path!r}: {e}")
+        return None
+    if not cands:
+        return None
+    trees = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+        *[c.tree for c in cands],
+    )
+    scores, losses = score_trees(trees, Xj, yj, wj, baseline, options)
+    hof = init_hall_of_fame(options, options.dtype)
+    return update_hall_of_fame(hof, trees, scores, losses, options)
+
+
+def _multi_output_path(path: str, output: int) -> str:
+    """Per-output variant of a checkpoint path: base.out{j}.ext (single
+    source for the writer and the warm-start reader)."""
+    root, ext = os.path.splitext(path)
+    return f"{root}.out{output}{ext}"
+
+
 def _curmaxsize(
     options: Options, iteration: int, niterations: int
 ) -> int:
@@ -222,6 +353,7 @@ def equation_search(
     options: Optional[Options] = None,
     niterations: int = 10,
     saved_state: Optional[List[SearchState]] = None,
+    warm_start_file: Optional[str] = None,
     return_state: bool = False,
     runtests: bool = True,
     on_iteration: Optional[Callable] = None,
@@ -234,7 +366,9 @@ def equation_search(
     binary_operators=..., npop=..., niterations is a search kwarg like the
     reference's). Returns the per-complexity hall of fame; with
     return_state=True the result carries resumable state (the analog of the
-    reference's saved_state round-trip)."""
+    reference's saved_state round-trip). warm_start_file seeds the search
+    from a hall-of-fame CSV checkpoint (multi-output runs look for the
+    .out{j} variants, mirroring how output_file writes them)."""
     if options is None:
         options = make_options(**option_kwargs)
     elif option_kwargs:
@@ -262,11 +396,19 @@ def equation_search(
         )
     nfeatures = X.shape[0]
 
+    # multi-host bring-up (no-op on a single host): the analog of the
+    # reference's addprocs/worker-setup block
+    # (src/SymbolicRegression.jl:500-528) — every host runs this same
+    # program, so there is nothing to ship, only the runtime to join.
+    # MUST run before preflight: jax.distributed.initialize refuses to run
+    # once any backend has executed a computation.
+    initialize_multihost()
+
     if runtests:
         preflight_checks(options, X, ys, weights, pipeline=True)
 
     I = options.npopulations
-    mesh = make_mesh(options, I)
+    mesh = make_mesh(options, I, row_shards=options.row_shards)
     t_start = time.time()
     early_stop = options.early_stop_fn()
     iteration_fn = _make_iteration_fn(options, weights is not None)
@@ -300,19 +442,55 @@ def equation_search(
         Xj, yj, wj = shard_dataset(ds.X, ds.y, ds.weights, mesh, options)
 
         master_key = jax.random.PRNGKey(options.seed + 7919 * j)
-        if saved_state is not None:
-            state = saved_state[j]
-            states, ghof = state.island_states, state.global_hof
-            start_iter = state.iteration
-        else:
-            k_init, master_key = jax.random.split(master_key)
+
+        def _fresh_init(key):
+            k_init, key = jax.random.split(key)
             init_keys = jax.random.split(k_init, I)
             init_fn = _make_init_fn(options, nfeatures, wj is not None)
             bl = jnp.asarray(ds.baseline_loss, options.dtype)
             if wj is not None:
-                states = init_fn(init_keys, Xj, yj, wj, bl)
+                sts = init_fn(init_keys, Xj, yj, wj, bl)
             else:
-                states = init_fn(init_keys, Xj, yj, bl)
+                sts = init_fn(init_keys, Xj, yj, bl)
+            return sts, key
+
+        if saved_state is not None:
+            state = saved_state[j]
+            ok_pop, ok_hof = _saved_state_compatible(state, options, I)
+            if ok_pop:
+                states, ghof = state.island_states, state.global_hof
+            else:
+                # the reference recreates mismatched populations with a
+                # warning (src/SymbolicRegression.jl:532-573); the saved
+                # hall of fame survives when its shapes still fit
+                import warnings
+
+                warnings.warn(
+                    "saved_state is incompatible with these Options "
+                    "(npopulations/npop/maxsize changed); recreating "
+                    "populations"
+                    + (" but keeping the saved hall of fame" if ok_hof
+                       else " and the hall of fame")
+                )
+                states, master_key = _fresh_init(master_key)
+                if ok_hof:
+                    states = _seed_hof_islands(
+                        states, state.global_hof, options
+                    )
+                ghof = merge_hofs_across_islands(states.hof)
+            start_iter = state.iteration
+        else:
+            states, master_key = _fresh_init(master_key)
+            if warm_start_file is not None:
+                path = warm_start_file
+                if multi:
+                    path = _multi_output_path(path, j)
+                bl = jnp.asarray(ds.baseline_loss, options.dtype)
+                warm = _warm_start_hof(
+                    path, options, variable_names, Xj, yj, wj, bl
+                )
+                if warm is not None:
+                    states = _seed_hof_islands(states, warm, options)
             ghof = merge_hofs_across_islands(states.hof)
             start_iter = 0
         states = shard_island_states(states, mesh, options)
@@ -325,11 +503,13 @@ def equation_search(
             baseline = jnp.asarray(ds.baseline_loss, options.dtype)
             t_dev = time.time()
             if wj is not None:
-                states, ghof = iteration_fn(
-                    states, k_it, cm, Xj, yj, wj, baseline
-                )
+                out = iteration_fn(states, k_it, cm, Xj, yj, wj, baseline)
             else:
-                states, ghof = iteration_fn(states, k_it, cm, Xj, yj, baseline)
+                out = iteration_fn(states, k_it, cm, Xj, yj, baseline)
+            if options.recorder:
+                states, ghof, events = out
+            else:
+                (states, ghof), events = out, None
             jax.block_until_ready(ghof.losses)
             t_host = time.time()
 
@@ -339,6 +519,8 @@ def equation_search(
             cands = hof_to_candidates(ghof, options, variable_names)
             if recorder is not None:
                 recorder.record_hall_of_fame(j, it, cands)
+                if events is not None:
+                    recorder.record_mutation_events(j, it, events)
                 for isl in range(I):
                     recorder.record_population(
                         j, isl, it,
@@ -352,8 +534,7 @@ def equation_search(
             if options.output_file and is_primary_host():
                 path = options.output_file
                 if multi:
-                    base, dot, ext = path.partition(".")
-                    path = f"{base}.out{j}{dot}{ext}" if dot else f"{path}.out{j}"
+                    path = _multi_output_path(path, j)
                 save_hof_csv(cands, path)
             if options.verbosity > 0 and is_primary_host():
                 best_loss = min((c.loss for c in cands), default=float("inf"))

@@ -251,10 +251,15 @@ def optimize_constants_population(
     weights: Optional[Array],
     baseline: float,
     options: Options,
-) -> Tuple[Population, Array]:
-    """Select members w.p. optimizer_probability, fit their constants, write
-    back where improved (reference src/SingleIteration.jl:75-79 +
-    src/ConstantOptimization.jl:22-65). Returns (population', n_extra_evals).
+    probability: Optional[float] = None,
+) -> Tuple[Population, Array, Array]:
+    """Select members w.p. optimizer_probability (or `probability` when
+    given — the `optimize` mutation pass uses its own rate), fit their
+    constants, write back where improved (reference
+    src/SingleIteration.jl:75-79 + src/ConstantOptimization.jl:22-65).
+    Returns (population', n_extra_evals, n_attempted) — n_attempted is
+    the number of constant-bearing members actually optimized (bounds
+    the telemetry's accepted count).
     """
     npop = pop.npop
     L = pop.trees.max_len
@@ -262,10 +267,12 @@ def optimize_constants_population(
     n_starts = 1 + n_restarts
     k_sel, k_perturb = jax.random.split(key)
 
+    if probability is None:
+        probability = options.optimizer_probability
     # Fixed-size random subset K ~= npop * p (static shape; the reference's
     # per-member Bernoulli draw has the same mean). Members without
     # constants are deprioritized and later masked out.
-    K = max(1, int(round(npop * options.optimizer_probability)))
+    K = max(1, int(round(npop * probability)))
     idx = jnp.arange(L)
     has_consts = jnp.sum(
         (pop.trees.kind == CONST) & (idx < pop.trees.length[:, None]), axis=-1
@@ -324,8 +331,9 @@ def optimize_constants_population(
 
     new_cval = pop.trees.cval.at[sel_idx].set(new_sub_cval)
     new_trees = pop.trees._replace(cval=new_cval)
+    n_attempted = jnp.sum(eligible.astype(jnp.int32))
     n_evals = (
-        jnp.sum(eligible.astype(jnp.float32))
+        n_attempted.astype(jnp.float32)
         * n_starts
         * evals_per_member(L, options.optimizer_iterations)
     )
@@ -337,4 +345,5 @@ def optimize_constants_population(
             birth=pop.birth,
         ),
         n_evals,
+        n_attempted,
     )

@@ -319,6 +319,27 @@ def _crossover_pair(
 # ---------------------------------------------------------------------------
 
 
+class MutationEvents(NamedTuple):
+    """Per-cycle device-side event record for the full-lineage recorder
+    (the batched analog of the reference's per-event mutation log,
+    src/Mutate.jl:207-281 accept/reject + src/RegularizedEvolution.jl:103-132).
+    Host-side draining computes refs (tree_hash) and strings. Reason codes:
+    0=accepted, 1=rejected (annealing/frequency gate), 2=constraint-failed
+    (no valid mutation found, parent kept), 3=no-op slot (do_nothing /
+    optimize placeholder)."""
+
+    parent: TreeBatch  # (B, ...)
+    child: TreeBatch  # (B, ...) the proposed child (pre-acceptance)
+    kind: Array  # (B,) mutation kind; crossover = len(MUTATION_NAMES)-1
+    accepted: Array  # (B,) bool
+    reason: Array  # (B,) int32
+    score: Array  # (B,) child score
+    loss: Array  # (B,) child loss
+
+
+REASON_NAMES = ("accept", "reject", "constraint_failed", "noop")
+
+
 class _Proposed(NamedTuple):
     """Per-island child proposals awaiting scoring."""
 
@@ -414,7 +435,8 @@ def _integrate_children(
     temperature: Array,
     n_rows: int,
     options: Options,
-) -> IslandState:
+    collect_events: bool = False,
+):
     """Acceptance + replace-oldest + statistics for one island
     (the post-scoring half of reference src/RegularizedEvolution.jl)."""
     pop, stats = state.pop, state.stats
@@ -481,20 +503,24 @@ def _integrate_children(
     cross_row = n_kinds - 1
     row = jnp.where(prop.use_cross, cross_row, prop.kind)
     ones = jnp.ones_like(row)
-    # do_nothing/optimize slots keep the parent BY DESIGN — the reference
-    # logs them as accepted (src/Mutate.jl early returns), so the counter
-    # does too; only annealing-rejected and constraint-failed children
-    # count as not accepted
-    noop = ~prop.use_cross & (
-        (prop.kind == DO_NOTHING) | (prop.kind == OPTIMIZE)
+    # do_nothing slots keep the parent BY DESIGN — the reference logs them
+    # as accepted (src/Mutate.jl early returns), so the counter does too.
+    # OPTIMIZE slots are placeholders here (the actual optimization is the
+    # iteration-level optimize_mutation pass, which records attempted/
+    # improved in the OPTIMIZE row itself — optimize_island_constants), so
+    # they are excluded from the counters entirely: accepted <= proposed
+    # stays deterministic.
+    noop = ~prop.use_cross & (prop.kind == DO_NOTHING)
+    is_opt_slot = ~prop.use_cross & (prop.kind == OPTIMIZE)
+    proposed = jnp.zeros((n_kinds,), jnp.int32).at[row].add(
+        jnp.where(is_opt_slot, 0, 1)
     )
-    proposed = jnp.zeros((n_kinds,), jnp.int32).at[row].add(ones)
     accepted = jnp.zeros((n_kinds,), jnp.int32).at[row].add(
-        jnp.where(accept | noop, 1, 0)
+        jnp.where((accept | noop) & ~is_opt_slot, 1, 0)
     )
     new_counts = state.mut_counts + jnp.stack([proposed, accepted], axis=-1)
 
-    return IslandState(
+    new_state = IslandState(
         pop=new_pop,
         stats=new_stats,
         hof=new_hof,
@@ -503,6 +529,29 @@ def _integrate_children(
         num_evals=state.num_evals + B * eval_fraction,
         mut_counts=new_counts,
     )
+    if not collect_events:
+        return new_state
+    mutated_or_cross = prop.was_mutated | prop.use_cross | prop.always_accept
+    # no-op for event purposes includes the OPTIMIZE placeholder slots
+    reason = jnp.where(
+        accept,
+        0,
+        jnp.where(
+            mutated_or_cross,
+            1,
+            jnp.where(noop | is_opt_slot, 3, 2),
+        ),
+    ).astype(jnp.int32)
+    events = MutationEvents(
+        parent=prop.parents,
+        child=prop.children,
+        kind=row.astype(jnp.int32),
+        accepted=accept,
+        reason=reason,
+        score=child_scores,
+        loss=child_losses,
+    )
+    return new_state, events
 
 
 def reg_evol_cycle(
@@ -557,7 +606,8 @@ def reg_evol_cycle_islands(
     baseline: float,
     options: Options,
     row_idx: Optional[Array] = None,
-) -> IslandState:
+    collect_events: bool = False,
+):
     nfeatures = X.shape[0]
     I = states.birth_counter.shape[0]
     props = jax.vmap(
@@ -572,7 +622,8 @@ def reg_evol_cycle_islands(
     B = props.parent_scores.shape[1]
     return jax.vmap(
         lambda st, pr, cs, cl: _integrate_children(
-            st, pr, cs, cl, temperature, X.shape[1], options
+            st, pr, cs, cl, temperature, X.shape[1], options,
+            collect_events=collect_events,
         )
     )(states, props, s.reshape(I, B), l.reshape(I, B))
 
@@ -591,10 +642,14 @@ def s_r_cycle_islands(
     baseline: float,
     options: Options,
     ncycles: Optional[int] = None,
-) -> IslandState:
+    collect_events: bool = False,
+):
     """ncycles fused evolution cycles over the annealing temperature
     schedule LinRange(1, 0) (reference src/SingleIteration.jl:17-61), all
     islands advancing together with one scoring call per cycle.
+
+    With collect_events=True (recorder mode) additionally returns
+    MutationEvents stacked (ncycles, I, B, ...) for host-side draining.
 
     Batching note: the reference draws an independent minibatch per
     score_func_batch call (per island); here one minibatch per cycle is
@@ -616,15 +671,22 @@ def s_r_cycle_islands(
             row_idx = sample_batch_idx(kb, n_rows, options.batch_size)
         else:
             row_idx = None
-        sts = reg_evol_cycle_islands(
+        out = reg_evol_cycle_islands(
             sts, temperature, curmaxsize, X, y, weights, baseline, options,
-            row_idx,
+            row_idx, collect_events=collect_events,
         )
-        return (sts, key), None
+        if collect_events:
+            sts, events = out
+        else:
+            sts, events = out, None
+        return (sts, key), events
 
     batch_key = jax.random.fold_in(states.key[0], 0x5F3759DF)
-    (states, _), _ = jax.lax.scan(step, (states, batch_key), temperatures)
-    return states._replace(stats=jax.vmap(move_window)(states.stats))
+    (states, _), events = jax.lax.scan(step, (states, batch_key), temperatures)
+    states = states._replace(stats=jax.vmap(move_window)(states.stats))
+    if collect_events:
+        return states, events
+    return states
 
 
 def s_r_cycle(
@@ -707,20 +769,58 @@ def optimize_island_constants(
     weights: Optional[Array],
     baseline: float,
     options: Options,
+    probability: Optional[float] = None,
+    count_optimize_telemetry: bool = False,
 ) -> IslandState:
     """Constant-optimize one island's population and fold the improved
     members into its hall of fame (the constant-opt leg of the reference's
     optimize_and_simplify_population, src/SingleIteration.jl:63-127).
     Single source for both the production iteration (api.py) and
-    engine-level tests."""
-    pop2, n_evals = optimize_constants_population(
-        key, state.pop, X, y, weights, baseline, options
+    engine-level tests.
+
+    With count_optimize_telemetry=True (the mutation_weights.optimize pass)
+    the attempted/improved counts land in the OPTIMIZE row of mut_counts
+    (the cycle switch's OPTIMIZE placeholder slots are excluded from the
+    counters so accepted <= proposed holds deterministically)."""
+    pop2, n_evals, n_attempted = optimize_constants_population(
+        key, state.pop, X, y, weights, baseline, options, probability
     )
     hof2 = update_hall_of_fame(
         state.hof, pop2.trees, pop2.scores, pop2.losses, options
     )
+    counts = state.mut_counts
+    if count_optimize_telemetry:
+        n_improved = jnp.sum(pop2.losses < state.pop.losses).astype(jnp.int32)
+        counts = counts.at[OPTIMIZE, 0].add(n_attempted)
+        counts = counts.at[OPTIMIZE, 1].add(n_improved)
     return state._replace(
-        pop=pop2, hof=hof2, num_evals=state.num_evals + n_evals
+        pop=pop2, hof=hof2, num_evals=state.num_evals + n_evals,
+        mut_counts=counts,
+    )
+
+
+def expected_optimize_count(options: Options) -> float:
+    """Expected `optimize` mutation events per island per iteration.
+
+    The reference runs constant optimization inline whenever the mutation
+    switch samples :optimize (src/Mutate.jl:142-168). The batched engine
+    instead sizes ONE iteration-level optimization pass to the same
+    expected event count: cycles x mutation slots x P(kind == optimize).
+    The kind probability uses the unadjusted weights (per-member weight
+    adjustment only redistributes mass between the other kinds in edge
+    cases), and crossover slots don't sample a kind."""
+    w = options.mutation_weights.as_tuple()
+    total = sum(w)
+    if total <= 0 or w[OPTIMIZE] <= 0:
+        return 0.0
+    B = options.n_parallel_tournaments
+    B += B % 2
+    p_kind = w[OPTIMIZE] / total
+    return (
+        options.ncycles_per_iteration
+        * B
+        * (1.0 - options.crossover_probability)
+        * p_kind
     )
 
 

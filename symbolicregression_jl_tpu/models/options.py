@@ -179,6 +179,11 @@ class Options:
     # --- TPU-native knobs (no reference analog; replace Distributed.jl) ---
     n_parallel_tournaments: int = 0  # 0 => npop // tournament_selection_n
     eval_backend: str = "auto"  # "jnp" | "pallas" | "auto"
+    # Dataset-row sharding width of the device mesh: with row_shards=r the
+    # mesh is (n_devices//r, r) (islands x rows) and X/y shard their row
+    # dim, loss reductions becoming cross-chip psums (the mesh analog of
+    # the reference's big-dataset batching advice, src/Configure.jl:63-70).
+    row_shards: int = 1
     # Working dtype for X/y/constants/losses (the reference's Float16/32/64
     # type parameter T). "float64" flips on jax_enable_x64 at search start;
     # "bfloat16" is the TPU-native half precision (the Pallas kernel itself
@@ -222,6 +227,8 @@ class Options:
             )
         if not 0 < self.tournament_selection_p <= 1:
             raise ValueError("tournament_selection_p must be in (0, 1]")
+        if self.row_shards < 1:
+            raise ValueError("row_shards must be >= 1")
         if self.tournament_selection_n > self.npop:
             raise ValueError("tournament_selection_n must be <= npop")
         # build and cache derived structures
@@ -306,9 +313,11 @@ class Options:
             self.hof_migration, self.fraction_replaced,
             self.fraction_replaced_hof, self.should_optimize_constants,
             self.optimizer_probability, self.optimizer_nrestarts,
-            self.optimizer_iterations,
+            self.optimizer_iterations, self.optimizer_algorithm,
             str(self.loss) if not callable(self.loss) else id(self.loss),
             None if self.loss_function is None else id(self.loss_function),
+            # recorder mode adds the event-collection outputs to the graph
+            self.recorder,
         )
 
     def __hash__(self):

@@ -41,6 +41,7 @@ def make_mesh(
     n_dev = len(devices)
     if n_dev <= 1:
         return None
+    row_shards = max(1, min(row_shards, n_dev))
     island_shards = n_dev // row_shards
     while island_shards > 1 and n_islands % island_shards != 0:
         island_shards -= 1
@@ -66,11 +67,27 @@ def data_sharding(mesh: Optional[Mesh], options: Options, rows_dim: int = 1):
     return NamedSharding(mesh, P(*spec))
 
 
+def put_global(x, sharding):
+    """Place an array with `sharding`, working under multi-process SPMD.
+
+    Single process: plain device_put. Multi-process: every process holds
+    the same host value (the program is deterministic and identical on all
+    hosts — the reason nothing needs shipping, see distributed.py), so
+    each process contributes its addressable shards via
+    make_array_from_callback."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    x_np = np.asarray(x)
+    return jax.make_array_from_callback(
+        x_np.shape, sharding, lambda idx: x_np[idx]
+    )
+
+
 def shard_island_states(states, mesh: Optional[Mesh], options: Options):
     if mesh is None:
         return states
     sh = island_sharding(mesh, options)
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), states)
+    return jax.tree_util.tree_map(lambda x: put_global(x, sh), states)
 
 
 def shard_dataset(X, y, weights, mesh: Optional[Mesh], options: Options):
@@ -78,8 +95,8 @@ def shard_dataset(X, y, weights, mesh: Optional[Mesh], options: Options):
         return X, y, weights
     xsh = data_sharding(mesh, options, rows_dim=1)
     vsh = NamedSharding(mesh, P(options.row_axis))
-    X = jax.device_put(X, xsh)
-    y = jax.device_put(y, vsh)
+    X = put_global(X, xsh)
+    y = put_global(y, vsh)
     if weights is not None:
-        weights = jax.device_put(weights, vsh)
+        weights = put_global(weights, vsh)
     return X, y, weights

@@ -17,6 +17,19 @@ import numpy as np
 from .api import EquationSearchResult, equation_search
 
 
+def _valid_option_keys() -> set:
+    """Every kwarg make_options accepts: Options fields, deprecated
+    camelCase aliases, and the turbo mapping."""
+    import dataclasses
+
+    from .models.options import _DEPRECATED_KWARGS, Options
+
+    keys = {f.name for f in dataclasses.fields(Options)}
+    keys.update(_DEPRECATED_KWARGS)
+    keys.add("turbo")
+    return keys
+
+
 class SymbolicRegressor:
     """Evolutionary symbolic regression estimator.
 
@@ -38,9 +51,24 @@ class SymbolicRegressor:
 
     # -- sklearn estimator protocol ------------------------------------
     def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        # no nested estimators, so `deep` changes nothing (sklearn's deep
+        # expansion only applies to sub-estimator params)
         return {"niterations": self.niterations, **self.options}
 
     def set_params(self, **params: Any) -> "SymbolicRegressor":
+        """Set estimator parameters, raising on unknown names (the sklearn
+        contract GridSearchCV/clone rely on — silent absorption would hide
+        typos until fit, or forever)."""
+        valid = _valid_option_keys()
+        unknown = [
+            k for k in params if k != "niterations" and k not in valid
+        ]
+        if unknown:
+            raise ValueError(
+                f"Invalid parameter(s) {sorted(unknown)} for "
+                "SymbolicRegressor; valid parameters are 'niterations' "
+                "plus make_options kwargs"
+            )
         self.niterations = params.pop("niterations", self.niterations)
         self.options.update(params)
         return self
@@ -107,7 +135,10 @@ class SymbolicRegressor:
             )
         ss_res = float(np.sum((y - y_pred) ** 2))
         ss_tot = float(np.sum((y - np.mean(y)) ** 2))
-        return 1.0 - ss_res / max(ss_tot, 1e-30)
+        if ss_tot == 0.0:
+            # constant target: sklearn's r2_score convention
+            return 1.0 if ss_res == 0.0 else 0.0
+        return 1.0 - ss_res / ss_tot
 
     def sympy(self, output: int = 0, complexity: Optional[int] = None):
         return self._fitted().sympy(output=output, complexity=complexity)

@@ -143,6 +143,77 @@ class Recorder:
             }
         self.record.setdefault(key, {})[f"iteration{iteration + 1}"] = entry
 
+    # -- full mutation lineage -----------------------------------------------
+    def record_mutation_events(self, output: int, iteration: int,
+                               events) -> None:
+        """Drain one iteration's device-side MutationEvents ring into the
+        reference recorder's `mutations` schema: every proposed child keyed
+        by content-hash ref with tree/score/loss/parent and an event list
+        carrying mutation kind + accept/reject reason
+        (reference src/Recorder.jl:6-22, schema asserted by
+        test/test_recorder.jl:24-46)."""
+        from .. import native
+        from ..models.evolve import MUTATION_NAMES, REASON_NAMES
+        from ..models.trees import tree_hash
+
+        ev = jax.tree_util.tree_map(np.asarray, events)
+        # (ncycles, I, B, ...) -> flat N
+        ncycles, I, B = ev.kind.shape
+        flat = jax.tree_util.tree_map(
+            lambda x: x.reshape((-1,) + x.shape[3:]), ev
+        )
+        n = flat.kind.shape[0]
+        child_refs = [f"{int(h):016x}" for h in tree_hash(flat.child)]
+        parent_refs = [f"{int(h):016x}" for h in tree_hash(flat.parent)]
+
+        eqs = None
+        if native.native_available():
+            eqs = native.trees_to_strings(
+                flat.child.kind, flat.child.op, flat.child.feat,
+                flat.child.cval, flat.child.length,
+                self.options.operators, self.variable_names,
+            )
+
+        mutations: RecordType = self.record.setdefault("mutations", {})
+        cross_row = len(MUTATION_NAMES) - 1
+        for e in range(n):
+            ref = child_refs[e]
+            entry = mutations.get(ref)
+            if entry is None:
+                if eqs is not None:
+                    eq = eqs[e]
+                else:
+                    eq = expr_to_string(
+                        decode_tree(
+                            jax.tree_util.tree_map(
+                                lambda x: x[e], flat.child
+                            )
+                        ),
+                        self.options.operators, self.variable_names,
+                    )
+                entry = mutations[ref] = {
+                    "tree": eq,
+                    "score": float(flat.score[e]),
+                    "loss": float(flat.loss[e]),
+                    "parent": parent_refs[e],
+                    "events": [],
+                }
+            kind = int(flat.kind[e])
+            cycle = e // (I * B)
+            island = (e // B) % I
+            entry["events"].append(
+                {
+                    "type": "crossover" if kind == cross_row else "mutate",
+                    "mutation": MUTATION_NAMES[kind],
+                    "accepted": bool(flat.accepted[e]),
+                    "reason": REASON_NAMES[int(flat.reason[e])],
+                    "output": output + 1,
+                    "island": island + 1,
+                    "iteration": iteration + 1,
+                    "cycle": cycle + 1,
+                }
+            )
+
     # -- hall of fame timeline ----------------------------------------------
     def record_hall_of_fame(self, output: int, iteration: int,
                             candidates) -> None:

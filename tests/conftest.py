@@ -46,10 +46,13 @@ def rng():
 # of a cold full-suite run. Dropping every compiled executable between test
 # modules keeps the native state small; recompiles across modules are cheap
 # because tests within a module share Options (and therefore programs).
+# Module-scoped so the guard is evaluated once per module per worker —
+# correct under pytest-xdist (each worker has its own process and its own
+# _last_module cell) and under randomized intra-module test order.
 _last_module = [None]
 
 
-@pytest.fixture(autouse=True)
+@pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules(request):
     mod = request.module.__name__
     if _last_module[0] is not None and _last_module[0] != mod:

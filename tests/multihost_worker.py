@@ -1,0 +1,58 @@
+"""Worker process for the 2-process multi-host test (the analog of the
+reference's in-process addprocs(2) distributed tests — here each "host" is
+a real separate process joined through jax.distributed, 4 virtual CPU
+devices each, global mesh of 8).
+
+Usage: python multihost_worker.py <process_id> <coordinator_port>
+Prints MULTIHOST_OK <best_loss> on success.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["SYMBOLIC_REGRESSION_TEST"] = "true"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+process_id = int(sys.argv[1])
+port = int(sys.argv[2])
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{port}",
+    num_processes=2,
+    process_id=process_id,
+)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.local_devices()) == 4
+assert len(jax.devices()) == 8
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import symbolicregression_jl_tpu as sr
+
+rng = np.random.default_rng(0)
+X = (rng.standard_normal((3, 64)) * 2).astype(np.float32)
+y = X[0] * X[0] + 2.0 * np.cos(X[2])
+
+res = sr.equation_search(
+    X, y,
+    niterations=2,
+    binary_operators=["+", "-", "*"],
+    unary_operators=["cos"],
+    npop=16,
+    npopulations=8,
+    ncycles_per_iteration=10,
+    maxsize=10,
+    should_optimize_constants=False,
+    row_shards=2,
+    verbosity=0,
+    progress=False,
+    runtests=False,
+    seed=0,
+)
+best = min(c.loss for c in res.frontier())
+assert np.isfinite(best)
+print(f"MULTIHOST_OK {best:.6f}", flush=True)

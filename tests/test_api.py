@@ -45,7 +45,7 @@ def test_recovery_and_predict(rng):
         npop=48, npopulations=4, ncycles_per_iteration=150, maxsize=14,
         verbosity=0, progress=False, early_stop_condition=1e-5, seed=2,
     )
-    best = res.best()
+    best = res.best_loss()
     assert best.loss < 1e-2
     pred = res.predict(X)
     np.testing.assert_allclose(pred, y, atol=0.3)
@@ -74,11 +74,11 @@ def test_resume_state(rng):
         X, y, niterations=2, return_state=True, seed=1, **TINY
     )
     assert res1.state is not None
-    best1 = res1.best().loss
+    best1 = res1.best_loss().loss
     res2 = sr.equation_search(
         X, y, niterations=2, saved_state=res1.state, seed=1, **TINY
     )
-    assert res2.best().loss <= best1 + 1e-9
+    assert res2.best_loss().loss <= best1 + 1e-9
     assert res2.state is None  # only returned when asked
 
 
@@ -170,3 +170,90 @@ def test_preflight_rejects_nonfinite(rng):
     Xbad[0, 0] = np.nan
     with pytest.raises(ValueError):
         sr.equation_search(Xbad, y, niterations=1, **TINY)
+
+
+def test_resume_mismatched_options_recreates(rng):
+    """A saved_state whose npop no longer matches Options is recreated with
+    a warning, keeping the saved hall of fame (analog of reference
+    src/SymbolicRegression.jl:532-573)."""
+    import warnings
+
+    X, y = make_data(rng)
+    res1 = sr.equation_search(
+        X, y, niterations=1, return_state=True, seed=1, **TINY
+    )
+    hof_best = min(c.loss for c in res1.frontier())
+    smaller = dict(TINY)
+    smaller["npop"] = 16
+    with pytest.warns(UserWarning, match="recreating"):
+        res2 = sr.equation_search(
+            X, y, niterations=1, saved_state=res1.state, seed=1, **smaller
+        )
+    assert len(res2.frontier()) > 0
+    # the saved hall of fame survived the population recreation
+    assert min(c.loss for c in res2.frontier()) <= hof_best + 1e-6
+
+
+def test_warm_start_from_csv(rng, tmp_path):
+    """warm_start_file seeds the search from a hall-of-fame CSV (analog of
+    load_saved_hall_of_fame, reference src/SearchUtils.jl:275-301)."""
+    X, y = make_data(rng)
+    path = str(tmp_path / "hof.csv")
+    opts = dict(TINY)
+    opts["output_file"] = path
+    res1 = sr.equation_search(X, y, niterations=2, seed=1, **opts)
+    best1 = min(c.loss for c in res1.frontier())
+    res2 = sr.equation_search(
+        X, y, niterations=1, warm_start_file=path, seed=99, **TINY
+    )
+    # the reloaded + rescored equations keep the search at least as good
+    assert min(c.loss for c in res2.frontier()) <= best1 + 1e-5
+
+
+def test_best_picks_score_column():
+    """best() selects by the -dlog(loss)/dcomplexity score column like the
+    reference's printed table (src/HallOfFame.jl:136-139); best_loss()
+    keeps the min-loss pick."""
+    from symbolicregression_jl_tpu.api import EquationSearchResult
+    from symbolicregression_jl_tpu.utils.output import Candidate
+
+    cands = [
+        Candidate(complexity=1, loss=1.0, score=0.0, equation="a", tree=None),
+        Candidate(complexity=3, loss=0.01, score=2.30, equation="b", tree=None),
+        Candidate(complexity=9, loss=0.008, score=0.037, equation="c", tree=None),
+    ]
+    res = EquationSearchResult(
+        candidates=[cands], options=None, variable_names=None
+    )
+    assert res.best().equation == "b"  # biggest log-loss drop per size
+    assert res.best_loss().equation == "c"  # global min loss
+
+
+def test_predict_warns_on_domain_violation(rng):
+    """predict surfaces the eval ok=false flag (NaN/Inf domain) as a
+    warning instead of silently returning non-finite values."""
+    import warnings
+
+    from symbolicregression_jl_tpu.api import EquationSearchResult
+    from symbolicregression_jl_tpu.models.trees import encode_tree, parse_expression
+    from symbolicregression_jl_tpu.utils.output import Candidate
+
+    opts = make_options(
+        binary_operators=["+"], unary_operators=["log"], maxsize=8
+    )
+    tree = encode_tree(parse_expression("log(x0)", opts.operators), opts.max_len)
+    cand = Candidate(
+        complexity=2, loss=0.0, score=1.0, equation="log(x0)", tree=tree
+    )
+    res = EquationSearchResult(
+        candidates=[[cand]], options=opts, variable_names=None
+    )
+    X = np.array([[-1.0, 2.0]], dtype=np.float32)
+    with pytest.warns(RuntimeWarning, match="NaN/Inf"):
+        y = res.predict(X)
+    assert not np.isfinite(y).all()
+    # clean inputs: no warning
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        y2 = res.predict(np.array([[1.0, 2.0]], dtype=np.float32))
+    assert np.isfinite(y2).all()

@@ -65,6 +65,22 @@ def test_recorder_json_schema(tmp_path):
     for name in MUTATION_NAMES:
         assert 0 <= mc1[name]["accepted"] <= mc1[name]["proposed"]
         assert mc2[name]["proposed"] >= mc1[name]["proposed"]
+    # full per-event mutation lineage (reference schema asserted by
+    # test/test_recorder.jl:24-46: mutations keyed by ref with
+    # events/parent/tree/score/loss)
+    muts = rec["mutations"]
+    assert len(muts) > 20
+    n_events = sum(len(m["events"]) for m in muts.values())
+    # every proposal is logged: niterations x ncycles x islands x B slots
+    assert n_events == 2 * 8 * 2 * 2
+    for m in list(muts.values())[:5]:
+        assert {"tree", "score", "loss", "parent", "events"} <= set(m)
+        for e in m["events"]:
+            assert e["mutation"] in MUTATION_NAMES
+            assert e["reason"] in (
+                "accept", "reject", "constraint_failed", "noop"
+            )
+            assert isinstance(e["accepted"], bool)
 
 
 def test_recursive_merge():
@@ -129,7 +145,7 @@ def test_custom_loss_function_steers_search():
     X = rng.uniform(-2, 2, (2, 64)).astype(np.float32)
     y = np.zeros(64, np.float32)  # ignored by the custom objective
     res = sr.equation_search(X, y, options=options, niterations=6)
-    assert res.best().loss < 1e-2
+    assert res.best_loss().loss < 1e-2
 
 
 # --------------------------- eval_diff -------------------------------------

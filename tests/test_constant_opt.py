@@ -75,7 +75,7 @@ def test_population_optimize(rng):
         trees=trees, scores=scores, losses=losses,
         birth=jnp.arange(3, dtype=jnp.int32),
     )
-    pop2, n_evals = jax.jit(
+    pop2, n_evals, _ = jax.jit(
         lambda p: optimize_constants_population(
             jax.random.PRNGKey(0), p, Xj, yj, None, 1.0, opt
         )
@@ -103,7 +103,7 @@ def test_optimize_skips_constant_free_members(rng):
         trees=trees, scores=scores, losses=losses,
         birth=jnp.zeros(1, jnp.int32),
     )
-    pop2, _ = optimize_constants_population(
+    pop2, _, _ = optimize_constants_population(
         jax.random.PRNGKey(0), pop, Xj, yj, None, 1.0, opt
     )
     np.testing.assert_array_equal(
@@ -184,7 +184,7 @@ def test_population_optimize_nelder_mead(rng):
         losses=jnp.full((4,), 1e9, jnp.float32),
         birth=jnp.zeros((4,), jnp.int32),
     )
-    pop2, n_evals = optimize_constants_population(
+    pop2, n_evals, _ = optimize_constants_population(
         jax.random.PRNGKey(0), pop, jnp.asarray(X), jnp.asarray(y), None,
         1.0, opt,
     )

@@ -129,3 +129,60 @@ def test_update_hof_ignores_out_of_range_and_nan(rng):
         hof, t, jnp.asarray([jnp.inf]), jnp.asarray([jnp.inf]), OPT
     )
     assert not bool(hof2.exists.any())
+
+
+def test_optimize_mutation_weight_improves_constants(rng):
+    """mutation_weights.optimize > 0 actually optimizes constants (the
+    reference runs constant optimization inside the mutation switch,
+    src/Mutate.jl:142-168; here it is an equivalently-sized iteration-level
+    pass) and records improvements in the OPTIMIZE telemetry row."""
+    from symbolicregression_jl_tpu.api import _make_iteration_fn
+    from symbolicregression_jl_tpu.models.evolve import (
+        MUTATION_NAMES,
+        expected_optimize_count,
+        init_island_state,
+    )
+
+    opts = make_options(
+        binary_operators=["+", "*"],
+        unary_operators=[],
+        npop=24,
+        npopulations=2,
+        ncycles_per_iteration=10,
+        maxsize=10,
+        should_optimize_constants=False,  # regular pass OFF: only the
+        # optimize mutation may fit constants
+        mutation_weights=dict(
+            mutate_constant=0.0, mutate_operator=0.0, add_node=0.0,
+            insert_node=0.0, delete_node=0.0, simplify=0.0,
+            randomize=0.0, do_nothing=1.0, optimize=1.0,
+        ),
+        verbosity=0,
+        progress=False,
+    )
+    assert expected_optimize_count(opts) > 0
+
+    X = jnp.asarray(rng.standard_normal((2, 50)).astype(np.float32))
+    y = 2.5 * X[0] + 0.7
+    baseline = jnp.float32(jnp.var(y))
+
+    keys = jax.random.split(jax.random.PRNGKey(0), opts.npopulations)
+    states = jax.vmap(
+        lambda k: init_island_state(
+            k, opts, 2, X, y, None, baseline
+        )
+    )(keys)
+    loss0 = float(jnp.sum(jnp.where(jnp.isfinite(states.pop.losses),
+                                    states.pop.losses, 0.0)))
+
+    fn = _make_iteration_fn(opts, False)
+    states2, _ = fn(states, jax.random.PRNGKey(1), jnp.int32(opts.maxsize),
+                    X, y, baseline)
+    loss1 = float(jnp.sum(jnp.where(jnp.isfinite(states2.pop.losses),
+                                    states2.pop.losses, 0.0)))
+    opt_row = MUTATION_NAMES.index("optimize")
+    accepted = int(jnp.sum(states2.mut_counts[:, opt_row, 1]))
+    proposed = int(jnp.sum(states2.mut_counts[:, opt_row, 0]))
+    assert proposed > 0  # the switch sampled optimize slots
+    assert accepted > 0  # the pass improved at least one member
+    assert loss1 < loss0  # population got strictly better

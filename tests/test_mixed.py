@@ -34,7 +34,7 @@ def make_data(rng, n=80):
 
 
 def check(res, X_test, y_test, atol=0.15):
-    best = res.best()
+    best = res.best_loss()
     assert best.loss < 1e-2, f"loss {best.loss} (eq: {best.equation})"
     pred = res.predict(X_test)
     np.testing.assert_allclose(pred, y_test, atol=atol)
@@ -93,7 +93,7 @@ def test_nelder_mead_search(rng):
         optimizer_probability=0.3,
         **OPSET, **BUDGET,
     )
-    best = res.best()
+    best = res.best_loss()
     assert best.loss < 1e-2, f"loss {best.loss} (eq: {best.equation})"
 
 
@@ -124,5 +124,5 @@ def test_multi_output_distinct_targets(rng):
     )
     assert res.multi_output and len(res.candidates) == 2
     for j in range(2):
-        best = res.best(output=j)
+        best = res.best_loss(output=j)
         assert best.loss < 1e-1, f"output {j}: {best.equation} {best.loss}"

@@ -1,0 +1,104 @@
+"""Multi-device search tests on the 8-virtual-CPU-device mesh (conftest
+sets --xla_force_host_platform_device_count=8 — the analog of the
+reference's in-process addprocs(2) distributed tests,
+test/test_custom_operators_multiprocessing.jl:18-34).
+
+These run the FULL public equation_search sharded over the mesh, not just
+one engine step: recovery must work through sharding, and the merged hall
+of fame must match the single-device run bit-for-bit (SPMD partitioning
+must not change the computation).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import symbolicregression_jl_tpu as sr
+from symbolicregression_jl_tpu.models.options import make_options
+from symbolicregression_jl_tpu.parallel import mesh as mesh_mod
+
+TINY = dict(
+    binary_operators=["+", "-", "*"],
+    unary_operators=["cos"],
+    npop=24,
+    npopulations=4,
+    ncycles_per_iteration=40,
+    maxsize=12,
+    should_optimize_constants=False,
+    verbosity=0,
+    progress=False,
+    runtests=False,
+)
+
+
+def make_data(seed=0, n=64):
+    rng = np.random.default_rng(seed)
+    X = (rng.standard_normal((3, n)) * 2).astype(np.float32)
+    y = X[0] * X[0] + 2.0 * np.cos(X[2])
+    return X, y
+
+
+def test_mesh_is_active():
+    """Sanity: the virtual-device harness is in effect and equation_search
+    will actually build a mesh (guards against silently running all other
+    tests single-device)."""
+    assert len(jax.devices()) >= 8
+    opts = make_options(binary_operators=["+"], npopulations=4)
+    m = mesh_mod.make_mesh(opts, 4)
+    assert m is not None
+    assert m.devices.size >= 4
+
+
+@pytest.mark.slow
+def test_sharded_search_recovers_target():
+    """Full sharded equation_search over the (islands, rows) mesh recovers
+    the synthetic target (reference e2e bar: loss < 1e-2,
+    test/test_mixed.jl:129-141) — with the rows axis active via the
+    row_shards Options knob."""
+    X, y = make_data()
+    res = sr.equation_search(
+        X, y,
+        niterations=8,
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        npop=33, npopulations=4, ncycles_per_iteration=120, maxsize=14,
+        row_shards=2,
+        verbosity=0, progress=False, runtests=False,
+        early_stop_condition=1e-6, seed=3,
+    )
+    assert min(c.loss for c in res.frontier()) < 1e-2
+
+
+def test_single_vs_multi_device_hof_parity(monkeypatch):
+    """The merged hall of fame from the sharded run equals the
+    single-device run: SPMD placement must be semantics-preserving.
+    (VERDICT r1 item 3b.)"""
+    X, y = make_data()
+
+    res_multi = sr.equation_search(X, y, niterations=2, seed=11, **TINY)
+
+    # force the single-device path: no mesh, plain jit
+    monkeypatch.setattr(
+        "symbolicregression_jl_tpu.api.make_mesh", lambda *a, **k: None
+    )
+    res_single = sr.equation_search(X, y, niterations=2, seed=11, **TINY)
+
+    eq_m = [(c.complexity, c.equation) for c in res_multi.frontier()]
+    eq_s = [(c.complexity, c.equation) for c in res_single.frontier()]
+    assert eq_m == eq_s
+    np.testing.assert_allclose(
+        [c.loss for c in res_multi.frontier()],
+        [c.loss for c in res_single.frontier()],
+        rtol=1e-5,
+    )
+
+
+def test_row_shards_two_matches_one():
+    """Row sharding is a layout choice, not an algorithm change: the same
+    search with row_shards=2 produces the same frontier as row_shards=1."""
+    X, y = make_data()
+    r1 = sr.equation_search(X, y, niterations=2, seed=7, row_shards=1, **TINY)
+    r2 = sr.equation_search(X, y, niterations=2, seed=7, row_shards=2, **TINY)
+    assert [(c.complexity, c.equation) for c in r1.frontier()] == [
+        (c.complexity, c.equation) for c in r2.frontier()
+    ]

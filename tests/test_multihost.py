@@ -1,0 +1,59 @@
+"""Two-process multi-host search test.
+
+Reference analog: the addprocs(2)-shaped distributed tests
+(test/test_custom_operators_multiprocessing.jl:18-34) — here two real OS
+processes join through jax.distributed with a local coordinator, each
+exposing 4 virtual CPU devices, and run a sharded equation_search over the
+global 8-device mesh (islands x rows = 4 x 2).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_sharded_search():
+    port = _free_port()
+    env = dict(os.environ)
+    # the workers set their own XLA_FLAGS/platform; drop the suite's 8-dev
+    # flag so each worker really has 4 local devices
+    env.pop("XLA_FLAGS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(i), str(port)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            env=env,
+        )
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=900)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail(f"multihost workers timed out; partial output: {outs}")
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+        assert "MULTIHOST_OK" in out, f"worker {i} output:\n{out[-3000:]}"
+    # both hosts computed the same global search: identical best loss
+    best = [o.split("MULTIHOST_OK")[1].strip() for o in outs]
+    assert best[0] == best[1], best

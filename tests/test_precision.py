@@ -27,7 +27,7 @@ def _tiny_search(precision):
 def test_search_runs_at_precision(precision):
     res = _tiny_search(precision)
     tol = 1e-2 if precision == "bfloat16" else 1e-4
-    assert res.best().loss < tol
+    assert res.best_loss().loss < tol
 
 
 def test_invalid_precision_rejected():
@@ -48,7 +48,7 @@ def test_float64_in_subprocess():
         "    binary_operators=['+','*'], npop=16, npopulations=2,\n"
         "    ncycles_per_iteration=20, tournament_selection_n=6,\n"
         "    precision='float64', verbosity=0, progress=False, maxsize=10)\n"
-        "assert res.best().loss < 1e-8, res.best().loss\n"
+        "assert res.best_loss().loss < 1e-8, res.best_loss().loss\n"
         "print('OK')\n"
     )
     env = dict(os.environ, JAX_PLATFORMS="cpu")

@@ -44,3 +44,28 @@ def test_unfitted_and_bad_shapes(rng):
     est.set_params(niterations=3, npop=16)
     assert est.get_params()["niterations"] == 3
     assert est.get_params()["npop"] == 16
+
+
+def test_set_params_rejects_unknown():
+    """sklearn contract: set_params raises on invalid names so typos in
+    tuned grids fail fast (GridSearchCV/clone rely on this)."""
+    est = SymbolicRegressor(niterations=1, **TINY)
+    with pytest.raises(ValueError, match="Invalid parameter"):
+        est.set_params(npoop=10)
+    # valid names (including deprecated aliases) still work
+    est.set_params(npop=16, npopulations=3)
+    assert est.get_params()["npop"] == 16
+
+
+def test_score_constant_target(rng):
+    """R^2 for a constant target follows sklearn's r2_score convention:
+    0.0 for imperfect predictions instead of a clamped-denominator
+    nonsense value."""
+    n = 40
+    Xs = (rng.standard_normal((n, 2))).astype(np.float32)
+    y = Xs[:, 0] + Xs[:, 1]
+    est = SymbolicRegressor(niterations=1, seed=0, **TINY)
+    est.fit(Xs, y)
+    y_const = np.full(n, 3.0, dtype=np.float32)
+    s = est.score(Xs, y_const)
+    assert s == 0.0
