@@ -114,7 +114,9 @@ def _time_backend(jax, jnp, options, device, n_trees, n_inner, label,
         fn = jax.jit(
             lambda: jax.lax.fori_loop(0, n_inner, body, jnp.float32(0.0))
         )
+        t_c = time.perf_counter()
         total = float(fn())  # warmup / compile
+        compile_s = time.perf_counter() - t_c
         assert np.isfinite(total)
 
         times = []
@@ -129,10 +131,11 @@ def _time_backend(jax, jnp, options, device, n_trees, n_inner, label,
         print(
             f"# {label}: {n_trees} trees x {N_ROWS} rows x {n_inner} iters, "
             f"{per_iter*1e3:.1f} ms/iter (dispatch overhead "
-            f"{overhead*1e3:.0f} ms subtracted) -> {rate:.3e} trees-rows/s",
+            f"{overhead*1e3:.0f} ms subtracted; first call incl. compile "
+            f"{compile_s:.1f}s) -> {rate:.3e} trees-rows/s",
             file=sys.stderr,
         )
-    return rate
+    return rate, compile_s
 
 
 def _native_cpu_anchor(jax, options, n_trees, verbose):
@@ -518,7 +521,22 @@ def main(verbose=True):
     platform = main_dev.platform
     n_trees = N_POPULATIONS * NPOP
 
-    value = _time_backend(
+    if platform != "cpu":
+        # persistent compilation cache: TPU executables serialize safely
+        # (the known segfault is CPU-only), so a repeat bench run loads its
+        # kernel from cache instead of paying the 20-40s compile
+        try:
+            from symbolicregression_jl_tpu.utils.precompile import (
+                enable_compilation_cache,
+            )
+
+            enable_compilation_cache()
+        except Exception as e:  # pragma: no cover
+            if verbose:
+                print(f"# compilation cache unavailable: {e}",
+                      file=sys.stderr)
+
+    value, compile_s = _time_backend(
         jax, jnp, options, main_dev, min(n_trees, CHUNK), 20,
         f"main ({platform})", verbose,
     )
@@ -557,7 +575,7 @@ def main(verbose=True):
         if platform != "cpu":
             try:
                 cpu_dev = jax.devices("cpu")[0]
-                cpu_rate = _time_backend(
+                cpu_rate, _ = _time_backend(
                     jax, jnp, options, cpu_dev, min(n_trees, 8192), 1,
                     "cpu anchor", verbose,
                 )
@@ -588,6 +606,7 @@ def main(verbose=True):
                 "tunnel_state": ACQUISITION["tunnel_state"],
                 "attempts": ACQUISITION["attempts"],
                 "anchor_cpu_cores": n_cores,
+                "first_call_s": round(compile_s, 1),
             }
         )
     )

@@ -113,6 +113,18 @@ def main():
                      t_block=512))
     grid.append(dict(dispatch="mux", tree_unroll=4, sort_trees=True,
                      r_block=2048))
+    # bf16 compute / f32 accumulate: halves VMEM traffic per slot
+    grid.append(dict(dispatch="mux", tree_unroll=4, sort_trees=True,
+                     compute_dtype="bfloat16"))
+    grid.append(dict(dispatch="mux", tree_unroll=8, sort_trees=True,
+                     compute_dtype="bfloat16"))
+    # roofline says the kernel is issue-bound with the serial slot chain
+    # the latency limiter -> go deeper on interleave
+    grid.append(dict(dispatch="mux", tree_unroll=16, sort_trees=True))
+    grid.append(dict(dispatch="mux", tree_unroll=16, sort_trees=True,
+                     compute_dtype="bfloat16"))
+    grid.append(dict(dispatch="mux", tree_unroll=16, sort_trees=True,
+                     r_block=512))
 
     if tail_n is not None:  # only the last N grid entries (quick probes)
         grid = grid[-tail_n:]
@@ -134,6 +146,16 @@ def main():
     if results:
         best_rate, best_kw = results[-1]
         print(f"\nBEST: {best_rate:.3e} trees-rows/s  {best_kw}")
+        # achieved fraction of the kernel's VPU/VMEM roofline (the bound
+        # the tuning is chasing — see roofline.py for the cost model)
+        from roofline import report
+
+        lens = np.asarray(
+            jax.device_get(trees.length), dtype=np.float64
+        )
+        avg_slots = float(np.mean(np.ceil(lens / 4.0) * 4.0))
+        cdt = best_kw.get("compute_dtype", "float32")
+        print(report(ops, avg_slots, best_rate, cdt))
 
 
 def _timeit(fn):

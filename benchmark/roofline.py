@@ -1,0 +1,113 @@
+"""Roofline model for the Pallas tree-interpreter kernel.
+
+The kernel (ops/pallas_eval.py) evaluates every candidate operator per
+slot and muxes the result — so its compute cost per (tree, slot, row) is
+the SUM of the operator-set's vector-op costs plus the select tree, and
+the relevant peak is the VPU vector-issue rate (the MXU plays no part: a
+tree interpreter has no matmuls).
+
+    bound_trees_rows_per_s = VPU_rate / (ops_per_slot * avg_slots)
+
+Two alternative bounds are computed and the binding one reported:
+
+* VPU issue: ops_per_slot x avg executed slots per tree (dynamic slot
+  loop runs ceil(len/4)*4 slots on length-sorted trees).
+* VMEM scratch traffic: each slot reads 2 and writes 1 (r_sub, 128) value
+  tile -> 12 B/row/slot in f32 (6 B in bf16 — the bf16 variant halves
+  this term but NOT the issue term, which is why bf16 only pays when the
+  kernel is VMEM-bound).
+
+Peak numbers are parameters with conservative public defaults for TPU
+v5e (VPU: 8 sublanes x 128 lanes x 4 SIMD subunits x ~0.94 GHz ~= 3.9e12
+f32 op/s; VMEM bandwidth taken as ~2e13 B/s); override with measured
+values when available. The per-op cost table is a coarse static model
+(transcendentals ~8 slots of the vector pipeline, div ~4, arithmetic 1);
+treat the bound as a scale anchor, not a promise.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+# vector-op cost of one candidate evaluation, in VPU issue slots
+_OP_COST = {
+    "+": 1.0, "-": 1.0, "*": 1.0, "neg": 1.0, "abs": 1.0,
+    "square": 1.0, "cube": 2.0, "relu": 1.0, "greater": 1.0,
+    "logical_or": 2.0, "logical_and": 2.0, "min": 1.0, "max": 1.0,
+    "/": 4.0, "pow": 12.0, "mod": 6.0,
+    "cos": 8.0, "sin": 8.0, "tan": 10.0, "exp": 8.0, "log": 8.0,
+    "log2": 8.0, "log10": 8.0, "log1p": 9.0, "sqrt": 4.0, "cbrt": 8.0,
+    "acos": 10.0, "asin": 10.0, "atan": 10.0, "sinh": 10.0,
+    "cosh": 10.0, "tanh": 9.0, "acosh": 12.0, "asinh": 12.0,
+    "atanh": 12.0, "erf": 10.0, "erfc": 10.0, "gamma": 16.0,
+    "lgamma": 16.0, "sign": 1.0, "exp2": 8.0,
+}
+_DEFAULT_COST = 6.0  # unknown / custom ops
+
+V5E_VPU_OPS = 3.9e12  # f32 vector op/s (8x128 lanes x 4 subunits x .94GHz)
+V5E_VMEM_BW = 2.0e13  # B/s, order-of-magnitude scratch bandwidth
+
+
+def _safe_overhead(name: str) -> float:
+    """NaN-guarding (domain masks + where) adds ~2 selects for the ops
+    that need it."""
+    return 2.0 if name in (
+        "/", "log", "log2", "log10", "log1p", "sqrt", "acosh", "atanh",
+        "pow", "gamma",
+    ) else 0.0
+
+
+def ops_per_slot(operators) -> float:
+    """Vector ops issued per (tree, slot, row): every candidate computed +
+    the log2-deep select mux + leaf broadcast/compare overhead."""
+    import math
+
+    names = list(operators.unary_names) + list(operators.binary_names)
+    compute = sum(
+        _OP_COST.get(n, _DEFAULT_COST) + _safe_overhead(n) for n in names
+    )
+    n_codes = 3 + len(names)
+    mux = math.ceil(math.log2(max(n_codes, 2)))  # balanced select tree
+    leaf = 2.0  # const broadcast + var pick
+    poison = 2.0  # isfinite + max accumulate
+    return compute + mux + leaf + poison
+
+
+def kernel_roofline(
+    operators,
+    avg_tree_len: float,
+    compute_dtype: str = "float32",
+    vpu_ops: float = V5E_VPU_OPS,
+    vmem_bw: float = V5E_VMEM_BW,
+) -> Dict[str, float]:
+    """Upper bounds on kernel throughput in trees*rows/s.
+
+    avg_tree_len: mean EXECUTED slots per tree — with the dynamic slot
+    loop and length sorting that is mean(ceil(len/4)*4) over the batch.
+    """
+    per_slot = ops_per_slot(operators)
+    issue_bound = vpu_ops / (per_slot * avg_tree_len)
+    bytes_per = 4 if compute_dtype == "float32" else 2
+    # 2 reads + 1 write of the value scratch per slot per row
+    vmem_bound = vmem_bw / (3 * bytes_per * avg_tree_len)
+    return {
+        "ops_per_slot": per_slot,
+        "avg_slots": avg_tree_len,
+        "issue_bound": issue_bound,
+        "vmem_bound": vmem_bound,
+        "bound": min(issue_bound, vmem_bound),
+        "binding": "issue" if issue_bound < vmem_bound else "vmem",
+    }
+
+
+def report(operators, avg_tree_len: float, measured_rate: float,
+           compute_dtype: str = "float32") -> str:
+    r = kernel_roofline(operators, avg_tree_len, compute_dtype)
+    frac = measured_rate / r["bound"] if r["bound"] > 0 else float("nan")
+    return (
+        f"roofline[{compute_dtype}]: {r['ops_per_slot']:.0f} vec-ops/slot x "
+        f"{r['avg_slots']:.1f} slots -> issue bound "
+        f"{r['issue_bound']:.2e} t-r/s, vmem bound {r['vmem_bound']:.2e} "
+        f"(binding: {r['binding']}); measured {measured_rate:.2e} = "
+        f"{100 * frac:.0f}% of bound"
+    )

@@ -32,25 +32,35 @@ def dispatch_eval(
     trees: TreeBatch, X: Array, operators: OperatorSet, backend: str = "auto"
 ):
     """Choose the eval kernel. 'auto': the Pallas scalar-dispatch kernel for
-    large float32 top-level batches on TPU (the bench / standalone-eval hot
-    path); the portable jnp lockstep interpreter otherwise (small per-island
-    batches inside the vmapped evolution step, CPU, non-f32 dtypes).
+    large float32/bfloat16 top-level batches on TPU (the bench /
+    standalone-eval hot path); the portable jnp lockstep interpreter
+    otherwise (small per-island batches inside the vmapped evolution step,
+    CPU, f64/f16 dtypes). bfloat16 inputs run the kernel's bf16-compute /
+    f32-accumulate variant (the TPU-native half precision).
 
-    The Pallas kernel is float32-only and has no VJP rule — differentiable
-    callers (constant optimization) must force backend='jnp' or call
-    eval_trees directly; 'auto' never changes dtype or breaks grads only
-    because the guards below route those cases to the jnp path."""
+    The Pallas kernel has no VJP rule — differentiable callers (constant
+    optimization) must force backend='jnp' or call eval_trees directly;
+    'auto' never changes semantics or breaks grads only because the guards
+    below route those cases to the jnp path."""
     from ..ops.pallas_eval import pallas_available
 
     if backend == "pallas" or (
         backend == "auto"
         and pallas_available()
-        and X.dtype == jnp.float32
+        and X.dtype in (jnp.float32, jnp.bfloat16)
         and int(np.prod(trees.length.shape)) >= _PALLAS_MIN_BATCH
     ):
         from ..ops.pallas_eval import eval_trees_pallas
 
-        return eval_trees_pallas(trees, X, operators)
+        compute_dtype = (
+            "bfloat16" if X.dtype == jnp.bfloat16 else "float32"
+        )
+        y, ok = eval_trees_pallas(
+            trees, X, operators, compute_dtype=compute_dtype
+        )
+        # downstream scoring expects the working dtype; the kernel
+        # accumulates/returns f32 (bf16-compute, f32-accumulate)
+        return y.astype(X.dtype), ok
     return eval_trees(trees, X, operators)
 
 

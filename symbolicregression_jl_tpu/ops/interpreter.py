@@ -70,6 +70,10 @@ def _eval_single(
         else:
             binv = jnp.zeros_like(a)
         v = jnp.where(k <= VAR, leaf, jnp.where(k == UNA, una, binv))
+        # some operator impls upcast half precisions internally (special
+        # functions route through f32); pin the working dtype so the stack
+        # update below type-checks for bf16/f16 inputs
+        v = v.astype(stack.dtype)
         arity = arity_table[k]
         new_sp = jnp.where(k == PAD, sp, sp - arity + 1)
         write = jnp.maximum(new_sp - 1, 0)

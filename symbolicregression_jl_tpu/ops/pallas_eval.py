@@ -139,7 +139,7 @@ _SLOT_UNROLL = 4  # slots per dynamic loop step
 
 def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                  max_len: int, slot_loop: str, dispatch: str,
-                 tree_unroll: int):
+                 tree_unroll: int, compute_dtype=jnp.float32):
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
     if slot_loop not in ("dynamic", "unrolled"):
@@ -148,9 +148,10 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
         )
     if dispatch not in ("mux", "chain"):
         raise ValueError(f"dispatch must be 'mux' or 'chain', got {dispatch!r}")
-    if tree_unroll not in (1, 2, 4, 8) or t_block % tree_unroll:
+    if tree_unroll not in (1, 2, 4, 8, 16) or t_block % tree_unroll:
         raise ValueError(
-            f"tree_unroll must be 1/2/4/8 and divide t_block, got {tree_unroll}"
+            "tree_unroll must be 1/2/4/8/16 and divide t_block, "
+            f"got {tree_unroll}"
         )
 
     unary_fns = operators.unary_fns
@@ -158,6 +159,7 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
     U = len(unary_fns)
     n_codes = 3 + U + len(binary_fns)
     r_sub = r_block // 128
+    cdt = compute_dtype
 
     def kernel(nrows_ref, pcode_ref, feat_ref, length_ref,
                cval_ref, lidx_ref, ridx_ref,  # SMEM, transposed (L, t_block)
@@ -179,7 +181,8 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
             a = val_ref[ridx_ref[si, ti]]  # top of stack: right arg
             b = val_ref[lidx_ref[si, ti]]  # second: left arg
             x = X_ref[feat_ref[si, ti]]
-            cv = jnp.full((r_sub, 128), cval_ref[si, ti], jnp.float32)
+            # cval stays f32 in SMEM (scalar reads); cast on broadcast
+            cv = jnp.full((r_sub, 128), cval_ref[si, ti], cdt)
             if dispatch == "chain":
                 # serial select chain: n_codes dependent `where`s
                 v = jnp.where(code == 1, cv, x)
@@ -205,6 +208,9 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                     return jnp.where(code < mid, mux(lo, mid), mux(mid, hi))
 
                 v = mux(0, n_codes)
+            # some operator impls upcast internally (special functions);
+            # normalize back to the compute dtype at the store
+            v = v.astype(cdt)
             val_ref[si] = v
             return jnp.maximum(
                 bad,
@@ -250,7 +256,10 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                     for t in range(tree_unroll):
                         bads[t] = slot_body(si, tis[t], bads[t], val_refs[t])
             for t in range(tree_unroll):
-                out_ref[tis[t]] = val_refs[t][jnp.maximum(ns[t] - 1, 0)]
+                # output/accumulation stays float32 regardless of cdt
+                out_ref[tis[t]] = val_refs[t][
+                    jnp.maximum(ns[t] - 1, 0)
+                ].astype(jnp.float32)
                 bad_ref[0, tis[t]] = jnp.sum(bads[t])
             return 0
 
@@ -266,7 +275,8 @@ def _round_up(x: int, m: int) -> int:
 @functools.partial(
     jax.jit,
     static_argnames=("operators", "t_block", "r_block", "interpret",
-                     "slot_loop", "dispatch", "tree_unroll", "sort_trees"),
+                     "slot_loop", "dispatch", "tree_unroll", "sort_trees",
+                     "compute_dtype"),
 )
 def eval_trees_pallas(
     trees: TreeBatch,
@@ -279,11 +289,17 @@ def eval_trees_pallas(
     dispatch: str = "mux",
     tree_unroll: int = 4,
     sort_trees: bool = True,
+    compute_dtype: str = "float32",
 ) -> Tuple[Array, Array]:
     """Evaluate a flat batch of trees over X (nfeat, nrows).
 
-    Returns (y (..., nrows), ok (...,)) with the same semantics as
-    interpreter.eval_trees. TPU only (or interpret=True anywhere)."""
+    Returns (y (..., nrows) float32, ok (...,)) with the same semantics as
+    interpreter.eval_trees. TPU only (or interpret=True anywhere).
+
+    compute_dtype="bfloat16" evaluates tree values in the TPU-native half
+    precision (halved VMEM traffic per slot, f32 output/poison
+    accumulation) — the bf16 analog of the reference's type-generic eval
+    (its Float16/32/64 sweeps, test/test_tree_construction.jl:96-145)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -335,14 +351,15 @@ def eval_trees_pallas(
     lidx, ridx = operand_schedule(flat.kind)
     lidx, ridx = padT(lidx), padT(ridx)
     length = jnp.pad(flat.length, (0, T_pad - T))[None, :]
+    cdt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[compute_dtype]
     cval = padT(flat.cval.astype(jnp.float32))
     # rows folded to (..., NR, 128) tiles — see module docstring point 3
-    Xp = jnp.pad(X.astype(jnp.float32), ((0, 0), (0, R_pad - nrows)))
+    Xp = jnp.pad(X.astype(cdt), ((0, 0), (0, R_pad - nrows)))
     Xp = Xp.reshape(nfeat, NR, 128)
     nrows_arr = jnp.asarray([nrows], jnp.int32)
 
     kernel = _make_kernel(operators, t_block, r_block, L, slot_loop,
-                          dispatch, tree_unroll)
+                          dispatch, tree_unroll, cdt)
 
     grid = (T_pad // t_block, NR // r_sub)
     smem_spec = lambda shape, imap: pl.BlockSpec(
@@ -371,7 +388,7 @@ def eval_trees_pallas(
             jax.ShapeDtypeStruct((grid[1], T_pad), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((L, r_sub, 128), jnp.float32)
+            pltpu.VMEM((L, r_sub, 128), cdt)
             for _ in range(tree_unroll)
         ],
         interpret=interpret,

@@ -28,23 +28,56 @@ def initialize_multihost(
 
     Arguments default from the standard env vars / cluster auto-detection
     (SLURM, GKE, ...). Returns True if multi-process mode is active.
-    Safe to call on a single host: falls back to no-op."""
+    Safe to call on a single host (no-op), under a single-task SLURM
+    allocation (SLURM_NTASKS=1 is not a cluster), and after the backend
+    has already run computations (warns and stays single-process instead
+    of crashing — jax.distributed.initialize refuses to run then)."""
     already = getattr(jax.distributed, "is_initialized", None)
     if callable(already) and jax.distributed.is_initialized():
         return jax.process_count() > 1
+    try:
+        slurm_n = int(os.environ.get("SLURM_NTASKS") or 1)
+    except ValueError:
+        slurm_n = 1
     if (
         coordinator_address is None
         and "JAX_COORDINATOR_ADDRESS" not in os.environ
         and num_processes is None
-        and "SLURM_NTASKS" not in os.environ
+        and slurm_n <= 1
     ):
         return False  # single-host
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
-    )
+    try:
+        from jax._src import xla_bridge
+
+        backends_up = xla_bridge.backends_are_initialized()
+    except Exception:  # pragma: no cover
+        backends_up = False
+    if backends_up:
+        import warnings
+
+        warnings.warn(
+            "multi-host environment detected but this process already ran "
+            "JAX computations, so the distributed runtime cannot be "
+            "joined (jax.distributed.initialize must precede any JAX "
+            "use). Continuing single-process; call "
+            "initialize_multihost() earlier to fix."
+        )
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+        )
+    except Exception as e:
+        import warnings
+
+        warnings.warn(
+            f"jax.distributed.initialize failed ({e}); continuing "
+            "single-process"
+        )
+        return False
     return jax.process_count() > 1
 
 

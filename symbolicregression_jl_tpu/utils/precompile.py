@@ -12,12 +12,63 @@ and the fitness kernel — on tiny shapes, so the first real
 from __future__ import annotations
 
 import os
+import subprocess
+import sys
 from typing import Optional
 
 import numpy as np
 
 
-def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
+def probe_compilation_cache(
+    cache_dir: str, timeout: float = 600.0
+) -> bool:
+    """Check in a throwaway subprocess whether this image's XLA executable
+    serializer survives writing the persistent cache.
+
+    Some jaxlib builds segfault inside `executable.serialize()` for certain
+    CPU executables (observed on the batching-mode evolution step), killing
+    the whole process from inside the cache write — so the probe compiles
+    exactly that known-crashy shape with the cache enabled. A crash takes
+    the subprocess, not the caller. Returns True when the cache is safe;
+    the probe's own cache writes then pre-warm `cache_dir` for the caller.
+
+    The probe always runs pinned to CPU: the serialize bug is CPU-only,
+    and an accelerator held exclusively by the parent (TPU) must not be
+    contended for. Callers skip the probe entirely on non-CPU backends
+    (enable_compilation_cache does this)."""
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from symbolicregression_jl_tpu.utils.precompile import ("
+        "do_precompilation)\n"
+        f"do_precompilation(mode='compile', cache_dir={cache_dir!r}, "
+        "probe_cache=False, batching=True, batch_size=8)\n"
+        "print('CACHE_PROBE_OK')\n"
+    )
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("PYTHONPATH", "")
+    pkg_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env["PYTHONPATH"]
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            timeout=timeout,
+            text=True,
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    return r.returncode == 0 and "CACHE_PROBE_OK" in r.stdout
+
+
+def enable_compilation_cache(
+    cache_dir: Optional[str] = None, probe: bool = False
+) -> Optional[str]:
     """Point JAX's persistent compilation cache at `cache_dir`.
 
     An explicit `cache_dir` always wins; otherwise an already-configured
@@ -25,13 +76,18 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
     only a fully-unconfigured process gets the package default
     (~/.cache/symbolicregression_jl_tpu).
 
+    With probe=True the cache is only enabled after
+    probe_compilation_cache() demonstrates in a subprocess that the
+    serializer survives on this backend; returns None (cache left
+    disabled) when the probe fails.
+
     Two process-global caveats: (1) once any compile has used the cache,
     JAX keeps the initialized cache singleton even if the config is later
     pointed elsewhere — call jax._src.compilation_cache.reset_cache() to
     truly detach; (2) on some jaxlib builds `executable.serialize()` can
     crash for certain large CPU executables, killing the process from
-    inside the cache write — if that happens, leave the cache disabled for
-    CPU runs (TPU executables are unaffected)."""
+    inside the cache write — that is exactly what the probe screens for
+    (TPU executables are unaffected)."""
     import jax
 
     existing = jax.config.jax_compilation_cache_dir
@@ -42,6 +98,19 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
             os.path.expanduser("~"), ".cache", "symbolicregression_jl_tpu"
         )
     os.makedirs(cache_dir, exist_ok=True)
+    # the serializer bug is CPU-only: accelerator backends enable the
+    # cache without probing (and the probe must never contend for an
+    # exclusively-held chip)
+    if probe and jax.default_backend() == "cpu":
+        if not probe_compilation_cache(cache_dir):
+            import warnings
+
+            warnings.warn(
+                "persistent compilation cache disabled: the executable "
+                "serializer crashed in the probe subprocess (known jaxlib "
+                "issue on some CPU executables)"
+            )
+            return None
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return cache_dir
@@ -52,6 +121,7 @@ def do_precompilation(
     cache_dir: Optional[str] = None,
     nfeatures: int = 5,
     n_rows: int = 32,
+    probe_cache: bool = True,
     **option_kwargs,
 ) -> None:
     """Warm the compile caches like the reference's precompile workload
@@ -65,7 +135,11 @@ def do_precompilation(
     warm with the `nfeatures`/`n_rows` of the dataset you will search and
     pass the same option kwargs (operators, npop, ...) — a warm-up on
     different shapes or options compiles different programs and the real
-    search will still compile cold."""
+    search will still compile cold.
+
+    probe_cache=True (default) screens the persistent cache through a
+    subprocess serializer probe first; when the probe fails, the warm-up
+    still runs but only fills this process's in-memory jit cache."""
     if mode not in ("compile", "search"):
         raise ValueError("mode must be 'compile' or 'search'")
     for reserved in ("niterations", "runtests"):
@@ -74,7 +148,7 @@ def do_precompilation(
                 f"{reserved!r} is fixed by do_precompilation; only Options "
                 "kwargs can be forwarded"
             )
-    enable_compilation_cache(cache_dir)
+    enable_compilation_cache(cache_dir, probe=probe_cache)
 
     from ..api import equation_search
 

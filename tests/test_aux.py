@@ -256,3 +256,22 @@ def test_do_precompilation_bad_mode():
 
     with pytest.raises(ValueError):
         sr.do_precompilation(mode="everything")
+
+
+# --------------------------- compilation cache ------------------------------
+
+
+@pytest.mark.slow
+def test_compilation_cache_probe(tmp_path):
+    """The persistent-cache serializer probe runs the known-crashy workload
+    in a subprocess and never takes down the caller; when it reports safe,
+    its own compiles have pre-warmed the cache directory."""
+    from symbolicregression_jl_tpu.utils.precompile import (
+        probe_compilation_cache,
+    )
+
+    cache_dir = str(tmp_path / "xla_cache")
+    ok = probe_compilation_cache(cache_dir)
+    assert isinstance(ok, bool)
+    if ok:
+        assert os.path.isdir(cache_dir) and len(os.listdir(cache_dir)) > 0
