@@ -150,10 +150,14 @@ def main():
         # the tuning is chasing — see roofline.py for the cost model)
         from roofline import report
 
+        from symbolicregression_jl_tpu.ops.pallas_eval import _SLOT_UNROLL
+
         lens = np.asarray(
             jax.device_get(trees.length), dtype=np.float64
         )
-        avg_slots = float(np.mean(np.ceil(lens / 4.0) * 4.0))
+        avg_slots = float(
+            np.mean(np.ceil(lens / _SLOT_UNROLL) * _SLOT_UNROLL)
+        )
         cdt = best_kw.get("compute_dtype", "float32")
         print(report(ops, avg_slots, best_rate, cdt))
 

@@ -357,6 +357,10 @@ def equation_search(
     return_state: bool = False,
     runtests: bool = True,
     on_iteration: Optional[Callable] = None,
+    parallelism: Optional[str] = None,
+    numprocs: Optional[int] = None,
+    procs=None,
+    addprocs_function=None,
     **option_kwargs,
 ) -> EquationSearchResult:
     """Search for symbolic expressions f(X) ~= y.
@@ -369,6 +373,27 @@ def equation_search(
     reference's saved_state round-trip). warm_start_file seeds the search
     from a hall-of-fame CSV checkpoint (multi-output runs look for the
     .out{j} variants, mirroring how output_file writes them)."""
+    # Reference EquationSearch scheduling kwargs
+    # (src/SymbolicRegression.jl:283-297): accepted for drop-in migration,
+    # but scheduling here is SPMD over the device mesh — islands are
+    # always parallel within one jitted step, and multi-host runs come
+    # from launching the same program per host (see README), not from
+    # spawning workers out of this process.
+    if parallelism is not None and parallelism not in (
+        "serial", "multithreading", "multiprocessing",
+        ":serial", ":multithreading", ":multiprocessing",
+    ):
+        raise ValueError(f"unknown parallelism {parallelism!r}")
+    if any(x is not None for x in (numprocs, procs, addprocs_function)):
+        import warnings
+
+        warnings.warn(
+            "numprocs/procs/addprocs_function have no effect: worker "
+            "processes are replaced by SPMD over the device mesh "
+            "(launch one process per host for multi-host — see README "
+            "'Multi-device and multi-host')"
+        )
+
     if options is None:
         options = make_options(**option_kwargs)
     elif option_kwargs:

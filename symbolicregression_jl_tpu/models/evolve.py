@@ -608,6 +608,9 @@ def reg_evol_cycle_islands(
     row_idx: Optional[Array] = None,
     collect_events: bool = False,
 ):
+    """row_idx: None (full data), (batch,) shared minibatch, or
+    (I, batch) per-island independent minibatches (the reference's
+    per-island score_func_batch draws, src/LossFunctions.jl:95-115)."""
     nfeatures = X.shape[0]
     I = states.birth_counter.shape[0]
     props = jax.vmap(
@@ -615,17 +618,28 @@ def reg_evol_cycle_islands(
             st, temperature, curmaxsize, nfeatures, options
         )
     )(states)
-    flat_children = _flatten2(props.children)  # (I*B, ...)
-    s, l = score_trees(
-        flat_children, X, y, weights, baseline, options, row_idx
-    )
     B = props.parent_scores.shape[1]
+    if row_idx is not None and row_idx.ndim == 2:
+        # per-island draws: score each island's children against its own
+        # minibatch (vmapped — forgoes the one fused flat call, so the
+        # Pallas kernel does not engage on this path)
+        s, l = jax.vmap(
+            lambda ch, ri: score_trees(
+                ch, X, y, weights, baseline, options, ri
+            )
+        )(props.children, row_idx)
+    else:
+        flat_children = _flatten2(props.children)  # (I*B, ...)
+        s, l = score_trees(
+            flat_children, X, y, weights, baseline, options, row_idx
+        )
+        s, l = s.reshape(I, B), l.reshape(I, B)
     return jax.vmap(
         lambda st, pr, cs, cl: _integrate_children(
             st, pr, cs, cl, temperature, X.shape[1], options,
             collect_events=collect_events,
         )
-    )(states, props, s.reshape(I, B), l.reshape(I, B))
+    )(states, props, s, l)
 
 
 # ---------------------------------------------------------------------------
@@ -651,10 +665,12 @@ def s_r_cycle_islands(
     With collect_events=True (recorder mode) additionally returns
     MutationEvents stacked (ncycles, I, B, ...) for host-side draining.
 
-    Batching note: the reference draws an independent minibatch per
-    score_func_batch call (per island); here one minibatch per cycle is
-    shared by all islands so the fused scoring call slices X once. Same
-    stochastic-minibatch semantics, coarser sharing."""
+    Batching note: by default one minibatch per cycle is shared by all
+    islands so the fused scoring call slices X once (each cycle still
+    draws fresh rows). options.independent_island_batches=True matches
+    the reference exactly — an independent draw per island per cycle
+    (src/LossFunctions.jl:95-115) — at the cost of the fused flat
+    scoring call (per-island vmapped scoring; no Pallas on that path)."""
     ncycles = ncycles or options.ncycles_per_iteration
     if options.annealing and ncycles > 1:
         temperatures = jnp.linspace(1.0, 0.0, ncycles)
@@ -662,13 +678,21 @@ def s_r_cycle_islands(
         temperatures = jnp.ones((ncycles,))
 
     n_rows = X.shape[1]
+    I = states.birth_counter.shape[0]
 
     def step(carry, inputs):
         sts, key = carry
         temperature = inputs
         if options.batching:
             kb, key = jax.random.split(key)
-            row_idx = sample_batch_idx(kb, n_rows, options.batch_size)
+            if options.independent_island_batches:
+                row_idx = jax.vmap(
+                    lambda k: sample_batch_idx(
+                        k, n_rows, options.batch_size
+                    )
+                )(jax.random.split(kb, I))
+            else:
+                row_idx = sample_batch_idx(kb, n_rows, options.batch_size)
         else:
             row_idx = None
         out = reg_evol_cycle_islands(

@@ -157,6 +157,12 @@ class Options:
     # --- batching ---
     batching: bool = False
     batch_size: int = 50
+    # True = an independent minibatch per island per cycle (the
+    # reference's exact per-island score_func_batch semantics,
+    # src/LossFunctions.jl:95-115) via per-island vmapped scoring; False
+    # (default) = one fresh minibatch per cycle shared across islands so
+    # scoring stays one fused flat call (the Pallas-kernel-sized batch).
+    independent_island_batches: bool = False
     # --- constraints ---
     constraints: Tuple[Tuple[str, Any], ...] = ()
     nested_constraints: Tuple[Tuple[str, Tuple[Tuple[str, int], ...]], ...] = ()
@@ -186,8 +192,9 @@ class Options:
     row_shards: int = 1
     # Working dtype for X/y/constants/losses (the reference's Float16/32/64
     # type parameter T). "float64" flips on jax_enable_x64 at search start;
-    # "bfloat16" is the TPU-native half precision (the Pallas kernel itself
-    # is float32-only — dispatch_eval routes other dtypes to the jnp path).
+    # "bfloat16" is the TPU-native half precision — large bf16 batches on
+    # TPU run the Pallas kernel's bf16-compute/f32-accumulate variant,
+    # f64/f16 route to the jnp interpreter (dispatch_eval).
     precision: str = "float32"
     island_axis: str = "islands"
     row_axis: str = "rows"
@@ -302,6 +309,7 @@ class Options:
             self.maxdepth, self.parsimony, self.alpha,
             self.tournament_selection_n, self.tournament_selection_p,
             self.topn, self.batching, self.batch_size,
+            self.independent_island_batches,
             self.n_parallel_tournaments, self.eval_backend, self.precision,
             self.constraints, self.nested_constraints,
             self.complexity_of_operators, self.complexity_of_constants,

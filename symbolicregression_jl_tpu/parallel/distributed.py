@@ -28,10 +28,11 @@ def initialize_multihost(
 
     Arguments default from the standard env vars / cluster auto-detection
     (SLURM, GKE, ...). Returns True if multi-process mode is active.
-    Safe to call on a single host (no-op), under a single-task SLURM
-    allocation (SLURM_NTASKS=1 is not a cluster), and after the backend
-    has already run computations (warns and stays single-process instead
-    of crashing — jax.distributed.initialize refuses to run then)."""
+    Safe to call on a single host (no-op) and under a single-task SLURM
+    allocation (SLURM_NTASKS=1 is not a cluster). When a cluster IS
+    configured, failures are loud: silently continuing single-process
+    would split-brain the job (N independent searches racing on shared
+    checkpoints while the joined hosts hang)."""
     already = getattr(jax.distributed, "is_initialized", None)
     if callable(already) and jax.distributed.is_initialized():
         return jax.process_count() > 1
@@ -53,31 +54,23 @@ def initialize_multihost(
     except Exception:  # pragma: no cover
         backends_up = False
     if backends_up:
-        import warnings
-
-        warnings.warn(
+        raise RuntimeError(
             "multi-host environment detected but this process already ran "
             "JAX computations, so the distributed runtime cannot be "
             "joined (jax.distributed.initialize must precede any JAX "
-            "use). Continuing single-process; call "
-            "initialize_multihost() earlier to fix."
+            "use). Call initialize_multihost() / equation_search before "
+            "touching JAX, or unset the cluster env vars "
+            "(JAX_COORDINATOR_ADDRESS / SLURM_NTASKS) for a deliberate "
+            "single-process run."
         )
-        return False
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-            local_device_ids=local_device_ids,
-        )
-    except Exception as e:
-        import warnings
-
-        warnings.warn(
-            f"jax.distributed.initialize failed ({e}); continuing "
-            "single-process"
-        )
-        return False
+    # no try/except: a failed join of a configured cluster must crash the
+    # job, not quietly run this host's own single-process search
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
     return jax.process_count() > 1
 
 

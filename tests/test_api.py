@@ -257,3 +257,35 @@ def test_predict_warns_on_domain_violation(rng):
         warnings.simplefilter("error")
         y2 = res.predict(np.array([[1.0, 2.0]], dtype=np.float32))
     assert np.isfinite(y2).all()
+
+
+def test_reference_parallelism_kwargs(rng):
+    """Reference EquationSearch scheduling kwargs are accepted for drop-in
+    migration: parallelism validates, numprocs/procs warn (SPMD replaces
+    worker spawning)."""
+    X, y = make_data(rng, n=40)
+    res = sr.equation_search(
+        X, y, niterations=1, parallelism="multithreading", seed=0,
+        runtests=False, **TINY,
+    )
+    assert len(res.frontier()) > 0
+    with pytest.raises(ValueError, match="parallelism"):
+        sr.equation_search(
+            X, y, niterations=1, parallelism="gpu", runtests=False, **TINY
+        )
+    with pytest.warns(UserWarning, match="no effect"):
+        sr.equation_search(
+            X, y, niterations=1, numprocs=4, seed=0, runtests=False, **TINY
+        )
+
+
+def test_independent_island_batches(rng):
+    """Reference-exact per-island minibatch draws
+    (src/LossFunctions.jl:95-115) as an Options knob."""
+    X, y = make_data(rng)
+    res = sr.equation_search(
+        X, y, niterations=2, batching=True, batch_size=20,
+        independent_island_batches=True, seed=0, runtests=False, **TINY,
+    )
+    assert len(res.frontier()) > 0
+    assert np.isfinite(res.best_loss().loss)

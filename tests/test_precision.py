@@ -23,10 +23,10 @@ def _tiny_search(precision):
     )
 
 
-@pytest.mark.parametrize("precision", ["float32", "bfloat16"])
+@pytest.mark.parametrize("precision", ["float32", "bfloat16", "float16"])
 def test_search_runs_at_precision(precision):
     res = _tiny_search(precision)
-    tol = 1e-2 if precision == "bfloat16" else 1e-4
+    tol = 1e-4 if precision == "float32" else 1e-2
     assert res.best_loss().loss < tol
 
 
