@@ -379,11 +379,23 @@ def equation_search(
     # always parallel within one jitted step, and multi-host runs come
     # from launching the same program per host (see README), not from
     # spawning workers out of this process.
-    if parallelism is not None and parallelism not in (
-        "serial", "multithreading", "multiprocessing",
-        ":serial", ":multithreading", ":multiprocessing",
-    ):
-        raise ValueError(f"unknown parallelism {parallelism!r}")
+    if parallelism is not None:
+        p = parallelism.lstrip(":")
+        if p not in ("serial", "multithreading", "multiprocessing"):
+            raise ValueError(f"unknown parallelism {parallelism!r}")
+        if p != "multithreading":
+            # "multithreading" matches what actually happens (parallel
+            # islands in one process); the other modes imply a different
+            # execution model and deserve a heads-up
+            import warnings
+
+            warnings.warn(
+                f"parallelism={parallelism!r} has no effect: the search "
+                "is always SPMD over the device mesh in this process "
+                "(launch one process per host for multi-host — see "
+                "README 'Multi-device and multi-host')",
+                stacklevel=2,
+            )
     if any(x is not None for x in (numprocs, procs, addprocs_function)):
         import warnings
 
@@ -391,7 +403,8 @@ def equation_search(
             "numprocs/procs/addprocs_function have no effect: worker "
             "processes are replaced by SPMD over the device mesh "
             "(launch one process per host for multi-host — see README "
-            "'Multi-device and multi-host')"
+            "'Multi-device and multi-host')",
+            stacklevel=2,
         )
 
     if options is None:

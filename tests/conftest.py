@@ -24,11 +24,23 @@ import jax
 if os.environ.get("SRTPU_TPU_TESTS", "") != "1":
     jax.config.update("jax_platforms", "cpu")
 
-# NOTE: the persistent compilation cache (jax_compilation_cache_dir) is
-# deliberately NOT enabled: on this image `executable.serialize()` segfaults
-# on some CPU executables (reproducibly the batching-mode evolution step in
-# test_mixed.py::test_batching_annealing), killing the whole pytest process
-# from inside the cache write. Repeat runs pay full XLA compile time instead.
+# Persistent compilation cache: ON by default since 2026-07-30 — two full
+# suite passes wrote ~100 CPU executables through `executable.serialize()`
+# without the segfault this image showed earlier (see the probe guard in
+# utils/precompile.py for the production-side screen), and a warm run cuts
+# the not-slow tier from ~30 min to ~11 min. If a pytest run ever dies
+# with a faulthandler dump ending in put_executable_and_time /
+# backend_compile_and_load, set SRTPU_TEST_CACHE=0 and delete the cache
+# dir. SRTPU_TEST_CACHE=<dir> overrides the location.
+_cache_dir = os.environ.get("SRTPU_TEST_CACHE", "")
+if _cache_dir != "0":
+    if not _cache_dir:
+        _cache_dir = os.path.join(
+            os.path.expanduser("~"), ".cache", "srtpu_test_xla"
+        )
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import numpy as np
 import pytest
