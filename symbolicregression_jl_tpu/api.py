@@ -380,7 +380,10 @@ def equation_search(
     # from launching the same program per host (see README), not from
     # spawning workers out of this process.
     if parallelism is not None:
-        p = parallelism.lstrip(":")
+        if not isinstance(parallelism, str):
+            raise ValueError(f"unknown parallelism {parallelism!r}")
+        # accept the Julia symbol spelling ":serial" (one leading colon)
+        p = parallelism[1:] if parallelism.startswith(":") else parallelism
         if p not in ("serial", "multithreading", "multiprocessing"):
             raise ValueError(f"unknown parallelism {parallelism!r}")
         if p != "multithreading":

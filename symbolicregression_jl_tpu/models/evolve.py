@@ -335,6 +335,8 @@ class MutationEvents(NamedTuple):
     reason: Array  # (B,) int32
     score: Array  # (B,) child score
     loss: Array  # (B,) child loss
+    dead: TreeBatch  # (B, ...) the replaced-oldest members (death events)
+    dead_loss: Array  # (B,)
 
 
 REASON_NAMES = ("accept", "reject", "constraint_failed", "noop")
@@ -550,6 +552,8 @@ def _integrate_children(
         reason=reason,
         score=child_scores,
         loss=child_losses,
+        dead=jax.tree_util.tree_map(lambda x: x[oldest], pop.trees),
+        dead_loss=pop.losses[oldest],
     )
     return new_state, events
 

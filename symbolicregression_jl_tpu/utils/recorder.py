@@ -174,11 +174,14 @@ class Recorder:
                 self.options.operators, self.variable_names,
             )
 
+        dead_refs = [f"{int(h):016x}" for h in tree_hash(flat.dead)]
+
         mutations: RecordType = self.record.setdefault("mutations", {})
         cross_row = len(MUTATION_NAMES) - 1
         for e in range(n):
             ref = child_refs[e]
             entry = mutations.get(ref)
+            entry_was_new = entry is None
             if entry is None:
                 if eqs is not None:
                     eq = eqs[e]
@@ -213,6 +216,24 @@ class Recorder:
                     "cycle": cycle + 1,
                 }
             )
+            # death of the replaced-oldest member in the same slot
+            # (reference src/RegularizedEvolution.jl:103-132 death events).
+            # Only the self-death of an entry first created by THIS event
+            # is suppressed; a pre-existing entry with the same content
+            # hash as the child legitimately records its member's death.
+            dref = dead_refs[e]
+            dentry = mutations.get(dref)
+            if dentry is not None and not (dref == ref and entry_was_new):
+                dentry["events"].append(
+                    {
+                        "type": "death",
+                        "loss": float(flat.dead_loss[e]),
+                        "output": output + 1,
+                        "island": island + 1,
+                        "iteration": iteration + 1,
+                        "cycle": cycle + 1,
+                    }
+                )
 
     # -- hall of fame timeline ----------------------------------------------
     def record_hall_of_fame(self, output: int, iteration: int,

@@ -70,17 +70,29 @@ def test_recorder_json_schema(tmp_path):
     # events/parent/tree/score/loss)
     muts = rec["mutations"]
     assert len(muts) > 20
-    n_events = sum(len(m["events"]) for m in muts.values())
+    n_events = sum(
+        1 for m in muts.values() for e in m["events"]
+        if e["type"] != "death"
+    )
     # every proposal is logged: niterations x ncycles x islands x B slots
     assert n_events == 2 * 8 * 2 * 2
     for m in list(muts.values())[:5]:
         assert {"tree", "score", "loss", "parent", "events"} <= set(m)
         for e in m["events"]:
+            if e["type"] == "death":
+                continue
             assert e["mutation"] in MUTATION_NAMES
             assert e["reason"] in (
                 "accept", "reject", "constraint_failed", "noop"
             )
             assert isinstance(e["accepted"], bool)
+    # replaced members of recorded lineage get death events
+    # (reference src/RegularizedEvolution.jl death records)
+    n_deaths = sum(
+        1 for m in muts.values() for e in m["events"]
+        if e["type"] == "death"
+    )
+    assert n_deaths > 0
 
 
 def test_recursive_merge():
