@@ -188,16 +188,11 @@ def _make_iteration_fn(options: Options, has_weights: bool):
         k_mig, k_opt, k_opt_mut = jax.random.split(key, 3)
         # all-island fused forms: one interpreter call per cycle across the
         # whole archipelago (Pallas-sized batches on TPU)
-        events = None
-        if options.recorder:
-            states, events = s_r_cycle_islands(
-                states, curmaxsize, X, y, weights, baseline, options,
-                collect_events=True,
-            )
-        else:
-            states = s_r_cycle_islands(
-                states, curmaxsize, X, y, weights, baseline, options
-            )
+        out = s_r_cycle_islands(
+            states, curmaxsize, X, y, weights, baseline, options,
+            collect_events=options.recorder,
+        )
+        states, events = out if options.recorder else (out, None)
         states = simplify_population_islands(
             states, curmaxsize, X, y, weights, baseline, options
         )
@@ -306,6 +301,7 @@ def _warm_start_hof(
     import warnings
 
     from .models.fitness import score_trees
+    from .models.trees import stack_trees
     from .utils.output import load_hof_csv
 
     try:
@@ -315,10 +311,7 @@ def _warm_start_hof(
         return None
     if not cands:
         return None
-    trees = jax.tree_util.tree_map(
-        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
-        *[c.tree for c in cands],
-    )
+    trees = stack_trees([c.tree for c in cands])
     scores, losses = score_trees(trees, Xj, yj, wj, baseline, options)
     hof = init_hall_of_fame(options, options.dtype)
     return update_hall_of_fame(hof, trees, scores, losses, options)
@@ -483,12 +476,12 @@ def equation_search(
         Xj, yj, wj = shard_dataset(ds.X, ds.y, ds.weights, mesh, options)
 
         master_key = jax.random.PRNGKey(options.seed + 7919 * j)
+        bl = jnp.asarray(ds.baseline_loss, options.dtype)
 
         def _fresh_init(key):
             k_init, key = jax.random.split(key)
             init_keys = jax.random.split(k_init, I)
             init_fn = _make_init_fn(options, nfeatures, wj is not None)
-            bl = jnp.asarray(ds.baseline_loss, options.dtype)
             if wj is not None:
                 sts = init_fn(init_keys, Xj, yj, wj, bl)
             else:
@@ -526,7 +519,6 @@ def equation_search(
                 path = warm_start_file
                 if multi:
                     path = _multi_output_path(path, j)
-                bl = jnp.asarray(ds.baseline_loss, options.dtype)
                 warm = _warm_start_hof(
                     path, options, variable_names, Xj, yj, wj, bl
                 )
@@ -541,12 +533,11 @@ def equation_search(
             it = start_iter + step
             cm = jnp.int32(_curmaxsize(options, it, max(niterations, 1)))
             master_key, k_it = jax.random.split(master_key)
-            baseline = jnp.asarray(ds.baseline_loss, options.dtype)
             t_dev = time.time()
             if wj is not None:
-                out = iteration_fn(states, k_it, cm, Xj, yj, wj, baseline)
+                out = iteration_fn(states, k_it, cm, Xj, yj, wj, bl)
             else:
-                out = iteration_fn(states, k_it, cm, Xj, yj, baseline)
+                out = iteration_fn(states, k_it, cm, Xj, yj, bl)
             if options.recorder:
                 states, ghof, events = out
             else:
