@@ -289,3 +289,17 @@ def test_independent_island_batches(rng):
     )
     assert len(res.frontier()) > 0
     assert np.isfinite(res.best_loss().loss)
+
+
+def test_integer_input_data_is_cast(rng):
+    """Integer-typed X/y are accepted and cast to the working float dtype
+    (deviation from reference test_integer_evaluation.jl, which preserves
+    integer node types — a float-first TPU engine casts at the boundary)."""
+    X = rng.integers(-5, 5, (2, 40)).astype(np.int64)
+    y = (X[0] * X[1]).astype(np.int64)
+    res = sr.equation_search(
+        X, y, niterations=2, seed=0, runtests=False, **TINY
+    )
+    assert len(res.frontier()) > 0
+    pred = res.predict(X)
+    assert pred.dtype == np.float32
