@@ -106,30 +106,58 @@ def save_hof_csv(
             f.write(body)
 
 
+def _parse_hof_csv(path, options, variable_names):
+    """Parse one checkpoint file. Returns (candidates, clean) — clean is
+    False when any line failed to parse (a torn file from a mid-write
+    kill)."""
+    from ..models.trees import encode_tree, parse_expression
+
+    out: List[Candidate] = []
+    clean = True
+    with open(path) as f:
+        f.readline()  # header
+        for line in f:
+            parts = line.rstrip("\n").split(";", 2)
+            try:
+                if len(parts) != 3:
+                    raise ValueError("short line")
+                c, loss, eq = parts
+                expr = parse_expression(
+                    eq, options.operators, variable_names
+                )
+                out.append(
+                    Candidate(
+                        complexity=int(c),
+                        loss=float(loss),
+                        score=0.0,
+                        equation=eq,
+                        tree=encode_tree(expr, options.max_len),
+                    )
+                )
+            except (ValueError, KeyError):
+                clean = False
+    return out, clean
+
+
 def load_hof_csv(
     path: str, options: Options, variable_names=None
 ) -> List[Candidate]:
     """Re-parse a checkpoint CSV back into candidates (equations re-parsed
-    through parse_expression; analog of load_saved_hall_of_fame)."""
-    from ..models.trees import encode_tree, parse_expression
+    through parse_expression; analog of load_saved_hall_of_fame,
+    reference src/SearchUtils.jl:275-301).
 
-    use = path if os.path.exists(path) else path + ".bkup"
-    out: List[Candidate] = []
-    with open(use) as f:
-        header = f.readline()
-        for line in f:
-            parts = line.rstrip("\n").split(";", 2)
-            if len(parts) != 3:
-                continue
-            c, loss, eq = parts
-            expr = parse_expression(eq, options.operators, variable_names)
-            out.append(
-                Candidate(
-                    complexity=int(c),
-                    loss=float(loss),
-                    score=0.0,
-                    equation=eq,
-                    tree=encode_tree(expr, options.max_len),
-                )
-            )
-    return out
+    The double-write (`save_hof_csv`) guarantees at least one intact copy
+    survives a mid-write kill: a missing OR torn main file falls back to
+    `.bkup` when the backup parses clean (prefer main on ties — it is the
+    newer write)."""
+    bkup = path + ".bkup"
+    cands, clean = (
+        _parse_hof_csv(path, options, variable_names)
+        if os.path.exists(path)
+        else ([], False)
+    )
+    if not clean and os.path.exists(bkup):
+        bcands, bclean = _parse_hof_csv(bkup, options, variable_names)
+        if bclean or len(bcands) > len(cands):
+            return bcands
+    return cands

@@ -303,3 +303,27 @@ def test_integer_input_data_is_cast(rng):
     assert len(res.frontier()) > 0
     pred = res.predict(X)
     assert pred.dtype == np.float32
+
+
+def test_checkpoint_bkup_fallback(rng, tmp_path):
+    """A torn or missing main checkpoint falls back to the .bkup
+    double-write (the reference's survive-mid-write-kill mechanism,
+    src/SymbolicRegression.jl:749-767)."""
+    X, y = make_data(rng)
+    path = str(tmp_path / "hof.csv")
+    opts = dict(TINY)
+    opts["output_file"] = path
+    res = sr.equation_search(X, y, niterations=1, seed=0, **opts)
+    expect = [c.complexity for c in res.frontier()]
+
+    # missing main file (killed before the rewrite started)
+    body = open(path).read()
+    os.remove(path)
+    reloaded = load_hof_csv(path, res.options)
+    assert [c.complexity for c in reloaded] == expect
+
+    # torn main file (killed mid-write): intact .bkup must win
+    with open(path, "w") as f:
+        f.write(body[: len(body) // 2].rsplit("\n", 1)[0] + "\n(((")
+    reloaded = load_hof_csv(path, res.options)
+    assert [c.complexity for c in reloaded] == expect

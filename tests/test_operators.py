@@ -1,0 +1,85 @@
+"""Scalar operator-semantics table across dtypes — the analog of the
+reference's generic operator tests (test/test_operators.jl:26-66):
+NaN-safe domain guards, pow edge cases, comparison/logical semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.ops.operators import (
+    BINARY_REGISTRY,
+    UNARY_REGISTRY,
+)
+
+
+def u(name, x, dtype):
+    return float(UNARY_REGISTRY[name](jnp.asarray(x, dtype)))
+
+
+def b(name, x, y, dtype):
+    return float(
+        BINARY_REGISTRY[name](jnp.asarray(x, dtype), jnp.asarray(y, dtype))
+    )
+
+
+DTYPES = [jnp.float32, jnp.float16, jnp.bfloat16]
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_safe_unary_domains(dtype):
+    val, val2 = 0.5, 3.2
+    tol = 2e-2 if dtype != jnp.float32 else 1e-6
+    assert abs(u("log", val, dtype) - np.log(val)) < tol
+    assert np.isnan(u("log", -val, dtype))
+    assert np.isnan(u("log", 0.0, dtype))
+    assert abs(u("log2", val, dtype) - np.log2(val)) < tol
+    assert np.isnan(u("log2", -val, dtype))
+    assert np.isnan(u("log2", 0.0, dtype))
+    assert abs(u("log10", val, dtype) - np.log10(val)) < tol
+    assert np.isnan(u("log10", -val, dtype))
+    assert abs(u("acosh", val2, dtype) - np.arccosh(val2)) < tol * 2
+    assert np.isnan(u("acosh", -val2, dtype))
+    assert abs(u("sqrt", val, dtype) - np.sqrt(val)) < tol
+    assert np.isnan(u("sqrt", -val, dtype))
+    assert u("neg", -val, dtype) == pytest.approx(val, abs=tol)
+    assert u("square", val, dtype) == pytest.approx(val * val, abs=tol)
+    assert u("cube", val, dtype) == pytest.approx(val**3, abs=tol)
+    assert u("relu", -val, dtype) == 0.0
+    assert u("relu", val, dtype) == pytest.approx(val, abs=tol)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_safe_pow_edge_cases(dtype):
+    """safe_pow NaN table (reference src/Operators.jl:38-46)."""
+    val, val2 = 0.5, 3.2
+    tol = 5e-2 if dtype != jnp.float32 else 1e-5
+    assert np.isnan(b("pow", 0.0, -1.0, dtype))
+    assert np.isnan(b("pow", -val, val2, dtype))
+    assert np.isnan(b("pow", -val, -val2, dtype))
+    assert np.isnan(b("pow", 0.0, -val2, dtype))
+    assert abs(b("pow", val, val2, dtype) - val**val2) < tol
+    assert abs(b("pow", val, -val2, dtype) - val ** (-val2)) < tol
+    # integer exponents of negative bases are fine / NaN per parity
+    assert not np.isnan(b("pow", -1.0, 2.0, dtype))
+    assert np.isnan(b("pow", -1.0, 2.1, dtype))
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_comparison_and_logical(dtype):
+    val, val2 = 0.5, 3.2
+    assert b("greater", val, val2, dtype) == 0.0
+    assert b("greater", val2, val, dtype) == 1.0
+    assert b("logical_or", val, val2, dtype) == 1.0
+    assert b("logical_or", 0.0, val2, dtype) == 1.0
+    assert b("logical_and", 0.0, val2, dtype) == 0.0
+    assert b("logical_and", val, val2, dtype) == 1.0
+    assert b("/", val, val2, dtype) == pytest.approx(val / val2, rel=2e-2)
+
+
+def test_gamma_pole_is_nan():
+    """gamma at non-positive integers -> NaN (reference
+    src/Operators.jl:8-12 maps the Inf pole to NaN)."""
+    assert np.isnan(u("gamma", 0.0, jnp.float32))
+    assert np.isnan(u("gamma", -1.0, jnp.float32))
+    assert u("gamma", 4.0, jnp.float32) == pytest.approx(6.0, rel=1e-5)
