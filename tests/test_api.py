@@ -327,3 +327,27 @@ def test_checkpoint_bkup_fallback(rng, tmp_path):
         f.write(body[: len(body) // 2].rsplit("\n", 1)[0] + "\n(((")
     reloaded = load_hof_csv(path, res.options)
     assert [c.complexity for c in reloaded] == expect
+
+
+def test_deprecated_kwargs_remap():
+    """camelCase kwargs remap to their snake_case fields with the same
+    table the reference keeps (analog of test/test_deprecation.jl;
+    src/Options.jl:122-143)."""
+    o = make_options(
+        binary_operators=["+"],
+        batchSize=17,
+        crossoverProbability=0.25,
+        useFrequency=False,
+        ns=4,
+        probPickFirst=0.9,
+        fractionReplaced=0.1,
+        npop=16,
+    )
+    assert o.batch_size == 17
+    assert o.crossover_probability == 0.25
+    assert o.use_frequency is False
+    assert o.tournament_selection_n == 4
+    assert o.tournament_selection_p == 0.9
+    assert o.fraction_replaced == 0.1
+    with pytest.raises(ValueError, match="Duplicate"):
+        make_options(binary_operators=["+"], batchSize=1, batch_size=2)
