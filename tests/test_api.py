@@ -351,3 +351,47 @@ def test_deprecated_kwargs_remap():
     assert o.fraction_replaced == 0.1
     with pytest.raises(ValueError, match="Duplicate"):
         make_options(binary_operators=["+"], batchSize=1, batch_size=2)
+
+
+def test_readme_quickstart_executes(monkeypatch, capsys):
+    """The README quickstart code blocks execute as written (analog of the
+    reference running its README example, test/full.jl:19-21). The search
+    budget is shrunk through a wrapper so the API surface — not the wall
+    clock — is what's under test."""
+    import re
+
+    import symbolicregression_jl_tpu.sklearn as sk_mod
+
+    orig = sr.equation_search
+
+    def small_budget(*a, **k):
+        k["niterations"] = 1
+        k.setdefault("npop", 16)
+        k.setdefault("npopulations", 2)
+        k.setdefault("ncycles_per_iteration", 15)
+        k.setdefault("maxsize", 10)
+        k.setdefault("tournament_selection_n", 6)
+        k.setdefault("verbosity", 0)
+        k.setdefault("progress", False)
+        k.setdefault("runtests", False)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(sr, "equation_search", small_budget)
+    monkeypatch.setattr(sk_mod, "equation_search", small_budget)
+
+    path = os.path.join(os.path.dirname(__file__), "..", "README.md")
+    with open(path, encoding="utf-8") as f:
+        readme = f.read()
+    all_blocks = re.findall(r"```python\n(.*?)```", readme, re.DOTALL)
+    # anchor on content, not position: the functional quickstart and the
+    # estimator-facade block
+    blocks = [
+        b for b in all_blocks
+        if "equation_search(" in b or "SymbolicRegressor(" in b
+    ]
+    assert len(blocks) >= 2
+    ns = {}
+    for block in blocks[:2]:
+        exec(compile(block, "<README>", "exec"), ns)  # noqa: S102
+    out = capsys.readouterr().out
+    assert "Hall of Fame" in out  # print(result) rendered the table
