@@ -1,0 +1,52 @@
+"""Profiling helpers — the TPU-native analog of the reference's profiling
+story (it ships `benchmark/analyze.py` to digest Julia `Profile` text
+dumps; here the profiler of record is XLA's, viewed in
+TensorBoard/Perfetto).
+
+`trace(...)` wraps `jax.profiler.trace` for capturing a search's device
+timeline; `annotate(...)` names host-side regions inside a capture;
+`device_memory_stats()` snapshots per-device live-buffer usage (the HBM
+analog of the reference's host ResourceMonitor).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False):
+    """Capture an XLA profiler trace of the enclosed block.
+
+    View with TensorBoard (`tensorboard --logdir <log_dir>`) or the
+    Perfetto UI. Typical use wraps a few warm search iterations:
+
+        with profiling.trace("/tmp/sr_trace"):
+            equation_search(X, y, niterations=2, ...)
+    """
+    with jax.profiler.trace(
+        log_dir, create_perfetto_link=create_perfetto_link
+    ):
+        yield
+
+
+def annotate(name: str):
+    """Named host-side region inside an active trace (shows up on the
+    timeline alongside device ops)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory_stats() -> Dict[str, Optional[Dict[str, int]]]:
+    """Per-device memory statistics (bytes_in_use, peak_bytes_in_use, ...)
+    keyed by device string; value None where the backend doesn't report
+    (CPU usually doesn't)."""
+    out: Dict[str, Optional[Dict[str, int]]] = {}
+    for d in jax.devices():
+        try:
+            out[str(d)] = d.memory_stats()
+        except Exception:  # pragma: no cover
+            out[str(d)] = None
+    return out

@@ -287,3 +287,23 @@ def test_compilation_cache_probe(tmp_path):
     assert isinstance(ok, bool)
     if ok:
         assert os.path.isdir(cache_dir) and len(os.listdir(cache_dir)) > 0
+
+
+# --------------------------- profiling -------------------------------------
+
+
+def test_profiler_trace_and_memory_stats(tmp_path):
+    """XLA profiler wrapper captures a trace of device work and the memory
+    snapshot reports per device (the profiling analog of the reference's
+    benchmark/analyze.py tooling)."""
+    from symbolicregression_jl_tpu.utils import profiling
+
+    d = str(tmp_path / "trace")
+    with profiling.trace(d):
+        with profiling.annotate("tiny-op"):
+            jnp.ones((8, 8)).sum().block_until_ready()
+    # a capture directory with at least one event file appeared
+    files = [p for p in os.walk(d)]
+    assert any(fs for _, _, fs in files), "no trace files written"
+    stats = profiling.device_memory_stats()
+    assert len(stats) == len(jax.devices())
