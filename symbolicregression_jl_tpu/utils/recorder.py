@@ -9,13 +9,18 @@ ids, and the final hall of fame; merged head-side via recursive_merge
 (src/Utils.jl:41-51) and serialized to JSON with allow_inf at exit
 (src/SymbolicRegression.jl:923-927).
 
-TPU-native deviation: members live in device arrays without per-member ref
-ids (the hot loop is one fused XLA computation), so lineage is tracked at
-*snapshot* granularity: each member carries a content hash; a member's
-parent is inferred as the same-hash member of the previous snapshot
-(surviving member) or marked "new" (accepted mutation/crossover/migrant).
-This preserves the recorder's purpose — auditing how the population evolved
-— without forcing a host round-trip per mutation.
+TPU-native design: members live in device arrays without per-member ref
+ids (the hot loop is one fused XLA computation), so refs are structural
+content hashes (tree_hash). Two granularities are recorded:
+
+* population snapshots per iteration (record_population), with
+  survived/new lineage inferred from hash membership;
+* the FULL per-event mutation log (record_mutation_events): in recorder
+  mode the cycle scan stacks a fixed-shape MutationEvents record per
+  cycle on device — parent/child trees, kind, accept/reject reason,
+  replaced-member deaths — drained here once per iteration into the
+  reference's ref-keyed `mutations` schema. One host transfer per
+  iteration, zero cost when the recorder is off.
 """
 
 from __future__ import annotations
