@@ -10,6 +10,7 @@ must not change the computation).
 """
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -102,3 +103,65 @@ def test_row_shards_two_matches_one():
     assert [(c.complexity, c.equation) for c in r1.frontier()] == [
         (c.complexity, c.equation) for c in r2.frontier()
     ]
+
+
+def test_sharded_iteration_lowers_to_collectives():
+    """The compiled sharded iteration contains real cross-device
+    communication: migration's island-axis gather and the row-axis loss
+    reduction must show up as collective ops in the optimized HLO (not be
+    partitioned away into per-device replicas)."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from symbolicregression_jl_tpu.api import _make_iteration_fn
+    from symbolicregression_jl_tpu.models.evolve import init_island_state
+
+    opts = make_options(
+        binary_operators=["+", "*"],
+        unary_operators=["cos"],
+        npop=16,
+        npopulations=4,
+        ncycles_per_iteration=2,
+        maxsize=10,
+        tournament_selection_n=5,
+        should_optimize_constants=False,
+        row_shards=2,
+    )
+    mesh = mesh_mod.make_mesh(opts, 4, row_shards=2)
+    assert mesh is not None and mesh.devices.size == 8
+
+    rng = np.random.default_rng(0)
+    X_h = rng.standard_normal((2, 32)).astype(np.float32)
+    y_h = (X_h[0] * X_h[0]).astype(np.float32)
+    X = jax.device_put(
+        jnp.asarray(X_h), NamedSharding(mesh, P(None, opts.row_axis))
+    )
+    y = jax.device_put(
+        jnp.asarray(y_h), NamedSharding(mesh, P(opts.row_axis))
+    )
+    baseline = jnp.float32(float(np.var(y_h)))
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    states = jax.vmap(
+        lambda k: init_island_state(k, opts, 2, X, y, None, baseline)
+    )(keys)
+    states = jax.tree_util.tree_map(
+        lambda a: jax.device_put(
+            a, NamedSharding(mesh, P(opts.island_axis))
+        ),
+        states,
+    )
+
+    fn = _make_iteration_fn(opts, has_weights=False)
+    compiled = fn.lower(
+        states, jax.random.PRNGKey(1), jnp.int32(opts.maxsize), X, y,
+        baseline,
+    ).compile()
+    hlo = compiled.as_text()
+    has_collective = any(
+        marker in hlo
+        for marker in (
+            "all-reduce", "all-gather", "collective-permute", "all-to-all",
+            "reduce-scatter",
+        )
+    )
+    assert has_collective, "no collective ops in the sharded iteration HLO"
