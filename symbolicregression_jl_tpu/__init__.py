@@ -62,6 +62,10 @@ __version__ = "0.1.0"
 # Populated lazily to avoid importing heavy modules at package import:
 from .api import EquationSearchResult, equation_search  # noqa: E402
 from .sklearn import SymbolicRegressor  # noqa: E402
+from .utils.checkpoint import (  # noqa: E402
+    load_search_state,
+    save_search_state,
+)
 from .utils.precompile import (  # noqa: E402
     do_precompilation,
     enable_compilation_cache,
@@ -105,5 +109,7 @@ __all__ = [
     "EquationSearch",
     "EquationSearchResult",
     "do_precompilation",
+    "save_search_state",
+    "load_search_state",
     "enable_compilation_cache",
 ]

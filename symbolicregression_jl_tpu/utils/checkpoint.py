@@ -1,0 +1,112 @@
+"""Full search-state disk checkpointing.
+
+The reference's exact-resume state lives only in the Julia session
+(`return_state=true` → pass the tuple back to EquationSearch,
+src/SearchUtils.jl:270-273); its only on-disk artifact is the hall-of-fame
+CSV. Here the complete `SearchState` (per-island populations, statistics,
+PRNG keys, hall of fame, iteration counter) round-trips through a file, so
+an exact resume survives a process restart:
+
+    res = equation_search(X, y, return_state=True, ...)
+    save_search_state("run.ckpt", res.state)
+    # ... new process ...
+    state = load_search_state("run.ckpt")
+    res2 = equation_search(X, y, saved_state=state, ...)
+
+Arrays are stored as host numpy inside a pickle (the state is small —
+populations, not datasets); `equation_search` feeds them straight back to
+jit, and its shape validation (`_saved_state_compatible`) still guards a
+changed Options. Under multi-host SPMD, shards spanning other processes
+are all-gathered first, so every process can materialize the global
+state; writing is the caller's to gate (process 0).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import List
+
+import jax
+import numpy as np
+
+_MAGIC = "srtpu-search-state-v1"
+
+
+def _to_host(x) -> np.ndarray:
+    """Fetch an array to host, all-gathering shards that live on other
+    processes (multi-host sharded state)."""
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        x = multihost_utils.process_allgather(x, tiled=True)
+    return np.asarray(x)
+
+
+def save_search_state(path: str, state: List["SearchState"]) -> str:
+    """Write the list of per-output SearchStates (from
+    `equation_search(..., return_state=True).state`) to `path`. Uses the
+    same double-write discipline as the CSV checkpoint (file + .bkup)."""
+    if state is None:
+        raise ValueError(
+            "state is None — run equation_search with return_state=True"
+        )
+    host = [
+        {
+            "island_states": jax.tree_util.tree_map(
+                _to_host, s.island_states
+            ),
+            "global_hof": jax.tree_util.tree_map(_to_host, s.global_hof),
+            "iteration": int(s.iteration),
+        }
+        for s in state
+    ]
+    payload = pickle.dumps({"magic": _MAGIC, "outputs": host},
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    for p in (path, path + ".bkup"):
+        with open(p, "wb") as f:
+            f.write(payload)
+    return path
+
+
+def load_search_state(path: str) -> List["SearchState"]:
+    """Load a checkpoint written by save_search_state; falls back to the
+    .bkup copy if the main file is missing or torn.
+
+    Raises FileNotFoundError only when NO checkpoint file exists (the
+    resume-if-present pattern); corrupt-but-present checkpoints raise
+    ValueError so a destroyed checkpoint is never silently mistaken for
+    a fresh start."""
+    import os
+
+    from ..api import SearchState
+
+    last_err: Exception | None = None
+    existed = False
+    for p in (path, path + ".bkup"):
+        if not os.path.exists(p):
+            continue
+        existed = True
+        try:
+            with open(p, "rb") as f:
+                data = pickle.load(f)
+            if data.get("magic") != _MAGIC:
+                raise ValueError(f"{p!r} is not a search-state checkpoint")
+            return [
+                SearchState(
+                    island_states=d["island_states"],
+                    global_hof=d["global_hof"],
+                    iteration=d["iteration"],
+                )
+                for d in data["outputs"]
+            ]
+        # corrupt pickles raise a zoo of types (AttributeError,
+        # ImportError, struct.error, ...): any failure means "try bkup"
+        except Exception as e:
+            last_err = e
+            continue
+    if existed:
+        raise ValueError(
+            f"checkpoint at {path!r} exists but is unreadable "
+            f"({last_err}); refusing to treat it as a fresh start"
+        )
+    raise FileNotFoundError(f"no search-state checkpoint at {path!r}")

@@ -52,7 +52,18 @@ res = sr.equation_search(
     progress=False,
     runtests=False,
     seed=0,
+    return_state=True,
 )
 best = min(c.loss for c in res.frontier())
 assert np.isfinite(best)
-print(f"MULTIHOST_OK {best:.6f}", flush=True)
+
+# disk checkpoint of multi-process sharded state: every process can
+# materialize the global state (allgather); each writes its own copy
+# here so the test can compare them byte-for-byte
+ckpt = f"/tmp/srtpu_mh_state_{process_id}.ckpt"
+sr.save_search_state(ckpt, res.state)
+reloaded = sr.load_search_state(ckpt)
+assert reloaded[0].iteration == res.state[0].iteration
+losses = np.asarray(reloaded[0].island_states.pop.losses, np.float64)
+pop_hash = float(np.sum(np.where(np.isfinite(losses), losses, 0.0)))
+print(f"MULTIHOST_OK {best:.6f} ckpt={pop_hash:.6f}", flush=True)

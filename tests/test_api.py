@@ -395,3 +395,42 @@ def test_readme_quickstart_executes(monkeypatch, capsys):
         exec(compile(block, "<README>", "exec"), ns)  # noqa: S102
     out = capsys.readouterr().out
     assert "Hall of Fame" in out  # print(result) rendered the table
+
+
+def test_search_state_disk_roundtrip(rng, tmp_path):
+    """Full search state survives a disk round-trip and resumes exactly
+    (beyond the reference, whose exact-resume state lives only in the
+    session): resume-from-disk equals resume-from-memory."""
+    X, y = make_data(rng)
+    res1 = sr.equation_search(
+        X, y, niterations=1, return_state=True, seed=4, **TINY
+    )
+    path = str(tmp_path / "run.ckpt")
+    sr.save_search_state(path, res1.state)
+
+    loaded = sr.load_search_state(path)
+    res_mem = sr.equation_search(
+        X, y, niterations=1, saved_state=res1.state, seed=4, **TINY
+    )
+    res_disk = sr.equation_search(
+        X, y, niterations=1, saved_state=loaded, seed=4, **TINY
+    )
+    assert [(c.complexity, c.equation) for c in res_disk.frontier()] == [
+        (c.complexity, c.equation) for c in res_mem.frontier()
+    ]
+
+    # torn main file falls back to .bkup
+    with open(path, "r+b") as f:
+        f.truncate(100)
+    loaded2 = sr.load_search_state(path)
+    assert loaded2[0].iteration == loaded[0].iteration
+
+    with pytest.raises(FileNotFoundError):
+        sr.load_search_state(str(tmp_path / "missing.ckpt"))
+    # both copies corrupt -> ValueError, never silently a fresh start
+    with open(path, "wb") as f:
+        f.write(b"garbage")
+    with open(path + ".bkup", "wb") as f:
+        f.write(b"garbage")
+    with pytest.raises(ValueError, match="unreadable"):
+        sr.load_search_state(path)
