@@ -125,6 +125,13 @@ def main():
                      compute_dtype="bfloat16"))
     grid.append(dict(dispatch="mux", tree_unroll=16, sort_trees=True,
                      r_block=512))
+    # compressed operator-only instruction program: ~half the steps per
+    # tree (leaves become operand fetches instead of executed slots)
+    for unroll in (4, 8, 16):
+        grid.append(dict(dispatch="mux", tree_unroll=unroll,
+                         sort_trees=True, program="instr"))
+    grid.append(dict(dispatch="mux", tree_unroll=8, sort_trees=True,
+                     program="instr", compute_dtype="bfloat16"))
 
     if tail_n is not None:  # only the last N grid entries (quick probes)
         grid = grid[-tail_n:]
@@ -152,14 +159,23 @@ def main():
 
         from symbolicregression_jl_tpu.ops.pallas_eval import _SLOT_UNROLL
 
-        lens = np.asarray(
-            jax.device_get(trees.length), dtype=np.float64
-        )
+        program = best_kw.get("program", "postfix")
+        if program == "instr":
+            from symbolicregression_jl_tpu.ops.pallas_eval import (
+                instruction_schedule,
+            )
+
+            _, n_instr = instruction_schedule(trees, ops)
+            lens = np.asarray(jax.device_get(n_instr), dtype=np.float64)
+        else:
+            lens = np.asarray(
+                jax.device_get(trees.length), dtype=np.float64
+            )
         avg_slots = float(
             np.mean(np.ceil(lens / _SLOT_UNROLL) * _SLOT_UNROLL)
         )
         cdt = best_kw.get("compute_dtype", "float32")
-        print(report(ops, avg_slots, best_rate, cdt))
+        print(report(ops, avg_slots, best_rate, cdt, program=program))
 
 
 def _timeit(fn):

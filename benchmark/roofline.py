@@ -57,17 +57,28 @@ def _safe_overhead(name: str) -> float:
     ) else 0.0
 
 
-def ops_per_slot(operators) -> float:
-    """Vector ops issued per (tree, slot, row): every candidate computed +
-    the log2-deep select mux + leaf broadcast/compare overhead."""
+def ops_per_slot(operators, program: str = "postfix") -> float:
+    """Vector ops issued per (tree, step, row).
+
+    program="postfix": every candidate computed per slot + the log2-deep
+    select mux + leaf broadcast/compare overhead. program="instr"
+    (compressed operator-only program): same candidate set per step, but
+    each step additionally pays the 2-operand source mux (2 loads + 2
+    selects + broadcast each) and the operand-finiteness poison check —
+    in exchange for executing ~half as many steps (use the instruction
+    count, not the postfix length, for avg_tree_len)."""
     import math
 
     names = list(operators.unary_names) + list(operators.binary_names)
     compute = sum(
         _OP_COST.get(n, _DEFAULT_COST) + _safe_overhead(n) for n in names
     )
-    n_codes = 3 + len(names)
+    n_codes = (3 if program == "postfix" else 2) + len(names)
     mux = math.ceil(math.log2(max(n_codes, 2)))  # balanced select tree
+    if program == "instr":
+        fetch = 10.0  # 2 operands x (2 dynamic loads + 2 selects + bcast)
+        poison = 4.0  # isfinite(v,a,b) + and + max accumulate
+        return compute + mux + fetch + poison
     leaf = 2.0  # const broadcast + var pick
     poison = 2.0  # isfinite + max accumulate
     return compute + mux + leaf + poison
@@ -79,17 +90,23 @@ def kernel_roofline(
     compute_dtype: str = "float32",
     vpu_ops: float = V5E_VPU_OPS,
     vmem_bw: float = V5E_VMEM_BW,
+    program: str = "postfix",
 ) -> Dict[str, float]:
     """Upper bounds on kernel throughput in trees*rows/s.
 
-    avg_tree_len: mean EXECUTED slots per tree — with the dynamic slot
-    loop and length sorting that is mean(ceil(len/4)*4) over the batch.
+    avg_tree_len: mean EXECUTED steps per tree — with the dynamic slot
+    loop and length sorting that is mean(ceil(len/4)*4) over the batch,
+    where len is the postfix length (program="postfix") or the
+    instruction count (program="instr").
     """
-    per_slot = ops_per_slot(operators)
+    per_slot = ops_per_slot(operators, program)
     issue_bound = vpu_ops / (per_slot * avg_tree_len)
     bytes_per = 4 if compute_dtype == "float32" else 2
-    # 2 reads + 1 write of the value scratch per slot per row
-    vmem_bound = vmem_bw / (3 * bytes_per * avg_tree_len)
+    # postfix: 2 scratch reads + 1 write per slot per row. instr: the
+    # branchless operand fetch materializes BOTH dynamic loads per operand
+    # (scratch + X) -> 4 reads + 1 write per step per row.
+    accesses = 3 if program == "postfix" else 5
+    vmem_bound = vmem_bw / (accesses * bytes_per * avg_tree_len)
     return {
         "ops_per_slot": per_slot,
         "avg_slots": avg_tree_len,
@@ -101,11 +118,13 @@ def kernel_roofline(
 
 
 def report(operators, avg_tree_len: float, measured_rate: float,
-           compute_dtype: str = "float32") -> str:
-    r = kernel_roofline(operators, avg_tree_len, compute_dtype)
+           compute_dtype: str = "float32", program: str = "postfix") -> str:
+    r = kernel_roofline(operators, avg_tree_len, compute_dtype,
+                        program=program)
     frac = measured_rate / r["bound"] if r["bound"] > 0 else float("nan")
     return (
-        f"roofline[{compute_dtype}]: {r['ops_per_slot']:.0f} vec-ops/slot x "
+        f"roofline[{program},{compute_dtype}]: "
+        f"{r['ops_per_slot']:.0f} vec-ops/slot x "
         f"{r['avg_slots']:.1f} slots -> issue bound "
         f"{r['issue_bound']:.2e} t-r/s, vmem bound {r['vmem_bound']:.2e} "
         f"(binding: {r['binding']}); measured {measured_rate:.2e} = "

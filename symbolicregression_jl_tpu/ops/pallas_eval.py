@@ -137,6 +137,143 @@ def operand_schedule(kind: Array):
 _SLOT_UNROLL = 4  # slots per dynamic loop step
 
 
+def _balanced_mux(code, cands):
+    """log2(n)-deep select tree over candidates by opcode range — shortens
+    the step's serial critical path vs a chained `where` (shared by the
+    postfix and instr kernels; their candidate lists differ)."""
+
+    def mux(lo, hi):
+        if hi - lo == 1:
+            return cands[lo]
+        mid = (lo + hi) // 2
+        return jnp.where(code < mid, mux(lo, mid), mux(mid, hi))
+
+    return mux(0, len(cands))
+
+
+# operand-source codes for the compressed instruction program
+_SRC_RES = 0  # a previous instruction's result (idx = instruction index)
+_SRC_VAR = 1  # a dataset feature (idx = feature index)
+_SRC_CONST = 2  # an inline constant (cval)
+
+
+def instruction_schedule(trees: TreeBatch, operators: OperatorSet):
+    """Compress postfix programs to operator-only instruction lists.
+
+    Roughly half the slots of a postfix program are leaves (a tree with b
+    binary ops has b+1 of them), and the postfix kernel pays the full
+    candidate mux on every slot. This schedule emits one instruction per
+    OPERATOR node only; each operand is described by (src, idx, cval)
+    where src says whether it is a previous instruction's result, a
+    feature column, or a constant. The kernel then fetches operands with
+    a 2-select source mux (cheap) and runs the candidate mux ~half as
+    often — and, just as important for the TPU pipeline, each tree's
+    serial write->read chain through its value scratch is ~half as long.
+
+    Instruction opcodes: 0 = DEAD (padding; executes harmlessly, excluded
+    from the poison flag), 1 = IDENT (passes operand `a` through — emitted
+    only for bare-leaf trees so every tree has >= 1 instruction),
+    2..2+U-1 = unary, 2+U.. = binary.
+
+    trees: flat TreeBatch with (T, L) fields. Returns a dict of (T, L)
+    int32/float32 tables (icode, lsrc, lidx, lcval, rsrc, ridx, rcval)
+    plus n_instr (T,). Pure jnp (jittable); runs once per eval call on
+    the host-side of the kernel launch, like `operand_schedule`.
+    """
+    from ..models.trees import ARITY
+
+    kind, op, feat, cval = trees.kind, trees.op, trees.feat, trees.cval
+    T, L = kind.shape
+    U = operators.n_unary
+    depth = L // 2 + 2
+
+    arity = jnp.asarray(ARITY)[kind]  # (T, L)
+
+    def step(state, inputs):
+        ssrc, sidx, scval, sp, nins = state
+        k, o, f, c, ar = inputs
+        is_pad = k == PAD
+        is_op = ar > 0
+        top = jnp.clip(sp - 1, 0, depth - 1)[:, None]
+        sec = jnp.clip(sp - 2, 0, depth - 1)[:, None]
+        take = lambda s, i: jnp.take_along_axis(s, i, axis=-1)[:, 0]
+        # right operand = stack top; left = second (binary only)
+        rsrc, ridx, rcval = take(ssrc, top), take(sidx, top), take(scval, top)
+        is_bin = ar == 2
+        lsrc = jnp.where(is_bin, take(ssrc, sec), _SRC_CONST)
+        lidx = jnp.where(is_bin, take(sidx, sec), 0)
+        lcval = jnp.where(is_bin, take(scval, sec), 0.0)
+        icode = jnp.where(
+            is_op, jnp.where(k == UNA, 2 + o, 2 + U + o), 0
+        ).astype(jnp.int32)
+        # push: the op's result, or the leaf itself
+        psrc = jnp.where(is_op, _SRC_RES,
+                         jnp.where(k == VAR, _SRC_VAR, _SRC_CONST))
+        pidx = jnp.where(is_op, nins, jnp.where(k == VAR, f, 0))
+        pcval = jnp.where(k == CONST, c, 0.0)
+        new_sp = jnp.where(is_pad, sp, sp - jnp.maximum(ar, 0) + 1)
+        w = jnp.clip(new_sp - 1, 0, depth - 1)
+        at_w = (jnp.arange(depth) == w[:, None]) & ~is_pad[:, None]
+        new_state = (
+            jnp.where(at_w, psrc[:, None], ssrc),
+            jnp.where(at_w, pidx[:, None], sidx),
+            jnp.where(at_w, pcval[:, None], scval),
+            new_sp,
+            nins + is_op.astype(jnp.int32),
+        )
+        out = (is_op, icode, lsrc, lidx, lcval, rsrc, ridx, rcval)
+        return new_state, out
+
+    init = (
+        jnp.zeros((T, depth), jnp.int32),
+        jnp.zeros((T, depth), jnp.int32),
+        jnp.zeros((T, depth), jnp.float32),
+        jnp.zeros((T,), jnp.int32),
+        jnp.zeros((T,), jnp.int32),
+    )
+    mv = lambda x: jnp.moveaxis(x, -1, 0)
+    inputs = (mv(kind), mv(op), mv(feat),
+              mv(cval.astype(jnp.float32)), mv(arity))
+    (ssrc, sidx, scval, sp, nins), outs = jax.lax.scan(step, init, inputs)
+    is_op, icode, lsrc, lidx, lcval, rsrc, ridx, rcval = (
+        jnp.moveaxis(x, 0, -1) for x in outs
+    )
+
+    # compact: drop leaf slots, placing instruction k of each tree at
+    # column k (batched scatter; dropped slots land in the L overflow col)
+    pos = jnp.cumsum(is_op.astype(jnp.int32), axis=-1) - 1
+    col = jnp.where(is_op, pos, L)
+    rows = jnp.arange(T)[:, None]
+
+    def compact(x, fill=0):
+        out = jnp.full((T, L + 1), fill, x.dtype)
+        return out.at[rows, col].set(x, mode="drop")[:, :L]
+
+    tables = {
+        "icode": compact(icode),
+        "lsrc": compact(lsrc, _SRC_CONST), "lidx": compact(lidx),
+        "lcval": compact(lcval, 0.0),
+        "rsrc": compact(rsrc, _SRC_CONST), "ridx": compact(ridx),
+        "rcval": compact(rcval, 0.0),
+    }
+
+    # bare-leaf trees (no operator nodes): one IDENT instruction whose
+    # operand is the root leaf, sitting on the final stack top
+    top = jnp.clip(sp - 1, 0, depth - 1)[:, None]
+    take = lambda s: jnp.take_along_axis(s, top, axis=-1)[:, 0]
+    bare = (nins == 0) & (trees.length > 0)
+    first = jnp.arange(L) == 0
+    sel = bare[:, None] & first
+    tables["icode"] = jnp.where(sel, 1, tables["icode"])
+    tables["rsrc"] = jnp.where(sel, take(ssrc)[:, None], tables["rsrc"])
+    tables["ridx"] = jnp.where(sel, take(sidx)[:, None], tables["ridx"])
+    tables["rcval"] = jnp.where(
+        sel, take(scval)[:, None], tables["rcval"]
+    )
+    n_instr = jnp.where(bare, 1, nins)
+    return tables, n_instr
+
+
 def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                  max_len: int, slot_loop: str, dispatch: str,
                  tree_unroll: int, compute_dtype=jnp.float32):
@@ -157,7 +294,6 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
     unary_fns = operators.unary_fns
     binary_fns = operators.binary_fns
     U = len(unary_fns)
-    n_codes = 3 + U + len(binary_fns)
     r_sub = r_block // 128
     cdt = compute_dtype
 
@@ -191,23 +327,13 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                 for j, fn in enumerate(binary_fns):
                     v = jnp.where(code == 3 + U + j, fn(b, a), v)
             else:
-                # balanced mux: all candidates computed in parallel, then a
-                # log2(n_codes)-deep select tree on code ranges — shortens
-                # the slot's serial critical path (the chain above is
-                # n_codes dependent selects; stack writes/reads already
-                # serialize consecutive slots, so path length is what the
-                # pipeline sees)
+                # balanced mux: all candidates computed in parallel (stack
+                # writes/reads already serialize consecutive slots, so the
+                # select tree's depth is what the pipeline sees)
                 cands = [x, cv, x]  # PAD (dead), CONST, VAR
                 cands += [fn(a) for fn in unary_fns]
                 cands += [fn(b, a) for fn in binary_fns]
-
-                def mux(lo, hi):
-                    if hi - lo == 1:
-                        return cands[lo]
-                    mid = (lo + hi) // 2
-                    return jnp.where(code < mid, mux(lo, mid), mux(mid, hi))
-
-                v = mux(0, n_codes)
+                v = _balanced_mux(code, cands)
             # some operator impls upcast internally (special functions);
             # normalize back to the compute dtype at the store
             v = v.astype(cdt)
@@ -268,6 +394,117 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
     return kernel
 
 
+def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
+                       max_len: int, dispatch: str, tree_unroll: int,
+                       nfeat: int, compute_dtype=jnp.float32):
+    """Kernel for the compressed instruction program (instruction_schedule).
+
+    Same layout discipline as `_make_kernel` (SMEM transposed tables, VMEM
+    row tiles, tree interleaving); differs per step: operands are fetched
+    through a source mux (result / feature / constant) instead of always
+    from the value scratch, and only operator nodes execute, so programs
+    are ~half as long and leaves never pay the candidate mux."""
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    if dispatch not in ("mux", "chain"):
+        raise ValueError(f"dispatch must be 'mux' or 'chain', got {dispatch!r}")
+    if tree_unroll not in (1, 2, 4, 8, 16) or t_block % tree_unroll:
+        raise ValueError(
+            "tree_unroll must be 1/2/4/8/16 and divide t_block, "
+            f"got {tree_unroll}"
+        )
+
+    unary_fns = operators.unary_fns
+    binary_fns = operators.binary_fns
+    U = len(unary_fns)
+    r_sub = r_block // 128
+    cdt = compute_dtype
+
+    def kernel(nrows_ref, icode_ref,
+               lsrc_ref, lidx_ref, lcval_ref,
+               rsrc_ref, ridx_ref, rcval_ref,
+               ninstr_ref,
+               X_ref, out_ref, bad_ref,
+               *val_refs):
+        sub = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 0)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 1)
+        row = (pl.program_id(1) * r_sub + sub) * 128 + lane
+        valid_f = jnp.where(row < nrows_ref[0], 1.0, 0.0)
+
+        def fetch(src, idx, cv, val_ref):
+            """Source mux: previous result / feature column / constant.
+            All three candidates are materialized (branchless); the two
+            dynamic reads are clipped to their arrays' bounds so dead
+            sources read harmless garbage."""
+            v_res = val_ref[jnp.minimum(idx, max_len - 1)]
+            v_var = X_ref[jnp.minimum(idx, nfeat - 1)]
+            v_cv = jnp.full((r_sub, 128), cv, cdt)
+            return jnp.where(
+                src == _SRC_RES, v_res,
+                jnp.where(src == _SRC_VAR, v_var, v_cv),
+            )
+
+        def instr_body(si, ti, bad, val_ref):
+            code = icode_ref[si, ti]
+            a = fetch(rsrc_ref[si, ti], ridx_ref[si, ti],
+                      rcval_ref[si, ti], val_ref)
+            b = fetch(lsrc_ref[si, ti], lidx_ref[si, ti],
+                      lcval_ref[si, ti], val_ref)
+            if dispatch == "chain":
+                v = a
+                for j, fn in enumerate(unary_fns):
+                    v = jnp.where(code == 2 + j, fn(a), v)
+                for j, fn in enumerate(binary_fns):
+                    v = jnp.where(code == 2 + U + j, fn(b, a), v)
+            else:
+                cands = [a, a]  # DEAD (dead), IDENT
+                cands += [fn(a) for fn in unary_fns]
+                cands += [fn(b, a) for fn in binary_fns]
+                v = _balanced_mux(code, cands)
+            v = v.astype(cdt)
+            val_ref[si] = v
+            # operand finiteness matters too: the postfix kernel checks
+            # every leaf slot's value, so a tree whose op maps an Inf
+            # operand back to a finite result (relu(-inf)=0) must still
+            # be poisoned for parity
+            fin = jnp.isfinite(v) & jnp.isfinite(a) & jnp.isfinite(b)
+            return jnp.maximum(
+                bad, jnp.where(fin | (code == 0), 0.0, valid_f)
+            )
+
+        zero = jnp.zeros((r_sub, 128), jnp.float32)
+
+        def tree_group_body(p, _):
+            tis = [p * tree_unroll + k for k in range(tree_unroll)]
+            ns = [ninstr_ref[0, ti] for ti in tis]
+            n_max = ns[0]
+            for n in ns[1:]:
+                n_max = jnp.maximum(n_max, n)
+
+            def slot_group(g, bads):
+                bads = list(bads)
+                for k in range(_SLOT_UNROLL):
+                    si = g * _SLOT_UNROLL + k
+                    for t in range(tree_unroll):
+                        bads[t] = instr_body(si, tis[t], bads[t], val_refs[t])
+                return tuple(bads)
+
+            n_groups = (n_max + _SLOT_UNROLL - 1) // _SLOT_UNROLL
+            bads = jax.lax.fori_loop(
+                0, n_groups, slot_group, (zero,) * tree_unroll
+            )
+            for t in range(tree_unroll):
+                out_ref[tis[t]] = val_refs[t][
+                    jnp.maximum(ns[t] - 1, 0)
+                ].astype(jnp.float32)
+                bad_ref[0, tis[t]] = jnp.sum(bads[t])
+            return 0
+
+        jax.lax.fori_loop(0, t_block // tree_unroll, tree_group_body, 0)
+
+    return kernel
+
+
 def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
@@ -276,7 +513,7 @@ def _round_up(x: int, m: int) -> int:
     jax.jit,
     static_argnames=("operators", "t_block", "r_block", "interpret",
                      "slot_loop", "dispatch", "tree_unroll", "sort_trees",
-                     "compute_dtype"),
+                     "compute_dtype", "program"),
 )
 def eval_trees_pallas(
     trees: TreeBatch,
@@ -290,6 +527,7 @@ def eval_trees_pallas(
     tree_unroll: int = 4,
     sort_trees: bool = True,
     compute_dtype: str = "float32",
+    program: str = "postfix",
 ) -> Tuple[Array, Array]:
     """Evaluate a flat batch of trees over X (nfeat, nrows).
 
@@ -299,14 +537,28 @@ def eval_trees_pallas(
     compute_dtype="bfloat16" evaluates tree values in the TPU-native half
     precision (halved VMEM traffic per slot, f32 output/poison
     accumulation) — the bf16 analog of the reference's type-generic eval
-    (its Float16/32/64 sweeps, test/test_tree_construction.jl:96-145)."""
+    (its Float16/32/64 sweeps, test/test_tree_construction.jl:96-145).
+
+    program="instr" runs the compressed operator-only instruction program
+    (see `instruction_schedule`): ~half the steps per tree, leaves fetched
+    as operands instead of executed as slots. `slot_loop` applies to the
+    postfix program only."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if program not in ("postfix", "instr"):
+        raise ValueError(
+            f"program must be 'postfix' or 'instr', got {program!r}"
+        )
     batch_shape = trees.length.shape
     flat = jax.tree_util.tree_map(
         lambda x: x.reshape((-1,) + x.shape[len(batch_shape):]), trees
     )
+    if program == "instr":
+        return _eval_instr(
+            flat, X, operators, t_block, r_block, interpret, dispatch,
+            tree_unroll, sort_trees, compute_dtype, batch_shape,
+        )
     # Sort by length so (a) tree_unroll groups advance trees of matching
     # length (the group's dynamic slot loop runs to the max of the group)
     # and (b) grid blocks are length-homogeneous. Gather here, inverse
@@ -396,6 +648,108 @@ def eval_trees_pallas(
 
     y = y.reshape(T_pad, R_pad)[:T, :nrows]
     ok = (jnp.sum(bad[:, :T], axis=0) == 0) & (flat.length > 0)
+    if inv_perm is not None:
+        y = y[inv_perm]
+        ok = ok[inv_perm]
+    return (
+        y.reshape(batch_shape + (nrows,)),
+        ok.reshape(batch_shape),
+    )
+
+
+def _eval_instr(flat, X, operators, t_block, r_block, interpret, dispatch,
+                tree_unroll, sort_trees, compute_dtype, batch_shape):
+    """instr-program body of eval_trees_pallas (already flattened trees)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    tables, n_instr = instruction_schedule(flat, operators)
+    length = flat.length
+    # sort by instruction count: the analog of the postfix path's length
+    # sort (interleave groups + grid blocks stay work-homogeneous)
+    inv_perm = None
+    if sort_trees and length.shape[0] > 1:
+        perm = jnp.argsort(n_instr)
+        inv_perm = jnp.zeros_like(perm).at[perm].set(
+            jnp.arange(perm.shape[0], dtype=perm.dtype)
+        )
+        tables = {k: v[perm] for k, v in tables.items()}
+        n_instr = n_instr[perm]
+        length = length[perm]
+
+    T, L0 = tables["icode"].shape
+    L = _round_up(L0, _SLOT_UNROLL)
+    if L != L0:
+        tables = {
+            k: jnp.pad(v, ((0, 0), (0, L - L0)),
+                       constant_values=_SRC_CONST if k.endswith("src") else 0)
+            for k, v in tables.items()
+        }
+    nfeat, nrows = X.shape
+
+    t_block = min(t_block, _round_up(max(T, 8), tree_unroll))
+    r_block = min(r_block, _round_up(nrows, 128))
+    r_sub = r_block // 128
+    T_pad = _round_up(T, t_block)
+    R_pad = _round_up(nrows, r_block)
+    NR = R_pad // 128
+
+    def padT(x, fill=0):
+        return jnp.pad(x, ((0, T_pad - T), (0, 0)),
+                       constant_values=fill).T
+
+    cdt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[compute_dtype]
+    tbl = {
+        k: padT(v.astype(jnp.float32) if k.endswith("cval") else v,
+                _SRC_CONST if k.endswith("src") else 0)
+        for k, v in tables.items()
+    }
+    ninstr_p = jnp.pad(n_instr, (0, T_pad - T))[None, :]
+    Xp = jnp.pad(X.astype(cdt), ((0, 0), (0, R_pad - nrows)))
+    Xp = Xp.reshape(nfeat, NR, 128)
+    nrows_arr = jnp.asarray([nrows], jnp.int32)
+
+    kernel = _make_instr_kernel(operators, t_block, r_block, L, dispatch,
+                                tree_unroll, nfeat, cdt)
+
+    grid = (T_pad // t_block, NR // r_sub)
+    smem_spec = lambda shape, imap: pl.BlockSpec(
+        shape, imap, memory_space=pltpu.SMEM
+    )
+    tree_tbl = lambda: smem_spec((L, t_block), lambda i, j: (0, i))
+    y, bad = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # nrows scalar
+            tree_tbl(),  # icode
+            tree_tbl(),  # lsrc
+            tree_tbl(),  # lidx
+            tree_tbl(),  # lcval
+            tree_tbl(),  # rsrc
+            tree_tbl(),  # ridx
+            tree_tbl(),  # rcval
+            smem_spec((1, t_block), lambda i, j: (0, i)),  # n_instr
+            pl.BlockSpec((nfeat, r_sub, 128), lambda i, j: (0, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((t_block, r_sub, 128), lambda i, j: (i, j, 0)),
+            smem_spec((1, t_block), lambda i, j: (j, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((T_pad, NR, 128), jnp.float32),
+            jax.ShapeDtypeStruct((grid[1], T_pad), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((L, r_sub, 128), cdt)
+            for _ in range(tree_unroll)
+        ],
+        interpret=interpret,
+    )(nrows_arr, tbl["icode"], tbl["lsrc"], tbl["lidx"], tbl["lcval"],
+      tbl["rsrc"], tbl["ridx"], tbl["rcval"], ninstr_p, Xp)
+
+    y = y.reshape(T_pad, R_pad)[:T, :nrows]
+    ok = (jnp.sum(bad[:, :T], axis=0) == 0) & (length > 0)
     if inv_perm is not None:
         y = y[inv_perm]
         ok = ok[inv_perm]

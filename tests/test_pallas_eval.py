@@ -192,3 +192,89 @@ def test_pallas_bf16_auto_routing():
     X = jnp.asarray(rng.standard_normal((NFEAT, 16))).astype(jnp.bfloat16)
     y, ok = dispatch_eval(trees, X, OPS, backend="auto")
     assert y.shape == (4, 16)
+
+
+@pytest.mark.parametrize("tree_unroll", [1, 4])
+@pytest.mark.parametrize("sort_trees", [True, False])
+def test_instr_program_matches_jnp(rng, tree_unroll, sort_trees):
+    """The compressed operator-only instruction program (program='instr')
+    must reproduce the jnp interpreter bit-for-bit in ok and numerically
+    in y — including the operand-finiteness poison semantics (leaves are
+    operands there, not executed slots)."""
+    trees = batch(rng, 13)
+    X = jnp.asarray(
+        (rng.standard_normal((NFEAT, 50)) * 2).astype(np.float32)
+    )
+    y_ref, ok_ref = eval_trees(trees, X, OPS)
+    y, ok = eval_trees_pallas(
+        trees, X, OPS, t_block=8, r_block=128, interpret=True,
+        program="instr", tree_unroll=tree_unroll, sort_trees=sort_trees,
+    )
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+    m = np.asarray(ok_ref)
+    np.testing.assert_allclose(
+        np.asarray(y)[m], np.asarray(y_ref)[m], rtol=1e-5, atol=1e-5
+    )
+
+
+def test_instr_program_bare_leaves_and_unary_chains(rng):
+    """Edge shapes of the compressed program: bare-leaf trees run one
+    synthetic IDENT instruction; pure unary chains compress to length-1
+    programs... of nearly the tree's own length (no leaves to drop)."""
+    from symbolicregression_jl_tpu.models.trees import Expr
+
+    chain = Expr.var(0)
+    for _ in range(9):
+        chain = Expr.unary(0, chain)  # cos^9(x0)
+    trees = stack_trees([
+        encode_tree(Expr.const(2.5), L),
+        encode_tree(Expr.var(1), L),
+        encode_tree(chain, L),
+    ])
+    X = jnp.asarray(
+        (rng.standard_normal((NFEAT, 40))).astype(np.float32)
+    )
+    y_ref, ok_ref = eval_trees(trees, X, OPS)
+    y, ok = eval_trees_pallas(
+        trees, X, OPS, t_block=8, r_block=128, interpret=True,
+        program="instr",
+    )
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(y_ref), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_instr_program_infinite_operand_poison(rng):
+    """relu(-inf) = 0 is finite, but the tree must still be flagged not-ok
+    (the jnp interpreter poisons the leaf slot; the instr kernel must
+    poison via the operand check)."""
+    ops = make_operator_set(["+"], ["relu"])
+    from symbolicregression_jl_tpu.models.trees import Expr
+
+    e = Expr.unary(0, Expr.const(float("-inf")))
+    trees = stack_trees([encode_tree(e, L)])
+    X = jnp.asarray(np.ones((1, 30), np.float32))
+    y_ref, ok_ref = eval_trees(trees, X, ops)
+    y, ok = eval_trees_pallas(
+        trees, X, ops, t_block=8, r_block=128, interpret=True,
+        program="instr",
+    )
+    assert not bool(ok[0])
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+
+
+def test_instruction_schedule_compression(rng):
+    """Instruction count equals the number of operator nodes (>=1 for any
+    nonempty tree), always <= postfix length."""
+    from symbolicregression_jl_tpu.ops.pallas_eval import (
+        instruction_schedule,
+    )
+
+    trees = batch(rng, 16)
+    tables, n_instr = instruction_schedule(trees, OPS)
+    kind = np.asarray(trees.kind)
+    n_ops = ((kind == 3) | (kind == 4)).sum(axis=-1)
+    expect = np.maximum(n_ops, 1)
+    np.testing.assert_array_equal(np.asarray(n_instr), expect)
+    assert tables["icode"].shape == trees.kind.shape
