@@ -459,7 +459,11 @@ def equation_search(
     recorder = Recorder(options, variable_names) if record_here else None
     total_its = niterations * max(ys.shape[0], 1)
     progress = SearchProgress(total_its, options)
-    bar = ProgressBar(total_its)
+    bar = (
+        ProgressBar(total_its, width=options.terminal_width)
+        if options.terminal_width
+        else ProgressBar(total_its)
+    )
     monitor = ResourceMonitor()
     # 'q'-to-quit is single-controller only: on multi-host SPMD a break taken
     # on host 0 alone would desync the collective-issuing host loops.
@@ -563,7 +567,8 @@ def equation_search(
                         states.pop.birth[isl],
                         mut_counts=states.mut_counts[isl],
                     )
-            if options.output_file and is_primary_host():
+            if (options.output_file and options.save_to_file
+                    and is_primary_host()):
                 path = options.output_file
                 if multi:
                     path = _multi_output_path(path, j)

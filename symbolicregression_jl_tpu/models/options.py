@@ -15,6 +15,7 @@ Static (hashable) so an Options instance can close over jitted functions.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -180,6 +181,19 @@ class Options:
     verbosity: int = 1
     progress: bool = True
     output_file: Optional[str] = None
+    # Gate for the hall-of-fame CSV double-write (reference save_to_file,
+    # src/Options.jl:285,353): False keeps output_file configured but
+    # suppresses the writes.
+    save_to_file: bool = True
+    # Progress-bar width in characters (reference terminal_width,
+    # src/Options.jl:359); None = the default width.
+    terminal_width: Optional[int] = None
+    # Reference define_helper_functions (src/Options.jl:312-376) `eval`s
+    # operator helpers into Julia's Main for REPL tree-calling. Operators
+    # here are plain Python callables already importable from
+    # ops.operators, so the knob is accepted for drop-in migration and has
+    # nothing to do.
+    define_helper_functions: bool = True
     recorder: bool = False
     recorder_file: str = "pysr_recorder.json"
     # --- TPU-native knobs (no reference analog; replace Distributed.jl) ---
@@ -353,6 +367,41 @@ def make_options(**kwargs) -> Options:
     if "turbo" in remapped:
         turbo = remapped.pop("turbo")
         remapped.setdefault("eval_backend", "auto" if turbo else "jnp")
+    # Recorder defaults from the environment like the reference
+    # (src/Options.jl:597-599): unset kwarg + PYSR_RECORDER=1 turns it on.
+    if "recorder" not in remapped and os.environ.get("PYSR_RECORDER") == "1":
+        remapped["recorder"] = True
+    # The reference renamed `loss` -> `elementwise_loss`
+    # (src/Options.jl:142,319); both name the same elementwise-loss knob.
+    if "elementwise_loss" in remapped:
+        if "loss" in remapped:
+            raise ValueError("Pass either loss= or elementwise_loss=, not both")
+        remapped["loss"] = remapped.pop("elementwise_loss")
+    # Split per-arity constraint kwargs (reference una_constraints /
+    # bin_constraints, src/Options.jl:33-84) merge into the unified
+    # `constraints` mapping. Dict form only — the reference's positional
+    # list form is ordered by its operator tuple, which invites silent
+    # misalignment; a dict says what it means.
+    for k in ("una_constraints", "bin_constraints"):
+        if k in remapped:
+            extra = remapped.pop(k)
+            if extra is None:
+                continue
+            if not isinstance(extra, dict):
+                raise ValueError(
+                    f"{k} must be a dict of operator-name -> constraint "
+                    "(the reference's positional-list form is not supported;"
+                    " name the operators)"
+                )
+            merged = dict(remapped.get("constraints") or {})
+            for op, spec in extra.items():
+                if op in merged:
+                    raise ValueError(
+                        f"operator {op!r} constrained in both constraints= "
+                        f"and {k}="
+                    )
+                merged[op] = spec
+            remapped["constraints"] = merged
     if isinstance(remapped.get("mutation_weights"), (list, tuple)):
         remapped["mutation_weights"] = MutationWeights(*remapped["mutation_weights"])
     elif isinstance(remapped.get("mutation_weights"), dict):

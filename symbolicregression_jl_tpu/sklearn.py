@@ -26,7 +26,10 @@ def _valid_option_keys() -> set:
 
     keys = {f.name for f in dataclasses.fields(Options)}
     keys.update(_DEPRECATED_KWARGS)
-    keys.add("turbo")
+    # make_options-level remaps (not Options fields themselves)
+    keys.update(
+        ("turbo", "elementwise_loss", "una_constraints", "bin_constraints")
+    )
     return keys
 
 

@@ -434,3 +434,58 @@ def test_search_state_disk_roundtrip(rng, tmp_path):
         f.write(b"garbage")
     with pytest.raises(ValueError, match="unreadable"):
         sr.load_search_state(path)
+
+
+def test_reference_option_kwargs_parity():
+    """The remaining reference Options kwargs accepted for drop-in
+    migration: elementwise_loss (the reference's rename of loss,
+    src/Options.jl:142,319), una_constraints/bin_constraints dicts merged
+    into the unified constraints mapping (src/Options.jl:33-84), plus the
+    save_to_file / terminal_width / define_helper_functions knobs."""
+    o = make_options(
+        binary_operators=["+", "*", "^"],
+        unary_operators=["cos", "exp"],
+        elementwise_loss="L1DistLoss",
+        una_constraints={"exp": 5},
+        bin_constraints={"^": (3, 1)},
+        save_to_file=False,
+        terminal_width=72,
+        define_helper_functions=False,
+    )
+    assert o.loss == "L1DistLoss"
+    cons = dict(o.constraints)
+    assert cons["exp"] == 5 and tuple(cons["^"]) == (3, 1)
+    assert o.save_to_file is False and o.terminal_width == 72
+
+    with pytest.raises(ValueError, match="not both"):
+        make_options(binary_operators=["+"], loss="L1DistLoss",
+                     elementwise_loss="L2DistLoss")
+    with pytest.raises(ValueError, match="constrained in both"):
+        make_options(binary_operators=["+"], unary_operators=["exp"],
+                     constraints={"exp": 4}, una_constraints={"exp": 5})
+    with pytest.raises(ValueError, match="dict"):
+        make_options(binary_operators=["+"], bin_constraints=[(3, 1)])
+
+
+def test_save_to_file_false_suppresses_csv(tmp_path):
+    """save_to_file=False keeps output_file configured but writes nothing
+    (reference src/Options.jl:285)."""
+    X = np.random.default_rng(0).standard_normal((2, 30)).astype(np.float32)
+    y = X[0] + X[1]
+    path = str(tmp_path / "hof.csv")
+    res = sr.equation_search(
+        X, y, niterations=1, seed=0, output_file=path, save_to_file=False,
+        **TINY,
+    )
+    assert res.best() is not None
+    assert not os.path.exists(path) and not os.path.exists(path + ".bkup")
+
+
+def test_recorder_env_default(monkeypatch):
+    """Unset recorder kwarg defaults from PYSR_RECORDER=1 like the
+    reference (src/Options.jl:597-599); an explicit kwarg wins."""
+    monkeypatch.setenv("PYSR_RECORDER", "1")
+    assert make_options(binary_operators=["+"]).recorder is True
+    assert make_options(binary_operators=["+"], recorder=False).recorder is False
+    monkeypatch.delenv("PYSR_RECORDER")
+    assert make_options(binary_operators=["+"]).recorder is False

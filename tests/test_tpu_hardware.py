@@ -126,3 +126,39 @@ def test_compiled_kernel_bf16_on_chip(tpu_ready):
     np.testing.assert_allclose(
         np.asarray(y)[both], np.asarray(y_ref)[both], rtol=0.1, atol=0.1
     )
+
+
+def test_compiled_instr_program_on_chip(tpu_ready):
+    """The compressed instruction program, Mosaic-compiled, must match the
+    jnp interpreter on hardware (its interpret-mode parity lives in
+    test_pallas_eval.py; Mosaic can diverge from interpret mode)."""
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.models.mutate_device import (
+        gen_random_tree_fixed_size,
+    )
+    from symbolicregression_jl_tpu.ops.interpreter import eval_trees
+    from symbolicregression_jl_tpu.ops.operators import make_operator_set
+    from symbolicregression_jl_tpu.ops.pallas_eval import eval_trees_pallas
+
+    ops = make_operator_set(["+", "-", "*", "/"], ["cos", "exp", "sqrt", "log"])
+    n, L = 1024, 24
+    sizes = jax.random.randint(jax.random.PRNGKey(1), (n,), 1, 20)
+    trees = jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(k, s, 4, ops, L)
+    )(jax.random.split(jax.random.PRNGKey(0), n), sizes)
+    X = jax.random.normal(jax.random.PRNGKey(2), (4, 1000), jnp.float32) * 2
+
+    y_ref, ok_ref = jax.device_get(eval_trees(trees, X, ops))
+    for unroll in (4, 16):
+        y, ok = jax.device_get(
+            eval_trees_pallas(trees, X, ops, program="instr",
+                              tree_unroll=unroll)
+        )
+        np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+        m = np.asarray(ok_ref)
+        np.testing.assert_allclose(
+            np.asarray(y)[m], np.asarray(y_ref)[m], rtol=1e-4, atol=1e-4,
+            err_msg=f"tree_unroll={unroll}",
+        )
