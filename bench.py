@@ -35,6 +35,12 @@ MAXSIZE = 20
 CHUNK = 8192
 REPS = 3
 
+# Max relative per-tree loss deviation accepted as "parity", shared by the
+# verdict in main() and the conditioning filter in _mse_parity (the filter
+# admits a tree only when f32 arithmetic can intrinsically deliver this
+# tolerance, so the two must move together).
+PARITY_TOL = 1e-3
+
 
 def _build_workload(jax, jnp, options, n_trees, n_feat):
     from symbolicregression_jl_tpu.models.mutate_device import (
@@ -173,28 +179,109 @@ def _native_cpu_anchor(jax, options, n_trees, verbose):
 def _mse_parity(jax, jnp, options, device, n_check, verbose):
     """North-star requires MSE *parity*, not just throughput: the TPU
     kernel's per-tree losses must match the CPU reference interpreter's.
-    Returns max relative |loss_dev - loss_cpu| over finite-on-both trees."""
+
+    Parity is only meaningful on trees whose evaluation is numerically
+    *stable* in float32. Random workloads contain ill-conditioned trees —
+    e.g. `const / cos(exp(exp(exp(c))))`, where a few-ULP difference in a
+    transcendental upstream rotates the cosine argument by radians, so
+    every correct implementation (numpy f32, numpy f64, XLA-CPU, TPU)
+    returns a different answer; milder cases like `cos(260.3*...)`
+    amplify exp's last-ULP variation ~1000x into percent-level loss
+    shifts. Those are excluded by an implementation-independent condition
+    test: the f64 numpy-oracle loss is re-evaluated with f32-ULP-scale
+    (3e-7) random relative perturbations of the constants and inputs, and
+    a tree counts as stable only when 10x its observed loss spread stays
+    under the parity tolerance — i.e. parity is demanded exactly where
+    f32 arithmetic itself can deliver it. Returns max relative
+    |loss_dev - loss_cpu| over stable finite-on-both trees."""
     from symbolicregression_jl_tpu.models.fitness import score_trees
+    from symbolicregression_jl_tpu.ops.eval_numpy import eval_tree_numpy
 
     X_h, y_h = _feynman_data()
     baseline = float(np.var(y_h))
 
+    # one workload, built once on CPU, shipped verbatim to both backends
+    with jax.default_device(jax.devices("cpu")[0]):
+        trees = _build_workload(jax, jnp, options, n_check, 1)
+    trees_h = jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x)), trees
+    )
+
     def losses_on(dev):
-        # identical workload on both devices (same PRNG keys); 'auto'
-        # dispatch routes to the Pallas kernel on TPU and the jnp lockstep
-        # interpreter under a CPU default_device
+        # 'auto' dispatch routes to the Pallas kernel on TPU and the jnp
+        # lockstep interpreter under a CPU default_device
         with jax.default_device(dev):
-            trees = _build_workload(jax, jnp, options, n_check, 1)
+            tt = jax.tree_util.tree_map(jnp.asarray, trees_h)
             _, losses = score_trees(
-                trees, jnp.asarray(X_h), jnp.asarray(y_h), None,
+                tt, jnp.asarray(X_h), jnp.asarray(y_h), None,
                 jnp.float32(baseline), options,
             )
             return np.asarray(jax.device_get(losses))
 
     l_dev = losses_on(device)
     l_cpu = losses_on(jax.devices("cpu")[0])
-    both = np.isfinite(l_dev) & np.isfinite(l_cpu)
-    agree_finite = float(np.mean(np.isfinite(l_dev) == np.isfinite(l_cpu)))
+
+    # f32-conditioning filter via the jax-free f64 numpy oracle:
+    # tol_i = max loss spread under K perturbations of relative size EPS
+    X64 = X_h.astype(np.float64)
+    y64 = y_h.astype(np.float64)
+    rng = np.random.default_rng(42)
+    EPS, K, SAFETY, TOL = 3e-7, 3, 10.0, PARITY_TOL
+
+    def oracle_loss(t_i, Xp, yd):
+        with np.errstate(all="ignore"):
+            y_pred, complete = eval_tree_numpy(t_i, Xp, options.operators)
+            return (
+                float(np.mean((y_pred - yd) ** 2))
+                if complete else np.inf
+            )
+
+    def perturb(a):
+        if np.issubdtype(a.dtype, np.floating):
+            return a * (1.0 + EPS * rng.standard_normal(a.shape))
+        return a
+
+    # three per-tree classes: `stable` (value parity demanded), `poisoned`
+    # (f32 oracle hits a NaN/Inf domain — finiteness parity demanded: a
+    # backend that silently un-poisons a tree must not escape the check),
+    # and the ill-conditioned remainder (excluded, counted separately)
+    stable = np.zeros(n_check, bool)
+    poisoned = np.zeros(n_check, bool)
+    for i in range(n_check):
+        t_i = jax.tree_util.tree_map(lambda x: x[i], trees_h)
+        poisoned[i] = not np.isfinite(oracle_loss(t_i, X_h, y_h))
+        base = oracle_loss(t_i, X64, y64)
+        if not np.isfinite(base):
+            continue
+        spread = 0.0
+        for _ in range(K):
+            lk = oracle_loss(
+                jax.tree_util.tree_map(perturb, t_i), perturb(X64), y64
+            )
+            if not np.isfinite(lk):
+                spread = np.inf
+                break
+            spread = max(
+                spread, abs(lk - base) / max(abs(base), 1e-6)
+            )
+        # an f32-poisoned tree can't be value-compared even if its f64
+        # evaluation is stable (borderline overflow): classes stay disjoint
+        stable[i] = (SAFETY * spread < TOL) and not poisoned[i]
+
+    both = np.isfinite(l_dev) & np.isfinite(l_cpu) & stable
+    # finiteness must match the ORACLE wherever it gives a decisive
+    # answer — finite on stable trees, non-finite on poisoned trees —
+    # so a shared backend defect that un-poisons a tree can't slip
+    # through by agreeing with itself; only the ill-conditioned middle
+    # ground — e.g. overflow within ULPs of the f32 cutoff — is exempt
+    decisive = stable | poisoned
+    expect_finite = stable[decisive]
+    agree_finite = float(
+        np.mean(
+            (np.isfinite(l_dev[decisive]) == expect_finite)
+            & (np.isfinite(l_cpu[decisive]) == expect_finite)
+        )
+    ) if decisive.any() else float("nan")
     rel = np.abs(l_dev[both] - l_cpu[both]) / np.maximum(
         np.abs(l_cpu[both]), 1e-6
     )
@@ -203,8 +290,12 @@ def _mse_parity(jax, jnp, options, device, n_check, verbose):
     enough = rel.size >= 100
     max_rel = float(rel.max()) if enough else float("nan")
     if verbose:
+        n_illcond = int(n_check - stable.sum() - poisoned.sum())
         print(
-            f"# MSE parity vs CPU interpreter: {int(both.sum())} trees, "
+            f"# MSE parity vs CPU interpreter: {int(both.sum())} stable "
+            f"trees compared ({int(poisoned.sum())} oracle-poisoned held "
+            f"to finiteness parity only, {n_illcond} f32-ill-conditioned "
+            "excluded by oracle perturbation test), "
             f"max rel dev {max_rel:.2e}, finite-mask agreement "
             f"{agree_finite:.4f}",
             file=sys.stderr,
@@ -549,7 +640,7 @@ def main(verbose=True):
             )
             if max_rel is None:
                 verdict = "INSUFFICIENT-SAMPLE"
-            elif max_rel < 1e-3 and agree > 0.999:
+            elif max_rel < PARITY_TOL and agree > 0.999:
                 verdict = "OK"
             else:
                 verdict = "MISMATCH"
