@@ -132,6 +132,13 @@ def main():
                          sort_trees=True, program="instr"))
     grid.append(dict(dispatch="mux", tree_unroll=8, sort_trees=True,
                      program="instr", compute_dtype="bfloat16"))
+    # packed-word instr kernel: 3 SMEM reads/step instead of 7 + unified
+    # operand scratch — relief for the per-slot scalar-unit bound
+    for unroll in (4, 8, 16):
+        grid.append(dict(dispatch="mux", tree_unroll=unroll,
+                         sort_trees=True, program="instr_packed"))
+    grid.append(dict(dispatch="mux", tree_unroll=8, sort_trees=True,
+                     program="instr_packed", t_block=512))
 
     if tail_n is not None:  # only the last N grid entries (quick probes)
         grid = grid[-tail_n:]
@@ -160,7 +167,7 @@ def main():
         from symbolicregression_jl_tpu.ops.pallas_eval import _SLOT_UNROLL
 
         program = best_kw.get("program", "postfix")
-        if program == "instr":
+        if program.startswith("instr"):
             from symbolicregression_jl_tpu.ops.pallas_eval import (
                 instruction_schedule,
             )

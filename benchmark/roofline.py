@@ -75,8 +75,12 @@ def ops_per_slot(operators, program: str = "postfix") -> float:
     )
     n_codes = (3 if program == "postfix" else 2) + len(names)
     mux = math.ceil(math.log2(max(n_codes, 2)))  # balanced select tree
-    if program == "instr":
-        fetch = 10.0  # 2 operands x (2 dynamic loads + 2 selects + bcast)
+    if program in ("instr", "instr_packed"):
+        # instr: 2 operands x (2 dynamic loads + 2 selects + bcast);
+        # instr_packed's unified operand scratch drops one dynamic load
+        # per operand — its bigger win (one packed SMEM word per step) is
+        # scalar-unit relief the vector-issue bound can't see
+        fetch = 10.0 if program == "instr" else 6.0
         poison = 4.0  # isfinite(v,a,b) + and + max accumulate
         return compute + mux + fetch + poison
     leaf = 2.0  # const broadcast + var pick
@@ -102,10 +106,10 @@ def kernel_roofline(
     per_slot = ops_per_slot(operators, program)
     issue_bound = vpu_ops / (per_slot * avg_tree_len)
     bytes_per = 4 if compute_dtype == "float32" else 2
-    # postfix: 2 scratch reads + 1 write per slot per row. instr: the
-    # branchless operand fetch materializes BOTH dynamic loads per operand
-    # (scratch + X) -> 4 reads + 1 write per step per row.
-    accesses = 3 if program == "postfix" else 5
+    # per step per row — postfix: 2 scratch reads + 1 write. instr: both
+    # dynamic loads per operand materialize (scratch + X) -> 4 reads +
+    # 1 write. instr_packed: 1 unified-scratch read per operand -> 2 + 1.
+    accesses = {"postfix": 3, "instr": 5, "instr_packed": 3}[program]
     vmem_bound = vmem_bw / (accesses * bytes_per * avg_tree_len)
     return {
         "ops_per_slot": per_slot,

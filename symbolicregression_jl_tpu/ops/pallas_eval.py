@@ -274,6 +274,46 @@ def instruction_schedule(trees: TreeBatch, operators: OperatorSet):
     return tables, n_instr
 
 
+def pack_instr_tables(tables, nfeat: int):
+    """Pack the instr program's five integer tables into ONE int32 word per
+    step, and unify result/feature operand indices into a single address
+    space (see _make_instr_kernel with packed=True).
+
+    Per step the packed kernel reads 3 SMEM scalars (word, lcval, rcval)
+    instead of 7 — the per-slot scalar-unit work (loads + addressing) is
+    what bounds the interpreter once trees are interleaved, so shrinking
+    it matters more than any vector-side tweak.
+
+    Unified operand space: scratch slot f in [0, nfeat) holds feature f
+    (preloaded once per grid cell), slot nfeat+k holds instruction k's
+    result. A _SRC_VAR operand becomes idx=feat, a _SRC_RES operand
+    becomes idx=nfeat+k, and only _SRC_CONST keeps a flag bit.
+
+    Word layout (32 bits): icode[0:8] | lconst[8] | rconst[9] |
+    lidx[10:21] | ridx[21:32]. Requires icode < 256 and
+    nfeat + max_len <= 2048 (11-bit indices) — checked by the caller.
+    """
+    icode = tables["icode"]
+    lconst = (tables["lsrc"] == _SRC_CONST).astype(jnp.int32)
+    rconst = (tables["rsrc"] == _SRC_CONST).astype(jnp.int32)
+    lidx = jnp.where(
+        tables["lsrc"] == _SRC_RES, nfeat + tables["lidx"],
+        jnp.where(tables["lsrc"] == _SRC_VAR, tables["lidx"], 0),
+    )
+    ridx = jnp.where(
+        tables["rsrc"] == _SRC_RES, nfeat + tables["ridx"],
+        jnp.where(tables["rsrc"] == _SRC_VAR, tables["ridx"], 0),
+    )
+    word = (
+        icode
+        | (lconst << 8)
+        | (rconst << 9)
+        | (lidx << 10)
+        | (ridx << 21)
+    ).astype(jnp.int32)
+    return word
+
+
 def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                  max_len: int, slot_loop: str, dispatch: str,
                  tree_unroll: int, compute_dtype=jnp.float32):
@@ -396,14 +436,24 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
 
 def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
                        max_len: int, dispatch: str, tree_unroll: int,
-                       nfeat: int, compute_dtype=jnp.float32):
+                       nfeat: int, compute_dtype=jnp.float32,
+                       packed: bool = False):
     """Kernel for the compressed instruction program (instruction_schedule).
 
     Same layout discipline as `_make_kernel` (SMEM transposed tables, VMEM
     row tiles, tree interleaving); differs per step: operands are fetched
-    through a source mux (result / feature / constant) instead of always
-    from the value scratch, and only operator nodes execute, so programs
-    are ~half as long and leaves never pay the candidate mux."""
+    as data (result / feature / constant) instead of always from the value
+    scratch, and only operator nodes execute, so programs are ~half as
+    long and leaves never pay the candidate mux.
+
+    packed=False: five integer SMEM tables; each operand materializes all
+    three candidate sources behind a 2-deep select.
+    packed=True (see pack_instr_tables): one packed int32 word per step
+    (3 SMEM reads instead of 7) and a unified operand scratch — features
+    preloaded at [0, nfeat), results at nfeat+k — so each operand is one
+    dynamic VMEM load plus a constant select. Both are scalar-unit
+    relief: per-step scalar loads/addressing, not vector issue, bound the
+    interpreter once enough trees are interleaved."""
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
     if dispatch not in ("mux", "chain"):
@@ -419,50 +469,30 @@ def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
     U = len(unary_fns)
     r_sub = r_block // 128
     cdt = compute_dtype
+    base = nfeat if packed else 0  # scratch offset of instruction results
 
-    def kernel(nrows_ref, icode_ref,
-               lsrc_ref, lidx_ref, lcval_ref,
-               rsrc_ref, ridx_ref, rcval_ref,
-               ninstr_ref,
-               X_ref, out_ref, bad_ref,
-               *val_refs):
-        sub = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 0)
-        lane = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 1)
-        row = (pl.program_id(1) * r_sub + sub) * 128 + lane
-        valid_f = jnp.where(row < nrows_ref[0], 1.0, 0.0)
+    def dispatch_value(code, a, b):
+        """Branchless candidate dispatch over the instruction opcodes
+        (shared by both table layouts)."""
+        if dispatch == "chain":
+            v = a
+            for j, fn in enumerate(unary_fns):
+                v = jnp.where(code == 2 + j, fn(a), v)
+            for j, fn in enumerate(binary_fns):
+                v = jnp.where(code == 2 + U + j, fn(b, a), v)
+            return v
+        cands = [a, a]  # DEAD (dead), IDENT
+        cands += [fn(a) for fn in unary_fns]
+        cands += [fn(b, a) for fn in binary_fns]
+        return _balanced_mux(code, cands)
 
-        def fetch(src, idx, cv, val_ref):
-            """Source mux: previous result / feature column / constant.
-            All three candidates are materialized (branchless); the two
-            dynamic reads are clipped to their arrays' bounds so dead
-            sources read harmless garbage."""
-            v_res = val_ref[jnp.minimum(idx, max_len - 1)]
-            v_var = X_ref[jnp.minimum(idx, nfeat - 1)]
-            v_cv = jnp.full((r_sub, 128), cv, cdt)
-            return jnp.where(
-                src == _SRC_RES, v_res,
-                jnp.where(src == _SRC_VAR, v_var, v_cv),
-            )
+    def make_body(read_operands, val_refs, valid_f):
+        """The per-step body around a layout-specific operand reader."""
 
         def instr_body(si, ti, bad, val_ref):
-            code = icode_ref[si, ti]
-            a = fetch(rsrc_ref[si, ti], ridx_ref[si, ti],
-                      rcval_ref[si, ti], val_ref)
-            b = fetch(lsrc_ref[si, ti], lidx_ref[si, ti],
-                      lcval_ref[si, ti], val_ref)
-            if dispatch == "chain":
-                v = a
-                for j, fn in enumerate(unary_fns):
-                    v = jnp.where(code == 2 + j, fn(a), v)
-                for j, fn in enumerate(binary_fns):
-                    v = jnp.where(code == 2 + U + j, fn(b, a), v)
-            else:
-                cands = [a, a]  # DEAD (dead), IDENT
-                cands += [fn(a) for fn in unary_fns]
-                cands += [fn(b, a) for fn in binary_fns]
-                v = _balanced_mux(code, cands)
-            v = v.astype(cdt)
-            val_ref[si] = v
+            code, a, b = read_operands(si, ti, val_ref)
+            v = dispatch_value(code, a, b).astype(cdt)
+            val_ref[base + si] = v
             # operand finiteness matters too: the postfix kernel checks
             # every leaf slot's value, so a tree whose op maps an Inf
             # operand back to a finite result (relu(-inf)=0) must still
@@ -472,6 +502,10 @@ def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
                 bad, jnp.where(fin | (code == 0), 0.0, valid_f)
             )
 
+        return instr_body
+
+    def run_groups(instr_body, ninstr_ref, out_ref, bad_ref, val_refs):
+        """Interleaved tree-group loop shared by both layouts."""
         zero = jnp.zeros((r_sub, 128), jnp.float32)
 
         def tree_group_body(p, _):
@@ -495,12 +529,84 @@ def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
             )
             for t in range(tree_unroll):
                 out_ref[tis[t]] = val_refs[t][
-                    jnp.maximum(ns[t] - 1, 0)
+                    base + jnp.maximum(ns[t] - 1, 0)
                 ].astype(jnp.float32)
                 bad_ref[0, tis[t]] = jnp.sum(bads[t])
             return 0
 
         jax.lax.fori_loop(0, t_block // tree_unroll, tree_group_body, 0)
+
+    def valid_rows(nrows_ref):
+        sub = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 0)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 1)
+        row = (pl.program_id(1) * r_sub + sub) * 128 + lane
+        return jnp.where(row < nrows_ref[0], 1.0, 0.0)
+
+    if packed:
+        def kernel(nrows_ref, word_ref, lcval_ref, rcval_ref, ninstr_ref,
+                   X_ref, out_ref, bad_ref, *val_refs):
+            valid_f = valid_rows(nrows_ref)
+            # preload features into every interleave slot's scratch once
+            # per grid cell; instruction results only ever write at
+            # nfeat+k so these stay valid across all tree groups
+            for f in range(nfeat):
+                xf = X_ref[f]
+                for t in range(tree_unroll):
+                    val_refs[t][f] = xf
+
+            def read_operands(si, ti, val_ref):
+                w = word_ref[si, ti]
+                code = w & 0xFF
+                lconst = (w >> 8) & 1
+                rconst = (w >> 9) & 1
+                lidx = (w >> 10) & 0x7FF
+                ridx = (w >> 21) & 0x7FF
+                acv = jnp.full((r_sub, 128), rcval_ref[si, ti], cdt)
+                bcv = jnp.full((r_sub, 128), lcval_ref[si, ti], cdt)
+                a = jnp.where(rconst == 1, acv, val_ref[ridx])
+                b = jnp.where(lconst == 1, bcv, val_ref[lidx])
+                return code, a, b
+
+            run_groups(
+                make_body(read_operands, val_refs, valid_f),
+                ninstr_ref, out_ref, bad_ref, val_refs,
+            )
+
+        return kernel
+
+    def kernel(nrows_ref, icode_ref,
+               lsrc_ref, lidx_ref, lcval_ref,
+               rsrc_ref, ridx_ref, rcval_ref,
+               ninstr_ref,
+               X_ref, out_ref, bad_ref,
+               *val_refs):
+        valid_f = valid_rows(nrows_ref)
+
+        def fetch(src, idx, cv, val_ref):
+            """Source mux: previous result / feature column / constant.
+            All three candidates are materialized (branchless); the two
+            dynamic reads are clipped to their arrays' bounds so dead
+            sources read harmless garbage."""
+            v_res = val_ref[jnp.minimum(idx, max_len - 1)]
+            v_var = X_ref[jnp.minimum(idx, nfeat - 1)]
+            v_cv = jnp.full((r_sub, 128), cv, cdt)
+            return jnp.where(
+                src == _SRC_RES, v_res,
+                jnp.where(src == _SRC_VAR, v_var, v_cv),
+            )
+
+        def read_operands(si, ti, val_ref):
+            code = icode_ref[si, ti]
+            a = fetch(rsrc_ref[si, ti], ridx_ref[si, ti],
+                      rcval_ref[si, ti], val_ref)
+            b = fetch(lsrc_ref[si, ti], lidx_ref[si, ti],
+                      lcval_ref[si, ti], val_ref)
+            return code, a, b
+
+        run_groups(
+            make_body(read_operands, val_refs, valid_f),
+            ninstr_ref, out_ref, bad_ref, val_refs,
+        )
 
     return kernel
 
@@ -541,23 +647,28 @@ def eval_trees_pallas(
 
     program="instr" runs the compressed operator-only instruction program
     (see `instruction_schedule`): ~half the steps per tree, leaves fetched
-    as operands instead of executed as slots. `slot_loop` applies to the
-    postfix program only."""
+    as operands instead of executed as slots. program="instr_packed" is
+    the same program through one packed int32 SMEM word per step and a
+    unified operand scratch (see `pack_instr_tables`) — scalar-unit
+    relief; requires <=255 opcodes and nfeat+max_len <= ~2048 (raises
+    otherwise). `slot_loop` applies to the postfix program only."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    if program not in ("postfix", "instr"):
+    if program not in ("postfix", "instr", "instr_packed"):
         raise ValueError(
-            f"program must be 'postfix' or 'instr', got {program!r}"
+            "program must be 'postfix', 'instr' or 'instr_packed', "
+            f"got {program!r}"
         )
     batch_shape = trees.length.shape
     flat = jax.tree_util.tree_map(
         lambda x: x.reshape((-1,) + x.shape[len(batch_shape):]), trees
     )
-    if program == "instr":
+    if program in ("instr", "instr_packed"):
         return _eval_instr(
             flat, X, operators, t_block, r_block, interpret, dispatch,
             tree_unroll, sort_trees, compute_dtype, batch_shape,
+            packed=(program == "instr_packed"),
         )
     # Sort by length so (a) tree_unroll groups advance trees of matching
     # length (the group's dynamic slot loop runs to the max of the group)
@@ -658,10 +769,31 @@ def eval_trees_pallas(
 
 
 def _eval_instr(flat, X, operators, t_block, r_block, interpret, dispatch,
-                tree_unroll, sort_trees, compute_dtype, batch_shape):
-    """instr-program body of eval_trees_pallas (already flattened trees)."""
+                tree_unroll, sort_trees, compute_dtype, batch_shape,
+                packed=False):
+    """instr-program body of eval_trees_pallas (already flattened trees).
+
+    packed=True runs the packed-word kernel (pack_instr_tables /
+    _make_instr_packed_kernel): 3 SMEM reads per step instead of 7 and a
+    unified operand scratch — the scalar-unit-relief variant."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if packed:
+        # the packed word has 8-bit opcodes and 11-bit operand indices;
+        # an explicit program='instr_packed' request that doesn't fit must
+        # fail loudly (a silent fallback would mislabel benchmark and
+        # roofline results) — callers wanting resilience use 'instr'
+        n_codes = 2 + operators.n_unary + operators.n_binary
+        if n_codes > 255 or (
+            X.shape[0] + flat.kind.shape[-1] + _SLOT_UNROLL > 2048
+        ):
+            raise ValueError(
+                "program='instr_packed' needs <=255 opcodes and "
+                "nfeat + max_len <= ~2048 (got "
+                f"{n_codes} opcodes, nfeat={X.shape[0]}, "
+                f"max_len={flat.kind.shape[-1]}); use program='instr'"
+            )
 
     tables, n_instr = instruction_schedule(flat, operators)
     length = flat.length
@@ -709,29 +841,12 @@ def _eval_instr(flat, X, operators, t_block, r_block, interpret, dispatch,
     Xp = Xp.reshape(nfeat, NR, 128)
     nrows_arr = jnp.asarray([nrows], jnp.int32)
 
-    kernel = _make_instr_kernel(operators, t_block, r_block, L, dispatch,
-                                tree_unroll, nfeat, cdt)
-
     grid = (T_pad // t_block, NR // r_sub)
     smem_spec = lambda shape, imap: pl.BlockSpec(
         shape, imap, memory_space=pltpu.SMEM
     )
     tree_tbl = lambda: smem_spec((L, t_block), lambda i, j: (0, i))
-    y, bad = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # nrows scalar
-            tree_tbl(),  # icode
-            tree_tbl(),  # lsrc
-            tree_tbl(),  # lidx
-            tree_tbl(),  # lcval
-            tree_tbl(),  # rsrc
-            tree_tbl(),  # ridx
-            tree_tbl(),  # rcval
-            smem_spec((1, t_block), lambda i, j: (0, i)),  # n_instr
-            pl.BlockSpec((nfeat, r_sub, 128), lambda i, j: (0, j, 0)),
-        ],
+    common_out = dict(
         out_specs=[
             pl.BlockSpec((t_block, r_sub, 128), lambda i, j: (i, j, 0)),
             smem_spec((1, t_block), lambda i, j: (j, i)),
@@ -740,13 +855,58 @@ def _eval_instr(flat, X, operators, t_block, r_block, interpret, dispatch,
             jax.ShapeDtypeStruct((T_pad, NR, 128), jnp.float32),
             jax.ShapeDtypeStruct((grid[1], T_pad), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((L, r_sub, 128), cdt)
-            for _ in range(tree_unroll)
-        ],
         interpret=interpret,
-    )(nrows_arr, tbl["icode"], tbl["lsrc"], tbl["lidx"], tbl["lcval"],
-      tbl["rsrc"], tbl["ridx"], tbl["rcval"], ninstr_p, Xp)
+    )
+    if packed:
+        # pack is purely elementwise, so it applies directly to the
+        # already-transposed (L, T_pad) tables
+        word = pack_instr_tables(tbl, nfeat)
+        kernel = _make_instr_kernel(
+            operators, t_block, r_block, L, dispatch, tree_unroll,
+            nfeat, cdt, packed=True,
+        )
+        y, bad = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # nrows scalar
+                tree_tbl(),  # packed word
+                tree_tbl(),  # lcval
+                tree_tbl(),  # rcval
+                smem_spec((1, t_block), lambda i, j: (0, i)),  # n_instr
+                pl.BlockSpec((nfeat, r_sub, 128), lambda i, j: (0, j, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((nfeat + L, r_sub, 128), cdt)
+                for _ in range(tree_unroll)
+            ],
+            **common_out,
+        )(nrows_arr, word, tbl["lcval"], tbl["rcval"], ninstr_p, Xp)
+    else:
+        kernel = _make_instr_kernel(operators, t_block, r_block, L,
+                                    dispatch, tree_unroll, nfeat, cdt)
+        y, bad = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(memory_space=pltpu.SMEM),  # nrows scalar
+                tree_tbl(),  # icode
+                tree_tbl(),  # lsrc
+                tree_tbl(),  # lidx
+                tree_tbl(),  # lcval
+                tree_tbl(),  # rsrc
+                tree_tbl(),  # ridx
+                tree_tbl(),  # rcval
+                smem_spec((1, t_block), lambda i, j: (0, i)),  # n_instr
+                pl.BlockSpec((nfeat, r_sub, 128), lambda i, j: (0, j, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((L, r_sub, 128), cdt)
+                for _ in range(tree_unroll)
+            ],
+            **common_out,
+        )(nrows_arr, tbl["icode"], tbl["lsrc"], tbl["lidx"], tbl["lcval"],
+          tbl["rsrc"], tbl["ridx"], tbl["rcval"], ninstr_p, Xp)
 
     y = y.reshape(T_pad, R_pad)[:T, :nrows]
     ok = (jnp.sum(bad[:, :T], axis=0) == 0) & (length > 0)

@@ -194,13 +194,15 @@ def test_pallas_bf16_auto_routing():
     assert y.shape == (4, 16)
 
 
+@pytest.mark.parametrize("program", ["instr", "instr_packed"])
 @pytest.mark.parametrize("tree_unroll", [1, 4])
 @pytest.mark.parametrize("sort_trees", [True, False])
-def test_instr_program_matches_jnp(rng, tree_unroll, sort_trees):
-    """The compressed operator-only instruction program (program='instr')
-    must reproduce the jnp interpreter bit-for-bit in ok and numerically
-    in y — including the operand-finiteness poison semantics (leaves are
-    operands there, not executed slots)."""
+def test_instr_program_matches_jnp(rng, program, tree_unroll, sort_trees):
+    """The compressed operator-only instruction programs (program='instr'
+    and its packed-word variant) must reproduce the jnp interpreter
+    bit-for-bit in ok and numerically in y — including the
+    operand-finiteness poison semantics (leaves are operands there, not
+    executed slots)."""
     trees = batch(rng, 13)
     X = jnp.asarray(
         (rng.standard_normal((NFEAT, 50)) * 2).astype(np.float32)
@@ -208,7 +210,7 @@ def test_instr_program_matches_jnp(rng, tree_unroll, sort_trees):
     y_ref, ok_ref = eval_trees(trees, X, OPS)
     y, ok = eval_trees_pallas(
         trees, X, OPS, t_block=8, r_block=128, interpret=True,
-        program="instr", tree_unroll=tree_unroll, sort_trees=sort_trees,
+        program=program, tree_unroll=tree_unroll, sort_trees=sort_trees,
     )
     np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
     m = np.asarray(ok_ref)
@@ -217,7 +219,8 @@ def test_instr_program_matches_jnp(rng, tree_unroll, sort_trees):
     )
 
 
-def test_instr_program_bare_leaves_and_unary_chains(rng):
+@pytest.mark.parametrize("program", ["instr", "instr_packed"])
+def test_instr_program_bare_leaves_and_unary_chains(rng, program):
     """Edge shapes of the compressed program: bare-leaf trees run one
     synthetic IDENT instruction; pure unary chains compress to length-1
     programs... of nearly the tree's own length (no leaves to drop)."""
@@ -237,7 +240,7 @@ def test_instr_program_bare_leaves_and_unary_chains(rng):
     y_ref, ok_ref = eval_trees(trees, X, OPS)
     y, ok = eval_trees_pallas(
         trees, X, OPS, t_block=8, r_block=128, interpret=True,
-        program="instr",
+        program=program,
     )
     np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
     np.testing.assert_allclose(
@@ -245,7 +248,8 @@ def test_instr_program_bare_leaves_and_unary_chains(rng):
     )
 
 
-def test_instr_program_infinite_operand_poison(rng):
+@pytest.mark.parametrize("program", ["instr", "instr_packed"])
+def test_instr_program_infinite_operand_poison(rng, program):
     """relu(-inf) = 0 is finite, but the tree must still be flagged not-ok
     (the jnp interpreter poisons the leaf slot; the instr kernel must
     poison via the operand check)."""
@@ -258,7 +262,7 @@ def test_instr_program_infinite_operand_poison(rng):
     y_ref, ok_ref = eval_trees(trees, X, ops)
     y, ok = eval_trees_pallas(
         trees, X, ops, t_block=8, r_block=128, interpret=True,
-        program="instr",
+        program=program,
     )
     assert not bool(ok[0])
     np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))

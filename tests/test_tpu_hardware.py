@@ -151,14 +151,17 @@ def test_compiled_instr_program_on_chip(tpu_ready):
     X = jax.random.normal(jax.random.PRNGKey(2), (4, 1000), jnp.float32) * 2
 
     y_ref, ok_ref = jax.device_get(eval_trees(trees, X, ops))
-    for unroll in (4, 16):
+    for program, unroll in (
+        ("instr", 4), ("instr", 16),
+        ("instr_packed", 4), ("instr_packed", 8),
+    ):
         y, ok = jax.device_get(
-            eval_trees_pallas(trees, X, ops, program="instr",
+            eval_trees_pallas(trees, X, ops, program=program,
                               tree_unroll=unroll)
         )
         np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
         m = np.asarray(ok_ref)
         np.testing.assert_allclose(
             np.asarray(y)[m], np.asarray(y_ref)[m], rtol=1e-4, atol=1e-4,
-            err_msg=f"tree_unroll={unroll}",
+            err_msg=f"{program} tree_unroll={unroll}",
         )
