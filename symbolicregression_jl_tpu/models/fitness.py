@@ -27,9 +27,14 @@ Array = jax.Array
 
 _PALLAS_MIN_BATCH = 512
 
+# Kernel program shape used when kernel_program="auto": the best measured
+# variant on hardware (benchmark/kernel_tune.py A/B history in BASELINE.md).
+_DEFAULT_PROGRAM = "postfix"
+
 
 def dispatch_eval(
-    trees: TreeBatch, X: Array, operators: OperatorSet, backend: str = "auto"
+    trees: TreeBatch, X: Array, operators: OperatorSet,
+    backend: str = "auto", program: str = "auto",
 ):
     """Choose the eval kernel. 'auto': the Pallas scalar-dispatch kernel for
     large float32/bfloat16 top-level batches on TPU (the bench /
@@ -56,7 +61,8 @@ def dispatch_eval(
             "bfloat16" if X.dtype == jnp.bfloat16 else "float32"
         )
         y, ok = eval_trees_pallas(
-            trees, X, operators, compute_dtype=compute_dtype
+            trees, X, operators, compute_dtype=compute_dtype,
+            program=_DEFAULT_PROGRAM if program == "auto" else program,
         )
         # downstream scoring expects the working dtype; the kernel
         # accumulates/returns f32 (bf16-compute, f32-accumulate)
@@ -73,6 +79,7 @@ def eval_loss_trees(
     loss_fn: Callable,
     row_idx: Optional[Array] = None,
     backend: str = "auto",
+    program: str = "auto",
 ) -> Array:
     """Per-tree aggregated loss over all rows (or the row_idx minibatch).
 
@@ -82,7 +89,7 @@ def eval_loss_trees(
         X = X[:, row_idx]
         y = y[row_idx]
         weights = None if weights is None else weights[row_idx]
-    y_pred, ok = dispatch_eval(trees, X, operators, backend)
+    y_pred, ok = dispatch_eval(trees, X, operators, backend, program)
     elem = loss_fn(y_pred, y)
     loss = aggregate_loss(elem, weights)
     loss = jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
@@ -139,6 +146,7 @@ def score_trees(
         loss = eval_loss_trees(
             trees, X, y, weights, options.operators, options.elementwise_loss,
             row_idx, backend=options.eval_backend,
+            program=options.kernel_program,
         )
     complexity = compute_complexity(trees, options)
     score = loss_to_score(loss, baseline, complexity, options)

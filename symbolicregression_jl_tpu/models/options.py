@@ -199,6 +199,12 @@ class Options:
     # --- TPU-native knobs (no reference analog; replace Distributed.jl) ---
     n_parallel_tournaments: int = 0  # 0 => npop // tournament_selection_n
     eval_backend: str = "auto"  # "jnp" | "pallas" | "auto"
+    # Program shape for the Pallas kernel: "auto" uses the fixed default
+    # in models/fitness.py (_DEFAULT_PROGRAM, set from kernel_tune A/B
+    # measurements on hardware); "postfix" / "instr" / "instr_packed"
+    # pin a shape (shapes documented in ops/pallas_eval.py). Ignored on
+    # the jnp interpreter path, like eval_backend="jnp".
+    kernel_program: str = "auto"
     # Dataset-row sharding width of the device mesh: with row_shards=r the
     # mesh is (n_devices//r, r) (islands x rows) and X/y shard their row
     # dim, loss reductions becoming cross-chip psums (the mesh analog of
@@ -248,6 +254,13 @@ class Options:
             )
         if not 0 < self.tournament_selection_p <= 1:
             raise ValueError("tournament_selection_p must be in (0, 1]")
+        if self.kernel_program not in (
+            "auto", "postfix", "instr", "instr_packed"
+        ):
+            raise ValueError(
+                "kernel_program must be one of "
+                "auto/postfix/instr/instr_packed"
+            )
         if self.row_shards < 1:
             raise ValueError("row_shards must be >= 1")
         if self.tournament_selection_n > self.npop:
@@ -324,7 +337,8 @@ class Options:
             self.tournament_selection_n, self.tournament_selection_p,
             self.topn, self.batching, self.batch_size,
             self.independent_island_batches,
-            self.n_parallel_tournaments, self.eval_backend, self.precision,
+            self.n_parallel_tournaments, self.eval_backend,
+            self.kernel_program, self.precision,
             self.constraints, self.nested_constraints,
             self.complexity_of_operators, self.complexity_of_constants,
             self.complexity_of_variables, self.mutation_weights.as_tuple(),
