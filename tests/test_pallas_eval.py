@@ -268,6 +268,19 @@ def test_instr_program_infinite_operand_poison(rng, program):
     np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
 
 
+def test_instr_packed_rejects_oversized_layout(rng):
+    """An explicit instr_packed request that does not fit the packed
+    word's bitfields must fail loudly, not silently fall back (a silent
+    fallback would mislabel benchmark and roofline results)."""
+    trees = batch(rng, 4)
+    # 3000 features blows the 11-bit unified-index budget
+    X = jnp.zeros((3000, 8), jnp.float32)
+    with pytest.raises(ValueError, match="instr_packed"):
+        eval_trees_pallas(
+            trees, X, OPS, interpret=True, program="instr_packed"
+        )
+
+
 def test_instruction_schedule_compression(rng):
     """Instruction count equals the number of operator nodes (>=1 for any
     nonempty tree), always <= postfix length."""
