@@ -191,7 +191,7 @@ def instruction_schedule(trees: TreeBatch, operators: OperatorSet):
 
     def step(state, inputs):
         ssrc, sidx, scval, sp, nins = state
-        k, o, f, c, ar = inputs
+        k, o, f, c, ar, si = inputs
         is_pad = k == PAD
         is_op = ar > 0
         top = jnp.clip(sp - 1, 0, depth - 1)[:, None]
@@ -201,15 +201,21 @@ def instruction_schedule(trees: TreeBatch, operators: OperatorSet):
         rsrc, ridx, rcval = take(ssrc, top), take(sidx, top), take(scval, top)
         is_bin = ar == 2
         lsrc = jnp.where(is_bin, take(ssrc, sec), _SRC_CONST)
-        lidx = jnp.where(is_bin, take(sidx, sec), 0)
+        # dummy left operand of non-binary steps points at slot L — a
+        # trash address distinct from every real postfix slot, so the
+        # gradient kernel's dead db write can never clobber a real
+        # constant's adjoint (eval kernels ignore idx for const operands)
+        lidx = jnp.where(is_bin, take(sidx, sec), L)
         lcval = jnp.where(is_bin, take(scval, sec), 0.0)
         icode = jnp.where(
             is_op, jnp.where(k == UNA, 2 + o, 2 + U + o), 0
         ).astype(jnp.int32)
-        # push: the op's result, or the leaf itself
+        # push: the op's result, or the leaf itself. CONST leaves record
+        # their postfix slot as idx (unused by eval, which reads cval, but
+        # it lets the gradient kernel scatter d loss/d cval by slot).
         psrc = jnp.where(is_op, _SRC_RES,
                          jnp.where(k == VAR, _SRC_VAR, _SRC_CONST))
-        pidx = jnp.where(is_op, nins, jnp.where(k == VAR, f, 0))
+        pidx = jnp.where(is_op, nins, jnp.where(k == VAR, f, si))
         pcval = jnp.where(k == CONST, c, 0.0)
         new_sp = jnp.where(is_pad, sp, sp - jnp.maximum(ar, 0) + 1)
         w = jnp.clip(new_sp - 1, 0, depth - 1)
@@ -232,8 +238,11 @@ def instruction_schedule(trees: TreeBatch, operators: OperatorSet):
         jnp.zeros((T,), jnp.int32),
     )
     mv = lambda x: jnp.moveaxis(x, -1, 0)
+    si_seq = jnp.broadcast_to(
+        jnp.arange(L, dtype=jnp.int32)[:, None], (L, T)
+    )
     inputs = (mv(kind), mv(op), mv(feat),
-              mv(cval.astype(jnp.float32)), mv(arity))
+              mv(cval.astype(jnp.float32)), mv(arity), si_seq)
     (ssrc, sidx, scval, sp, nins), outs = jax.lax.scan(step, init, inputs)
     is_op, icode, lsrc, lidx, lcval, rsrc, ridx, rcval = (
         jnp.moveaxis(x, 0, -1) for x in outs
@@ -270,11 +279,15 @@ def instruction_schedule(trees: TreeBatch, operators: OperatorSet):
     tables["rcval"] = jnp.where(
         sel, take(scval)[:, None], tables["rcval"]
     )
+    # IDENT's dummy left operand gets the same trash slot as other
+    # non-binary steps (the compact fill of 0 would alias postfix slot 0
+    # in the gradient kernel's adjoint space)
+    tables["lidx"] = jnp.where(sel, L, tables["lidx"])
     n_instr = jnp.where(bare, 1, nins)
     return tables, n_instr
 
 
-def pack_instr_tables(tables, nfeat: int):
+def pack_instr_tables(tables, nfeat: int, const_base: int = 0):
     """Pack the instr program's five integer tables into ONE int32 word per
     step, and unify result/feature operand indices into a single address
     space (see _make_instr_kernel with packed=True).
@@ -289,21 +302,30 @@ def pack_instr_tables(tables, nfeat: int):
     result. A _SRC_VAR operand becomes idx=feat, a _SRC_RES operand
     becomes idx=nfeat+k, and only _SRC_CONST keeps a flag bit.
 
+    const_base > 0 (gradient kernel): a _SRC_CONST operand's idx becomes
+    const_base + its postfix slot, giving each constant its own adjoint
+    scratch address so the backward sweep can scatter d loss/d cval by
+    slot; the eval kernel passes 0 and ignores idx for const operands.
+
     Word layout (32 bits): icode[0:8] | lconst[8] | rconst[9] |
-    lidx[10:21] | ridx[21:32]. Requires icode < 256 and
-    nfeat + max_len <= 2048 (11-bit indices) — checked by the caller.
+    lidx[10:21] | ridx[21:32]. Requires icode < 256 and indices < 2048
+    (11 bits) — checked by the caller.
     """
     icode = tables["icode"]
     lconst = (tables["lsrc"] == _SRC_CONST).astype(jnp.int32)
     rconst = (tables["rsrc"] == _SRC_CONST).astype(jnp.int32)
-    lidx = jnp.where(
-        tables["lsrc"] == _SRC_RES, nfeat + tables["lidx"],
-        jnp.where(tables["lsrc"] == _SRC_VAR, tables["lidx"], 0),
-    )
-    ridx = jnp.where(
-        tables["rsrc"] == _SRC_RES, nfeat + tables["ridx"],
-        jnp.where(tables["rsrc"] == _SRC_VAR, tables["ridx"], 0),
-    )
+
+    def unify(src, idx):
+        return jnp.where(
+            src == _SRC_RES, nfeat + idx,
+            jnp.where(
+                src == _SRC_VAR, idx,
+                (const_base + idx) if const_base else 0,
+            ),
+        )
+
+    lidx = unify(tables["lsrc"], tables["lidx"])
+    ridx = unify(tables["rsrc"], tables["ridx"])
     word = (
         icode
         | (lconst << 8)
@@ -312,6 +334,15 @@ def pack_instr_tables(tables, nfeat: int):
         | (ridx << 21)
     ).astype(jnp.int32)
     return word
+
+
+def decode_packed_word(w):
+    """Inverse of pack_instr_tables' bit layout — the single decoder
+    shared by every packed-program kernel (eval and gradient), so a
+    layout change cannot silently diverge them. Returns
+    (code, lconst, rconst, lidx, ridx)."""
+    return (w & 0xFF, (w >> 8) & 1, (w >> 9) & 1,
+            (w >> 10) & 0x7FF, (w >> 21) & 0x7FF)
 
 
 def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
@@ -555,12 +586,9 @@ def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
                     val_refs[t][f] = xf
 
             def read_operands(si, ti, val_ref):
-                w = word_ref[si, ti]
-                code = w & 0xFF
-                lconst = (w >> 8) & 1
-                rconst = (w >> 9) & 1
-                lidx = (w >> 10) & 0x7FF
-                ridx = (w >> 21) & 0x7FF
+                code, lconst, rconst, lidx, ridx = decode_packed_word(
+                    word_ref[si, ti]
+                )
                 acv = jnp.full((r_sub, 128), rcval_ref[si, ti], cdt)
                 bcv = jnp.full((r_sub, 128), lcval_ref[si, ti], cdt)
                 a = jnp.where(rconst == 1, acv, val_ref[ridx])
@@ -768,14 +796,45 @@ def eval_trees_pallas(
     )
 
 
+def prep_instr_tables(flat, operators, sort_trees):
+    """Shared host-side prep of the instruction-program tables (used by
+    the eval kernels here and the gradient kernel in pallas_grad.py, so
+    their table pipelines stay identical by construction): compile the
+    schedule, sort trees by instruction count — the analog of the postfix
+    path's length sort (interleave groups + grid blocks stay
+    work-homogeneous) — and pad the step axis to whole _SLOT_UNROLL
+    groups. Returns (tables (T, L), n_instr (T,), flat trees in sorted
+    order, inv_perm or None, L)."""
+    tables, n_instr = instruction_schedule(flat, operators)
+    inv_perm = None
+    if sort_trees and flat.length.shape[0] > 1:
+        perm = jnp.argsort(n_instr)
+        inv_perm = jnp.zeros_like(perm).at[perm].set(
+            jnp.arange(perm.shape[0], dtype=perm.dtype)
+        )
+        tables = {k: v[perm] for k, v in tables.items()}
+        n_instr = n_instr[perm]
+        flat = jax.tree_util.tree_map(lambda x: x[perm], flat)
+
+    L0 = tables["icode"].shape[1]
+    L = _round_up(L0, _SLOT_UNROLL)
+    if L != L0:
+        tables = {
+            k: jnp.pad(v, ((0, 0), (0, L - L0)),
+                       constant_values=_SRC_CONST if k.endswith("src") else 0)
+            for k, v in tables.items()
+        }
+    return tables, n_instr, flat, inv_perm, L
+
+
 def _eval_instr(flat, X, operators, t_block, r_block, interpret, dispatch,
                 tree_unroll, sort_trees, compute_dtype, batch_shape,
                 packed=False):
     """instr-program body of eval_trees_pallas (already flattened trees).
 
-    packed=True runs the packed-word kernel (pack_instr_tables /
-    _make_instr_packed_kernel): 3 SMEM reads per step instead of 7 and a
-    unified operand scratch — the scalar-unit-relief variant."""
+    packed=True runs the packed-word kernel (pack_instr_tables +
+    _make_instr_kernel(packed=True)): 3 SMEM reads per step instead of 7
+    and a unified operand scratch — the scalar-unit-relief variant."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -795,28 +854,11 @@ def _eval_instr(flat, X, operators, t_block, r_block, interpret, dispatch,
                 f"max_len={flat.kind.shape[-1]}); use program='instr'"
             )
 
-    tables, n_instr = instruction_schedule(flat, operators)
+    tables, n_instr, flat, inv_perm, L = prep_instr_tables(
+        flat, operators, sort_trees
+    )
     length = flat.length
-    # sort by instruction count: the analog of the postfix path's length
-    # sort (interleave groups + grid blocks stay work-homogeneous)
-    inv_perm = None
-    if sort_trees and length.shape[0] > 1:
-        perm = jnp.argsort(n_instr)
-        inv_perm = jnp.zeros_like(perm).at[perm].set(
-            jnp.arange(perm.shape[0], dtype=perm.dtype)
-        )
-        tables = {k: v[perm] for k, v in tables.items()}
-        n_instr = n_instr[perm]
-        length = length[perm]
-
-    T, L0 = tables["icode"].shape
-    L = _round_up(L0, _SLOT_UNROLL)
-    if L != L0:
-        tables = {
-            k: jnp.pad(v, ((0, 0), (0, L - L0)),
-                       constant_values=_SRC_CONST if k.endswith("src") else 0)
-            for k, v in tables.items()
-        }
+    T = tables["icode"].shape[0]
     nfeat, nrows = X.shape
 
     t_block = min(t_block, _round_up(max(T, 8), tree_unroll))

@@ -1,0 +1,351 @@
+"""Pallas TPU kernel: fused loss + gradient w.r.t. constants.
+
+The constant-optimization objective (reference src/ConstantOptimization.jl
+:11-19 — full-dataset loss as a function of the tree's constants, gradients
+via Zygote-derived operator rules in DynamicExpressions) evaluated for a
+whole BATCH of trees in one kernel launch: forward sweep of the compressed
+instruction program (ops/pallas_eval.instruction_schedule), elementwise-loss
+seed, then a backward adjoint sweep over the same program, accumulating
+d loss / d cval per postfix constant slot on-chip.
+
+Why a hand-rolled backward instead of `jax.grad` through the interpreter:
+the lockstep jnp interpreter differentiates fine (models/constant_opt.py
+uses that path), but XLA's autodiff materializes the full primal scan in
+HBM and pays the padded-slot lockstep cost twice; here the primals live in
+VMEM scratch (written by the forward sweep, still resident for the
+backward), programs stop at their own instruction count, and per-step
+operator derivatives come from `jax.vjp` of the SAME registered operator
+implementations — so NaN-guard semantics (ops/operators.py) and their
+gradients match the interpreter path exactly.
+
+The one structural gift of expression trees: every node has exactly ONE
+consumer, so each adjoint is written exactly once — the backward sweep has
+no read-modify-write and needs no zero-initialization. Adjoint addresses
+reuse the packed operand index (pack_instr_tables with const_base):
+
+    [0, nfeat)                    feature operands (adjoint discarded)
+    [nfeat, nfeat+L)              instruction results
+    [const_base, const_base+ML)   constants, by postfix slot
+    const_base + ML               trash (dummy left operand of non-binary
+                                  steps; ML = postfix max_len)
+
+Backward runs instructions in descending order, so a consumer's adjoint
+write always precedes the producer's read, and DEAD padding steps (which
+write zeros at the const-space base) run before every real step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..models.trees import CONST, TreeBatch
+from .losses import l2_dist_loss
+from .operators import OperatorSet
+from .pallas_eval import (
+    _SLOT_UNROLL,
+    _balanced_mux,
+    _round_up,
+    decode_packed_word,
+    pack_instr_tables,
+    prep_instr_tables,
+)
+
+Array = jax.Array
+
+
+def _make_grad_kernel(operators: OperatorSet, t_block: int, r_block: int,
+                      L: int, ML: int, tree_unroll: int, nfeat: int,
+                      loss_fn: Callable):
+    """L = padded instruction-table width; ML = postfix max_len (the width
+    of the cval slot axis the gradient is reported in)."""
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    if tree_unroll not in (1, 2, 4, 8, 16) or t_block % tree_unroll:
+        raise ValueError(
+            "tree_unroll must be 1/2/4/8/16 and divide t_block, "
+            f"got {tree_unroll}"
+        )
+    unary_fns = operators.unary_fns
+    binary_fns = operators.binary_fns
+    r_sub = r_block // 128
+    const_base = nfeat + L
+    A = const_base + ML + 1  # adjoint scratch slots (incl. trash)
+    V = nfeat + L            # value scratch slots
+
+    def kernel(nrows_ref, word_ref, lcval_ref, rcval_ref, ninstr_ref,
+               X_ref, y_ref, wn_ref,
+               loss_ref, cgrad_ref, bad_ref,
+               *scratch):
+        val_refs = scratch[:tree_unroll]
+        adj_refs = scratch[tree_unroll:]
+
+        # row validity comes from nrows (matching the eval kernels) — a
+        # genuinely zero-weighted VALID row must still poison a tree
+        # whose evaluation is non-finite there, exactly like
+        # eval_trees_pallas and the jnp scoring path
+        sub = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 0)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 1)
+        row = (pl.program_id(1) * r_sub + sub) * 128 + lane
+        valid_f = jnp.where(row < nrows_ref[0], 1.0, 0.0)
+        wn = wn_ref[...]
+        y_t = y_ref[...]
+
+        for f in range(nfeat):
+            xf = X_ref[f]
+            for t in range(tree_unroll):
+                val_refs[t][f] = xf
+
+        def operands(si, ti, val_ref):
+            code, lconst, rconst, lidx, ridx = decode_packed_word(
+                word_ref[si, ti]
+            )
+            acv = jnp.full((r_sub, 128), rcval_ref[si, ti], jnp.float32)
+            bcv = jnp.full((r_sub, 128), lcval_ref[si, ti], jnp.float32)
+            # const operands carry adjoint-space indices past the value
+            # scratch; clip the (muxed-away) value read back into range
+            a = jnp.where(rconst == 1, acv,
+                          val_ref[jnp.minimum(ridx, V - 1)])
+            b = jnp.where(lconst == 1, bcv,
+                          val_ref[jnp.minimum(lidx, V - 1)])
+            return code, lidx, ridx, a, b
+
+        def fwd_body(si, ti, bad, val_ref):
+            code, _, _, a, b = operands(si, ti, val_ref)
+            cands = [a, a]
+            cands += [fn(a) for fn in unary_fns]
+            cands += [fn(b, a) for fn in binary_fns]
+            v = _balanced_mux(code, cands)
+            val_ref[nfeat + si] = v
+            fin = jnp.isfinite(v) & jnp.isfinite(a) & jnp.isfinite(b)
+            return jnp.maximum(
+                bad, jnp.where(fin | (code == 0), 0.0, valid_f)
+            )
+
+        def bwd_body(si, ti, val_ref, adj_ref):
+            code, lidx, ridx, a, b = operands(si, ti, val_ref)
+            w = adj_ref[nfeat + si]
+            zero = jnp.zeros((r_sub, 128), jnp.float32)
+            da_cands = [zero, w]   # DEAD, IDENT (pass-through)
+            db_cands = [zero, zero]
+            for fn in unary_fns:
+                _, vf = jax.vjp(fn, a)
+                da_cands.append(vf(w)[0])
+                db_cands.append(zero)
+            for fn in binary_fns:
+                _, vf = jax.vjp(fn, b, a)
+                db_j, da_j = vf(w)
+                da_cands.append(da_j)
+                db_cands.append(db_j)
+            da = _balanced_mux(code, da_cands)
+            db = _balanced_mux(code, db_cands)
+            # single-writer: each operand (result or const slot) has
+            # exactly one consumer, so plain stores suffice
+            adj_ref[jnp.minimum(ridx, A - 1)] = da
+            adj_ref[jnp.minimum(lidx, A - 1)] = db
+
+        def tree_group_body(p, _):
+            tis = [p * tree_unroll + k for k in range(tree_unroll)]
+            ns = [ninstr_ref[0, ti] for ti in tis]
+            n_max = ns[0]
+            for n in ns[1:]:
+                n_max = jnp.maximum(n_max, n)
+            n_groups = (n_max + _SLOT_UNROLL - 1) // _SLOT_UNROLL
+
+            zero = jnp.zeros((r_sub, 128), jnp.float32)
+
+            def fwd_group(g, bads):
+                bads = list(bads)
+                for k in range(_SLOT_UNROLL):
+                    si = g * _SLOT_UNROLL + k
+                    for t in range(tree_unroll):
+                        bads[t] = fwd_body(si, tis[t], bads[t], val_refs[t])
+                return tuple(bads)
+
+            bads = jax.lax.fori_loop(
+                0, n_groups, fwd_group, (zero,) * tree_unroll
+            )
+
+            # seed: adjoint of the root = d(weighted elementwise loss)/dy
+            for t in range(tree_unroll):
+                y_pred = val_refs[t][nfeat + jnp.maximum(ns[t] - 1, 0)]
+                elem, vloss = jax.vjp(
+                    lambda yp: loss_fn(yp, y_t), y_pred
+                )
+                masked = jnp.where(wn != 0.0, elem * wn, 0.0)
+                (seed,) = vloss(wn)
+                seed = jnp.where(wn != 0.0, seed, 0.0)
+                adj_refs[t][nfeat + jnp.maximum(ns[t] - 1, 0)] = seed
+                loss_ref[0, tis[t]] = jnp.sum(masked)
+                bad_ref[0, tis[t]] = jnp.sum(bads[t])
+
+            def bwd_group(g, _):
+                # descending instruction order: consumers before producers
+                for k in range(_SLOT_UNROLL):
+                    si = (n_groups - 1 - g) * _SLOT_UNROLL \
+                        + (_SLOT_UNROLL - 1 - k)
+                    for t in range(tree_unroll):
+                        bwd_body(si, tis[t], val_refs[t], adj_refs[t])
+                return 0
+
+            jax.lax.fori_loop(0, n_groups, bwd_group, 0)
+
+            # flush per-slot constant gradients (row-reduced) for this
+            # group's trees; non-const slots are stale scratch — the
+            # wrapper masks them by kind. PADDED lanes can carry NaN
+            # (0-seed x inf local derivative on garbage rows), so mask by
+            # validity before the reduction — lanes never mix (all ops
+            # are elementwise), so valid lanes are exact. A NaN on a
+            # zero-weight VALID lane survives, matching `jax.grad`
+            # through the interpreter on the same data.
+            for t in range(tree_unroll):
+                for s in range(ML):
+                    cgrad_ref[0, s, tis[t]] = jnp.sum(
+                        jnp.where(
+                            valid_f != 0.0,
+                            adj_refs[t][const_base + s], 0.0,
+                        )
+                    )
+            return 0
+
+        jax.lax.fori_loop(0, t_block // tree_unroll, tree_group_body, 0)
+
+    return kernel, A
+
+
+def eval_loss_grad_pallas(
+    trees: TreeBatch,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    operators: OperatorSet,
+    loss_fn: Optional[Callable] = None,
+    t_block: int = 256,
+    r_block: int = 1024,
+    tree_unroll: int = 4,
+    sort_trees: bool = True,
+    interpret: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """Batched constant-optimization objective: per-tree aggregated loss
+    and its gradient w.r.t. every constant slot, in one fused kernel.
+
+    Returns (loss (...,), grad (..., max_len), ok (...,)) where
+    loss = weighted mean of `loss_fn(y_pred, y)` over rows (mean when
+    weights is None), grad is d loss / d trees.cval masked to CONST
+    slots, and ok mirrors eval_trees_pallas' poison flag (loss is NOT
+    forced to inf for poisoned trees — callers gate on ok, matching
+    models/fitness.eval_loss_trees' contract before its where()).
+
+    TPU only (or interpret=True anywhere); float32.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if loss_fn is None:
+        loss_fn = l2_dist_loss
+    batch_shape = trees.length.shape
+    flat = jax.tree_util.tree_map(
+        lambda x: x.reshape((-1,) + x.shape[len(batch_shape):]), trees
+    )
+    nfeat, nrows = X.shape
+    ML = flat.kind.shape[-1]
+
+    tables, n_instr, flat, inv_perm, L = prep_instr_tables(
+        flat, operators, sort_trees
+    )
+    T = tables["icode"].shape[0]
+    const_base = nfeat + L
+    n_codes = 2 + operators.n_unary + operators.n_binary
+    if n_codes > 255 or const_base + ML + 1 > 2048:
+        raise ValueError(
+            "eval_loss_grad_pallas needs <=255 opcodes and "
+            f"nfeat + padded_len + max_len <= ~2048 (got {n_codes} "
+            f"opcodes, nfeat={nfeat}, L={L}, max_len={ML})"
+        )
+
+    t_block = min(t_block, _round_up(max(T, 8), tree_unroll))
+    r_block = min(r_block, _round_up(nrows, 128))
+    r_sub = r_block // 128
+    T_pad = _round_up(T, t_block)
+    R_pad = _round_up(nrows, r_block)
+    NR = R_pad // 128
+
+    def padT(x, fill=0):
+        return jnp.pad(x, ((0, T_pad - T), (0, 0)),
+                       constant_values=fill).T
+
+    word = padT(pack_instr_tables(tables, nfeat, const_base=const_base))
+    lcval = padT(tables["lcval"].astype(jnp.float32))
+    rcval = padT(tables["rcval"].astype(jnp.float32))
+    ninstr_p = jnp.pad(n_instr, (0, T_pad - T))[None, :]
+
+    Xp = jnp.pad(X.astype(jnp.float32), ((0, 0), (0, R_pad - nrows)))
+    Xp = Xp.reshape(nfeat, NR, 128)
+    yp = jnp.pad(y.astype(jnp.float32), (0, R_pad - nrows))
+    yp = yp.reshape(NR, 128)
+    # normalized weights: w / sum(w) (or 1/nrows), zero on padded rows —
+    # the kernel's loss partials and seeds then just sum
+    if weights is None:
+        wn = jnp.full((nrows,), 1.0 / nrows, jnp.float32)
+    else:
+        wf = weights.astype(jnp.float32)
+        wn = wf / jnp.sum(wf)
+    wn = jnp.pad(wn, (0, R_pad - nrows)).reshape(NR, 128)
+
+    kernel, A = _make_grad_kernel(
+        operators, t_block, r_block, L, ML, tree_unroll, nfeat, loss_fn
+    )
+    grid = (T_pad // t_block, NR // r_sub)
+    smem_spec = lambda shape, imap: pl.BlockSpec(
+        shape, imap, memory_space=pltpu.SMEM
+    )
+    tree_tbl = lambda: smem_spec((L, t_block), lambda i, j: (0, i))
+    loss_p, cgrad_p, bad = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # nrows scalar
+            tree_tbl(),  # packed word
+            tree_tbl(),  # lcval
+            tree_tbl(),  # rcval
+            smem_spec((1, t_block), lambda i, j: (0, i)),  # n_instr
+            pl.BlockSpec((nfeat, r_sub, 128), lambda i, j: (0, j, 0)),
+            pl.BlockSpec((r_sub, 128), lambda i, j: (j, 0)),  # y
+            pl.BlockSpec((r_sub, 128), lambda i, j: (j, 0)),  # wn
+        ],
+        out_specs=[
+            smem_spec((1, t_block), lambda i, j: (j, i)),       # loss
+            smem_spec((1, ML, t_block), lambda i, j: (j, 0, i)),  # cgrad
+            smem_spec((1, t_block), lambda i, j: (j, i)),       # bad
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((grid[1], T_pad), jnp.float32),
+            jax.ShapeDtypeStruct((grid[1], ML, T_pad), jnp.float32),
+            jax.ShapeDtypeStruct((grid[1], T_pad), jnp.float32),
+        ],
+        scratch_shapes=(
+            [pltpu.VMEM((nfeat + L, r_sub, 128), jnp.float32)
+             for _ in range(tree_unroll)]
+            + [pltpu.VMEM((A, r_sub, 128), jnp.float32)
+               for _ in range(tree_unroll)]
+        ),
+        interpret=interpret,
+    )(jnp.asarray([nrows], jnp.int32), word, lcval, rcval, ninstr_p,
+      Xp, yp, wn)
+
+    loss = jnp.sum(loss_p[:, :T], axis=0)
+    grad = jnp.sum(cgrad_p[:, :, :T], axis=0).T  # (T, ML)
+    ok = (jnp.sum(bad[:, :T], axis=0) == 0) & (flat.length > 0)
+    # only CONST slots carry gradients; everything else is stale scratch
+    grad = jnp.where(flat.kind == CONST, grad, 0.0)
+    if inv_perm is not None:
+        loss = loss[inv_perm]
+        grad = grad[inv_perm]
+        ok = ok[inv_perm]
+    return (
+        loss.reshape(batch_shape),
+        grad.reshape(batch_shape + (ML,)),
+        ok.reshape(batch_shape),
+    )

@@ -1,0 +1,203 @@
+"""Fused loss+gradient kernel (ops/pallas_grad.py) vs two oracles:
+`jax.grad` through the jnp lockstep interpreter where that is finite, and
+float64 central finite differences of the numpy oracle where autodiff
+produces spurious NaN (the lockstep interpreter evaluates every candidate
+operator per slot, and a non-selected branch that overflows turns the
+zero cotangent into inf*0=NaN — the backward kernel muxes derivative
+VALUES instead, so discarded candidates cannot contaminate)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.models.mutate_device import (
+    gen_random_tree_fixed_size,
+)
+from symbolicregression_jl_tpu.models.trees import (
+    CONST,
+    Expr,
+    encode_tree,
+    stack_trees,
+)
+from symbolicregression_jl_tpu.ops.eval_numpy import eval_tree_numpy
+from symbolicregression_jl_tpu.ops.interpreter import eval_trees
+from symbolicregression_jl_tpu.ops.operators import make_operator_set
+from symbolicregression_jl_tpu.ops.pallas_grad import eval_loss_grad_pallas
+
+OPS = make_operator_set(["+", "-", "*", "/"], ["cos", "exp", "sqrt", "log"])
+L = 24
+NFEAT = 3
+NROWS = 64
+
+
+def _workload(n=24, seed=0):
+    sizes = jax.random.randint(jax.random.PRNGKey(seed + 1), (n,), 1, 16)
+    trees = jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(k, s, NFEAT, OPS, L)
+    )(jax.random.split(jax.random.PRNGKey(seed), n), sizes)
+    X = jax.random.normal(
+        jax.random.PRNGKey(seed + 2), (NFEAT, NROWS), jnp.float32
+    )
+    y = jax.random.normal(jax.random.PRNGKey(seed + 3), (NROWS,), jnp.float32)
+    return trees, X, y
+
+
+def _autodiff_oracle(trees, X, y, weights=None):
+    """loss + grad per tree via jax.grad through the jnp interpreter."""
+    def loss_of(cval, tree):
+        t2 = tree._replace(cval=cval)
+        yp, _ = eval_trees(
+            jax.tree_util.tree_map(lambda x: x[None], t2), X, OPS
+        )
+        e = (yp[0] - y) ** 2
+        if weights is None:
+            return jnp.mean(e)
+        return jnp.sum(e * weights) / jnp.sum(weights)
+
+    n = trees.length.shape[0]
+    losses, grads = [], []
+    for i in range(n):
+        t = jax.tree_util.tree_map(lambda x: x[i], trees)
+        losses.append(float(loss_of(t.cval, t)))
+        grads.append(np.asarray(jax.grad(loss_of)(t.cval, t)))
+    return np.asarray(losses), np.stack(grads)
+
+
+def _fd64(trees, X, y, i, s, h=1e-5):
+    """f64 central finite difference of the numpy oracle at (tree i, slot s)."""
+    t = jax.tree_util.tree_map(
+        lambda x: np.asarray(jax.device_get(x))[i], trees
+    )
+    X64 = np.asarray(X, np.float64)
+    y64 = np.asarray(y, np.float64)
+
+    def loss_at(c):
+        cv = t.cval.astype(np.float64).copy()
+        cv[s] = c
+        yp, _ = eval_tree_numpy(t._replace(cval=cv), X64, OPS)
+        return float(np.mean((yp - y64) ** 2))
+
+    c0 = float(t.cval[s])
+    d = max(abs(c0) * h, h)
+    return (loss_at(c0 + d) - loss_at(c0 - d)) / (2 * d)
+
+
+def _check_grads(trees, X, y, grad, ok_mask, grad_ref, kmask):
+    """Per-entry comparison: autodiff oracle where finite, f64 finite
+    differences where autodiff produced spurious NaN."""
+    grad_expect = np.where(kmask, grad_ref, 0.0)
+    for i in np.flatnonzero(ok_mask):
+        for s in range(L):
+            want = grad_expect[i, s]
+            if np.isfinite(want):
+                np.testing.assert_allclose(
+                    grad[i, s], want, rtol=2e-4, atol=1e-4,
+                    err_msg=f"tree {i} slot {s}",
+                )
+            elif kmask[i, s]:
+                fd = _fd64(trees, X, y, i, s)
+                np.testing.assert_allclose(
+                    grad[i, s], fd, rtol=1e-3, atol=1e-4,
+                    err_msg=f"tree {i} slot {s} (fd oracle)",
+                )
+
+
+@pytest.mark.parametrize("tree_unroll", [1, 4])
+def test_grad_kernel_matches_oracles(tree_unroll):
+    trees, X, y = _workload()
+    loss, grad, ok = eval_loss_grad_pallas(
+        trees, X, y, None, OPS, interpret=True, t_block=8,
+        tree_unroll=tree_unroll,
+    )
+    loss, grad, ok = (np.asarray(jax.device_get(a)) for a in (loss, grad, ok))
+    _, ok_ref = jax.device_get(eval_trees(trees, X, OPS))
+    np.testing.assert_array_equal(ok, np.asarray(ok_ref))
+
+    loss_ref, grad_ref = _autodiff_oracle(trees, X, y)
+    kmask = np.asarray(trees.kind) == CONST
+    m = np.asarray(ok_ref)
+    np.testing.assert_allclose(loss[m], loss_ref[m], rtol=1e-5, atol=1e-6)
+    _check_grads(trees, X, y, grad, m, grad_ref, kmask)
+
+
+def test_grad_kernel_weighted():
+    trees, X, y = _workload(n=12, seed=7)
+    w = jax.random.uniform(jax.random.PRNGKey(11), (NROWS,)) + 0.5
+    loss, grad, ok = eval_loss_grad_pallas(
+        trees, X, y, w, OPS, interpret=True, t_block=8, tree_unroll=2
+    )
+    loss_ref, grad_ref = _autodiff_oracle(trees, X, y, weights=w)
+    kmask = np.asarray(trees.kind) == CONST
+    m = np.asarray(jax.device_get(ok))
+    grad_expect = np.where(kmask, grad_ref, 0.0)
+    np.testing.assert_allclose(
+        np.asarray(loss)[m], loss_ref[m], rtol=1e-5, atol=1e-6
+    )
+    both = m[:, None] & np.isfinite(grad_expect)
+    np.testing.assert_allclose(
+        np.asarray(grad)[both], grad_expect[both], rtol=2e-4, atol=1e-5
+    )
+
+
+def test_grad_kernel_edge_shapes():
+    """Bare const leaf, bare var leaf, and a unary chain."""
+    chain = Expr.const(0.8)
+    for _ in range(3):
+        chain = Expr.unary(1, chain)  # exp^3(0.8), finite in f32
+    trees = stack_trees([
+        encode_tree(Expr.const(2.5), L),
+        encode_tree(Expr.var(1), L),
+        encode_tree(chain, L),
+    ])
+    X = jnp.asarray(
+        np.random.default_rng(3).standard_normal((NFEAT, 40)), jnp.float32
+    )
+    y = jnp.asarray(
+        np.random.default_rng(4).standard_normal(40), jnp.float32
+    )
+    loss, grad, ok = eval_loss_grad_pallas(
+        trees, X, y, None, OPS, interpret=True, t_block=8, tree_unroll=1
+    )
+    ok = np.asarray(jax.device_get(ok))
+    assert np.all(ok)
+    loss_ref, grad_ref = _autodiff_oracle(trees, X, y)
+    kmask = np.asarray(trees.kind) == CONST
+    np.testing.assert_allclose(
+        np.asarray(loss), loss_ref, rtol=1e-5, atol=1e-6
+    )
+    _check_grads(trees, X, y, np.asarray(grad), ok, grad_ref, kmask)
+    # var-leaf tree has no constants: all-zero grad
+    assert np.all(np.asarray(grad)[1] == 0.0)
+
+
+def test_grad_kernel_poison_flag():
+    """sqrt of a negative constant poisons ok, like the eval kernels."""
+    trees = stack_trees([
+        encode_tree(Expr.unary(2, Expr.const(-4.0)), L),  # sqrt(-4)
+        encode_tree(Expr.const(1.0), L),
+    ])
+    X = jnp.ones((NFEAT, 16), jnp.float32)
+    y = jnp.zeros((16,), jnp.float32)
+    _, _, ok = eval_loss_grad_pallas(
+        trees, X, y, None, OPS, interpret=True, t_block=8, tree_unroll=1
+    )
+    assert not bool(ok[0])
+    assert bool(ok[1])
+
+
+def test_grad_kernel_zero_weight_row_still_poisons():
+    """A tree that is non-finite only on a zero-weighted VALID row must
+    still be flagged not-ok (parity with eval_trees_pallas, whose ok is
+    weight-independent) — row validity comes from nrows, not weights."""
+    # log(x0): negative only on the zero-weighted row
+    trees = stack_trees([encode_tree(Expr.unary(3, Expr.var(0)), L)])
+    Xh = np.ones((NFEAT, 16), np.float32)
+    Xh[0, 5] = -1.0
+    w = np.ones(16, np.float32)
+    w[5] = 0.0
+    _, _, ok = eval_loss_grad_pallas(
+        trees, jnp.asarray(Xh), jnp.zeros((16,), jnp.float32),
+        jnp.asarray(w), OPS, interpret=True, t_block=8, tree_unroll=1,
+    )
+    assert not bool(ok[0])
