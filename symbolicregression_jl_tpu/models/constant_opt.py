@@ -226,6 +226,144 @@ def _newton_single(
     return jax.lax.fori_loop(0, n_iters, body, (x0, loss_f(x0)))
 
 
+_FORCE_INTERPRET = False  # tests only: run the fused kernels in interpret
+# mode so the batched path is exercisable off-TPU
+
+
+def _use_fused_kernels(options: Options, n_instances: int, X: Array) -> bool:
+    """Route constant optimization through the fused Pallas loss/grad
+    kernels (optimizer_backend knob): 'auto' engages them for BFGS at
+    population scale on TPU with a standard elementwise loss in f32 —
+    the same conditions under which fitness.dispatch_eval picks the eval
+    kernel — and only when the packed word's address space fits; 'jnp'
+    pins the vmapped interpreter path; 'pallas' forces the fused path
+    (TPU-only, no custom loss_function, BFGS; layout overflows raise
+    from the kernel)."""
+    from ..ops.pallas_eval import _SLOT_UNROLL, pallas_available
+    from .fitness import _PALLAS_MIN_BATCH
+
+    backend = options.optimizer_backend
+    if backend == "jnp":
+        return False
+    if options.optimizer_algorithm != "BFGS" or (
+        options.loss_function is not None
+    ):
+        if backend == "pallas":
+            raise ValueError(
+                "optimizer_backend='pallas' requires "
+                "optimizer_algorithm='BFGS' and no custom loss_function"
+            )
+        return False
+    if backend == "pallas":
+        return True
+    # packed-word limits (mirrors make_loss_kernel's check): 'auto' must
+    # quietly keep the jnp path where the fused kernel would raise
+    ops = options.operators
+    n_codes = 2 + ops.n_unary + ops.n_binary
+    ML = options.max_len
+    L_pad = ((ML + _SLOT_UNROLL - 1) // _SLOT_UNROLL) * _SLOT_UNROLL
+    fits = n_codes <= 255 and X.shape[0] + L_pad + ML + 1 <= 2048
+    return (
+        fits
+        and pallas_available()
+        and X.dtype == jnp.float32
+        and n_instances >= _PALLAS_MIN_BATCH
+    )
+
+
+def _bfgs_batched(
+    trees_flat: TreeBatch,
+    x0: Array,
+    cmask: Array,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    options: Options,
+    n_iters: int,
+) -> Tuple[Array, Array]:
+    """BFGS over M = (restarts x members) instances with losses and
+    gradients from the fused Pallas kernels (ops/pallas_grad.py) — one
+    kernel launch per step for the WHOLE batch, instead of vmapping
+    per-member `jax.grad` closures through the lockstep interpreter.
+    Same update rule as _bfgs_single (descent safeguard, parallel
+    backtracking, curvature-gated H update); used at population scale
+    where the per-closure path would materialize (instances x rows)
+    prediction intermediates in HBM."""
+    from ..ops.pallas_grad import make_loss_kernel
+
+    M, L = x0.shape
+    loss_fn = options.elementwise_loss
+    ops = options.operators
+
+    # structure-dependent staging (instruction schedule, sort, packing)
+    # happens ONCE here; the BFGS loop below only swaps constants in
+    grad_fn = make_loss_kernel(
+        trees_flat, X, y, weights, ops, loss_fn=loss_fn, with_grad=True,
+        interpret=_FORCE_INTERPRET,
+    )
+    trees_ls = jax.tree_util.tree_map(
+        lambda a: jnp.repeat(a, _LS_STEPS, axis=0), trees_flat
+    )
+    ls_fn = make_loss_kernel(
+        trees_ls, X, y, weights, ops, loss_fn=loss_fn, with_grad=False,
+        interpret=_FORCE_INTERPRET,
+    )
+
+    def loss_grad(x):
+        loss, grad, ok = grad_fn(x)
+        f = jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
+        g = jnp.where(jnp.isfinite(grad), grad, 0.0) * cmask
+        return f, g
+
+    def loss_batch(xs):  # (M, _LS_STEPS, L) -> (M, _LS_STEPS)
+        loss, _, ok = ls_fn(xs.reshape(M * _LS_STEPS, L))
+        return jnp.where(
+            ok & jnp.isfinite(loss), loss, jnp.inf
+        ).reshape(M, _LS_STEPS)
+
+    I = jnp.eye(L, dtype=x0.dtype)
+
+    def body(i, carry):
+        x, f, g, H = carry
+        d = -jnp.einsum("mij,mj->mi", H, g)
+        descent = jnp.einsum("mi,mi->m", d, g) < 0
+        d = jnp.where(descent[:, None], d, -g)
+        ts = 2.0 ** -jnp.arange(_LS_STEPS, dtype=x.dtype)
+        cand = x[:, None, :] + ts[None, :, None] * d[:, None, :]
+        fs = loss_batch(cand)
+        k = jnp.argmin(fs, axis=1)
+        f_new = jnp.take_along_axis(fs, k[:, None], axis=1)[:, 0]
+        improved = f_new < f
+        # select, don't scale: 0 * inf direction would poison x with NaN
+        # (matching _bfgs_single's where-form)
+        x_new = jnp.where(
+            improved[:, None], x + ts[k][:, None] * d, x
+        )
+        _, g_cand = loss_grad(x_new)
+        g_new = jnp.where(improved[:, None], g_cand, g)
+        s = x_new - x
+        yv = g_new - g
+        sy = jnp.einsum("mi,mi->m", s, yv)
+        rho = jnp.where(jnp.abs(sy) > 1e-10, 1.0 / sy, 0.0)
+        V = I[None] - rho[:, None, None] * s[:, :, None] * yv[:, None, :]
+        H_new = (
+            jnp.einsum("mij,mjk,mlk->mil", V, H, V)
+            + rho[:, None, None] * s[:, :, None] * s[:, None, :]
+        )
+        ok_H = (
+            improved & (rho > 0)
+            & jnp.all(jnp.isfinite(H_new), axis=(1, 2))
+        )
+        H = jnp.where(ok_H[:, None, None], H_new, H)
+        f = jnp.where(improved, f_new, f)
+        return x_new, f, g_new, H
+
+    f0, g0 = loss_grad(x0)
+    H0 = jnp.broadcast_to(I, (M, L, L))
+    x, f, _, _ = jax.lax.fori_loop(0, n_iters, body, (x0, f0, g0, H0))
+    return x, f
+
+
 # name -> (fn, evals_per_member(L, n_iters)) for num_evals accounting
 _OPTIMIZERS = {
     "BFGS": (
@@ -305,13 +443,31 @@ def optimize_constants_population(
         )
     optimizer, evals_per_member = _OPTIMIZERS[options.optimizer_algorithm]
 
-    def run_one(tree, x0, cm):
-        f = _member_loss_fn(tree, X, y, weights, options)
-        return optimizer(f, x0, cm, options.optimizer_iterations)
+    if _use_fused_kernels(options, n_starts * K, X):
+        # population-scale path: all (restart x member) instances through
+        # the fused loss/grad kernels in one launch per BFGS step
+        tiled = jax.tree_util.tree_map(
+            lambda a: jnp.tile(a, (n_starts,) + (1,) * (a.ndim - 1)),
+            sub_trees,
+        )
+        x_flat, f_flat = _bfgs_batched(
+            tiled,
+            starts.reshape(n_starts * K, L),
+            jnp.tile(cmask, (n_starts, 1)),
+            X, y, weights, options, options.optimizer_iterations,
+        )
+        xs = x_flat.reshape(n_starts, K, L)
+        fs = f_flat.reshape(n_starts, K)
+    else:
+        def run_one(tree, x0, cm):
+            f = _member_loss_fn(tree, X, y, weights, options)
+            return optimizer(f, x0, cm, options.optimizer_iterations)
 
-    # vmap over restarts then members
-    run_members = jax.vmap(run_one)
-    xs, fs = jax.vmap(lambda s: run_members(sub_trees, s, cmask))(starts)
+        # vmap over restarts then members
+        run_members = jax.vmap(run_one)
+        xs, fs = jax.vmap(
+            lambda s: run_members(sub_trees, s, cmask)
+        )(starts)
     # best restart per member
     best_r = jnp.argmin(fs, axis=0)  # (K,)
     x_best = jnp.take_along_axis(xs, best_r[None, :, None], axis=0)[0]

@@ -205,6 +205,11 @@ class Options:
     # pin a shape (shapes documented in ops/pallas_eval.py). Ignored on
     # the jnp interpreter path, like eval_backend="jnp".
     kernel_program: str = "auto"
+    # Constant-optimization eval path: "auto" routes BFGS through the
+    # fused Pallas loss/grad kernels (ops/pallas_grad.py) at population
+    # scale on TPU; "jnp" pins the vmapped-interpreter path; "pallas"
+    # forces the fused path (TPU-only; requires BFGS + elementwise loss).
+    optimizer_backend: str = "auto"
     # Dataset-row sharding width of the device mesh: with row_shards=r the
     # mesh is (n_devices//r, r) (islands x rows) and X/y shard their row
     # dim, loss reductions becoming cross-chip psums (the mesh analog of
@@ -260,6 +265,10 @@ class Options:
             raise ValueError(
                 "kernel_program must be one of "
                 "auto/postfix/instr/instr_packed"
+            )
+        if self.optimizer_backend not in ("auto", "jnp", "pallas"):
+            raise ValueError(
+                "optimizer_backend must be one of auto/jnp/pallas"
             )
         if self.row_shards < 1:
             raise ValueError("row_shards must be >= 1")
@@ -350,6 +359,7 @@ class Options:
             self.fraction_replaced_hof, self.should_optimize_constants,
             self.optimizer_probability, self.optimizer_nrestarts,
             self.optimizer_iterations, self.optimizer_algorithm,
+            self.optimizer_backend,
             str(self.loss) if not callable(self.loss) else id(self.loss),
             None if self.loss_function is None else id(self.loss_function),
             # recorder mode adds the event-collection outputs to the graph

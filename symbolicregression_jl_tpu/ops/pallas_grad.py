@@ -46,6 +46,7 @@ from .losses import l2_dist_loss
 from .operators import OperatorSet
 from .pallas_eval import (
     _SLOT_UNROLL,
+    _SRC_CONST,
     _balanced_mux,
     _round_up,
     decode_packed_word,
@@ -58,9 +59,15 @@ Array = jax.Array
 
 def _make_grad_kernel(operators: OperatorSet, t_block: int, r_block: int,
                       L: int, ML: int, tree_unroll: int, nfeat: int,
-                      loss_fn: Callable):
+                      loss_fn: Callable, with_grad: bool = True):
     """L = padded instruction-table width; ML = postfix max_len (the width
-    of the cval slot axis the gradient is reported in)."""
+    of the cval slot axis the gradient is reported in).
+
+    with_grad=False builds the loss-only sibling (forward sweep + fused
+    weighted loss, no adjoint scratch / backward sweep / cgrad output) —
+    the line-search evaluator of the batched constant optimizer, which
+    needs thousands of candidate losses per step WITHOUT materializing
+    (trees, rows) predictions in HBM the way eval_trees_pallas would."""
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
     if tree_unroll not in (1, 2, 4, 8, 16) or t_block % tree_unroll:
@@ -77,10 +84,15 @@ def _make_grad_kernel(operators: OperatorSet, t_block: int, r_block: int,
 
     def kernel(nrows_ref, word_ref, lcval_ref, rcval_ref, ninstr_ref,
                X_ref, y_ref, wn_ref,
-               loss_ref, cgrad_ref, bad_ref,
-               *scratch):
+               *outs_and_scratch):
+        if with_grad:
+            loss_ref, cgrad_ref, bad_ref = outs_and_scratch[:3]
+            scratch = outs_and_scratch[3:]
+            adj_refs = scratch[tree_unroll:]
+        else:
+            loss_ref, bad_ref = outs_and_scratch[:2]
+            scratch = outs_and_scratch[2:]
         val_refs = scratch[:tree_unroll]
-        adj_refs = scratch[tree_unroll:]
 
         # row validity comes from nrows (matching the eval kernels) — a
         # genuinely zero-weighted VALID row must still poison a tree
@@ -175,11 +187,15 @@ def _make_grad_kernel(operators: OperatorSet, t_block: int, r_block: int,
                     lambda yp: loss_fn(yp, y_t), y_pred
                 )
                 masked = jnp.where(wn != 0.0, elem * wn, 0.0)
-                (seed,) = vloss(wn)
-                seed = jnp.where(wn != 0.0, seed, 0.0)
-                adj_refs[t][nfeat + jnp.maximum(ns[t] - 1, 0)] = seed
                 loss_ref[0, tis[t]] = jnp.sum(masked)
                 bad_ref[0, tis[t]] = jnp.sum(bads[t])
+                if with_grad:
+                    (seed,) = vloss(wn)
+                    seed = jnp.where(wn != 0.0, seed, 0.0)
+                    adj_refs[t][nfeat + jnp.maximum(ns[t] - 1, 0)] = seed
+
+            if not with_grad:
+                return 0
 
             def bwd_group(g, _):
                 # descending instruction order: consumers before producers
@@ -240,6 +256,52 @@ def eval_loss_grad_pallas(
 
     TPU only (or interpret=True anywhere); float32.
     """
+    return _loss_impl(
+        trees, X, y, weights, operators, loss_fn, t_block, r_block,
+        tree_unroll, sort_trees, interpret, with_grad=True,
+    )
+
+
+def eval_loss_pallas(
+    trees: TreeBatch,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    operators: OperatorSet,
+    loss_fn: Optional[Callable] = None,
+    t_block: int = 256,
+    r_block: int = 1024,
+    tree_unroll: int = 4,
+    sort_trees: bool = True,
+    interpret: bool = False,
+) -> Tuple[Array, Array]:
+    """Loss-only sibling of eval_loss_grad_pallas: (loss, ok) with the
+    weighted mean fused on-chip, never materializing per-row predictions
+    in HBM (unlike scoring through eval_trees_pallas). The line-search
+    evaluator of the batched constant optimizer."""
+    loss, _, ok = _loss_impl(
+        trees, X, y, weights, operators, loss_fn, t_block, r_block,
+        tree_unroll, sort_trees, interpret, with_grad=False,
+    )
+    return loss, ok
+
+
+def make_loss_kernel(trees, X, y, weights, operators, loss_fn=None,
+                     with_grad=True, t_block=256, r_block=1024,
+                     tree_unroll=4, sort_trees=True, interpret=False):
+    """Stage the structure-dependent work of the fused loss(+grad) kernel
+    ONCE and return `fn(cval) -> (loss, grad|None, ok)` for repeated
+    evaluation at different constants.
+
+    The instruction schedule (a sequential O(max_len) scan), the sort by
+    instruction count, and the word packing depend only on tree
+    STRUCTURE; per call only the operand-constant tables are rebuilt —
+    two (T, L) gathers from `cval` via the postfix-slot indices the
+    schedule already records for const operands — plus the kernel
+    launch. This is what makes the batched constant optimizer cheap: its
+    BFGS loop calls fn() twice per iteration inside a fori_loop, where
+    re-running the schedule each step would dominate.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -260,7 +322,7 @@ def eval_loss_grad_pallas(
     n_codes = 2 + operators.n_unary + operators.n_binary
     if n_codes > 255 or const_base + ML + 1 > 2048:
         raise ValueError(
-            "eval_loss_grad_pallas needs <=255 opcodes and "
+            "the fused loss/grad kernel needs <=255 opcodes and "
             f"nfeat + padded_len + max_len <= ~2048 (got {n_codes} "
             f"opcodes, nfeat={nfeat}, L={L}, max_len={ML})"
         )
@@ -277,9 +339,16 @@ def eval_loss_grad_pallas(
                        constant_values=fill).T
 
     word = padT(pack_instr_tables(tables, nfeat, const_base=const_base))
-    lcval = padT(tables["lcval"].astype(jnp.float32))
-    rcval = padT(tables["rcval"].astype(jnp.float32))
     ninstr_p = jnp.pad(n_instr, (0, T_pad - T))[None, :]
+    perm = None if inv_perm is None else jnp.argsort(inv_perm)
+    # operand-constant reconstruction indices: const operands carry their
+    # postfix cval slot (instruction_schedule records it); the dummy left
+    # operand of non-binary steps points at slot ML, which maps onto the
+    # zero pad column below
+    lconst_m = tables["lsrc"] == _SRC_CONST
+    rconst_m = tables["rsrc"] == _SRC_CONST
+    lslot = jnp.clip(tables["lidx"], 0, ML)
+    rslot = jnp.clip(tables["ridx"], 0, ML)
 
     Xp = jnp.pad(X.astype(jnp.float32), ((0, 0), (0, R_pad - nrows)))
     Xp = Xp.reshape(nfeat, NR, 128)
@@ -295,14 +364,39 @@ def eval_loss_grad_pallas(
     wn = jnp.pad(wn, (0, R_pad - nrows)).reshape(NR, 128)
 
     kernel, A = _make_grad_kernel(
-        operators, t_block, r_block, L, ML, tree_unroll, nfeat, loss_fn
+        operators, t_block, r_block, L, ML, tree_unroll, nfeat, loss_fn,
+        with_grad=with_grad,
     )
     grid = (T_pad // t_block, NR // r_sub)
     smem_spec = lambda shape, imap: pl.BlockSpec(
         shape, imap, memory_space=pltpu.SMEM
     )
     tree_tbl = lambda: smem_spec((L, t_block), lambda i, j: (0, i))
-    loss_p, cgrad_p, bad = pl.pallas_call(
+    scalar_out = lambda: smem_spec((1, t_block), lambda i, j: (j, i))
+    scalar_shape = jax.ShapeDtypeStruct((grid[1], T_pad), jnp.float32)
+    if with_grad:
+        out_specs = [
+            scalar_out(),                                       # loss
+            smem_spec((1, ML, t_block), lambda i, j: (j, 0, i)),  # cgrad
+            scalar_out(),                                       # bad
+        ]
+        out_shape = [
+            scalar_shape,
+            jax.ShapeDtypeStruct((grid[1], ML, T_pad), jnp.float32),
+            scalar_shape,
+        ]
+        scratch = (
+            [pltpu.VMEM((nfeat + L, r_sub, 128), jnp.float32)
+             for _ in range(tree_unroll)]
+            + [pltpu.VMEM((A, r_sub, 128), jnp.float32)
+               for _ in range(tree_unroll)]
+        )
+    else:
+        out_specs = [scalar_out(), scalar_out()]  # loss, bad
+        out_shape = [scalar_shape, scalar_shape]
+        scratch = [pltpu.VMEM((nfeat + L, r_sub, 128), jnp.float32)
+                   for _ in range(tree_unroll)]
+    launch = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -315,37 +409,55 @@ def eval_loss_grad_pallas(
             pl.BlockSpec((r_sub, 128), lambda i, j: (j, 0)),  # y
             pl.BlockSpec((r_sub, 128), lambda i, j: (j, 0)),  # wn
         ],
-        out_specs=[
-            smem_spec((1, t_block), lambda i, j: (j, i)),       # loss
-            smem_spec((1, ML, t_block), lambda i, j: (j, 0, i)),  # cgrad
-            smem_spec((1, t_block), lambda i, j: (j, i)),       # bad
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((grid[1], T_pad), jnp.float32),
-            jax.ShapeDtypeStruct((grid[1], ML, T_pad), jnp.float32),
-            jax.ShapeDtypeStruct((grid[1], T_pad), jnp.float32),
-        ],
-        scratch_shapes=(
-            [pltpu.VMEM((nfeat + L, r_sub, 128), jnp.float32)
-             for _ in range(tree_unroll)]
-            + [pltpu.VMEM((A, r_sub, 128), jnp.float32)
-               for _ in range(tree_unroll)]
-        ),
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(jnp.asarray([nrows], jnp.int32), word, lcval, rcval, ninstr_p,
-      Xp, yp, wn)
-
-    loss = jnp.sum(loss_p[:, :T], axis=0)
-    grad = jnp.sum(cgrad_p[:, :, :T], axis=0).T  # (T, ML)
-    ok = (jnp.sum(bad[:, :T], axis=0) == 0) & (flat.length > 0)
-    # only CONST slots carry gradients; everything else is stale scratch
-    grad = jnp.where(flat.kind == CONST, grad, 0.0)
-    if inv_perm is not None:
-        loss = loss[inv_perm]
-        grad = grad[inv_perm]
-        ok = ok[inv_perm]
-    return (
-        loss.reshape(batch_shape),
-        grad.reshape(batch_shape + (ML,)),
-        ok.reshape(batch_shape),
     )
+    nrows_arr = jnp.asarray([nrows], jnp.int32)
+
+    def fn(cval):
+        cv = cval.reshape((-1, ML))
+        if perm is not None:
+            cv = cv[perm]
+        # extra zero column: the dummy-operand slot ML resolves to 0.0
+        cv_ext = jnp.pad(cv.astype(jnp.float32), ((0, 0), (0, 1)))
+        take = lambda slot: jnp.take_along_axis(cv_ext, slot, axis=1)
+        lcval = padT(jnp.where(lconst_m, take(lslot), 0.0))
+        rcval = padT(jnp.where(rconst_m, take(rslot), 0.0))
+        outs = launch(nrows_arr, word, lcval, rcval, ninstr_p, Xp, yp, wn)
+        if with_grad:
+            loss_p, cgrad_p, bad = outs
+        else:
+            loss_p, bad = outs
+            cgrad_p = None
+
+        loss = jnp.sum(loss_p[:, :T], axis=0)
+        ok = (jnp.sum(bad[:, :T], axis=0) == 0) & (flat.length > 0)
+        if cgrad_p is None:
+            grad = None
+        else:
+            grad = jnp.sum(cgrad_p[:, :, :T], axis=0).T  # (T, ML)
+            # only CONST slots carry gradients; the rest is stale scratch
+            grad = jnp.where(flat.kind == CONST, grad, 0.0)
+        if inv_perm is not None:
+            loss = loss[inv_perm]
+            ok = ok[inv_perm]
+            if grad is not None:
+                grad = grad[inv_perm]
+        return (
+            loss.reshape(batch_shape),
+            None if grad is None else grad.reshape(batch_shape + (ML,)),
+            ok.reshape(batch_shape),
+        )
+
+    return fn
+
+
+def _loss_impl(trees, X, y, weights, operators, loss_fn, t_block, r_block,
+               tree_unroll, sort_trees, interpret, with_grad):
+    fn = make_loss_kernel(
+        trees, X, y, weights, operators, loss_fn, with_grad, t_block,
+        r_block, tree_unroll, sort_trees, interpret,
+    )
+    return fn(trees.cval)

@@ -209,3 +209,87 @@ def test_unknown_optimizer_rejected(rng):
         optimize_constants_population(
             jax.random.PRNGKey(0), pop, X, X[0], None, 1.0, opt
         )
+
+
+def test_bfgs_batched_matches_vmapped(rng, monkeypatch):
+    """The fused-kernel batched BFGS (optimizer_backend='pallas', interpret
+    mode here) recovers the same constants as the vmapped-interpreter
+    path on the same starts."""
+    import symbolicregression_jl_tpu.models.constant_opt as co
+
+    opt = make_options(
+        binary_operators=["+", "*"], unary_operators=["cos"], maxsize=10,
+        optimizer_probability=1.0, optimizer_iterations=12,
+        optimizer_nrestarts=0, optimizer_backend="pallas",
+    )
+    ops = opt.operators
+    plus, mult = ops.binary_index("+"), ops.binary_index("*")
+    cos = ops.unary_index("cos")
+    X = rng.standard_normal((1, 40)).astype(np.float32)
+    y = 2.0 * np.cos(X[0]) + 0.5
+
+    def member(c0, c1):
+        return encode_tree(
+            Expr.binary(
+                plus,
+                Expr.binary(
+                    mult, Expr.const(c0), Expr.unary(cos, Expr.var(0))
+                ),
+                Expr.const(c1),
+            ),
+            opt.max_len,
+        )
+
+    trees = stack_trees([member(1.0, 0.0), member(-0.5, 1.5),
+                         member(3.0, -1.0), member(0.2, 0.2)])
+    pop = Population(
+        trees=jax.tree_util.tree_map(jnp.asarray, trees),
+        scores=jnp.full((4,), 1e9, jnp.float32),
+        losses=jnp.full((4,), 1e9, jnp.float32),
+        birth=jnp.zeros((4,), jnp.int32),
+    )
+    monkeypatch.setattr(co, "_FORCE_INTERPRET", True)
+    pop_p, n_evals, n_att = optimize_constants_population(
+        jax.random.PRNGKey(0), pop, jnp.asarray(X), jnp.asarray(y), None,
+        1.0, opt,
+    )
+    # every member should land on c0=2.0, c1=0.5 (convex in constants)
+    assert float(jnp.max(pop_p.losses)) < 1e-4
+    assert int(n_att) == 4
+    # and the jnp path agrees on the fit quality
+    opt_j = make_options(
+        binary_operators=["+", "*"], unary_operators=["cos"],
+        maxsize=10, optimizer_probability=1.0, optimizer_iterations=12,
+        optimizer_nrestarts=0, optimizer_backend="jnp",
+    )
+    pop_j, _, _ = optimize_constants_population(
+        jax.random.PRNGKey(0), pop, jnp.asarray(X), jnp.asarray(y), None,
+        1.0, opt_j,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pop_p.losses), np.asarray(pop_j.losses),
+        rtol=1e-3, atol=1e-5,
+    )
+
+
+def test_optimizer_backend_pallas_validates(rng):
+    import pytest
+
+    opt = make_options(
+        optimizer_algorithm="NelderMead", optimizer_backend="pallas",
+        optimizer_probability=1.0,
+    )
+    X = jnp.ones((1, 10), jnp.float32)
+    pop = Population(
+        trees=jax.tree_util.tree_map(
+            jnp.asarray,
+            stack_trees([encode_tree(Expr.const(1.0), opt.max_len)] * 2),
+        ),
+        scores=jnp.ones((2,), jnp.float32),
+        losses=jnp.ones((2,), jnp.float32),
+        birth=jnp.zeros((2,), jnp.int32),
+    )
+    with pytest.raises(ValueError, match="optimizer_backend"):
+        optimize_constants_population(
+            jax.random.PRNGKey(0), pop, X, X[0], None, 1.0, opt
+        )

@@ -186,6 +186,25 @@ def test_grad_kernel_poison_flag():
     assert bool(ok[1])
 
 
+def test_loss_only_kernel_matches_grad_kernel():
+    """eval_loss_pallas (line-search evaluator) returns the same fused
+    loss and ok as the with-grad kernel."""
+    from symbolicregression_jl_tpu.ops.pallas_grad import eval_loss_pallas
+
+    trees, X, y = _workload(n=16, seed=5)
+    l1, _, ok1 = eval_loss_grad_pallas(
+        trees, X, y, None, OPS, interpret=True, t_block=8, tree_unroll=2
+    )
+    l2, ok2 = eval_loss_pallas(
+        trees, X, y, None, OPS, interpret=True, t_block=8, tree_unroll=2
+    )
+    np.testing.assert_array_equal(np.asarray(ok1), np.asarray(ok2))
+    m = np.asarray(ok1)
+    np.testing.assert_allclose(
+        np.asarray(l1)[m], np.asarray(l2)[m], rtol=1e-6, atol=1e-7
+    )
+
+
 def test_grad_kernel_zero_weight_row_still_poisons():
     """A tree that is non-finite only on a zero-weighted VALID row must
     still be flagged not-ok (parity with eval_trees_pallas, whose ok is
