@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Throughput A/B of the constant-optimization objective on real TPU:
+the fused Pallas loss+grad kernel (ops/pallas_grad.py) vs `jax.grad`
+through the vmapped lockstep interpreter (the models/constant_opt.py
+default path) on the bench.py workload shape.
+
+Prints trees-rows/s for (a) loss+grad batch, (b) loss-only batch (the
+line-search evaluator), for both backends. Usage:
+    python benchmark/grad_bench.py [n_trees] [n_inner]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bench import (
+        N_ROWS,
+        _build_workload,
+        _devices_or_cpu_fallback,
+        _dispatch_overhead_s,
+        _feynman_data,
+    )
+
+    _devices_or_cpu_fallback(verbose=True, use_memo=True)
+    from symbolicregression_jl_tpu.models.options import make_options
+    from symbolicregression_jl_tpu.ops.interpreter import eval_trees
+    from symbolicregression_jl_tpu.ops.losses import aggregate_loss
+    from symbolicregression_jl_tpu.ops.pallas_grad import make_loss_kernel
+
+    args = sys.argv[1:]
+    n_trees = int(args[0]) if args else 4096
+    n_inner = int(args[1]) if len(args) > 1 else 10
+
+    options = make_options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp"],
+        maxsize=20,
+    )
+    ops = options.operators
+    dev = jax.devices()[0]
+    print(f"# device: {dev} ({dev.platform})", file=sys.stderr)
+
+    trees = _build_workload(jax, jnp, options, n_trees, 1)
+    X_h, y_h = _feynman_data()
+    X = jnp.asarray(X_h)
+    y = jnp.asarray(y_h)
+    overhead = _dispatch_overhead_s(jax, jnp, dev)
+
+    def timeit(fn):
+        t0 = time.perf_counter()
+        fn()
+        compile_s = time.perf_counter() - t0
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        per = max((float(np.median(ts)) - overhead) / n_inner, 1e-9)
+        return n_trees * N_ROWS / per, per, compile_s
+
+    results = []
+
+    # fused kernels: structure staged once, constants swapped per call
+    for with_grad, label in ((True, "fused loss+grad"),
+                             (False, "fused loss-only")):
+        fn = make_loss_kernel(
+            trees, X, y, None, ops, with_grad=with_grad
+        )
+
+        def run(fn=fn):
+            def body(i, acc):
+                out = fn(trees.cval + acc * 1e-12)
+                loss = out[0]
+                return acc + jnp.clip(
+                    jnp.mean(jnp.where(jnp.isfinite(loss), loss, 0.0)),
+                    0.0, 1.0,
+                )
+
+            return float(jax.jit(
+                lambda: jax.lax.fori_loop(0, n_inner, body, jnp.float32(0.0))
+            )())
+
+        try:
+            rate, per, comp = timeit(run)
+        except Exception as e:
+            print(f"FAIL {label}: {type(e).__name__}: {e}", file=sys.stderr)
+            continue
+        results.append((label, rate))
+        print(f"{rate:.3e} t-r/s  {per*1e3:7.2f} ms/iter  "
+              f"(compile {comp:.0f}s)  {label}", flush=True)
+
+    # interpreter autodiff baseline (the vmapped per-member closure path)
+    def member_loss(cval, kind, op, feat, length):
+        from symbolicregression_jl_tpu.models.trees import TreeBatch
+        t = TreeBatch(kind=kind[None], op=op[None], feat=feat[None],
+                      cval=cval[None], length=length[None])
+        yp, ok = eval_trees(t, X, ops)
+        elem = (yp[0] - y) ** 2
+        loss = aggregate_loss(elem, None)
+        return jnp.where(ok[0] & jnp.isfinite(loss), loss, jnp.inf)
+
+    vg = jax.vmap(jax.value_and_grad(member_loss),
+                  in_axes=(0, 0, 0, 0, 0))
+
+    def run_autodiff():
+        def body(i, acc):
+            f, g = vg(trees.cval + acc * 1e-12, trees.kind, trees.op,
+                      trees.feat, trees.length)
+            return acc + jnp.clip(
+                jnp.mean(jnp.where(jnp.isfinite(f), f, 0.0)), 0.0, 1.0
+            )
+
+        return float(jax.jit(
+            lambda: jax.lax.fori_loop(0, n_inner, body, jnp.float32(0.0))
+        )())
+
+    try:
+        rate, per, comp = timeit(run_autodiff)
+        results.append(("interpreter value_and_grad (vmap)", rate))
+        print(f"{rate:.3e} t-r/s  {per*1e3:7.2f} ms/iter  "
+              f"(compile {comp:.0f}s)  interpreter value_and_grad (vmap)",
+              flush=True)
+    except Exception as e:
+        print(f"FAIL autodiff baseline: {type(e).__name__}: {e}",
+              file=sys.stderr)
+
+    if results:
+        best = max(results, key=lambda r: r[1])
+        print(f"\nBEST: {best[1]:.3e} trees-rows/s  {best[0]}")
+
+
+if __name__ == "__main__":
+    main()
