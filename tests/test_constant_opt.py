@@ -293,3 +293,36 @@ def test_optimizer_backend_pallas_validates(rng):
         optimize_constants_population(
             jax.random.PRNGKey(0), pop, X, X[0], None, 1.0, opt
         )
+
+
+def test_use_fused_kernels_routing(monkeypatch):
+    """'auto' engages the fused path only on TPU, at scale, in f32, for
+    BFGS with an elementwise loss, AND when the packed layout fits;
+    'jnp' always pins the interpreter path."""
+    import symbolicregression_jl_tpu.models.constant_opt as co
+    import symbolicregression_jl_tpu.ops.pallas_eval as pe
+
+    X = jnp.ones((1, 10), jnp.float32)
+    opt = make_options(optimizer_backend="auto")
+    # off-TPU: never
+    assert not co._use_fused_kernels(opt, 10_000, X)
+
+    monkeypatch.setattr(pe, "pallas_available", lambda: True)
+    assert co._use_fused_kernels(opt, 10_000, X)
+    # too small a batch
+    assert not co._use_fused_kernels(opt, 8, X)
+    # non-f32 data (bf16 here; f64 is unconstructable without x64 enabled)
+    assert not co._use_fused_kernels(
+        opt, 10_000, jnp.ones((1, 10), jnp.bfloat16)
+    )
+    # layout overflow (wide feature space) falls back quietly on auto
+    X_wide = jnp.ones((2040, 10), jnp.float32)
+    assert not co._use_fused_kernels(opt, 10_000, X_wide)
+    # non-BFGS never routes on auto
+    opt_nm = make_options(
+        optimizer_algorithm="NelderMead", optimizer_backend="auto"
+    )
+    assert not co._use_fused_kernels(opt_nm, 10_000, X)
+    # explicit jnp pin
+    opt_jnp = make_options(optimizer_backend="jnp")
+    assert not co._use_fused_kernels(opt_jnp, 10_000, X)
