@@ -239,7 +239,7 @@ def _use_fused_kernels(options: Options, n_instances: int, X: Array) -> bool:
     pins the vmapped interpreter path; 'pallas' forces the fused path
     (TPU-only, no custom loss_function, BFGS; layout overflows raise
     from the kernel)."""
-    from ..ops.pallas_eval import _SLOT_UNROLL, pallas_available
+    from ..ops.pallas_eval import _SLOT_UNROLL, _round_up, pallas_available
     from .fitness import _PALLAS_MIN_BATCH
 
     backend = options.optimizer_backend
@@ -261,7 +261,7 @@ def _use_fused_kernels(options: Options, n_instances: int, X: Array) -> bool:
     ops = options.operators
     n_codes = 2 + ops.n_unary + ops.n_binary
     ML = options.max_len
-    L_pad = ((ML + _SLOT_UNROLL - 1) // _SLOT_UNROLL) * _SLOT_UNROLL
+    L_pad = _round_up(ML, _SLOT_UNROLL)
     fits = n_codes <= 255 and X.shape[0] + L_pad + ML + 1 <= 2048
     return (
         fits

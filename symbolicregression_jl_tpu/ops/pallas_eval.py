@@ -345,6 +345,24 @@ def decode_packed_word(w):
             (w >> 10) & 0x7FF, (w >> 21) & 0x7FF)
 
 
+def instr_dispatch(code, a, b, unary_fns, binary_fns, dispatch="mux"):
+    """Branchless candidate dispatch over the instruction opcodes —
+    shared by both instr-kernel table layouts and the gradient kernel's
+    forward sweep (opcodes: 0 DEAD, 1 IDENT, then unary, then binary)."""
+    if dispatch == "chain":
+        U = len(unary_fns)
+        v = a
+        for j, fn in enumerate(unary_fns):
+            v = jnp.where(code == 2 + j, fn(a), v)
+        for j, fn in enumerate(binary_fns):
+            v = jnp.where(code == 2 + U + j, fn(b, a), v)
+        return v
+    cands = [a, a]  # DEAD (dead), IDENT
+    cands += [fn(a) for fn in unary_fns]
+    cands += [fn(b, a) for fn in binary_fns]
+    return _balanced_mux(code, cands)
+
+
 def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                  max_len: int, slot_loop: str, dispatch: str,
                  tree_unroll: int, compute_dtype=jnp.float32):
@@ -497,32 +515,18 @@ def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
 
     unary_fns = operators.unary_fns
     binary_fns = operators.binary_fns
-    U = len(unary_fns)
     r_sub = r_block // 128
     cdt = compute_dtype
     base = nfeat if packed else 0  # scratch offset of instruction results
-
-    def dispatch_value(code, a, b):
-        """Branchless candidate dispatch over the instruction opcodes
-        (shared by both table layouts)."""
-        if dispatch == "chain":
-            v = a
-            for j, fn in enumerate(unary_fns):
-                v = jnp.where(code == 2 + j, fn(a), v)
-            for j, fn in enumerate(binary_fns):
-                v = jnp.where(code == 2 + U + j, fn(b, a), v)
-            return v
-        cands = [a, a]  # DEAD (dead), IDENT
-        cands += [fn(a) for fn in unary_fns]
-        cands += [fn(b, a) for fn in binary_fns]
-        return _balanced_mux(code, cands)
 
     def make_body(read_operands, val_refs, valid_f):
         """The per-step body around a layout-specific operand reader."""
 
         def instr_body(si, ti, bad, val_ref):
             code, a, b = read_operands(si, ti, val_ref)
-            v = dispatch_value(code, a, b).astype(cdt)
+            v = instr_dispatch(
+                code, a, b, unary_fns, binary_fns, dispatch
+            ).astype(cdt)
             val_ref[base + si] = v
             # operand finiteness matters too: the postfix kernel checks
             # every leaf slot's value, so a tree whose op maps an Inf

@@ -50,6 +50,7 @@ from .pallas_eval import (
     _balanced_mux,
     _round_up,
     decode_packed_word,
+    instr_dispatch,
     pack_instr_tables,
     prep_instr_tables,
 )
@@ -126,10 +127,7 @@ def _make_grad_kernel(operators: OperatorSet, t_block: int, r_block: int,
 
         def fwd_body(si, ti, bad, val_ref):
             code, _, _, a, b = operands(si, ti, val_ref)
-            cands = [a, a]
-            cands += [fn(a) for fn in unary_fns]
-            cands += [fn(b, a) for fn in binary_fns]
-            v = _balanced_mux(code, cands)
+            v = instr_dispatch(code, a, b, unary_fns, binary_fns)
             val_ref[nfeat + si] = v
             fin = jnp.isfinite(v) & jnp.isfinite(a) & jnp.isfinite(b)
             return jnp.maximum(
