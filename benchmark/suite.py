@@ -205,6 +205,73 @@ def bench_search_iteration():
     ]
 
 
+def bench_search_iteration_northstar():
+    """BASELINE.json's north-star search shape (npopulations=64,
+    npop=1000): at this scale the in-loop scoring batches clear
+    _PALLAS_MIN_BATCH, so on TPU the evolution cycles themselves run
+    through the Pallas eval kernel and constant optimization through the
+    fused loss/grad kernels (optimizer_backend='auto'). Heavy — runs on
+    non-CPU platforms or with SRTPU_SUITE_BIG=1."""
+    import jax
+
+    if jax.devices()[0].platform == "cpu" and not os.environ.get(
+        "SRTPU_SUITE_BIG"
+    ):
+        return []
+    import jax.numpy as jnp
+    import numpy as np
+
+    from symbolicregression_jl_tpu.api import _make_init_fn, _make_iteration_fn
+    from symbolicregression_jl_tpu.models.options import make_options
+
+    options = make_options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp"],
+        npop=1000,
+        npopulations=64,
+        ncycles_per_iteration=25,
+        maxsize=20,
+    )
+    n_feat, n_rows = 1, 1000
+    rng = np.random.default_rng(0)
+    theta = rng.uniform(1.0, 3.0, n_rows).astype(np.float32)
+    X = jnp.asarray(theta[None, :])
+    y = jnp.asarray(
+        (np.exp(-(theta**2) / 2.0) / np.sqrt(2 * np.pi)).astype(np.float32)
+    )
+    baseline = jnp.float32(float(jnp.var(y)))
+
+    init_fn = _make_init_fn(options, n_feat, False)
+    states = init_fn(
+        jax.random.split(jax.random.PRNGKey(0), options.npopulations),
+        X, y, baseline,
+    )
+    it_fn = _make_iteration_fn(options, False)
+    cm = jnp.int32(options.maxsize)
+
+    def run():
+        s2, ghof = it_fn(states, jax.random.PRNGKey(1), cm, X, y, baseline)
+        jax.block_until_ready(ghof.losses)
+
+    dt = _median_time(run, reps=3)
+    cand_evals = (
+        options.ncycles_per_iteration
+        * options.n_parallel_tournaments
+        * options.npopulations
+    )
+    return [
+        {
+            "suite": "search_iteration_northstar",
+            "case": (
+                f"islands{options.npopulations}_npop{options.npop}_"
+                f"cycles{options.ncycles_per_iteration}_rows{n_rows}"
+            ),
+            "median_s": dt,
+            "candidate_evals_per_s": cand_evals / dt,
+        }
+    ]
+
+
 def main():
     from bench import _devices_or_cpu_fallback
 
@@ -216,6 +283,7 @@ def main():
         bench_single_eval_48_nodes,
         bench_population_scoring,
         bench_search_iteration,
+        bench_search_iteration_northstar,
     ):
         try:
             results.extend(fn())

@@ -36,7 +36,7 @@ from .models.evolve import (
     IslandState,
     expected_optimize_count,
     init_island_state,
-    optimize_island_constants,
+    optimize_islands_constants,
     s_r_cycle_islands,
     simplify_population_islands,
 )
@@ -199,11 +199,9 @@ def _make_iteration_fn(options: Options, has_weights: bool):
         if options.should_optimize_constants and options.optimizer_probability > 0:
             I = states.birth_counter.shape[0]
             okeys = jax.random.split(k_opt, I)
-            states = jax.vmap(
-                lambda k, st: optimize_island_constants(
-                    k, st, X, y, weights, baseline, options
-                )
-            )(okeys, states)
+            states = optimize_islands_constants(
+                okeys, states, X, y, weights, baseline, options
+            )
         # the `optimize` mutation (reference src/Mutate.jl:142-168): one
         # iteration-level pass sized to the expected number of sampled
         # optimize slots, instead of BFGS inside the cycle scan
@@ -212,12 +210,10 @@ def _make_iteration_fn(options: Options, has_weights: bool):
             p_sel = min(1.0, n_opt_mut / options.npop)
             I = states.birth_counter.shape[0]
             okeys2 = jax.random.split(k_opt_mut, I)
-            states = jax.vmap(
-                lambda k, st: optimize_island_constants(
-                    k, st, X, y, weights, baseline, options,
-                    probability=p_sel, count_optimize_telemetry=True,
-                )
-            )(okeys2, states)
+            states = optimize_islands_constants(
+                okeys2, states, X, y, weights, baseline, options,
+                probability=p_sel, count_optimize_telemetry=True,
+            )
         ghof = merge_hofs_across_islands(states.hof)
         states = migrate(k_mig, states, ghof, options)
         if options.recorder:

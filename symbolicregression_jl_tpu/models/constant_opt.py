@@ -381,41 +381,31 @@ _OPTIMIZERS = {
 }
 
 
-def optimize_constants_population(
-    key: Array,
-    pop: Population,
-    X: Array,
-    y: Array,
-    weights: Optional[Array],
-    baseline: float,
-    options: Options,
-    probability: Optional[float] = None,
-) -> Tuple[Population, Array, Array]:
-    """Select members w.p. optimizer_probability (or `probability` when
-    given — the `optimize` mutation pass uses its own rate), fit their
-    constants, write back where improved (reference
-    src/SingleIteration.jl:75-79 + src/ConstantOptimization.jl:22-65).
-    Returns (population', n_extra_evals, n_attempted) — n_attempted is
-    the number of constant-bearing members actually optimized (bounds
-    the telemetry's accepted count).
-    """
-    npop = pop.npop
-    L = pop.trees.max_len
-    n_restarts = options.optimizer_nrestarts
-    n_starts = 1 + n_restarts
-    k_sel, k_perturb = jax.random.split(key)
-
+def _static_shapes(pop: Population, options: Options,
+                   probability: Optional[float]):
+    """(K, n_starts, L) — the static sizes of one island's optimization."""
     if probability is None:
         probability = options.optimizer_probability
-    # Fixed-size random subset K ~= npop * p (static shape; the reference's
-    # per-member Bernoulli draw has the same mean). Members without
-    # constants are deprioritized and later masked out.
-    K = max(1, int(round(npop * probability)))
+    K = max(1, int(round(pop.npop * probability)))
+    return K, 1 + options.optimizer_nrestarts, pop.trees.max_len
+
+
+def _select_and_starts(key, pop, options, K, n_starts):
+    """Pick the K members to optimize and build their restart starting
+    points; pure jnp so it vmaps over islands. Fixed-size random subset
+    K ~= npop * p (static shape; the reference's per-member Bernoulli
+    draw has the same mean); members without constants are deprioritized
+    and masked out via `eligible`."""
+    L = pop.trees.max_len
+    n_restarts = n_starts - 1
+    k_sel, k_perturb = jax.random.split(key)
     idx = jnp.arange(L)
     has_consts = jnp.sum(
         (pop.trees.kind == CONST) & (idx < pop.trees.length[:, None]), axis=-1
     ) > 0
-    priority = jax.random.uniform(k_sel, (npop,)) + has_consts.astype(jnp.float32)
+    priority = jax.random.uniform(
+        k_sel, (pop.npop,)
+    ) + has_consts.astype(jnp.float32)
     _, sel_idx = jax.lax.top_k(priority, K)  # (K,)
     sub_trees = jax.tree_util.tree_map(lambda x: x[sel_idx], pop.trees)
     sub_losses = pop.losses[sel_idx]
@@ -435,39 +425,28 @@ def optimize_constants_population(
     cmask = (
         (sub_trees.kind == CONST) & (idx < sub_trees.length[:, None])
     ).astype(pop.trees.cval.dtype)
+    return sel_idx, sub_trees, sub_losses, eligible, starts, cmask
 
-    if options.optimizer_algorithm not in _OPTIMIZERS:
-        raise ValueError(
-            f"optimizer_algorithm {options.optimizer_algorithm!r} not in "
-            f"{sorted(_OPTIMIZERS)}"
-        )
-    optimizer, evals_per_member = _OPTIMIZERS[options.optimizer_algorithm]
 
-    if _use_fused_kernels(options, n_starts * K, X):
-        # population-scale path: all (restart x member) instances through
-        # the fused loss/grad kernels in one launch per BFGS step
-        tiled = jax.tree_util.tree_map(
-            lambda a: jnp.tile(a, (n_starts,) + (1,) * (a.ndim - 1)),
-            sub_trees,
-        )
-        x_flat, f_flat = _bfgs_batched(
-            tiled,
-            starts.reshape(n_starts * K, L),
-            jnp.tile(cmask, (n_starts, 1)),
-            X, y, weights, options, options.optimizer_iterations,
-        )
-        xs = x_flat.reshape(n_starts, K, L)
-        fs = f_flat.reshape(n_starts, K)
-    else:
-        def run_one(tree, x0, cm):
-            f = _member_loss_fn(tree, X, y, weights, options)
-            return optimizer(f, x0, cm, options.optimizer_iterations)
+def _run_vmapped(sub_trees, starts, cmask, X, y, weights, options,
+                 optimizer):
+    """The portable path: one `jax.grad`/loss closure per member, vmapped
+    over restarts then members. Returns (xs (n_starts, K, L),
+    fs (n_starts, K))."""
 
-        # vmap over restarts then members
-        run_members = jax.vmap(run_one)
-        xs, fs = jax.vmap(
-            lambda s: run_members(sub_trees, s, cmask)
-        )(starts)
+    def run_one(tree, x0, cm):
+        f = _member_loss_fn(tree, X, y, weights, options)
+        return optimizer(f, x0, cm, options.optimizer_iterations)
+
+    run_members = jax.vmap(run_one)
+    return jax.vmap(lambda s: run_members(sub_trees, s, cmask))(starts)
+
+
+def _write_back(pop, sel_idx, sub_trees, sub_losses, eligible, xs, fs,
+                baseline, options, n_starts, evals_per_member):
+    """Fold optimized constants back where improved; pure jnp so it vmaps
+    over islands. Returns (Population, n_evals, n_attempted)."""
+    L = pop.trees.max_len
     # best restart per member
     best_r = jnp.argmin(fs, axis=0)  # (K,)
     x_best = jnp.take_along_axis(xs, best_r[None, :, None], axis=0)[0]
@@ -503,3 +482,114 @@ def optimize_constants_population(
         n_evals,
         n_attempted,
     )
+
+
+def _get_optimizer(options: Options):
+    if options.optimizer_algorithm not in _OPTIMIZERS:
+        raise ValueError(
+            f"optimizer_algorithm {options.optimizer_algorithm!r} not in "
+            f"{sorted(_OPTIMIZERS)}"
+        )
+    return _OPTIMIZERS[options.optimizer_algorithm]
+
+
+def optimize_constants_population(
+    key: Array,
+    pop: Population,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    options: Options,
+    probability: Optional[float] = None,
+) -> Tuple[Population, Array, Array]:
+    """Select members w.p. optimizer_probability (or `probability` when
+    given — the `optimize` mutation pass uses its own rate), fit their
+    constants, write back where improved (reference
+    src/SingleIteration.jl:75-79 + src/ConstantOptimization.jl:22-65).
+    Returns (population', n_extra_evals, n_attempted) — n_attempted is
+    the number of constant-bearing members actually optimized (bounds
+    the telemetry's accepted count).
+
+    NOTE: must not be called under `jax.vmap` with the fused path
+    engaged (the Pallas launch has no batching rule); the production
+    multi-island entry is `optimize_constants_islands`, which batches
+    islands into the kernel launch itself — this function is its I=1
+    special case.
+    """
+    pops = jax.tree_util.tree_map(lambda x: x[None], pop)
+    pops2, n_evals, n_attempted = optimize_constants_islands(
+        key[None], pops, X, y, weights, baseline, options, probability
+    )
+    return (
+        jax.tree_util.tree_map(lambda x: x[0], pops2),
+        n_evals[0],
+        n_attempted[0],
+    )
+
+
+def optimize_constants_islands(
+    keys: Array,
+    pops: Population,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    options: Options,
+    probability: Optional[float] = None,
+) -> Tuple[Population, Array, Array]:
+    """Multi-island constant optimization: `pops` carries a leading
+    islands axis on every field, `keys` is (I, key). Selection and
+    write-back vmap per island; the OPTIMIZATION itself routes either
+    through one global fused-kernel BFGS over every
+    (island x restart x member) instance — the path jax.vmap cannot
+    express, since the Pallas launch has no batching rule — or through
+    the per-member vmapped closures (identical results to vmapping
+    `optimize_constants_population`). Returns (pops', n_evals (I,),
+    n_attempted (I,))."""
+    I = pops.losses.shape[0]
+    one = jax.tree_util.tree_map(lambda x: x[0], pops)
+    K, n_starts, L = _static_shapes(one, options, probability)
+    optimizer, evals_per_member = _get_optimizer(options)
+
+    sel_idx, sub_trees, sub_losses, eligible, starts, cmask = jax.vmap(
+        lambda k, p: _select_and_starts(k, p, options, K, n_starts)
+    )(keys, pops)
+    # shapes: sel_idx (I, K), sub_trees (I, K, ...), starts
+    # (I, n_starts, K, L), cmask (I, K, L)
+
+    if _use_fused_kernels(options, I * n_starts * K, X):
+        # flatten islands into the member axis, restart-major like the
+        # single-population path
+        flat_sub = jax.tree_util.tree_map(
+            lambda a: a.reshape((I * K,) + a.shape[2:]), sub_trees
+        )
+        tiled = jax.tree_util.tree_map(
+            lambda a: jnp.tile(a, (n_starts,) + (1,) * (a.ndim - 1)),
+            flat_sub,
+        )
+        starts_flat = jnp.moveaxis(starts, 1, 0).reshape(
+            n_starts * I * K, L
+        )
+        cmask_flat = jnp.tile(cmask.reshape(I * K, L), (n_starts, 1))
+        x_flat, f_flat = _bfgs_batched(
+            tiled, starts_flat, cmask_flat, X, y, weights, options,
+            options.optimizer_iterations,
+        )
+        xs = jnp.moveaxis(
+            x_flat.reshape(n_starts, I, K, L), 0, 1
+        )  # (I, n_starts, K, L)
+        fs = jnp.moveaxis(f_flat.reshape(n_starts, I, K), 0, 1)
+    else:
+        xs, fs = jax.vmap(
+            lambda st, s, cm: _run_vmapped(
+                st, s, cm, X, y, weights, options, optimizer
+            )
+        )(sub_trees, starts, cmask)
+
+    return jax.vmap(
+        lambda p, si, st, sl, el, x, f: _write_back(
+            p, si, st, sl, el, x, f, baseline, options, n_starts,
+            evals_per_member,
+        )
+    )(pops, sel_idx, sub_trees, sub_losses, eligible, xs, fs)

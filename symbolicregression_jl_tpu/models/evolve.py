@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from .complexity import compute_complexity
-from .constant_opt import optimize_constants_population
+from .constant_opt import optimize_constants_islands
 from .constraints import check_constraints_single
 from .fitness import sample_batch_idx, score_trees
 from .mutate_device import (
@@ -809,22 +809,58 @@ def optimize_island_constants(
     With count_optimize_telemetry=True (the mutation_weights.optimize pass)
     the attempted/improved counts land in the OPTIMIZE row of mut_counts
     (the cycle switch's OPTIMIZE placeholder slots are excluded from the
-    counters so accepted <= proposed holds deterministically)."""
-    pop2, n_evals, n_attempted = optimize_constants_population(
-        key, state.pop, X, y, weights, baseline, options, probability
+    counters so accepted <= proposed holds deterministically).
+
+    I=1 special case of optimize_islands_constants (same add/strip
+    leading-axis shape as simplify_population over its islands form)."""
+    states = jax.tree_util.tree_map(lambda x: x[None], state)
+    states2 = optimize_islands_constants(
+        key[None], states, X, y, weights, baseline, options, probability,
+        count_optimize_telemetry,
     )
-    hof2 = update_hall_of_fame(
-        state.hof, pop2.trees, pop2.scores, pop2.losses, options
+    return jax.tree_util.tree_map(lambda x: x[0], states2)
+
+
+def optimize_islands_constants(
+    keys: Array,
+    states,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    options: Options,
+    probability: Optional[float] = None,
+    count_optimize_telemetry: bool = False,
+):
+    """Multi-island sibling of optimize_island_constants — the production
+    entry (api.py). Selection and write-back vmap per island, but the
+    optimization itself goes through constant_opt.optimize_constants_islands
+    so the fused-kernel BFGS can batch EVERY island's
+    (restart x member) instances into one Pallas launch — a shape
+    `jax.vmap(optimize_island_constants)` cannot express (pallas_call has
+    no batching rule). The jnp fallback path is numerically identical to
+    the vmapped form."""
+    pops2, n_evals, n_attempted = optimize_constants_islands(
+        keys, states.pop, X, y, weights, baseline, options, probability
     )
-    counts = state.mut_counts
-    if count_optimize_telemetry:
-        n_improved = jnp.sum(pop2.losses < state.pop.losses).astype(jnp.int32)
-        counts = counts.at[OPTIMIZE, 0].add(n_attempted)
-        counts = counts.at[OPTIMIZE, 1].add(n_improved)
-    return state._replace(
-        pop=pop2, hof=hof2, num_evals=state.num_evals + n_evals,
-        mut_counts=counts,
-    )
+
+    def fold(state, pop2, n_ev, n_att):
+        hof2 = update_hall_of_fame(
+            state.hof, pop2.trees, pop2.scores, pop2.losses, options
+        )
+        counts = state.mut_counts
+        if count_optimize_telemetry:
+            n_improved = jnp.sum(
+                pop2.losses < state.pop.losses
+            ).astype(jnp.int32)
+            counts = counts.at[OPTIMIZE, 0].add(n_att)
+            counts = counts.at[OPTIMIZE, 1].add(n_improved)
+        return state._replace(
+            pop=pop2, hof=hof2, num_evals=state.num_evals + n_ev,
+            mut_counts=counts,
+        )
+
+    return jax.vmap(fold)(states, pops2, n_evals, n_attempted)
 
 
 def expected_optimize_count(options: Options) -> float:

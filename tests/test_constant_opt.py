@@ -326,3 +326,86 @@ def test_use_fused_kernels_routing(monkeypatch):
     # explicit jnp pin
     opt_jnp = make_options(optimizer_backend="jnp")
     assert not co._use_fused_kernels(opt_jnp, 10_000, X)
+
+
+def test_optimize_constants_islands_fused_matches_vmapped(rng, monkeypatch):
+    """The islands-level entry must give the same result through the
+    global fused-kernel batch (interpret mode) as through the vmapped
+    per-member path, and identical to vmapping the single-population
+    function (the production-equivalence guarantee)."""
+    import symbolicregression_jl_tpu.models.constant_opt as co
+    from symbolicregression_jl_tpu.models.constant_opt import (
+        optimize_constants_islands,
+    )
+
+    def opts(backend):
+        return make_options(
+            binary_operators=["+", "*"], unary_operators=["cos"],
+            maxsize=10, optimizer_probability=1.0,
+            optimizer_iterations=8, optimizer_nrestarts=1,
+            optimizer_backend=backend,
+        )
+
+    opt_p, opt_j = opts("pallas"), opts("jnp")
+    ops = opt_p.operators
+    plus, mult = ops.binary_index("+"), ops.binary_index("*")
+    cos = ops.unary_index("cos")
+    X = rng.standard_normal((1, 30)).astype(np.float32)
+    y = 2.0 * np.cos(X[0]) + 0.5
+
+    def member(c0, c1):
+        return encode_tree(
+            Expr.binary(
+                plus,
+                Expr.binary(
+                    mult, Expr.const(c0), Expr.unary(cos, Expr.var(0))
+                ),
+                Expr.const(c1),
+            ),
+            opt_p.max_len,
+        )
+
+    I, npop = 3, 2
+    flat = stack_trees([
+        member(float(c0), float(c1))
+        for c0, c1 in rng.uniform(-2, 2, (I * npop, 2))
+    ])
+    trees = jax.tree_util.tree_map(
+        lambda a: jnp.asarray(a).reshape((I, npop) + a.shape[1:]), flat
+    )
+    pops = Population(
+        trees=trees,
+        scores=jnp.full((I, npop), 1e9, jnp.float32),
+        losses=jnp.full((I, npop), 1e9, jnp.float32),
+        birth=jnp.zeros((I, npop), jnp.int32),
+    )
+    keys = jax.random.split(jax.random.PRNGKey(0), I)
+    Xj, yj = jnp.asarray(X), jnp.asarray(y)
+
+    monkeypatch.setattr(co, "_FORCE_INTERPRET", True)
+    pops_f, ev_f, att_f = optimize_constants_islands(
+        keys, pops, Xj, yj, None, 1.0, opt_p
+    )
+    pops_j, ev_j, att_j = optimize_constants_islands(
+        keys, pops, Xj, yj, None, 1.0, opt_j
+    )
+    # same members attempted, same eval accounting, same quality
+    np.testing.assert_array_equal(np.asarray(att_f), np.asarray(att_j))
+    np.testing.assert_allclose(
+        np.asarray(pops_f.losses), np.asarray(pops_j.losses),
+        rtol=1e-3, atol=1e-5,
+    )
+    # and the jnp islands path is bit-identical to vmapping the
+    # single-population function (what api.py used to do)
+    pops_v, ev_v, att_v = jax.vmap(
+        lambda k, p: optimize_constants_population(
+            k, p, Xj, yj, None, 1.0, opt_j
+        )
+    )(keys, pops)
+    np.testing.assert_array_equal(
+        np.asarray(pops_j.trees.cval), np.asarray(pops_v.trees.cval)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pops_j.losses), np.asarray(pops_v.losses)
+    )
+    np.testing.assert_array_equal(np.asarray(ev_j), np.asarray(ev_v))
