@@ -7,7 +7,8 @@ islands advance together inside ONE jitted iteration function:
 
     s_r_cycle (lax.scan of batched evolution cycles)
     -> simplify_population
-    -> optimize_constants_population      (vmapped BFGS)
+    -> optimize_constants_islands         (batched BFGS: vmapped closures
+                                           or fused Pallas loss/grad kernels)
     -> merge_halls_of_fame across islands (cross-island reduction)
     -> migrate                            (all-gather topn pool + masked replace)
 
