@@ -40,8 +40,9 @@ def dispatch_eval(
     large float32/bfloat16 top-level batches on TPU (the bench /
     standalone-eval hot path); the portable jnp lockstep interpreter
     otherwise (small per-island batches inside the vmapped evolution step,
-    CPU, f64/f16 dtypes). bfloat16 inputs run the kernel's bf16-compute /
-    f32-accumulate variant (the TPU-native half precision).
+    CPU, f64/f16 dtypes). bfloat16 inputs run the kernel's bf16-storage /
+    f32-compute variant (the TPU-native half precision; Mosaic cannot
+    lower transcendentals on bf16 vectors, so bf16 is storage-only).
 
     The Pallas kernel has no VJP rule — differentiable callers (constant
     optimization) must force backend='jnp' or call eval_trees directly;

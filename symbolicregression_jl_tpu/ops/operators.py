@@ -35,6 +35,20 @@ def _nan_like(x: Array) -> Array:
     return jnp.full_like(x, jnp.nan)
 
 
+def isfinite_(x: Array) -> Array:
+    """`jnp.isfinite` that also lowers inside bf16 Pallas TPU kernels.
+
+    Mosaic's finiteness check (`tpu.weird`) only accepts F32 vectors, so a
+    bf16 value is cast up first — lossless for finiteness (bf16 inf/nan map
+    to f32 inf/nan). Other dtypes (f32, f64) pass through unchanged; f64 is
+    NOT cast down, since a finite f64 above f32 max would falsely read as
+    inf.
+    """
+    if x.dtype == jnp.bfloat16:
+        x = x.astype(jnp.float32)
+    return jnp.isfinite(x)
+
+
 def safe_pow(x: Array, y: Array) -> Array:
     """x^y, NaN when x<0 with non-integer y, or x==0 with y<0.
 
@@ -100,7 +114,7 @@ def gamma_op(x: Array) -> Array:
     neg = jnp.pi / (jnp.sin(jnp.pi * x) * jnp.exp(jax.lax.lgamma(1.0 - x)))
     out = jnp.where(x > 0, pos, neg)
     is_pole = (x <= 0) & (x == jnp.round(x))
-    out = jnp.where(is_pole | ~jnp.isfinite(out), jnp.nan, out)
+    out = jnp.where(is_pole | ~isfinite_(out), jnp.nan, out)
     return out
 
 

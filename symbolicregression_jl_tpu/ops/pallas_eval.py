@@ -22,7 +22,7 @@ Design, in order of what made it fast on real hardware:
    Two selection shapes (`dispatch=`): "chain" = serial `where` chain
    (n_ops dependent selects on the critical path), "mux" (default) = a
    balanced log2(n_ops)-deep select tree on opcode ranges.
-2b. **Tree interleaving** (`tree_unroll`, default 4). A single tree's slot
+2b. **Tree interleaving** (`tree_unroll`, default 8). A single tree's slot
    stream is a serial write→read chain through its value scratch; two
    independent trees advanced in lockstep give the pipeline parallel work
    at every step. The wrapper sorts trees by length (`sort_trees`) so
@@ -60,7 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.trees import BIN, CONST, PAD, UNA, VAR, TreeBatch
-from .operators import OperatorSet
+from .operators import OperatorSet, isfinite_
 
 Array = jax.Array
 
@@ -406,8 +406,14 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
             a = val_ref[ridx_ref[si, ti]]  # top of stack: right arg
             b = val_ref[lidx_ref[si, ti]]  # second: left arg
             x = X_ref[feat_ref[si, ti]]
-            # cval stays f32 in SMEM (scalar reads); cast on broadcast
-            cv = jnp.full((r_sub, 128), cval_ref[si, ti], cdt)
+            if cdt != jnp.float32:
+                # bf16 is a STORAGE dtype only: operands upcast to f32 for
+                # the candidate ops (Mosaic cannot lower cos/sin/sqrt/round
+                # /mod/nan-splat on bf16 vectors — probed on v5e 2026-07-31)
+                # and results round back to bf16 at the scratch store, so
+                # only the VMEM/X traffic pays half price.
+                a, b, x = (t.astype(jnp.float32) for t in (a, b, x))
+            cv = jnp.full((r_sub, 128), cval_ref[si, ti], jnp.float32)
             if dispatch == "chain":
                 # serial select chain: n_codes dependent `where`s
                 v = jnp.where(code == 1, cv, x)
@@ -424,12 +430,15 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                 cands += [fn(b, a) for fn in binary_fns]
                 v = _balanced_mux(code, cands)
             # some operator impls upcast internally (special functions);
-            # normalize back to the compute dtype at the store
-            v = v.astype(cdt)
-            val_ref[si] = v
+            # normalize, then round to the storage dtype at the store.
+            # Poison checks the STORED value: rounding f32->bf16 can
+            # overflow to inf in (bf16_max, f32_max], which downstream
+            # slots will read and must count as non-finite.
+            stored = v.astype(jnp.float32).astype(cdt)
+            val_ref[si] = stored
             return jnp.maximum(
                 bad,
-                jnp.where(jnp.isfinite(v) | (code == 0), 0.0, valid_f),
+                jnp.where(isfinite_(stored) | (code == 0), 0.0, valid_f),
             )
 
         zero = jnp.zeros((r_sub, 128), jnp.float32)
@@ -524,15 +533,23 @@ def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
 
         def instr_body(si, ti, bad, val_ref):
             code, a, b = read_operands(si, ti, val_ref)
+            if cdt != jnp.float32:
+                # bf16 is storage-only: ops run in f32 (Mosaic cannot
+                # lower cos/sin/sqrt/round/mod on bf16 vectors), results
+                # round back at the scratch store — see _make_kernel.
+                a, b = a.astype(jnp.float32), b.astype(jnp.float32)
             v = instr_dispatch(
                 code, a, b, unary_fns, binary_fns, dispatch
-            ).astype(cdt)
+            ).astype(jnp.float32)
+            # store first, poison on the STORED value (f32->bf16 rounding
+            # can overflow to inf; see _make_kernel)
+            v = v.astype(cdt)
             val_ref[base + si] = v
             # operand finiteness matters too: the postfix kernel checks
             # every leaf slot's value, so a tree whose op maps an Inf
             # operand back to a finite result (relu(-inf)=0) must still
             # be poisoned for parity
-            fin = jnp.isfinite(v) & jnp.isfinite(a) & jnp.isfinite(b)
+            fin = isfinite_(v) & isfinite_(a) & isfinite_(b)
             return jnp.maximum(
                 bad, jnp.where(fin | (code == 0), 0.0, valid_f)
             )
@@ -647,6 +664,23 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _check_r_block(r_block: int, r_sub: int, NR: int, interpret: bool):
+    """Mosaic blocks over the row-tile axis must have a sublane count that
+    is a multiple of 8 or covers the whole axis, and the row padding math
+    needs whole 128-lane tiles; anything else dies deep in lowering (or
+    tracing) with an opaque error, so fail here with the actual knob."""
+    if r_block < 128 or r_block % 128:
+        raise ValueError(
+            f"r_block must be a positive multiple of 128, got {r_block}"
+        )
+    if not interpret and r_sub % 8 and r_sub != NR:
+        raise ValueError(
+            f"r_block={r_block} gives {r_sub} row tiles per block over "
+            f"{NR} total; the TPU lowering needs r_block % 1024 == 0 or a "
+            "single block covering all rows"
+        )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("operators", "t_block", "r_block", "interpret",
@@ -662,7 +696,7 @@ def eval_trees_pallas(
     interpret: bool = False,
     slot_loop: str = "dynamic",
     dispatch: str = "mux",
-    tree_unroll: int = 4,
+    tree_unroll: int = 8,
     sort_trees: bool = True,
     compute_dtype: str = "float32",
     program: str = "postfix",
@@ -672,9 +706,12 @@ def eval_trees_pallas(
     Returns (y (..., nrows) float32, ok (...,)) with the same semantics as
     interpreter.eval_trees. TPU only (or interpret=True anywhere).
 
-    compute_dtype="bfloat16" evaluates tree values in the TPU-native half
-    precision (halved VMEM traffic per slot, f32 output/poison
-    accumulation) — the bf16 analog of the reference's type-generic eval
+    compute_dtype="bfloat16" stores tree values (X tiles + value scratch)
+    in the TPU-native half precision — halved VMEM traffic per slot — while
+    every operator computes in f32 with results rounded back at the store
+    (the v5e toolchain cannot lower cos/sin/sqrt/round/mod on bf16 vectors,
+    so bf16 is a storage dtype, not a compute dtype). f32 output/poison
+    accumulation. The bf16 analog of the reference's type-generic eval
     (its Float16/32/64 sweeps, test/test_tree_construction.jl:96-145).
 
     program="instr" runs the compressed operator-only instruction program
@@ -735,6 +772,7 @@ def eval_trees_pallas(
     T_pad = _round_up(T, t_block)
     R_pad = _round_up(nrows, r_block)
     NR = R_pad // 128  # row tiles of 128 lanes
+    _check_r_block(r_block, r_sub, NR, interpret)
 
     # tables transposed to (L, T_pad) — see module docstring point 4
     def padT(x, fill=0):
@@ -871,6 +909,7 @@ def _eval_instr(flat, X, operators, t_block, r_block, interpret, dispatch,
     T_pad = _round_up(T, t_block)
     R_pad = _round_up(nrows, r_block)
     NR = R_pad // 128
+    _check_r_block(r_block, r_sub, NR, interpret)
 
     def padT(x, fill=0):
         return jnp.pad(x, ((0, T_pad - T), (0, 0)),

@@ -43,11 +43,12 @@ import jax.numpy as jnp
 
 from ..models.trees import CONST, TreeBatch
 from .losses import l2_dist_loss
-from .operators import OperatorSet
+from .operators import OperatorSet, isfinite_
 from .pallas_eval import (
     _SLOT_UNROLL,
     _SRC_CONST,
     _balanced_mux,
+    _check_r_block,
     _round_up,
     decode_packed_word,
     instr_dispatch,
@@ -129,7 +130,7 @@ def _make_grad_kernel(operators: OperatorSet, t_block: int, r_block: int,
             code, _, _, a, b = operands(si, ti, val_ref)
             v = instr_dispatch(code, a, b, unary_fns, binary_fns)
             val_ref[nfeat + si] = v
-            fin = jnp.isfinite(v) & jnp.isfinite(a) & jnp.isfinite(b)
+            fin = isfinite_(v) & isfinite_(a) & isfinite_(b)
             return jnp.maximum(
                 bad, jnp.where(fin | (code == 0), 0.0, valid_f)
             )
@@ -331,6 +332,7 @@ def make_loss_kernel(trees, X, y, weights, operators, loss_fn=None,
     T_pad = _round_up(T, t_block)
     R_pad = _round_up(nrows, r_block)
     NR = R_pad // 128
+    _check_r_block(r_block, r_sub, NR, interpret)
 
     def padT(x, fill=0):
         return jnp.pad(x, ((0, T_pad - T), (0, 0)),

@@ -97,8 +97,19 @@ def test_compiled_kernel_variants_match(tpu_ready):
 
 
 def test_compiled_kernel_bf16_on_chip(tpu_ready):
-    """Mosaic-compiled bf16-compute variant stays within bf16 tolerance of
-    the f32 interpreter on real hardware."""
+    """Mosaic-compiled bf16-storage variant on real hardware.
+
+    Two claims, separately checked (measured on v5e 2026-07-31):
+    1. The compiled path matches interpret mode EXACTLY — same stores,
+       same rounding — so Mosaic lowering introduces no drift.
+    2. Against an INDEPENDENT bf16 evaluation — the lockstep jnp
+       interpreter carrying bf16 values — the kernel agrees within a few
+       bf16 ulps everywhere. Comparing against the f32 interpreter
+       instead is unsound: storage rounding of an exp()/cos() argument
+       amplifies (exp(x(1+eps))), so chaotic trees are >10% off in ANY
+       faithful bf16 evaluation, and no input-perturbation filter can
+       screen that (scale-invariant subtrees like x0/x3 cancel it).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -121,11 +132,36 @@ def test_compiled_kernel_bf16_on_chip(tpu_ready):
     y, ok = jax.device_get(
         eval_trees_pallas(trees, X, ops, compute_dtype="bfloat16")
     )
-    both = np.asarray(ok_ref) & np.asarray(ok)
-    assert both.mean() > 0.5  # overflow-driven mask drift must stay rare
-    np.testing.assert_allclose(
-        np.asarray(y)[both], np.asarray(y_ref)[both], rtol=0.1, atol=0.1
+    y_i, ok_i = jax.device_get(
+        eval_trees_pallas(
+            trees, X, ops, compute_dtype="bfloat16", interpret=True
+        )
     )
+    ok, ok_i, ok_ref = map(np.asarray, (ok, ok_i, ok_ref))
+    # claim 1: compiled == interpret, bit-for-bit
+    assert (ok == ok_i).all()
+    np.testing.assert_array_equal(
+        np.asarray(y)[ok], np.asarray(y_i)[ok_i]
+    )
+    # sanity vs f32: the ok mask may only drift through bf16 overflow,
+    # which must stay rare on this workload
+    both = ok_ref & ok
+    assert both.mean() > 0.5
+    # claim 2: against the lockstep interpreter carrying bf16 values
+    # (an independent code path with the same round-between-ops
+    # semantics; measured CPU+v5e 2026-07-31: ok agreement 1.0, zero
+    # elements outside 2%)
+    y_o, ok_o = jax.device_get(
+        eval_trees(trees, X.astype(jnp.bfloat16), ops)
+    )
+    y_o = np.asarray(y_o, dtype=np.float32)
+    ok_o = np.asarray(ok_o)
+    assert (ok == ok_o).mean() > 0.99
+    m = ok & ok_o
+    d = np.abs(np.asarray(y)[m] - y_o[m])
+    assert (
+        (d <= 0.02 + 0.02 * np.abs(y_o[m])).mean() > 0.999
+    ), "bf16 kernel drifts from the independent bf16 interpreter"
 
 
 def test_compiled_instr_program_on_chip(tpu_ready):
