@@ -56,6 +56,24 @@ from .ops.operators import (
     register_binary,
     register_unary,
 )
+# Evolution-layer types and helpers the reference exports publicly
+# (reference src/SymbolicRegression.jl:4-31: Population, HallOfFame,
+# s_r_cycle, calculate_pareto_frontier, compute_complexity,
+# gen_random_tree_fixed_size, simplify_tree, combine_operators).
+from .models.complexity import compute_complexity
+from .models.evolve import s_r_cycle
+from .models.mutate_device import (
+    combine_operators,
+    gen_random_tree_fixed_size,
+    simplify_tree,
+)
+from .models.population import (
+    HallOfFame,
+    Population,
+    calculate_pareto_frontier,
+    init_hall_of_fame,
+    init_population,
+)
 
 __version__ = "0.1.0"
 
@@ -112,4 +130,14 @@ __all__ = [
     "save_search_state",
     "load_search_state",
     "enable_compilation_cache",
+    "Population",
+    "HallOfFame",
+    "init_population",
+    "init_hall_of_fame",
+    "calculate_pareto_frontier",
+    "compute_complexity",
+    "gen_random_tree_fixed_size",
+    "simplify_tree",
+    "combine_operators",
+    "s_r_cycle",
 ]

@@ -664,15 +664,19 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
-def _check_r_block(r_block: int, r_sub: int, NR: int, interpret: bool):
+def _check_r_block(r_block: int, nrows: int, interpret: bool):
     """Mosaic blocks over the row-tile axis must have a sublane count that
     is a multiple of 8 or covers the whole axis, and the row padding math
     needs whole 128-lane tiles; anything else dies deep in lowering (or
-    tracing) with an opaque error, so fail here with the actual knob."""
+    tracing, or a ZeroDivision in the padding arithmetic) with an opaque
+    error, so fail here with the actual knob — called before any padding
+    math, on the post-clamp value."""
     if r_block < 128 or r_block % 128:
         raise ValueError(
             f"r_block must be a positive multiple of 128, got {r_block}"
         )
+    r_sub = r_block // 128
+    NR = _round_up(nrows, r_block) // 128
     if not interpret and r_sub % 8 and r_sub != NR:
         raise ValueError(
             f"r_block={r_block} gives {r_sub} row tiles per block over "
@@ -768,11 +772,11 @@ def eval_trees_pallas(
 
     t_block = min(t_block, _round_up(max(T, 8), tree_unroll))
     r_block = min(r_block, _round_up(nrows, 128))
+    _check_r_block(r_block, nrows, interpret)
     r_sub = r_block // 128
     T_pad = _round_up(T, t_block)
     R_pad = _round_up(nrows, r_block)
     NR = R_pad // 128  # row tiles of 128 lanes
-    _check_r_block(r_block, r_sub, NR, interpret)
 
     # tables transposed to (L, T_pad) — see module docstring point 4
     def padT(x, fill=0):
@@ -905,11 +909,11 @@ def _eval_instr(flat, X, operators, t_block, r_block, interpret, dispatch,
 
     t_block = min(t_block, _round_up(max(T, 8), tree_unroll))
     r_block = min(r_block, _round_up(nrows, 128))
+    _check_r_block(r_block, nrows, interpret)
     r_sub = r_block // 128
     T_pad = _round_up(T, t_block)
     R_pad = _round_up(nrows, r_block)
     NR = R_pad // 128
-    _check_r_block(r_block, r_sub, NR, interpret)
 
     def padT(x, fill=0):
         return jnp.pad(x, ((0, T_pad - T), (0, 0)),

@@ -328,11 +328,11 @@ def make_loss_kernel(trees, X, y, weights, operators, loss_fn=None,
 
     t_block = min(t_block, _round_up(max(T, 8), tree_unroll))
     r_block = min(r_block, _round_up(nrows, 128))
+    _check_r_block(r_block, nrows, interpret)
     r_sub = r_block // 128
     T_pad = _round_up(T, t_block)
     R_pad = _round_up(nrows, r_block)
     NR = R_pad // 128
-    _check_r_block(r_block, r_sub, NR, interpret)
 
     def padT(x, fill=0):
         return jnp.pad(x, ((0, T_pad - T), (0, 0)),
