@@ -42,6 +42,12 @@ REPS = 3
 PARITY_TOL = 1e-3
 
 
+# (n_trees, n_feat) -> np.ndarray of tree lengths, stashed by
+# _build_workload so the roofline report can reuse the already-built
+# workload's length distribution instead of regenerating 8192 trees
+_WORKLOAD_LENGTHS = {}
+
+
 def _build_workload(jax, jnp, options, n_trees, n_feat):
     from symbolicregression_jl_tpu.models.mutate_device import (
         gen_random_tree_fixed_size,
@@ -56,6 +62,9 @@ def _build_workload(jax, jnp, options, n_trees, n_feat):
             k, s, n_feat, options.operators, options.max_len
         )
     )(jax.random.split(key, n_trees), sizes)
+    _WORKLOAD_LENGTHS[(n_trees, n_feat)] = np.asarray(
+        jax.device_get(trees.length), dtype=np.float64
+    )
     return trees
 
 
@@ -681,6 +690,32 @@ def main(verbose=True):
         n_cores = len(os.sched_getaffinity(0))
     except AttributeError:  # pragma: no cover
         n_cores = os.cpu_count()
+
+    # achieved fraction of the kernel's VPU-issue roofline (see
+    # benchmark/roofline.py for the model; CPU runs have no such bound)
+    roofline_fraction = None
+    if platform != "cpu":
+        try:
+            sys.path.insert(
+                0,
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)), "benchmark"
+                ),
+            )
+            from roofline import kernel_roofline
+
+            from symbolicregression_jl_tpu.ops.pallas_eval import (
+                _SLOT_UNROLL,
+            )
+
+            # the timed run already built this exact workload
+            lens = _WORKLOAD_LENGTHS[(min(n_trees, CHUNK), 1)]
+            avg = float(np.mean(np.ceil(lens / _SLOT_UNROLL) * _SLOT_UNROLL))
+            rl = kernel_roofline(options.operators, avg)
+            roofline_fraction = round(value / rl["bound"], 4)
+        except Exception as e:  # pragma: no cover
+            if verbose:
+                print(f"# roofline unavailable: {e}", file=sys.stderr)
     print(
         json.dumps(
             {
@@ -698,6 +733,7 @@ def main(verbose=True):
                 "attempts": ACQUISITION["attempts"],
                 "anchor_cpu_cores": n_cores,
                 "first_call_s": round(compile_s, 1),
+                "roofline_fraction": roofline_fraction,
             }
         )
     )

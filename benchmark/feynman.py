@@ -62,6 +62,43 @@ CASES = [
         lambda v: v[0] / v[1],
         [(1.0, 10.0), (1.0, 10.0)],
     ),
+    (
+        "I.6.2",  # exp(-(theta/sigma)^2/2) / (sqrt(2*pi)*sigma)
+        2,
+        lambda v: np.exp(-((v[0] / v[1]) ** 2) / 2.0)
+        / (np.sqrt(2 * np.pi) * v[1]),
+        [(1.0, 3.0), (1.0, 3.0)],
+    ),
+    (
+        "I.27.6",  # 1 / (1/d1 + n/d2)
+        3,
+        lambda v: 1.0 / (1.0 / v[0] + v[2] / v[1]),
+        [(1.0, 5.0), (1.0, 5.0), (1.0, 5.0)],
+    ),
+    (
+        "II.3.24",  # Pwr / (4 pi r^2)
+        2,
+        lambda v: v[0] / (4.0 * np.pi * v[1] ** 2),
+        [(1.0, 5.0), (1.0, 5.0)],
+    ),
+    (
+        "I.8.14",  # sqrt((x2-x1)^2 + (y2-y1)^2)
+        4,
+        lambda v: np.sqrt((v[1] - v[0]) ** 2 + (v[3] - v[2]) ** 2),
+        [(1.0, 5.0), (1.0, 5.0), (1.0, 5.0), (1.0, 5.0)],
+    ),
+    (
+        "II.38.14",  # Y / (2 (1 + sigma))
+        2,
+        lambda v: v[0] / (2.0 + 2.0 * v[1]),
+        [(1.0, 5.0), (0.0, 1.0)],
+    ),
+    (
+        "I.34.27",  # (h / (2 pi)) * omega
+        2,
+        lambda v: v[0] * v[1] / (2.0 * np.pi),
+        [(1.0, 5.0), (1.0, 5.0)],
+    ),
 ]
 
 
