@@ -42,12 +42,6 @@ REPS = 3
 PARITY_TOL = 1e-3
 
 
-# (n_trees, n_feat) -> np.ndarray of tree lengths, stashed by
-# _build_workload so the roofline report can reuse the already-built
-# workload's length distribution instead of regenerating 8192 trees
-_WORKLOAD_LENGTHS = {}
-
-
 def _build_workload(jax, jnp, options, n_trees, n_feat):
     from symbolicregression_jl_tpu.models.mutate_device import (
         gen_random_tree_fixed_size,
@@ -62,9 +56,6 @@ def _build_workload(jax, jnp, options, n_trees, n_feat):
             k, s, n_feat, options.operators, options.max_len
         )
     )(jax.random.split(key, n_trees), sizes)
-    _WORKLOAD_LENGTHS[(n_trees, n_feat)] = np.asarray(
-        jax.device_get(trees.length), dtype=np.float64
-    )
     return trees
 
 
@@ -99,7 +90,7 @@ def _dispatch_overhead_s(jax, jnp, device):
 def _time_backend(jax, jnp, options, device, n_trees, n_inner, label,
                   verbose):
     """Score n_trees random trees against the Feynman-I.6.2a dataset on
-    `device`; return trees-rows/sec.
+    `device`; return (trees-rows/sec, compile seconds, tree lengths).
 
     The scoring step runs `n_inner` times INSIDE one jit (constants
     perturbed per iteration so no computation can be reused) and the fixed
@@ -141,6 +132,7 @@ def _time_backend(jax, jnp, options, device, n_trees, n_inner, label,
             times.append(time.perf_counter() - t0)
         per_iter = max((float(np.median(times)) - overhead) / n_inner, 1e-9)
 
+    lengths = np.asarray(jax.device_get(trees.length), dtype=np.float64)
     rate = n_trees * N_ROWS / per_iter
     if verbose:
         print(
@@ -150,7 +142,7 @@ def _time_backend(jax, jnp, options, device, n_trees, n_inner, label,
             f"{compile_s:.1f}s) -> {rate:.3e} trees-rows/s",
             file=sys.stderr,
         )
-    return rate, compile_s
+    return rate, compile_s, lengths
 
 
 def _native_cpu_anchor(jax, options, n_trees, verbose):
@@ -636,7 +628,7 @@ def main(verbose=True):
                 print(f"# compilation cache unavailable: {e}",
                       file=sys.stderr)
 
-    value, compile_s = _time_backend(
+    value, compile_s, workload_lengths = _time_backend(
         jax, jnp, options, main_dev, min(n_trees, CHUNK), 20,
         f"main ({platform})", verbose,
     )
@@ -675,7 +667,7 @@ def main(verbose=True):
         if platform != "cpu":
             try:
                 cpu_dev = jax.devices("cpu")[0]
-                cpu_rate, _ = _time_backend(
+                cpu_rate, _, _ = _time_backend(
                     jax, jnp, options, cpu_dev, min(n_trees, 8192), 1,
                     "cpu anchor", verbose,
                 )
@@ -708,8 +700,8 @@ def main(verbose=True):
                 _SLOT_UNROLL,
             )
 
-            # the timed run already built this exact workload
-            lens = _WORKLOAD_LENGTHS[(min(n_trees, CHUNK), 1)]
+            # the timed run's own workload, returned by _time_backend
+            lens = workload_lengths
             avg = float(np.mean(np.ceil(lens / _SLOT_UNROLL) * _SLOT_UNROLL))
             rl = kernel_roofline(options.operators, avg)
             roofline_fraction = round(value / rl["bound"], 4)

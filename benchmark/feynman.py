@@ -7,10 +7,13 @@ reporting per-case solved/loss/time as one JSON line each.
 Quality metric = normalized loss of the best frontier member (loss /
 var(y)); a case counts as solved below 1e-4. Usage:
 
-    python benchmark/feynman.py [--fast] [--seed N]
+    python benchmark/feynman.py [--fast] [--seed N] [--data-seed M]
 
 --fast shrinks the search budget (CI smoke); default budget aims at
-recovery on every case on a single chip.
+recovery on every case on a single chip. --seed seeds BOTH the dataset
+sampling and the search; --data-seed pins the dataset independently, so
+`--seed 1 --data-seed 0` reproduces the seed-marginality sweeps in
+BASELINE.md (same data as the benchmark, different search stream).
 """
 
 from __future__ import annotations
@@ -113,6 +116,9 @@ def main():
     seed = 0
     if "--seed" in sys.argv:
         seed = int(sys.argv[sys.argv.index("--seed") + 1])
+    data_seed = seed
+    if "--data-seed" in sys.argv:
+        data_seed = int(sys.argv[sys.argv.index("--data-seed") + 1])
 
     budget = dict(
         niterations=4 if fast else 12,
@@ -125,7 +131,7 @@ def main():
 
     solved = 0
     for name, n_vars, fn, ranges in CASES:
-        rng = np.random.default_rng(seed)
+        rng = np.random.default_rng(data_seed)
         X = np.stack(
             [rng.uniform(lo, hi, n_rows) for lo, hi in ranges]
         ).astype(np.float32)
