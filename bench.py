@@ -145,6 +145,40 @@ def _time_backend(jax, jnp, options, device, n_trees, n_inner, label,
     return rate, compile_s, lengths
 
 
+def time_pallas_variant(jax, jnp, trees, X, operators, overhead,
+                        n_inner, **kw):
+    """Shared timing harness for kernel A/B scripts (kernel_tune,
+    opset_sweep): n_inner kernel calls inside ONE jit with the
+    constant-perturbation trick, 3-rep median, dispatch overhead
+    subtracted. Keeping this here keeps every sweep's methodology in
+    lockstep with the headline benchmark by construction.
+
+    Returns (trees_rows_per_s, seconds_per_iteration, compile_seconds)."""
+    from symbolicregression_jl_tpu.ops.pallas_eval import eval_trees_pallas
+
+    def body(i, acc):
+        t = trees._replace(cval=trees.cval + acc * 1e-12)
+        y, ok = eval_trees_pallas(t, X, operators, **kw)
+        s = jnp.where(ok, jnp.mean(y, axis=-1), 0.0)
+        return acc + jnp.clip(jnp.mean(s), 0.0, 1.0)
+
+    fn = jax.jit(
+        lambda: jax.lax.fori_loop(0, n_inner, body, jnp.float32(0.0))
+    )
+    t_c0 = time.perf_counter()
+    total = float(fn())
+    compile_s = time.perf_counter() - t_c0
+    assert np.isfinite(total), kw
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(fn())
+        ts.append(time.perf_counter() - t0)
+    per_iter = max((float(np.median(ts)) - overhead) / n_inner, 1e-9)
+    n_trees = int(np.prod(trees.length.shape))
+    return n_trees * N_ROWS / per_iter, per_iter, compile_s
+
+
 def _native_cpu_anchor(jax, options, n_trees, verbose):
     """Multithreaded native-C++ score throughput (eval + MSE reduction) on
     the same workload — the honest stand-in for the reference's

@@ -18,7 +18,6 @@ from __future__ import annotations
 import itertools
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -37,11 +36,11 @@ def main():
         _devices_or_cpu_fallback,
         _dispatch_overhead_s,
         _feynman_data,
+        time_pallas_variant,
     )
 
     _devices_or_cpu_fallback(verbose=True, use_memo=True)  # hung-tunnel watchdog
     from symbolicregression_jl_tpu.models.options import make_options
-    from symbolicregression_jl_tpu.ops.pallas_eval import eval_trees_pallas
 
     args = sys.argv[1:]
     tail_n = None
@@ -70,23 +69,9 @@ def main():
     print(f"# dispatch overhead: {overhead*1e3:.1f} ms", file=sys.stderr)
 
     def run_variant(**kw):
-        def body(i, acc):
-            t = trees._replace(cval=trees.cval + acc * 1e-12)
-            y, ok = eval_trees_pallas(t, X, ops, **kw)
-            s = jnp.where(ok, jnp.mean(y, axis=-1), 0.0)
-            return acc + jnp.clip(jnp.mean(s), 0.0, 1.0)
-
-        fn = jax.jit(
-            lambda: jax.lax.fori_loop(0, n_inner, body, jnp.float32(0.0))
+        return time_pallas_variant(
+            jax, jnp, trees, X, ops, overhead, n_inner, **kw
         )
-        t_c0 = time.perf_counter()
-        total = float(fn())
-        compile_s = time.perf_counter() - t_c0
-        assert np.isfinite(total), kw
-        ts = [_timeit(lambda: float(fn())) for _ in range(3)]
-        per_iter = max((float(np.median(ts)) - overhead) / n_inner, 1e-9)
-        rate = N_TREES * N_ROWS / per_iter
-        return rate, per_iter, compile_s
 
     results = []
     grid = []
@@ -183,12 +168,6 @@ def main():
         )
         cdt = best_kw.get("compute_dtype", "float32")
         print(report(ops, avg_slots, best_rate, cdt, program=program))
-
-
-def _timeit(fn):
-    t0 = time.perf_counter()
-    fn()
-    return time.perf_counter() - t0
 
 
 if __name__ == "__main__":

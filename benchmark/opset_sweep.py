@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Per-slot overhead decomposition for the Pallas eval kernel (TPU).
+
+Holds the tree programs FIXED (a workload built over {+,*} only) while
+widening the candidate operator set the kernel computes per slot, then
+fits time/iteration = fixed + per_op * vec_ops (roofline.fit_slot_model).
+The fixed term is per-step overhead the VPU-issue roofline cannot see —
+scalar/SMEM reads, dynamic scratch indexing, loop bookkeeping, pipeline
+latency the tree interleave fails to hide — and bounds what any further
+candidate-compute optimization can recover.
+
+Usage: python benchmark/opset_sweep.py [n_inner]   (TPU only: the Pallas
+kernel does not lower on CPU, so a dead tunnel exits cleanly with a note
+instead of a decomposition.)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bench import (
+        _build_workload,
+        _devices_or_cpu_fallback,
+        _dispatch_overhead_s,
+        _feynman_data,
+        time_pallas_variant,
+    )
+
+    devices = _devices_or_cpu_fallback(verbose=True, use_memo=True)
+    if devices[0].platform == "cpu":
+        sys.exit("# opset_sweep needs the TPU (the compiled Pallas kernel "
+                 "does not lower on CPU); tunnel unavailable — exiting")
+    from roofline import fit_slot_model, ops_per_slot
+
+    from symbolicregression_jl_tpu.models.options import make_options
+
+    n_inner = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    N_TREES = 8192
+
+    opsets = [
+        (["+", "*"], []),
+        (["+", "-", "*", "/"], []),
+        (["+", "-", "*", "/"], ["cos", "exp"]),
+        (["+", "-", "*", "/"], ["cos", "exp", "sin", "sqrt", "log", "abs"]),
+        (["+", "-", "*", "/", "pow", "max", "min"],
+         ["cos", "exp", "sin", "sqrt", "log", "abs", "tanh", "cosh",
+          "sinh", "atan"]),
+    ]
+    # one workload over the smallest common op set: the slot stream is
+    # identical across runs; only the candidate mux width varies
+    base_opts = make_options(binary_operators=["+", "*"], maxsize=20)
+    trees = _build_workload(jax, jnp, base_opts, N_TREES, 1)
+    X = jnp.asarray(_feynman_data()[0])
+    dev = jax.devices()[0]
+    print(f"# device: {dev} ({dev.platform})", file=sys.stderr)
+    overhead = _dispatch_overhead_s(jax, jnp, dev)
+
+    points = []
+    for bins, unas in opsets:
+        options = make_options(
+            binary_operators=bins, unary_operators=unas, maxsize=20
+        )
+        ops = options.operators
+        rate, per_iter, _ = time_pallas_variant(
+            jax, jnp, trees, X, ops, overhead, n_inner
+        )
+        vec_ops = ops_per_slot(ops)
+        points.append((vec_ops, per_iter))
+        n_cands = 3 + len(unas) + len(bins)
+        print(
+            f"n_cands={n_cands:2d}  vec_ops={vec_ops:5.1f}  "
+            f"{rate:.3e} t-r/s  {per_iter*1e3:7.2f} ms/iter",
+            flush=True,
+        )
+
+    fit = fit_slot_model(points)
+    print("slot-cost decomposition:",
+          {k: f"{v:.4g}" for k, v in fit.items()})
+    print(
+        f"-> {100*fit['overhead_frac']:.0f}% of per-step time is fixed "
+        "overhead the issue-bound model does not see; the candidate-"
+        "compute-only bound over-estimates achievable throughput by "
+        f"{1/max(fit['effective_bound_scale'], 1e-9):.2f}x at the bench "
+        "op set",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -134,3 +134,49 @@ def report(operators, avg_tree_len: float, measured_rate: float,
         f"(binding: {r['binding']}); measured {measured_rate:.2e} = "
         f"{100 * frac:.0f}% of bound"
     )
+
+
+def fit_slot_model(points):
+    """Decompose measured per-step cost into per-step overhead + per-
+    vector-op compute by linear least squares.
+
+    points: [(vec_ops_per_slot, seconds_per_iteration), ...] measured on
+    ONE workload whose programs are held fixed while only the candidate
+    set widens (benchmark/opset_sweep.py: trees built over {+,*},
+    evaluated under growing operator sets — the step stream is
+    identical, so any time difference is candidate compute).
+
+    Returns {"overhead_frac": fraction of the richest point's step cost
+    NOT attributable to candidate compute, "per_op_s", "fixed_s",
+    "effective_bound_scale": how much of the naive issue bound the fixed
+    per-step cost forfeits at the richest point}. Fractions are clamped
+    to [0, 1]; measurement noise can drive the raw intercept slightly
+    negative (the unclamped values are in fixed_s/per_op_s).
+    """
+    import numpy as np
+
+    if len(points) < 2:
+        raise ValueError(
+            f"fit_slot_model needs >= 2 (vec_ops, time) points to "
+            f"separate overhead from compute, got {len(points)}"
+        )
+    xs = np.asarray([p[0] for p in points], dtype=np.float64)
+    ys = np.asarray([p[1] for p in points], dtype=np.float64)
+    if np.ptp(xs) <= 0:
+        raise ValueError(
+            "fit_slot_model needs points at distinct vec_ops values; "
+            f"all {len(points)} share vec_ops={xs[0]:g}"
+        )
+    A = np.stack([np.ones_like(xs), xs], axis=1)
+    (fixed_s, per_op_s), *_ = np.linalg.lstsq(A, ys, rcond=None)
+    x_rich = float(xs.max())
+    compute_s = per_op_s * x_rich
+    total_s = fixed_s + compute_s
+    frac = float(fixed_s / total_s) if total_s > 0 else 0.0
+    frac = min(max(frac, 0.0), 1.0)
+    return {
+        "fixed_s": float(fixed_s),
+        "per_op_s": float(per_op_s),
+        "overhead_frac": frac,
+        "effective_bound_scale": 1.0 - frac,
+    }
