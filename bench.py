@@ -179,36 +179,41 @@ def time_pallas_variant(jax, jnp, trees, X, operators, overhead,
     return n_trees * N_ROWS / per_iter, per_iter, compile_s
 
 
+ANCHOR_REPS = 5  # the anchor swung 1.8x between rounds when timed once;
+# >=5 runs with the spread recorded makes vs_baseline attributable
+
+
 def _native_cpu_anchor(jax, options, n_trees, verbose):
     """Multithreaded native-C++ score throughput (eval + MSE reduction) on
     the same workload — the honest stand-in for the reference's
-    compiled-Julia CPU `score_func` path. Returns trees-rows/sec or None."""
+    compiled-Julia CPU `score_func` path. Returns (median trees-rows/sec,
+    per-run rates) or (None, [])."""
     from symbolicregression_jl_tpu import native
 
     if not native.native_available():
-        return None
+        return None, []
     X, y = _feynman_data()
     with jax.default_device(jax.devices("cpu")[0]):
         trees = _build_workload(jax, None, options, n_trees, 1)
         arrs = tuple(np.asarray(x) for x in trees)
     out = native.eval_batch(*arrs, X, options.operators, y_target=y)
     if out is None:
-        return None
-    ts = []
-    for _ in range(REPS):
+        return None, []
+    rates = []
+    for _ in range(ANCHOR_REPS):
         t0 = time.perf_counter()
         native.eval_batch(*arrs, X, options.operators, y_target=y)
-        ts.append(time.perf_counter() - t0)
-    dt = float(np.median(ts))
-    rate = n_trees * N_ROWS / dt
+        rates.append(n_trees * N_ROWS / (time.perf_counter() - t0))
+    rate = float(np.median(rates))
     if verbose:
         print(
             f"# native CPU anchor (multithreaded C++ score): {n_trees} "
-            f"trees x {N_ROWS} rows, {dt*1e3:.0f} ms -> {rate:.3e} "
-            "trees-rows/s",
+            f"trees x {N_ROWS} rows, {len(rates)} runs -> median "
+            f"{rate:.3e} trees-rows/s "
+            f"(spread {min(rates):.3e}..{max(rates):.3e})",
             file=sys.stderr,
         )
-    return rate
+    return rate, rates
 
 
 def _mse_parity(jax, jnp, options, device, n_check, verbose):
@@ -628,6 +633,45 @@ def _devices_or_cpu_fallback(verbose, use_memo=False):
     _fallback_to_cpu(verbose)
 
 
+def _last_tpu_block():
+    """The most recent on-chip evidence captured by scripts/tpu_watcher.py
+    (BENCH_TPU_LATEST.json), with log tails stripped — embedded in the
+    output whenever this run is forced into its CPU fallback, so the
+    official artifact carries a dated hardware record even when the
+    tunnel is down at capture time."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LATEST.json"
+    )
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except Exception:
+        return None
+    steps = {}
+    for name, rec in (data.get("steps") or {}).items():
+        rec = {k: v for k, v in rec.items() if not k.endswith("_tail")}
+        # a recorded CPU-fallback bench line may itself carry a last_tpu
+        # block — drop it so the embedding can't nest recursively
+        rec["json"] = [
+            {k: v for k, v in j.items() if k != "last_tpu"}
+            for j in rec.get("json", []) or []
+        ]
+        steps[name] = rec
+    out = {
+        "captured_at": data.get("captured_at"),
+        "complete": data.get("complete"),
+        "steps": steps,
+    }
+    for j in steps.get("bench", {}).get("json", []):
+        # only a line the bench itself attributes to the chip counts as
+        # the on-chip headline (a flapping tunnel can leave a recorded
+        # CPU-fallback bench step)
+        if "vs_baseline" in j and j.get("platform") == "tpu":
+            out["value"] = j.get("value")
+            out["vs_baseline"] = j.get("vs_baseline")
+    return out
+
+
 def main(verbose=True):
     devices = _devices_or_cpu_fallback(verbose)
 
@@ -687,28 +731,37 @@ def main(verbose=True):
     # Preferred anchor: native multithreaded C++ score path (the analog of
     # the reference's compiled-Julia CPU throughput). Fallback: XLA-CPU
     # lockstep interpreter.
-    cpu_rate = None
+    cpu_rate, anchor_rates = None, []
     try:
-        cpu_rate = _native_cpu_anchor(
+        cpu_rate, anchor_rates = _native_cpu_anchor(
             jax, options, min(n_trees, 8192), verbose
         )
     except Exception as e:  # pragma: no cover
         if verbose:
             print(f"# native anchor failed: {e}", file=sys.stderr)
     anchor = "native-C++-MT-CPU"
+    # secondary anchor: the XLA-CPU lockstep interpreter on the same
+    # workload, so swings in vs_baseline are attributable to the native
+    # anchor vs the machine (VERDICT r2 weak-5). Skipped when this run
+    # IS the CPU fallback (then `value` is that number already).
+    xla_cpu_rate = None
+    if platform != "cpu":
+        try:
+            cpu_dev = jax.devices("cpu")[0]
+            xla_cpu_rate, _, _ = _time_backend(
+                jax, jnp, options, cpu_dev, min(n_trees, 8192), 1,
+                "xla-cpu anchor", verbose,
+            )
+        except Exception as e:  # pragma: no cover
+            if verbose:
+                print(f"# xla-cpu anchor unavailable: {e}",
+                      file=sys.stderr)
     if cpu_rate is None:
         anchor = "xla-cpu"
-        if platform != "cpu":
-            try:
-                cpu_dev = jax.devices("cpu")[0]
-                cpu_rate, _, _ = _time_backend(
-                    jax, jnp, options, cpu_dev, min(n_trees, 8192), 1,
-                    "cpu anchor", verbose,
-                )
-            except Exception as e:  # pragma: no cover
-                if verbose:
-                    print(f"# cpu anchor unavailable: {e}", file=sys.stderr)
-                cpu_rate = _CPU_FALLBACK
+        if xla_cpu_rate is not None:
+            cpu_rate = xla_cpu_rate
+        elif platform != "cpu":
+            cpu_rate = _CPU_FALLBACK
         else:
             cpu_rate = value
 
@@ -742,27 +795,34 @@ def main(verbose=True):
         except Exception as e:  # pragma: no cover
             if verbose:
                 print(f"# roofline unavailable: {e}", file=sys.stderr)
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    "population fitness-eval throughput, Feynman-I.6.2a "
-                    f"({min(n_trees, CHUNK)} trees/batch x {N_ROWS} rows, "
-                    f"maxsize {MAXSIZE}, platform {platform}; baseline = "
-                    f"{anchor} score throughput{parity})"
-                ),
-                "value": round(value, 1),
-                "unit": "trees-rows/sec/chip",
-                "vs_baseline": round(value / cpu_rate, 3),
-                "platform": platform,
-                "tunnel_state": ACQUISITION["tunnel_state"],
-                "attempts": ACQUISITION["attempts"],
-                "anchor_cpu_cores": n_cores,
-                "first_call_s": round(compile_s, 1),
-                "roofline_fraction": roofline_fraction,
-            }
-        )
-    )
+    out = {
+        "metric": (
+            "population fitness-eval throughput, Feynman-I.6.2a "
+            f"({min(n_trees, CHUNK)} trees/batch x {N_ROWS} rows, "
+            f"maxsize {MAXSIZE}, platform {platform}; baseline = "
+            f"{anchor} score throughput{parity})"
+        ),
+        "value": round(value, 1),
+        "unit": "trees-rows/sec/chip",
+        "vs_baseline": round(value / cpu_rate, 3),
+        "platform": platform,
+        "tunnel_state": ACQUISITION["tunnel_state"],
+        "attempts": ACQUISITION["attempts"],
+        "anchor_cpu_cores": n_cores,
+        "anchor_runs": len(anchor_rates),
+        "anchor_spread": (
+            [round(min(anchor_rates), 1), round(max(anchor_rates), 1)]
+            if anchor_rates else None
+        ),
+        "anchor_xla_cpu": (
+            round(xla_cpu_rate, 1) if xla_cpu_rate is not None else None
+        ),
+        "first_call_s": round(compile_s, 1),
+        "roofline_fraction": roofline_fraction,
+    }
+    if platform == "cpu":
+        out["last_tpu"] = _last_tpu_block()
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

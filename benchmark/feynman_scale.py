@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""North-star-scale Feynman recovery runs: npopulations=64 x npop=1000
+(BASELINE.json config 2's population shape) over the same 12-case suite
+as benchmark/feynman.py — the quality half of the TPU thesis, converting
+kernel throughput into solved equations. The reference's recovery bar is
+the analog: exact-form recovery within budget
+(/root/reference/test/test_mixed.jl:129-141).
+
+At this scale the per-cycle scoring batches clear `_PALLAS_MIN_BATCH`, so
+on TPU every candidate evaluation runs through the Pallas kernel and
+constant optimization through the fused loss/grad kernels. On a 1-core
+CPU one iteration of this shape takes >40 min (BASELINE.md) — this
+script is only meant for chip time; it refuses to start on CPU unless
+SRTPU_SCALE_CPU_OK=1.
+
+Hard cases run first (I.8.14 / I.6.2 / I.6.2a / I.27.6 — the seed-0
+misses of the small-budget benchmark) so a tunnel drop mid-suite still
+captures the runs that answer BASELINE.md's open scale question. The op
+set adds `square` (the probe that got I.8.14 to half-structure at small
+scale, and to the EXACT form at 32x128 on CPU — BASELINE.md).
+
+Usage:
+    python benchmark/feynman_scale.py [--seed N] [--cases I.8.14,I.6.2]
+                                      [--niter K] [--hard-only]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from feynman import CASES  # noqa: E402  (shared 12-case table)
+
+HARD_FIRST = ["I.8.14", "I.6.2", "I.6.2a", "I.27.6"]
+
+BUDGET = dict(
+    npop=1000,
+    npopulations=64,
+    ncycles_per_iteration=100,
+    maxsize=18,
+)
+N_ROWS = 256
+UNARY_OPS = ["cos", "exp", "sqrt", "square"]
+
+
+def main():
+    from bench import _devices_or_cpu_fallback
+
+    devices = _devices_or_cpu_fallback(verbose=True, use_memo=True)
+    if devices[0].platform == "cpu" and not os.environ.get(
+        "SRTPU_SCALE_CPU_OK"
+    ):
+        sys.exit(
+            "# feynman_scale needs the TPU (one 64x1000 iteration takes "
+            ">40 min on this CPU — BASELINE.md); tunnel unavailable. Set "
+            "SRTPU_SCALE_CPU_OK=1 to force."
+        )
+
+    import symbolicregression_jl_tpu as sr
+
+    seed = 0
+    if "--seed" in sys.argv:
+        seed = int(sys.argv[sys.argv.index("--seed") + 1])
+    niter = 8
+    if "--niter" in sys.argv:
+        niter = int(sys.argv[sys.argv.index("--niter") + 1])
+    wanted = None
+    if "--cases" in sys.argv:
+        wanted = set(sys.argv[sys.argv.index("--cases") + 1].split(","))
+    if "--hard-only" in sys.argv:
+        wanted = set(HARD_FIRST)
+
+    order = {n: i for i, n in enumerate(HARD_FIRST)}
+    cases = sorted(CASES, key=lambda c: order.get(c[0], len(HARD_FIRST)))
+    if wanted is not None:
+        cases = [c for c in cases if c[0] in wanted]
+
+    solved = 0
+    for name, n_vars, fn, ranges in cases:
+        rng = np.random.default_rng(seed)
+        X = np.stack(
+            [rng.uniform(lo, hi, N_ROWS) for lo, hi in ranges]
+        ).astype(np.float32)
+        y = fn(X).astype(np.float32)
+        var = float(np.var(y))
+
+        t0 = time.time()
+        res = sr.equation_search(
+            X,
+            y,
+            binary_operators=["+", "-", "*", "/"],
+            unary_operators=UNARY_OPS,
+            niterations=niter,
+            seed=seed,
+            verbosity=0,
+            progress=False,
+            runtests=False,
+            early_stop_condition=1e-6 * var,
+            **BUDGET,
+        )
+        dt = time.time() - t0
+        best = res.best_loss()
+        norm_loss = best.loss / max(var, 1e-12)
+        ok = norm_loss < 1e-4
+        solved += ok
+        print(
+            json.dumps(
+                {
+                    "case": name,
+                    "scale": (
+                        f"{BUDGET['npopulations']}x{BUDGET['npop']}"
+                    ),
+                    # per-case platform stamp: a tunnel drop mid-suite
+                    # must leave each finished case attributable
+                    "platform": devices[0].platform,
+                    "seed": seed,
+                    "solved": bool(ok),
+                    "norm_loss": float(f"{norm_loss:.3e}"),
+                    "complexity": best.complexity,
+                    "equation": best.equation,
+                    "seconds": round(dt, 1),
+                    "num_evals": round(res.num_evals),
+                }
+            ),
+            flush=True,
+        )
+    print(
+        json.dumps(
+            {
+                "suite": "feynman_scale",
+                "seed": seed,
+                "solved": solved,
+                "of": len(cases),
+                "platform": devices[0].platform,
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,264 @@
+#!/usr/bin/env python
+"""Standing TPU-tunnel watcher: captures on-chip evidence the moment the
+tunnel answers, so a later outage cannot erase it.
+
+The axon tunnel drops for hours at a time and — worse — hangs
+`jax.devices()` rather than erroring, so a benchmark launched at a fixed
+time (e.g. the driver's end-of-round capture) can miss every hardware
+window of a working day. This watcher inverts that: it polls the tunnel
+with a killable subprocess probe and, the first time the chip answers,
+runs the full hardware evidence list:
+
+  1. SRTPU_TPU_TESTS=1 pytest tests/test_tpu_hardware.py   (Mosaic tier)
+  2. python bench.py                                        (headline)
+  3. python benchmark/suite.py          (north-star search iteration)
+  4. python benchmark/opset_sweep.py    (per-slot overhead decomposition)
+  5. python benchmark/feynman_scale.py  (64x1000 quality at scale)
+
+After every completed step the accumulated results are written to
+BENCH_TPU_LATEST.json at the repo root and committed, so a tunnel drop
+mid-list still preserves the finished steps. bench.py embeds this file
+as a `last_tpu` block whenever it is forced into its CPU fallback —
+giving the round's official artifact a dated on-chip record even if the
+tunnel is down at capture time.
+
+A sentinel at /tmp/srtpu_watcher_capturing marks an active capture:
+nothing else should run benchmarks or test suites on this 1-core box
+while it exists (concurrent load corrupts timings — BASELINE.md's
+timing discipline).
+
+Exits after one complete capture.
+
+Usage:  python scripts/tpu_watcher.py [--poll SECONDS]
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULT_PATH = os.path.join(REPO, "BENCH_TPU_LATEST.json")
+SENTINEL = "/tmp/srtpu_watcher_capturing"
+
+STEPS = [
+    # (name, argv, timeout_s, extra_env)
+    (
+        "tpu_tests",
+        [sys.executable, "-m", "pytest", "tests/test_tpu_hardware.py",
+         "-q", "--no-header"],
+        3000,
+        {"SRTPU_TPU_TESTS": "1"},
+    ),
+    ("bench", [sys.executable, "bench.py"], 3000, None),
+    ("suite", [sys.executable, "benchmark/suite.py"], 7200, None),
+    (
+        "opset_sweep",
+        [sys.executable, "benchmark/opset_sweep.py"],
+        3000,
+        None,
+    ),
+    (
+        "feynman_scale",
+        [sys.executable, "benchmark/feynman_scale.py", "--seed", "0"],
+        10800,
+        None,
+    ),
+]
+
+
+def log(msg):
+    ts = datetime.datetime.now().strftime("%H:%M:%S")
+    print(f"[{ts}] {msg}", flush=True)
+
+
+def probe_platform(timeout=90):
+    """jax.devices()[0].platform in a killable subprocess, or None."""
+    code = "import jax; print('PLAT=' + jax.devices()[0].platform)"
+    p = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        start_new_session=True,
+    )
+    try:
+        out, _ = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except Exception:
+            p.kill()
+        try:
+            p.communicate(timeout=10)
+        except Exception:
+            pass
+        return None
+    for line in (out or "").splitlines():
+        if line.startswith("PLAT="):
+            return line[len("PLAT="):].strip()
+    return None
+
+
+def parse_json_lines(text):
+    out = []
+    for line in (text or "").splitlines():
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                pass
+    return out
+
+
+def run_step(name, argv, timeout, extra_env):
+    env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
+    t0 = time.time()
+    timed_out = False
+    try:
+        p = subprocess.run(
+            argv, cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=timeout,
+        )
+        rc, out, err = p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as ex:
+        rc, timed_out = -9, True
+        out = ex.stdout if isinstance(ex.stdout, str) else (
+            (ex.stdout or b"").decode("utf-8", "replace")
+        )
+        err = ex.stderr if isinstance(ex.stderr, str) else (
+            (ex.stderr or b"").decode("utf-8", "replace")
+        )
+    dt = round(time.time() - t0, 1)
+    jl = parse_json_lines(out)
+    rec = {
+        "rc": rc,
+        "seconds": dt,
+        "timed_out": timed_out,
+        "json": jl,
+        "stdout_tail": "\n".join((out or "").splitlines()[-12:]),
+        "stderr_tail": "\n".join((err or "").splitlines()[-8:]),
+    }
+    return rec
+
+
+def step_on_chip(name, rec):
+    """Did this step's output actually come from the TPU? (bench/suite
+    report a platform field — feynman_scale stamps it per case line, so
+    a partially-finished suite still attributes its finished cases; the
+    pytest tier passes only when not skipped; text-only steps count by
+    exit code.)"""
+    if name in ("bench", "suite", "feynman_scale"):
+        plats = {j.get("platform") for j in rec["json"] if "platform" in j}
+        return "tpu" in plats
+    if name == "tpu_tests":
+        tail = rec["stdout_tail"]
+        return rec["rc"] == 0 and "passed" in tail and "skipped" not in tail
+    return rec["rc"] == 0
+
+
+def save_and_commit(results, done):
+    payload = {
+        "captured_at": datetime.datetime.now().isoformat(
+            timespec="seconds"
+        ),
+        "complete": done,
+        "steps": results,
+    }
+    with open(RESULT_PATH, "w") as f:
+        json.dump(payload, f, indent=1)
+    steps = ",".join(results)
+    msg = (
+        f"TPU evidence capture ({'complete' if done else 'partial'}): "
+        f"{steps}"
+    )
+    for attempt in range(5):
+        add = subprocess.run(
+            ["git", "add", "BENCH_TPU_LATEST.json"], cwd=REPO,
+            capture_output=True, text=True,
+        )
+        commit = subprocess.run(
+            ["git", "commit", "-m", msg, "--", "BENCH_TPU_LATEST.json"],
+            cwd=REPO, capture_output=True, text=True,
+        )
+        if commit.returncode == 0 or "nothing to commit" in (
+            commit.stdout + commit.stderr
+        ):
+            log(f"committed: {msg}")
+            return
+        log(f"git commit retry ({attempt}): "
+            f"{(commit.stderr or add.stderr).strip()[:120]}")
+        time.sleep(10)
+
+
+def main():
+    poll = 120
+    if "--poll" in sys.argv:
+        poll = int(sys.argv[sys.argv.index("--poll") + 1])
+
+    remaining = list(STEPS)
+    results = {}
+    attempts = {}
+    MAX_ATTEMPTS = 3  # per step, across tunnel windows
+    while remaining:
+        plat = probe_platform()
+        if plat != "tpu":
+            log(f"tunnel down (probe: {plat}); retry in {poll}s")
+            time.sleep(poll)
+            continue
+        log("tunnel UP — starting capture")
+        with open(SENTINEL, "w") as f:
+            f.write(str(os.getpid()))
+        try:
+            while remaining:
+                name, argv, timeout, extra_env = remaining[0]
+                attempts[name] = attempts.get(name, 0) + 1
+                log(f"step {name} (attempt {attempts[name]}): "
+                    f"{' '.join(argv)}")
+                rec = run_step(name, argv, timeout, extra_env)
+                on_chip = step_on_chip(name, rec)
+                ok = on_chip and rec["rc"] == 0 and not rec["timed_out"]
+                rec["on_chip"] = on_chip
+                rec["partial"] = not ok
+                log(
+                    f"step {name}: rc={rec['rc']} {rec['seconds']}s "
+                    f"on_chip={on_chip} ok={ok}"
+                )
+                if ok or attempts[name] >= MAX_ATTEMPTS:
+                    # done — or persistently failing: record what there
+                    # is (flagged partial) and stop burning chip time
+                    results[name] = rec
+                    remaining.pop(0)
+                    save_and_commit(results, done=not remaining)
+                    continue
+                # failed with attempts left: keep any on-chip JSON the
+                # step emitted before dying (hours of finished feynman
+                # cases must survive a drop), flagged partial, and
+                # retry — immediately if the tunnel is still up, else
+                # back to polling
+                if rec["json"] and on_chip:
+                    results[name] = rec
+                    save_and_commit(results, done=False)
+                if probe_platform() != "tpu":
+                    log(f"tunnel dropped during {name}; back to polling")
+                    break
+        finally:
+            try:
+                os.remove(SENTINEL)
+            except OSError:
+                pass
+        if remaining:
+            time.sleep(poll)
+    log("all evidence captured — exiting")
+
+
+if __name__ == "__main__":
+    main()
