@@ -781,15 +781,32 @@ def main(verbose=True):
                     os.path.dirname(os.path.abspath(__file__)), "benchmark"
                 ),
             )
+            import inspect
+
             from roofline import kernel_roofline
 
             from symbolicregression_jl_tpu.ops.pallas_eval import (
                 _SLOT_UNROLL,
+                eval_trees_pallas,
             )
 
-            # the timed run's own workload, returned by _time_backend
-            lens = workload_lengths
-            avg = float(np.mean(np.ceil(lens / _SLOT_UNROLL) * _SLOT_UNROLL))
+            # the timed run's own workload, returned by _time_backend.
+            # Interleaved tree groups (tree_unroll consecutive trees
+            # after the wrapper's length sort) advance in lockstep to
+            # the GROUP's max length, so executed slots come from
+            # per-group maxima, not per-tree lengths.
+            tu = inspect.signature(eval_trees_pallas).parameters[
+                "tree_unroll"
+            ].default
+            lens = np.sort(workload_lengths)
+            pad = (-len(lens)) % tu
+            if pad:
+                lens = np.concatenate([lens, np.repeat(lens[-1], pad)])
+            gmax = lens.reshape(-1, tu).max(axis=1)
+            executed = np.ceil(gmax / _SLOT_UNROLL) * _SLOT_UNROLL
+            avg = float(
+                np.repeat(executed, tu)[: len(workload_lengths)].mean()
+            )
             rl = kernel_roofline(options.operators, avg)
             roofline_fraction = round(value / rl["bound"], 4)
         except Exception as e:  # pragma: no cover

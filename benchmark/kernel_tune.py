@@ -124,6 +124,17 @@ def main():
                          sort_trees=True, program="instr_packed"))
     grid.append(dict(dispatch="mux", tree_unroll=8, sort_trees=True,
                      program="instr_packed", t_block=512))
+    # leaf-skip: scalar-predicated 2-way branch per slot skips the whole
+    # operator candidate set on leaf slots (~half the postfix slots).
+    # Issue-bound prediction: up to ~1.8x IF Mosaic keeps the interleave
+    # pipeline overlapping across the branch — the open question.
+    for unroll in (2, 4, 8):
+        grid.append(dict(dispatch="mux", tree_unroll=unroll,
+                         sort_trees=True, leaf_skip=True))
+    grid.append(dict(dispatch="mux", tree_unroll=8, sort_trees=True,
+                     leaf_skip=True, compute_dtype="bfloat16"))
+    grid.append(dict(dispatch="mux", tree_unroll=16, sort_trees=True,
+                     leaf_skip=True))
 
     if tail_n is not None:  # only the last N grid entries (quick probes)
         grid = grid[-tail_n:]

@@ -152,6 +152,14 @@ def fit_slot_model(points):
     per-step cost forfeits at the richest point}. Fractions are clamped
     to [0, 1]; measurement noise can drive the raw intercept slightly
     negative (the unclamped values are in fixed_s/per_op_s).
+
+    Caveat: widening the candidate set also deepens the balanced select
+    mux by log2(n_cands) and reshapes the select tree, so the fitted
+    slope conflates mux-depth cost with candidate compute and part of
+    the mux lands in the intercept. The two-term fit is a sound BOUND on
+    recoverable compute (the mux is as unavoidable as the candidates in
+    this kernel design) but should not be read as a pure
+    overhead-vs-compute split.
     """
     import numpy as np
 

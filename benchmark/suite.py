@@ -175,15 +175,18 @@ def bench_search_iteration():
     baseline = jnp.float32(float(jnp.var(y)))
 
     init_fn = _make_init_fn(options, n_feat, False)
+    scalars = options.traced_scalars()
     states = init_fn(
         jax.random.split(jax.random.PRNGKey(0), options.npopulations),
-        X, y, baseline,
+        X, y, baseline, scalars,
     )
     it_fn = _make_iteration_fn(options, False)
     cm = jnp.int32(options.maxsize)
 
     def run():
-        s2, ghof = it_fn(states, jax.random.PRNGKey(1), cm, X, y, baseline)
+        s2, ghof = it_fn(
+            states, jax.random.PRNGKey(1), cm, X, y, baseline, scalars
+        )
         jax.block_until_ready(ghof.losses)
 
     dt = _median_time(run, reps=3)
@@ -242,15 +245,18 @@ def bench_search_iteration_northstar():
     baseline = jnp.float32(float(jnp.var(y)))
 
     init_fn = _make_init_fn(options, n_feat, False)
+    scalars = options.traced_scalars()
     states = init_fn(
         jax.random.split(jax.random.PRNGKey(0), options.npopulations),
-        X, y, baseline,
+        X, y, baseline, scalars,
     )
     it_fn = _make_iteration_fn(options, False)
     cm = jnp.int32(options.maxsize)
 
     def run():
-        s2, ghof = it_fn(states, jax.random.PRNGKey(1), cm, X, y, baseline)
+        s2, ghof = it_fn(
+            states, jax.random.PRNGKey(1), cm, X, y, baseline, scalars
+        )
         jax.block_until_ready(ghof.losses)
 
     dt = _median_time(run, reps=3)
@@ -259,7 +265,7 @@ def bench_search_iteration_northstar():
         * options.n_parallel_tournaments
         * options.npopulations
     )
-    return [
+    out = [
         {
             "suite": "search_iteration_northstar",
             "case": (
@@ -270,6 +276,114 @@ def bench_search_iteration_northstar():
             "candidate_evals_per_s": cand_evals / dt,
         }
     ]
+
+    # breakdown (VERDICT r2 #2): where does the iteration go — evolve
+    # cycles vs constant optimization? Re-time with the optimizer off
+    # (one extra compile); the BFGS share is the difference. Host share
+    # is negligible by construction (the whole iteration is ONE jit
+    # call; host work happens between calls and is excluded by timing
+    # block_until_ready around the call itself).
+    try:
+        opt_off = make_options(
+            binary_operators=["+", "-", "*", "/"],
+            unary_operators=["cos", "exp"],
+            npop=1000,
+            npopulations=64,
+            ncycles_per_iteration=25,
+            maxsize=20,
+            should_optimize_constants=False,
+        )
+        it2 = _make_iteration_fn(opt_off, False)
+        sc2 = opt_off.traced_scalars()
+
+        def run2():
+            s2, ghof = it2(
+                states, jax.random.PRNGKey(1), cm, X, y, baseline, sc2
+            )
+            jax.block_until_ready(ghof.losses)
+
+        dt2 = _median_time(run2, reps=3)
+        out.append(
+            {
+                "suite": "search_iteration_northstar",
+                "case": "breakdown",
+                "full_s": dt,
+                "no_optimizer_s": dt2,
+                "bfgs_share": max(0.0, 1.0 - dt2 / dt),
+            }
+        )
+    except Exception as e:  # pragma: no cover
+        print(f"# northstar breakdown failed: {e}", file=sys.stderr)
+    return out
+
+
+def bench_precision_ratio():
+    """float64 vs float32 population-scoring throughput on one workload.
+
+    The reference's default dtype is Float64 with native-speed fused eval
+    (reference src/InterfaceDynamicExpressions.jl:50-52); here f64 routes
+    to the lockstep jnp interpreter (the Pallas kernel is f32/bf16-only —
+    no native f64 on v5e), so this entry publishes the measured cost of
+    choosing precision='float64'. Runs LAST: jax_enable_x64 is
+    process-global."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.models.fitness import score_trees
+    from symbolicregression_jl_tpu.models.mutate_device import (
+        gen_random_tree_fixed_size,
+    )
+    from symbolicregression_jl_tpu.models.options import make_options
+
+    n_trees, n_rows = 2048, 1000
+    options = make_options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp"],
+        maxsize=20,
+    )
+    sizes = jax.random.randint(jax.random.PRNGKey(1), (n_trees,), 3, 20)
+    trees = jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(
+            k, s, 2, options.operators, options.max_len
+        )
+    )(jax.random.split(jax.random.PRNGKey(0), n_trees), sizes)
+    rng = np.random.default_rng(0)
+    X_h = rng.standard_normal((2, n_rows))
+    y_h = 2.0 * np.cos(X_h[1]) + X_h[0] ** 2
+
+    out = []
+    rates = {}
+    for name, ftype in (("float32", np.float32), ("float64", np.float64)):
+        X = jnp.asarray(X_h.astype(ftype))
+        y = jnp.asarray(y_h.astype(ftype))
+        t = trees._replace(cval=trees.cval.astype(X.dtype))
+        bl = jnp.asarray(np.var(y_h).astype(ftype))
+        f = jax.jit(
+            lambda t, X, y, bl: score_trees(t, X, y, None, bl, options)
+        )
+        f(t, X, y, bl)
+        dt = _median_time(
+            lambda: jax.block_until_ready(f(t, X, y, bl)), reps=3
+        )
+        rates[name] = n_trees * n_rows / dt
+        out.append(
+            {
+                "suite": "precision_ratio",
+                "case": name,
+                "median_s": dt,
+                "trees_rows_per_s": rates[name],
+            }
+        )
+    out.append(
+        {
+            "suite": "precision_ratio",
+            "case": "f32_over_f64",
+            "ratio": rates["float32"] / rates["float64"],
+        }
+    )
+    return out
 
 
 def main():
@@ -284,6 +398,7 @@ def main():
         bench_population_scoring,
         bench_search_iteration,
         bench_search_iteration_northstar,
+        bench_precision_ratio,  # keep last: flips jax_enable_x64
     ):
         try:
             results.extend(fn())

@@ -170,9 +170,18 @@ import functools
 
 @functools.lru_cache(maxsize=32)
 def _make_iteration_fn(options: Options, has_weights: bool):
-    """One jitted function per Options; X/y/weights/baseline are traced
-    arguments so multi-output searches (and repeated equation_search calls
-    with equal Options) reuse the compilation.
+    """One jitted function per Options GRAPH (Options hash/eq deliberately
+    ignore the TRACED_SCALAR_FIELDS knobs); X/y/weights/baseline AND the
+    scalar knobs are traced arguments, so multi-output searches, repeated
+    equation_search calls with equal Options, and sweeps over
+    parsimony/alpha/annealing/migration fractions all reuse one
+    compilation (the 20-40s TPU compile is paid per graph, not per
+    config).
+
+    The returned function's REQUIRED trailing argument is
+    `options.traced_scalars()` — required precisely because the lru_cache
+    may hand this closure to an Options instance that differs in those
+    knobs; the caller's own values must flow in at every call.
 
     With options.recorder the returned function yields a third output:
     the per-cycle MutationEvents for the lineage recorder."""
@@ -185,23 +194,27 @@ def _make_iteration_fn(options: Options, has_weights: bool):
         y: Array,
         weights,
         baseline: Array,
+        scalars,
     ):
+        options_ = options.bind_scalars(scalars)
         k_mig, k_opt, k_opt_mut = jax.random.split(key, 3)
         # all-island fused forms: one interpreter call per cycle across the
-        # whole archipelago (Pallas-sized batches on TPU)
+        # whole archipelago (Pallas-sized batches on TPU). Static,
+        # graph-shaping decisions (recorder, optimizer gating) read the
+        # closure `options`; everything numeric reads the bound copy.
         out = s_r_cycle_islands(
-            states, curmaxsize, X, y, weights, baseline, options,
+            states, curmaxsize, X, y, weights, baseline, options_,
             collect_events=options.recorder,
         )
         states, events = out if options.recorder else (out, None)
         states = simplify_population_islands(
-            states, curmaxsize, X, y, weights, baseline, options
+            states, curmaxsize, X, y, weights, baseline, options_
         )
         if options.should_optimize_constants and options.optimizer_probability > 0:
             I = states.birth_counter.shape[0]
             okeys = jax.random.split(k_opt, I)
             states = optimize_islands_constants(
-                okeys, states, X, y, weights, baseline, options
+                okeys, states, X, y, weights, baseline, options_
             )
         # the `optimize` mutation (reference src/Mutate.jl:142-168): one
         # iteration-level pass sized to the expected number of sampled
@@ -212,11 +225,11 @@ def _make_iteration_fn(options: Options, has_weights: bool):
             I = states.birth_counter.shape[0]
             okeys2 = jax.random.split(k_opt_mut, I)
             states = optimize_islands_constants(
-                okeys2, states, X, y, weights, baseline, options,
+                okeys2, states, X, y, weights, baseline, options_,
                 probability=p_sel, count_optimize_telemetry=True,
             )
         ghof = merge_hofs_across_islands(states.hof)
-        states = migrate(k_mig, states, ghof, options)
+        states = migrate(k_mig, states, ghof, options_)
         if options.recorder:
             return states, ghof, events
         return states, ghof
@@ -224,18 +237,23 @@ def _make_iteration_fn(options: Options, has_weights: bool):
     if has_weights:
         return jax.jit(one_iteration)
     return jax.jit(
-        lambda states, key, cm, X, y, baseline: one_iteration(
-            states, key, cm, X, y, None, baseline
+        lambda states, key, cm, X, y, baseline, scalars: one_iteration(
+            states, key, cm, X, y, None, baseline, scalars
         )
     )
 
 
 @functools.lru_cache(maxsize=32)
 def _make_init_fn(options: Options, nfeatures: int, has_weights: bool):
-    def init(keys, X, y, weights, baseline):
+    """Like _make_iteration_fn: the trailing REQUIRED `scalars` argument
+    is `options.traced_scalars()` (initial scoring reads parsimony
+    through it)."""
+
+    def init(keys, X, y, weights, baseline, scalars):
+        options_ = options.bind_scalars(scalars)
         return jax.vmap(
             lambda k: init_island_state(
-                k, options, nfeatures, X, y, weights, baseline,
+                k, options_, nfeatures, X, y, weights, baseline,
                 dtype=options.dtype,
             )
         )(keys)
@@ -243,7 +261,9 @@ def _make_init_fn(options: Options, nfeatures: int, has_weights: bool):
     if has_weights:
         return jax.jit(init)
     return jax.jit(
-        lambda keys, X, y, baseline: init(keys, X, y, None, baseline)
+        lambda keys, X, y, baseline, scalars: init(
+            keys, X, y, None, baseline, scalars
+        )
     )
 
 
@@ -443,6 +463,10 @@ def equation_search(
     t_start = time.time()
     early_stop = options.early_stop_fn()
     iteration_fn = _make_iteration_fn(options, weights is not None)
+    # this Options' trace-irrelevant scalar knobs, passed to every jitted
+    # call (the factories' lru_caches dedup Options differing only in
+    # these, so the values MUST come from here, not the closure)
+    scalars = options.traced_scalars()
 
     results: List[List[Candidate]] = []
     out_states: List[SearchState] = []
@@ -468,8 +492,20 @@ def equation_search(
         enabled=options.verbosity > 0 and jax.process_count() == 1
     )
     global_it = 0  # host-loop iterations completed across all outputs
+    nout = ys.shape[0]
 
-    for j in range(ys.shape[0]):
+    # ---- per-output setup: every output's islands and hall of fame are
+    # initialized up front (the reference's event loop owns all
+    # (output, population) tasks the same way —
+    # src/SymbolicRegression.jl:539-573), so the joint loop below can
+    # stop globally at any moment with every output's frontier live ----
+    out_data = []          # (Xj, yj, wj, bl) per output
+    live_states = []       # current IslandStates per output
+    live_hofs = []         # current merged hall of fame per output
+    out_keys = []          # per-output PRNG stream
+    start_iters = []
+
+    for j in range(nout):
         ds = make_dataset(
             X, ys[j], weights, variable_names, dtype=options.dtype
         )
@@ -484,9 +520,9 @@ def equation_search(
             init_keys = jax.random.split(k_init, I)
             init_fn = _make_init_fn(options, nfeatures, wj is not None)
             if wj is not None:
-                sts = init_fn(init_keys, Xj, yj, wj, bl)
+                sts = init_fn(init_keys, Xj, yj, wj, bl, scalars)
             else:
-                sts = init_fn(init_keys, Xj, yj, bl)
+                sts = init_fn(init_keys, Xj, yj, bl, scalars)
             return sts, key
 
         if saved_state is not None:
@@ -528,28 +564,57 @@ def equation_search(
             ghof = merge_hofs_across_islands(states.hof)
             start_iter = 0
         states = shard_island_states(states, mesh, options)
+        out_data.append((Xj, yj, wj, bl))
+        live_states.append(states)
+        live_hofs.append(ghof)
+        out_keys.append(master_key)
+        start_iters.append(start_iter)
 
-        it = start_iter
-        for step in range(niterations):
-            it = start_iter + step
+    # ---- joint iteration loop: one iteration per output per round
+    # (the reference's kappa round-robin over (out, pop) pairs,
+    # src/SymbolicRegression.jl:659-694). Global stop semantics match
+    # src/SymbolicRegression.jl:899-909: 'q', timeout, and max_evals
+    # terminate the WHOLE search the moment they trip; the loss
+    # threshold stops only once EVERY output's frontier satisfies it
+    # (src/SearchUtils.jl:109-141). ----
+    # per-output index of the last EXECUTED iteration (start-1 when none
+    # ran, so the saved SearchState.iteration = its[j]+1 counts only real
+    # work — an output cut off by a global stop before its first
+    # iteration resumes at exactly start_iters[j])
+    its = [s - 1 for s in start_iters]
+    latest_cands: List[Optional[List[Candidate]]] = [None] * nout
+    # host-side cache of each output's num_evals total: only output j's
+    # count changes in its own iteration, so the global max_evals check
+    # needs ONE device sync per iteration, not nout
+    evals_cache = [0.0] * nout
+    stop_all = False
+    for step in range(niterations):
+        for j in range(nout):
+            Xj, yj, wj, bl = out_data[j]
+            states = live_states[j]
+            its[j] = start_iters[j] + step
+            it = its[j]
             cm = jnp.int32(_curmaxsize(options, it, max(niterations, 1)))
-            master_key, k_it = jax.random.split(master_key)
+            out_keys[j], k_it = jax.random.split(out_keys[j])
             t_dev = time.time()
             if wj is not None:
-                out = iteration_fn(states, k_it, cm, Xj, yj, wj, bl)
+                out = iteration_fn(states, k_it, cm, Xj, yj, wj, bl, scalars)
             else:
-                out = iteration_fn(states, k_it, cm, Xj, yj, bl)
+                out = iteration_fn(states, k_it, cm, Xj, yj, bl, scalars)
             if options.recorder:
                 states, ghof, events = out
             else:
                 (states, ghof), events = out, None
             jax.block_until_ready(ghof.losses)
             t_host = time.time()
+            live_states[j] = states
+            live_hofs[j] = ghof
 
             # ---- host-side orchestration (off the hot path) ----
             progress.note_iteration(I)
             global_it += 1
             cands = hof_to_candidates(ghof, options, variable_names)
+            latest_cands[j] = cands
             if recorder is not None:
                 recorder.record_hall_of_fame(j, it, cands)
                 if events is not None:
@@ -585,27 +650,48 @@ def equation_search(
             monitor.note(t_host - t_dev, time.time() - t_host)
             monitor.maybe_warn()
 
-            # early stopping (reference src/SearchUtils.jl:109-141)
-            if early_stop is not None and any(
-                early_stop(c.loss, c.complexity) for c in cands
-            ):
-                break
+            # global immediate stops: any one trips → the whole search
+            # ends, all outputs included (src/SymbolicRegression.jl:899-909)
             if (
                 options.timeout_in_seconds is not None
                 and time.time() - t_start > options.timeout_in_seconds
             ):
-                break
-            if options.max_evals is not None:
-                evals = float(jnp.sum(states.num_evals))
-                if evals > options.max_evals:
-                    break
+                stop_all = True
+            elif options.max_evals is not None:
+                # the reference sums num_evals over every output
+                # (src/SearchUtils.jl:139-141); only output j's count
+                # moved since the last check
+                evals_cache[j] = float(jnp.sum(states.num_evals))
+                if sum(evals_cache) > options.max_evals:
+                    stop_all = True
             if quit_watcher.should_quit():
+                stop_all = True
+            if stop_all:
                 break
+        if stop_all:
+            break
+        # loss threshold: stop only when every output's frontier has a
+        # satisfying member (src/SearchUtils.jl:109-141 returns false on
+        # any output that doesn't)
+        if early_stop is not None and all(
+            c is not None
+            and any(early_stop(m.loss, m.complexity) for m in c)
+            for c in latest_cands
+        ):
+            break
 
+    for j in range(nout):
+        states = live_states[j]
         total_evals += float(jnp.sum(states.num_evals))
-        results.append(hof_to_candidates(ghof, options, variable_names))
+        results.append(
+            hof_to_candidates(live_hofs[j], options, variable_names)
+        )
         out_states.append(
-            SearchState(island_states=states, global_hof=ghof, iteration=it + 1)
+            SearchState(
+                island_states=states,
+                global_hof=live_hofs[j],
+                iteration=its[j] + 1,
+            )
         )
 
     if recorder is not None:

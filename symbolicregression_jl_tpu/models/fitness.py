@@ -50,6 +50,15 @@ def dispatch_eval(
     below route those cases to the jnp path."""
     from ..ops.pallas_eval import pallas_available
 
+    if backend == "pallas" and X.dtype not in (jnp.float32, jnp.bfloat16):
+        # never silently downcast: the kernel computes in f32 (bf16 is
+        # storage-only), so an explicit pallas request for f64/f16 data
+        # would quietly lose the precision the caller asked for
+        raise ValueError(
+            f"eval_backend='pallas' supports float32/bfloat16 only, got "
+            f"{X.dtype} (float64 has no native TPU path — use "
+            "eval_backend='jnp'; see BASELINE.md 'float64')"
+        )
     if backend == "pallas" or (
         backend == "auto"
         and pallas_available()
@@ -103,7 +112,11 @@ def loss_to_score(
     """score = loss/baseline + complexity*parsimony
     (reference src/LossFunctions.jl:70-83)."""
     normalized = loss / baseline
-    return normalized + complexity.astype(loss.dtype) * options.parsimony
+    # parsimony may be an f32 tracer (TRACED_SCALAR_FIELDS): cast to the
+    # working dtype so bf16/f16 scores don't get promoted to f32 (the
+    # evolution scan carries scores at the search precision)
+    par = jnp.asarray(options.parsimony, loss.dtype)
+    return normalized + complexity.astype(loss.dtype) * par
 
 
 def _custom_loss_trees(

@@ -104,6 +104,25 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+# Scalar knobs that shape the search but NOT the traced graph: they are
+# excluded from Options._graph_key and enter jitted functions as traced
+# arguments (Options.traced_scalars / bind_scalars), so sweeping them
+# costs zero recompiles. Every field here must only ever be consumed as
+# array math — never in Python control flow (audited use sites:
+# fitness.loss_to_score, evolve mutate/anneal, population tournament,
+# migration bernoulli draws).
+TRACED_SCALAR_FIELDS = (
+    "parsimony",
+    "alpha",
+    "perturbation_factor",
+    "probability_negate_constant",
+    "adaptive_parsimony_scaling",
+    "tournament_selection_p",
+    "fraction_replaced",
+    "fraction_replaced_hof",
+)
+
+
 @dataclasses.dataclass(frozen=True, eq=False)
 class Options:
     # --- operators ---
@@ -338,12 +357,22 @@ class Options:
     def _graph_key(self):
         """Fields that affect the compiled search graph. Hash/eq use only
         these so jit-compilation caches hit across Options that differ only
-        in orchestration knobs (verbosity, output_file, stopping...)."""
+        in orchestration knobs (verbosity, output_file, stopping...).
+
+        The TRACED_SCALAR_FIELDS knobs (parsimony, alpha, annealing and
+        migration fractions, ...) are deliberately ABSENT: they enter the
+        jitted iteration as traced arguments (`traced_scalars`), so a
+        sweep over them re-uses one compiled graph instead of paying the
+        20-40s TPU compile per variant. That also means the iteration
+        factories' lru_caches can legitimately return one closure for
+        Options differing only in those knobs — which is exactly why the
+        jitted functions REQUIRE the scalars argument at every call: the
+        caller's own Options supplies the values, never the closure."""
         return (
             self.binary_operators, self.unary_operators, self.npopulations,
             self.npop, self.ncycles_per_iteration, self.maxsize, self.max_len,
-            self.maxdepth, self.parsimony, self.alpha,
-            self.tournament_selection_n, self.tournament_selection_p,
+            self.maxdepth,
+            self.tournament_selection_n,
             self.topn, self.batching, self.batch_size,
             self.independent_island_batches,
             self.n_parallel_tournaments, self.eval_backend,
@@ -351,12 +380,10 @@ class Options:
             self.constraints, self.nested_constraints,
             self.complexity_of_operators, self.complexity_of_constants,
             self.complexity_of_variables, self.mutation_weights.as_tuple(),
-            self.crossover_probability, self.perturbation_factor,
-            self.probability_negate_constant, self.annealing,
+            self.crossover_probability, self.annealing,
             self.use_frequency, self.use_frequency_in_tournament,
-            self.adaptive_parsimony_scaling, self.migration,
-            self.hof_migration, self.fraction_replaced,
-            self.fraction_replaced_hof, self.should_optimize_constants,
+            self.migration,
+            self.hof_migration, self.should_optimize_constants,
             self.optimizer_probability, self.optimizer_nrestarts,
             self.optimizer_iterations, self.optimizer_algorithm,
             self.optimizer_backend,
@@ -365,6 +392,30 @@ class Options:
             # recorder mode adds the event-collection outputs to the graph
             self.recorder,
         )
+
+    def traced_scalars(self) -> Tuple:
+        """The trace-irrelevant scalar knobs as jnp.float32 leaves, in
+        TRACED_SCALAR_FIELDS order — passed as a traced argument to the
+        jitted iteration/init functions so sweeping any of them re-uses
+        the compiled graph (the reference pays compilation once per
+        *method*, not per config — src/precompile.jl:34-79)."""
+        import jax.numpy as jnp
+
+        return tuple(
+            jnp.float32(getattr(self, f)) for f in TRACED_SCALAR_FIELDS
+        )
+
+    def bind_scalars(self, scalars: Tuple) -> "Options":
+        """Shallow copy with the TRACED_SCALAR_FIELDS replaced by `scalars`
+        (typically tracers, inside jit). Downstream code reads
+        options.parsimony etc. unchanged; every audited use site is pure
+        array math (no Python control flow on these fields)."""
+        import copy
+
+        new = copy.copy(self)
+        for f, v in zip(TRACED_SCALAR_FIELDS, scalars):
+            object.__setattr__(new, f, v)
+        return new
 
     def __hash__(self):
         return hash(self._graph_key())
@@ -430,4 +481,35 @@ def make_options(**kwargs) -> Options:
         remapped["mutation_weights"] = MutationWeights(*remapped["mutation_weights"])
     elif isinstance(remapped.get("mutation_weights"), dict):
         remapped["mutation_weights"] = MutationWeights(**remapped["mutation_weights"])
-    return Options(**remapped)
+    opts = Options(**remapped)
+    if opts.eval_backend == "pallas" and opts.precision in (
+        "float64", "float16"
+    ):
+        # fail at construction, not at the first evaluation: the kernel
+        # computes in f32 (bf16 storage-only) and dispatch_eval rejects
+        # other dtypes rather than silently downcasting
+        raise ValueError(
+            f"eval_backend='pallas' supports float32/bfloat16 only "
+            f"(precision={opts.precision!r} has no native TPU kernel "
+            "path); use eval_backend='jnp' or 'auto'"
+        )
+    if opts.precision == "float64" and opts.eval_backend != "jnp":
+        # The reference's default dtype is Float64 with native-speed fused
+        # eval (src/InterfaceDynamicExpressions.jl:50-52). Here the Pallas
+        # kernel is f32/bf16-only — v5e has no native f64 vector path —
+        # so float64 scoring routes to the lockstep jnp interpreter. Say
+        # so up front rather than letting a user discover an order-of-
+        # magnitude eval-throughput gap by profiling (BASELINE.md
+        # 'float64' records the measured ratio).
+        import warnings
+
+        warnings.warn(
+            "precision='float64': fitness evaluation uses the jnp "
+            "lockstep interpreter — the Pallas TPU kernel supports only "
+            "float32/bfloat16 (no native f64 on this TPU generation). "
+            "Expect roughly interpreter-vs-kernel (O(100x) on TPU) lower "
+            "eval throughput than float32; use precision='float32' unless "
+            "you need f64 constants/losses. See BASELINE.md.",
+            stacklevel=2,
+        )
+    return opts

@@ -127,9 +127,11 @@ def tournament_winner(
         ] / tot
         scores = scores * jnp.exp(options.adaptive_parsimony_scaling * freq)
     order = jnp.argsort(scores)  # ascending: best first
-    p = options.tournament_selection_p
+    # tournament_selection_p may be a tracer (TRACED_SCALAR_FIELDS), so
+    # clamp with jnp, not Python min
+    p = jnp.minimum(options.tournament_selection_p, 1 - 1e-6)
     ranks = jnp.arange(n)
-    logits = ranks * jnp.log1p(-min(p, 1 - 1e-6)) + jnp.log(p)
+    logits = ranks * jnp.log1p(-p) + jnp.log(p)
     pick = jax.random.categorical(k2, logits)
     return idx[order[pick]]
 

@@ -365,7 +365,8 @@ def instr_dispatch(code, a, b, unary_fns, binary_fns, dispatch="mux"):
 
 def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                  max_len: int, slot_loop: str, dispatch: str,
-                 tree_unroll: int, compute_dtype=jnp.float32):
+                 tree_unroll: int, compute_dtype=jnp.float32,
+                 leaf_skip: bool = False):
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
     if slot_loop not in ("dynamic", "unrolled"):
@@ -414,6 +415,40 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                 # only the VMEM/X traffic pays half price.
                 a, b, x = (t.astype(jnp.float32) for t in (a, b, x))
             cv = jnp.full((r_sub, 128), cval_ref[si, ti], jnp.float32)
+            if leaf_skip:
+                # Scalar-predicated two-way branch: roughly half the slots
+                # of a postfix program are leaves (a tree with b binary
+                # ops has b+1 of them), and the branchless mux pays the
+                # FULL candidate set (every transcendental) on each. The
+                # opcode is a per-(slot, tree) SCALAR — uniform across
+                # lanes — so a real branch skips the operator candidates
+                # entirely on leaf slots without any lane divergence.
+                # (The 2023-vintage lax.switch-per-op design measured
+                # ~800 ns/slot, but that was ~n_ops branch targets plus a
+                # carried stack pointer; this is one 2-way branch with the
+                # precomputed operand schedule intact. Whether Mosaic's
+                # lowering keeps the tree-interleave pipeline overlap
+                # across the branch is exactly what kernel_tune measures.)
+                @pl.when(code < 3)
+                def _():
+                    val_ref[si] = jnp.where(code == 1, cv, x).astype(cdt)
+
+                @pl.when(code >= 3)
+                def _():
+                    cands = [fn(a) for fn in unary_fns]
+                    cands += [fn(b, a) for fn in binary_fns]
+                    v = _balanced_mux(code - 3, cands)
+                    val_ref[si] = v.astype(jnp.float32).astype(cdt)
+
+                stored = val_ref[si]
+                if cdt != jnp.float32:
+                    stored = stored.astype(jnp.float32)
+                return jnp.maximum(
+                    bad,
+                    jnp.where(
+                        isfinite_(stored) | (code == 0), 0.0, valid_f
+                    ),
+                )
             if dispatch == "chain":
                 # serial select chain: n_codes dependent `where`s
                 v = jnp.where(code == 1, cv, x)
@@ -689,7 +724,7 @@ def _check_r_block(r_block: int, nrows: int, interpret: bool):
     jax.jit,
     static_argnames=("operators", "t_block", "r_block", "interpret",
                      "slot_loop", "dispatch", "tree_unroll", "sort_trees",
-                     "compute_dtype", "program"),
+                     "compute_dtype", "program", "leaf_skip"),
 )
 def eval_trees_pallas(
     trees: TreeBatch,
@@ -704,6 +739,7 @@ def eval_trees_pallas(
     sort_trees: bool = True,
     compute_dtype: str = "float32",
     program: str = "postfix",
+    leaf_skip: bool = False,
 ) -> Tuple[Array, Array]:
     """Evaluate a flat batch of trees over X (nfeat, nrows).
 
@@ -724,7 +760,13 @@ def eval_trees_pallas(
     the same program through one packed int32 SMEM word per step and a
     unified operand scratch (see `pack_instr_tables`) — scalar-unit
     relief; requires <=255 opcodes and nfeat+max_len <= ~2048 (raises
-    otherwise). `slot_loop` applies to the postfix program only."""
+    otherwise). `slot_loop` applies to the postfix program only.
+
+    leaf_skip=True (postfix only) replaces the slot's single branchless
+    mux with a scalar-predicated two-way branch that skips the operator
+    candidate set entirely on leaf slots (~half the slots of a postfix
+    program) — an A/B lever for the per-slot overhead question
+    (BASELINE.md roofline section; sweep with kernel_tune.py)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -732,6 +774,11 @@ def eval_trees_pallas(
         raise ValueError(
             "program must be 'postfix', 'instr' or 'instr_packed', "
             f"got {program!r}"
+        )
+    if leaf_skip and program != "postfix":
+        raise ValueError(
+            "leaf_skip applies to the postfix program only (the instr "
+            "programs have no leaf slots to skip)"
         )
     batch_shape = trees.length.shape
     flat = jax.tree_util.tree_map(
@@ -796,7 +843,7 @@ def eval_trees_pallas(
     nrows_arr = jnp.asarray([nrows], jnp.int32)
 
     kernel = _make_kernel(operators, t_block, r_block, L, slot_loop,
-                          dispatch, tree_unroll, cdt)
+                          dispatch, tree_unroll, cdt, leaf_skip=leaf_skip)
 
     grid = (T_pad // t_block, NR // r_sub)
     smem_spec = lambda shape, imap: pl.BlockSpec(

@@ -177,7 +177,7 @@ def test_optimize_mutation_weight_improves_constants(rng):
 
     fn = _make_iteration_fn(opts, False)
     states2, _ = fn(states, jax.random.PRNGKey(1), jnp.int32(opts.maxsize),
-                    X, y, baseline)
+                    X, y, baseline, opts.traced_scalars())
     loss1 = float(jnp.sum(jnp.where(jnp.isfinite(states2.pop.losses),
                                     states2.pop.losses, 0.0)))
     opt_row = MUTATION_NAMES.index("optimize")

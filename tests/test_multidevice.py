@@ -154,7 +154,7 @@ def test_sharded_iteration_lowers_to_collectives():
     fn = _make_iteration_fn(opts, has_weights=False)
     compiled = fn.lower(
         states, jax.random.PRNGKey(1), jnp.int32(opts.maxsize), X, y,
-        baseline,
+        baseline, opts.traced_scalars(),
     ).compile()
     hlo = compiled.as_text()
     has_collective = any(

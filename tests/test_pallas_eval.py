@@ -156,6 +156,50 @@ def test_kernel_variants_agree(rng, dispatch, tree_unroll, sort_trees):
     )
 
 
+@pytest.mark.parametrize("tree_unroll", [1, 4])
+@pytest.mark.parametrize("compute_dtype", ["float32", "bfloat16"])
+def test_leaf_skip_variant_agrees(rng, tree_unroll, compute_dtype):
+    """The leaf-skip kernel (scalar-predicated 2-way branch per slot) must
+    match the always-mux kernel exactly: same stores, same poison
+    semantics — including PAD slots taking the leaf branch harmlessly and
+    non-finite CONST leaves still poisoning."""
+    trees = batch(rng, 13)
+    # plant a non-finite constant leaf in one tree: the leaf branch must
+    # still record the poison
+    from symbolicregression_jl_tpu.models.trees import CONST
+
+    kind0 = np.asarray(trees.kind)
+    cval0 = np.array(trees.cval, np.float32)  # copy: jax buffers are RO
+    t_i, s_i = np.argwhere(kind0 == CONST)[0]
+    cval0[t_i, s_i] = np.inf
+    trees = trees._replace(cval=jnp.asarray(cval0))
+    X = jnp.asarray(
+        (rng.standard_normal((NFEAT, 50)) * 2).astype(np.float32)
+    )
+    y_ref, ok_ref = eval_trees_pallas(
+        trees, X, OPS, t_block=8, r_block=128, interpret=True,
+        tree_unroll=tree_unroll, compute_dtype=compute_dtype,
+    )
+    y, ok = eval_trees_pallas(
+        trees, X, OPS, t_block=8, r_block=128, interpret=True,
+        tree_unroll=tree_unroll, compute_dtype=compute_dtype,
+        leaf_skip=True,
+    )
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+    m = np.asarray(ok_ref)
+    np.testing.assert_array_equal(np.asarray(y)[m], np.asarray(y_ref)[m])
+    assert not np.asarray(ok)[t_i]  # the inf const poisoned its tree
+
+
+def test_leaf_skip_rejects_instr_program(rng):
+    trees = batch(rng, 4)
+    X = jnp.asarray(rng.standard_normal((NFEAT, 16)).astype(np.float32))
+    with pytest.raises(ValueError, match="postfix"):
+        eval_trees_pallas(
+            trees, X, OPS, interpret=True, program="instr", leaf_skip=True
+        )
+
+
 def test_pallas_bf16_compute_tolerance(rng):
     """bf16-compute / f32-accumulate kernel variant stays within bf16
     tolerance of the f32 oracle (the TPU-native analog of the reference's

@@ -138,11 +138,15 @@ def test_compiled_kernel_bf16_on_chip(tpu_ready):
         )
     )
     ok, ok_i, ok_ref = map(np.asarray, (ok, ok_i, ok_ref))
-    # claim 1: compiled == interpret, bit-for-bit
+    # claim 1: compiled == interpret — ok-mask exactly; values within a
+    # few bf16 ulps (bit-for-bit held on v5e 2026-07-31, but Mosaic's
+    # transcendental lowering is not guaranteed identical to the
+    # interpret path across libtpu/jaxlib versions, so the value check
+    # tolerates 4 ulps of bf16 drift rather than pinning the toolchain)
     assert (ok == ok_i).all()
-    np.testing.assert_array_equal(
-        np.asarray(y)[ok], np.asarray(y_i)[ok_i]
-    )
+    a = np.asarray(y, np.float32)[ok]
+    b = np.asarray(y_i, np.float32)[ok_i]
+    np.testing.assert_allclose(a, b, rtol=2.0**-6, atol=1e-6)
     # sanity vs f32: the ok mask may only drift through bf16 overflow,
     # which must stay rare on this workload
     both = ok_ref & ok
@@ -305,15 +309,20 @@ def test_search_step_on_chip(tpu_ready):
     baseline = jnp.float32(float(np.var(y_h)))
 
     init_fn = _make_init_fn(options, 3, False)
+    scalars = options.traced_scalars()
     states = init_fn(
         jax.random.split(jax.random.PRNGKey(0), options.npopulations),
-        X, y, baseline,
+        X, y, baseline, scalars,
     )
     it_fn = _make_iteration_fn(options, False)
     cm = jnp.int32(options.maxsize)
 
-    states, hof1 = it_fn(states, jax.random.PRNGKey(1), cm, X, y, baseline)
-    states, hof2 = it_fn(states, jax.random.PRNGKey(2), cm, X, y, baseline)
+    states, hof1 = it_fn(
+        states, jax.random.PRNGKey(1), cm, X, y, baseline, scalars
+    )
+    states, hof2 = it_fn(
+        states, jax.random.PRNGKey(2), cm, X, y, baseline, scalars
+    )
 
     exists1 = np.asarray(jax.device_get(hof1.exists))
     exists2 = np.asarray(jax.device_get(hof2.exists))
