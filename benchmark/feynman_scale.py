@@ -20,8 +20,9 @@ set adds `square` (the probe that got I.8.14 to half-structure at small
 scale, and to the EXACT form at 32x128 on CPU — BASELINE.md).
 
 Usage:
-    python benchmark/feynman_scale.py [--seed N] [--cases I.8.14,I.6.2]
-                                      [--niter K] [--hard-only]
+    python benchmark/feynman_scale.py [--seed N | --seeds 0,1,2]
+                                      [--cases I.8.14,I.6.2] [--niter K]
+                                      [--hard-only]
 """
 
 from __future__ import annotations
@@ -65,9 +66,13 @@ def main():
 
     import symbolicregression_jl_tpu as sr
 
-    seed = 0
+    seeds = [0]
     if "--seed" in sys.argv:
-        seed = int(sys.argv[sys.argv.index("--seed") + 1])
+        seeds = [int(sys.argv[sys.argv.index("--seed") + 1])]
+    if "--seeds" in sys.argv:  # e.g. --seeds 0,1,2 (BASELINE.md 3-seed row)
+        seeds = [
+            int(s) for s in sys.argv[sys.argv.index("--seeds") + 1].split(",")
+        ]
     niter = 8
     if "--niter" in sys.argv:
         niter = int(sys.argv[sys.argv.index("--niter") + 1])
@@ -82,6 +87,11 @@ def main():
     if wanted is not None:
         cases = [c for c in cases if c[0] in wanted]
 
+    for seed in seeds:
+        _run_seed(sr, devices, cases, seed, niter)
+
+
+def _run_seed(sr, devices, cases, seed, niter):
     solved = 0
     for name, n_vars, fn, ranges in cases:
         rng = np.random.default_rng(seed)

@@ -13,7 +13,8 @@ runs the full hardware evidence list:
   2. python bench.py                                        (headline)
   3. python benchmark/suite.py          (north-star search iteration)
   4. python benchmark/opset_sweep.py    (per-slot overhead decomposition)
-  5. python benchmark/feynman_scale.py  (64x1000 quality at scale)
+  5. python benchmark/kernel_tune.py --tail 5   (leaf_skip variants)
+  6. python benchmark/feynman_scale.py  (64x1000 quality at scale)
 
 After every completed step the accumulated results are written to
 BENCH_TPU_LATEST.json at the repo root and committed, so a tunnel drop
@@ -60,6 +61,14 @@ STEPS = [
     (
         "opset_sweep",
         [sys.executable, "benchmark/opset_sweep.py"],
+        3000,
+        None,
+    ),
+    # the round-3 kernel variants only (leaf_skip sweep): --tail keeps
+    # it to the newly added grid entries
+    (
+        "kernel_tune_tail",
+        [sys.executable, "benchmark/kernel_tune.py", "--tail", "5"],
         3000,
         None,
     ),
@@ -162,6 +171,9 @@ def step_on_chip(name, rec):
     if name == "tpu_tests":
         tail = rec["stdout_tail"]
         return rec["rc"] == 0 and "passed" in tail and "skipped" not in tail
+    if name == "kernel_tune_tail":
+        # on a CPU fallback every variant FAILs and no BEST line prints
+        return rec["rc"] == 0 and "BEST" in rec["stdout_tail"]
     return rec["rc"] == 0
 
 
