@@ -135,6 +135,12 @@ def main():
                      leaf_skip=True, compute_dtype="bfloat16"))
     grid.append(dict(dispatch="mux", tree_unroll=16, sort_trees=True,
                      leaf_skip=True))
+    # 3-way class split: the binary arm (most operator slots) also skips
+    # the transcendental candidates — expected issued vec-ops/slot drop
+    # from ~33 to ~7 on this op set IF the branches are cheap
+    for unroll in (4, 8):
+        grid.append(dict(dispatch="mux", tree_unroll=unroll,
+                         sort_trees=True, leaf_skip="class"))
 
     if tail_n is not None:  # only the last N grid entries (quick probes)
         grid = grid[-tail_n:]

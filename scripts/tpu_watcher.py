@@ -13,7 +13,7 @@ runs the full hardware evidence list:
   2. python bench.py                                        (headline)
   3. python benchmark/suite.py          (north-star search iteration)
   4. python benchmark/opset_sweep.py    (per-slot overhead decomposition)
-  5. python benchmark/kernel_tune.py --tail 5   (leaf_skip variants)
+  5. python benchmark/kernel_tune.py --tail 7   (leaf_skip/class variants)
   6. python benchmark/feynman_scale.py  (64x1000 quality at scale)
 
 After every completed step the accumulated results are written to
@@ -68,7 +68,7 @@ STEPS = [
     # it to the newly added grid entries
     (
         "kernel_tune_tail",
-        [sys.executable, "benchmark/kernel_tune.py", "--tail", "5"],
+        [sys.executable, "benchmark/kernel_tune.py", "--tail", "7"],
         3000,
         None,
     ),

@@ -366,7 +366,7 @@ def instr_dispatch(code, a, b, unary_fns, binary_fns, dispatch="mux"):
 def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                  max_len: int, slot_loop: str, dispatch: str,
                  tree_unroll: int, compute_dtype=jnp.float32,
-                 leaf_skip: bool = False):
+                 leaf_skip: "bool | str" = False):
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
     if slot_loop not in ("dynamic", "unrolled"):
@@ -416,29 +416,51 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                 a, b, x = (t.astype(jnp.float32) for t in (a, b, x))
             cv = jnp.full((r_sub, 128), cval_ref[si, ti], jnp.float32)
             if leaf_skip:
-                # Scalar-predicated two-way branch: roughly half the slots
-                # of a postfix program are leaves (a tree with b binary
-                # ops has b+1 of them), and the branchless mux pays the
-                # FULL candidate set (every transcendental) on each. The
+                # Scalar-predicated branches: roughly half the slots of a
+                # postfix program are leaves (a tree with b binary ops
+                # has b+1 of them), and the branchless mux pays the FULL
+                # candidate set (every transcendental) on each. The
                 # opcode is a per-(slot, tree) SCALAR — uniform across
                 # lanes — so a real branch skips the operator candidates
                 # entirely on leaf slots without any lane divergence.
+                # leaf_skip=True: 2-way (leaf | all ops).
+                # leaf_skip="class": 3-way (leaf | unary | binary) — the
+                # binary arm (usually cheap arithmetic, the most common
+                # operator class) skips the transcendental unary
+                # candidates too.
                 # (The 2023-vintage lax.switch-per-op design measured
                 # ~800 ns/slot, but that was ~n_ops branch targets plus a
-                # carried stack pointer; this is one 2-way branch with the
+                # carried stack pointer; these are 2-3 branches with the
                 # precomputed operand schedule intact. Whether Mosaic's
                 # lowering keeps the tree-interleave pipeline overlap
-                # across the branch is exactly what kernel_tune measures.)
+                # across the branches is exactly what kernel_tune
+                # measures.)
                 @pl.when(code < 3)
                 def _():
                     val_ref[si] = jnp.where(code == 1, cv, x).astype(cdt)
 
-                @pl.when(code >= 3)
-                def _():
-                    cands = [fn(a) for fn in unary_fns]
-                    cands += [fn(b, a) for fn in binary_fns]
-                    v = _balanced_mux(code - 3, cands)
-                    val_ref[si] = v.astype(jnp.float32).astype(cdt)
+                if leaf_skip == "class" and U > 0 and binary_fns:
+                    @pl.when((code >= 3) & (code < 3 + U))
+                    def _():
+                        v = _balanced_mux(
+                            code - 3, [fn(a) for fn in unary_fns]
+                        )
+                        val_ref[si] = v.astype(jnp.float32).astype(cdt)
+
+                    @pl.when(code >= 3 + U)
+                    def _():
+                        v = _balanced_mux(
+                            code - 3 - U,
+                            [fn(b, a) for fn in binary_fns],
+                        )
+                        val_ref[si] = v.astype(jnp.float32).astype(cdt)
+                else:
+                    @pl.when(code >= 3)
+                    def _():
+                        cands = [fn(a) for fn in unary_fns]
+                        cands += [fn(b, a) for fn in binary_fns]
+                        v = _balanced_mux(code - 3, cands)
+                        val_ref[si] = v.astype(jnp.float32).astype(cdt)
 
                 stored = val_ref[si]
                 if cdt != jnp.float32:
@@ -739,7 +761,7 @@ def eval_trees_pallas(
     sort_trees: bool = True,
     compute_dtype: str = "float32",
     program: str = "postfix",
-    leaf_skip: bool = False,
+    leaf_skip: "bool | str" = False,
 ) -> Tuple[Array, Array]:
     """Evaluate a flat batch of trees over X (nfeat, nrows).
 
@@ -762,11 +784,13 @@ def eval_trees_pallas(
     relief; requires <=255 opcodes and nfeat+max_len <= ~2048 (raises
     otherwise). `slot_loop` applies to the postfix program only.
 
-    leaf_skip=True (postfix only) replaces the slot's single branchless
-    mux with a scalar-predicated two-way branch that skips the operator
-    candidate set entirely on leaf slots (~half the slots of a postfix
-    program) — an A/B lever for the per-slot overhead question
-    (BASELINE.md roofline section; sweep with kernel_tune.py)."""
+    leaf_skip (postfix only) replaces the slot's single branchless mux
+    with scalar-predicated branches that skip unused candidate work:
+    True = 2-way (leaf | operator; leaves are ~half the slots of a
+    postfix program), "class" = 3-way (leaf | unary | binary; the cheap-
+    arithmetic binary arm also skips the transcendental candidates) — A/B
+    levers for the per-slot overhead question (BASELINE.md roofline
+    section; sweep with kernel_tune.py)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -774,6 +798,10 @@ def eval_trees_pallas(
         raise ValueError(
             "program must be 'postfix', 'instr' or 'instr_packed', "
             f"got {program!r}"
+        )
+    if leaf_skip not in (False, True, "class"):
+        raise ValueError(
+            f"leaf_skip must be False, True or 'class', got {leaf_skip!r}"
         )
     if leaf_skip and program != "postfix":
         raise ValueError(

@@ -156,13 +156,16 @@ def test_kernel_variants_agree(rng, dispatch, tree_unroll, sort_trees):
     )
 
 
+@pytest.mark.parametrize("leaf_skip", [True, "class"])
 @pytest.mark.parametrize("tree_unroll", [1, 4])
 @pytest.mark.parametrize("compute_dtype", ["float32", "bfloat16"])
-def test_leaf_skip_variant_agrees(rng, tree_unroll, compute_dtype):
-    """The leaf-skip kernel (scalar-predicated 2-way branch per slot) must
-    match the always-mux kernel exactly: same stores, same poison
-    semantics — including PAD slots taking the leaf branch harmlessly and
-    non-finite CONST leaves still poisoning."""
+def test_leaf_skip_variant_agrees(rng, tree_unroll, compute_dtype,
+                                  leaf_skip):
+    """The leaf-skip kernels (scalar-predicated 2-way leaf|op branch, and
+    the 3-way leaf|unary|binary 'class' split) must match the always-mux
+    kernel exactly: same stores, same poison semantics — including PAD
+    slots taking the leaf branch harmlessly and non-finite CONST leaves
+    still poisoning."""
     trees = batch(rng, 13)
     # plant a non-finite constant leaf in one tree: the leaf branch must
     # still record the poison
@@ -183,7 +186,7 @@ def test_leaf_skip_variant_agrees(rng, tree_unroll, compute_dtype):
     y, ok = eval_trees_pallas(
         trees, X, OPS, t_block=8, r_block=128, interpret=True,
         tree_unroll=tree_unroll, compute_dtype=compute_dtype,
-        leaf_skip=True,
+        leaf_skip=leaf_skip,
     )
     np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
     m = np.asarray(ok_ref)
