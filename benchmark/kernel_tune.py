@@ -185,6 +185,13 @@ def main():
         )
         cdt = best_kw.get("compute_dtype", "float32")
         print(report(ops, avg_slots, best_rate, cdt, program=program))
+        if best_kw.get("leaf_skip"):
+            print(
+                "# note: the roofline model charges the FULL candidate "
+                "mux per slot; a leaf_skip/class variant issues fewer "
+                "vec-ops, so its true bound is lower and the printed "
+                "fraction understates how close the kernel is to it"
+            )
 
 
 if __name__ == "__main__":

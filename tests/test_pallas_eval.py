@@ -20,12 +20,12 @@ L = 24
 NFEAT = 4
 
 
-def batch(rng, n, max_size=14):
+def batch(rng, n, max_size=14, ops=OPS):
     return stack_trees(
         [
             encode_tree(
                 random_expr_fixed_size(
-                    rng, OPS, NFEAT, int(rng.integers(1, max_size))
+                    rng, ops, NFEAT, int(rng.integers(1, max_size))
                 ),
                 L,
             )
@@ -192,6 +192,37 @@ def test_leaf_skip_variant_agrees(rng, tree_unroll, compute_dtype,
     m = np.asarray(ok_ref)
     np.testing.assert_array_equal(np.asarray(y)[m], np.asarray(y_ref)[m])
     assert not np.asarray(ok)[t_i]  # the inf const poisoned its tree
+
+
+@pytest.mark.parametrize("leaf_skip", [False, True, "class"])
+@pytest.mark.parametrize(
+    "bins,unas",
+    [
+        (["+"], []),  # single binary, no unary: degenerate mux + fallback
+        (["+", "*"], ["cos"]),  # single unary arm
+        (["+", "-", "*", "/"],
+         ["square", "sqrt", "abs", "cos", "exp", "log"]),  # wide set
+    ],
+)
+def test_skip_variants_across_opsets(rng, bins, unas, leaf_skip):
+    """Branch/mux boundaries across operator-set shapes: every skip shape
+    must reproduce the jnp interpreter on sets where an arm is empty,
+    singleton, or wide (the 'class' fallback for U=0 included)."""
+    ops2 = make_operator_set(bins, unas)
+    trees = batch(rng, 9, max_size=12, ops=ops2)
+    X = jnp.asarray(
+        (rng.standard_normal((NFEAT, 40)) * 1.5).astype(np.float32)
+    )
+    y_ref, ok_ref = eval_trees(trees, X, ops2)
+    y, ok = eval_trees_pallas(
+        trees, X, ops2, t_block=8, r_block=128, interpret=True,
+        tree_unroll=2, leaf_skip=leaf_skip,
+    )
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+    m = np.asarray(ok_ref)
+    np.testing.assert_allclose(
+        np.asarray(y)[m], np.asarray(y_ref)[m], rtol=1e-5, atol=1e-5
+    )
 
 
 def test_leaf_skip_rejects_instr_program(rng):
