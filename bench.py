@@ -176,7 +176,9 @@ def time_pallas_variant(jax, jnp, trees, X, operators, overhead,
         ts.append(time.perf_counter() - t0)
     per_iter = max((float(np.median(ts)) - overhead) / n_inner, 1e-9)
     n_trees = int(np.prod(trees.length.shape))
-    return n_trees * N_ROWS / per_iter, per_iter, compile_s
+    # row count from the actual workload (kernel_tune's --rows-sweep
+    # passes datasets of varying width)
+    return n_trees * X.shape[1] / per_iter, per_iter, compile_s
 
 
 ANCHOR_REPS = 5  # the anchor swung 1.8x between rounds when timed once;

@@ -7,10 +7,19 @@ trees-rows/sec for each, highest last. Timing matches bench.py: n_inner
 iterations inside one jit with the constant-perturbation trick, tunnel
 dispatch overhead subtracted.
 
-Usage: python benchmark/kernel_tune.py [n_inner] [--tail N]
+Usage: python benchmark/kernel_tune.py [n_inner] [--tail N] [--rows-sweep]
 
 --tail N runs only the last N grid entries (quick probes of newly added
 variants without re-sweeping the full grid).
+
+--rows-sweep instead measures the default variant across dataset row
+counts {128, 256, 512, 1024, 2048}: rows live on (r_sub, 128) vreg
+tiles, so row counts below 1024 under-fill the 8 sublanes — 256 rows
+uses 2/8 — and this sweep quantifies how much trees-rows/s that lane
+waste actually costs in the in-search regime (feynman searches run at
+256 rows). A near-constant ms/iter across row counts = the waste is
+real (same vector work regardless of rows); trees-rows/s scaling
+linearly with rows = it is not.
 """
 
 from __future__ import annotations
@@ -50,6 +59,8 @@ def main():
             sys.exit("--tail requires a value: kernel_tune.py [n_inner] --tail N")
         tail_n = int(args[i + 1])
         args = args[:i] + args[i + 2:]
+    rows_sweep = "--rows-sweep" in args
+    args = [a for a in args if a != "--rows-sweep"]
     n_inner = int(args[0]) if args else 20
     N_TREES, MAXSIZE = 8192, 20
 
@@ -72,6 +83,25 @@ def main():
         return time_pallas_variant(
             jax, jnp, trees, X, ops, overhead, n_inner, **kw
         )
+
+    if rows_sweep:
+        # lane-utilization diagnostic: rows under 1024 under-fill the
+        # (8, 128) vreg sublanes ((nrows/128) of 8 used)
+        rng = np.random.default_rng(0)
+        for nrows in (128, 256, 512, 1024, 2048):
+            Xr = jnp.asarray(
+                rng.uniform(1.0, 3.0, nrows).astype("f4")[None, :]
+            )
+            rate, per_iter, compile_s = time_pallas_variant(
+                jax, jnp, trees, Xr, ops, overhead, n_inner
+            )
+            print(
+                f"rows={nrows:5d}  sublanes={min(nrows // 128, 8)}/8  "
+                f"{rate:.3e} t-r/s  {per_iter*1e3:7.2f} ms/iter  "
+                f"(compile {compile_s:.0f}s)",
+                flush=True,
+            )
+        return
 
     results = []
     grid = []

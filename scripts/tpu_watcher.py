@@ -14,7 +14,8 @@ runs the full hardware evidence list:
   3. python benchmark/suite.py          (north-star search iteration)
   4. python benchmark/opset_sweep.py    (per-slot overhead decomposition)
   5. python benchmark/kernel_tune.py --tail 7   (leaf_skip/class variants)
-  6. python benchmark/feynman_scale.py  (64x1000 quality at scale)
+  6. python benchmark/kernel_tune.py --rows-sweep  (lane-waste diagnostic)
+  7. python benchmark/feynman_scale.py  (64x1000 quality at scale)
 
 After every completed step the accumulated results are written to
 BENCH_TPU_LATEST.json at the repo root and committed, so a tunnel drop
@@ -70,6 +71,13 @@ STEPS = [
         "kernel_tune_tail",
         [sys.executable, "benchmark/kernel_tune.py", "--tail", "7"],
         3000,
+        None,
+    ),
+    # lane-utilization diagnostic for the in-search (256-row) regime
+    (
+        "rows_sweep",
+        [sys.executable, "benchmark/kernel_tune.py", "--rows-sweep"],
+        1800,
         None,
     ),
     (
