@@ -71,7 +71,7 @@ from .population import (
     tournament_winner,
     update_hall_of_fame,
 )
-from .trees import TreeBatch
+from .trees import TreeBatch, count_constants, tree_depth
 
 Array = jax.Array
 
@@ -116,16 +116,19 @@ def _adjusted_mutation_logits(
     tree: TreeBatch, curmaxsize: Array, options: Options
 ) -> Array:
     """Per-member mutation weights with the reference's adjustments
-    (src/Mutate.jl:51-62): no constants -> no mutate_constant; at the size
-    cap -> no add/insert."""
+    (src/Mutate.jl:51-62): mutate_constant scaled by min(8, #constants)/8
+    (more constants -> proportionally likelier, saturating at 8; zero
+    constants -> impossible); at the size OR depth cap -> no add/insert."""
     w = jnp.asarray(options.mutation_weights.as_tuple(), jnp.float32)
     idx = jnp.arange(tree.max_len)
-    n_const = jnp.sum((tree.kind == 1) & (idx < tree.length))
+    n_const = count_constants(tree)
     n_ops = jnp.sum((tree.kind >= 3) & (idx < tree.length))
     complexity = compute_complexity(tree, options)
-    at_cap = complexity >= curmaxsize
+    depth = tree_depth(tree.kind, tree.length)
+    at_cap = (complexity >= curmaxsize) | (depth >= options.maxdepth)
     sel = jnp.arange(N_MUTATIONS)
-    w = jnp.where((sel == MUTATE_CONSTANT) & (n_const == 0), 0.0, w)
+    const_scale = jnp.minimum(n_const, 8).astype(jnp.float32) / 8.0
+    w = jnp.where(sel == MUTATE_CONSTANT, w * const_scale, w)
     w = jnp.where((sel == MUTATE_OPERATOR) & (n_ops == 0), 0.0, w)
     w = jnp.where((sel == ADD_NODE) & at_cap, 0.0, w)
     w = jnp.where((sel == INSERT_NODE) & at_cap, 0.0, w)
