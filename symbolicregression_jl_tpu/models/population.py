@@ -125,6 +125,12 @@ def tournament_winner(
         freq = stats_frequencies[
             jnp.clip(complexity - 1, 0, stats_frequencies.shape[0] - 1)
         ] / tot
+        # out-of-range sizes carry NO penalty in the reference
+        # (frequency = 0 unless 0 < size <= maxsize — NOT actual_maxsize,
+        # even though the histogram has maxsize+2 bins;
+        # src/Population.jl:96-101) rather than the nearest bin's
+        in_range = (complexity > 0) & (complexity <= options.maxsize)
+        freq = jnp.where(in_range, freq, 0.0)
         scores = scores * jnp.exp(options.adaptive_parsimony_scaling * freq)
     order = jnp.argsort(scores)  # ascending: best first
     # tournament_selection_p may be a tracer (TRACED_SCALAR_FIELDS), so
