@@ -62,6 +62,7 @@ from .options import (
 from .parsimony import (
     RunningSearchStatistics,
     move_window,
+    normalize,
     update_frequencies,
 )
 from .population import (
@@ -275,11 +276,21 @@ def _accept_mutation(
             -delta / (options.alpha * jnp.maximum(temperature, 1e-6))
         )
     if options.use_frequency:
+        # reference src/Mutate.jl:231-245: NORMALIZED frequency when
+        # 0 < size <= maxsize, the constant 1e-6 otherwise (the
+        # normalization matters exactly because the out-of-range
+        # constant is in normalized units; in-range values carry a tiny
+        # floor only to keep the ratio NaN-free when a bin decays to 0)
         S = frequencies.shape[0]
-        c_old = jnp.clip(compute_complexity(old_tree, options) - 1, 0, S - 1)
-        c_new = jnp.clip(compute_complexity(new_tree, options) - 1, 0, S - 1)
-        f_old = jnp.maximum(frequencies[c_old], 1e-6)
-        f_new = jnp.maximum(frequencies[c_new], 1e-6)
+        norm = normalize(frequencies)
+
+        def f_at(c):
+            raw = norm[jnp.clip(c - 1, 0, S - 1)]
+            in_range = (c > 0) & (c <= options.maxsize)
+            return jnp.where(in_range, jnp.maximum(raw, 1e-30), 1e-6)
+
+        f_old = f_at(compute_complexity(old_tree, options))
+        f_new = f_at(compute_complexity(new_tree, options))
         prob = prob * f_old / f_new
     accept = jax.random.uniform(key) < prob
     accept &= jnp.isfinite(new_score)

@@ -20,14 +20,21 @@ Array = jax.Array
 WINDOW_SIZE = 100000.0  # reference src/AdaptiveParsimony.jl:29
 
 
+def normalize(frequencies: Array) -> Array:
+    """Frequency vector normalized to sum 1 (the reference's
+    normalized_frequencies, src/AdaptiveParsimony.jl:91-95) — the single
+    owner of the 1e-9 clamp for every consumer (tournament rescale,
+    acceptance-gate ratio, stats property)."""
+    return frequencies / jnp.maximum(jnp.sum(frequencies), 1e-9)
+
+
 class RunningSearchStatistics(NamedTuple):
     frequencies: Array  # (actual_maxsize,) float32
     window_size: float = WINDOW_SIZE
 
     @property
     def normalized(self) -> Array:
-        tot = jnp.sum(self.frequencies)
-        return self.frequencies / jnp.maximum(tot, 1e-9)
+        return normalize(self.frequencies)
 
 
 def init_search_statistics(actual_maxsize: int) -> RunningSearchStatistics:

@@ -23,7 +23,7 @@ from .complexity import compute_complexity
 from .fitness import score_trees
 from .mutate_device import gen_random_tree_fixed_size
 from .options import Options
-from .parsimony import RunningSearchStatistics
+from .parsimony import RunningSearchStatistics, normalize
 from .trees import TreeBatch
 
 Array = jax.Array
@@ -121,10 +121,9 @@ def tournament_winner(
     scores = pop.scores[idx]
     if options.use_frequency_in_tournament:
         complexity = compute_complexity(pop.trees[idx], options)
-        tot = jnp.maximum(jnp.sum(stats_frequencies), 1e-9)
-        freq = stats_frequencies[
+        freq = normalize(stats_frequencies)[
             jnp.clip(complexity - 1, 0, stats_frequencies.shape[0] - 1)
-        ] / tot
+        ]
         # out-of-range sizes carry NO penalty in the reference
         # (frequency = 0 unless 0 < size <= maxsize — NOT actual_maxsize,
         # even though the histogram has maxsize+2 bins;

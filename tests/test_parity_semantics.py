@@ -1,0 +1,109 @@
+"""Micro-tests pinning reference-exact search semantics (round 3):
+mutation-weight conditioning (src/Mutate.jl:54-62), tournament frequency
+range gating (src/Population.jl:96-101), and the acceptance gate's
+normalized-frequency ratio with its out-of-range 1e-6 constant
+(src/Mutate.jl:231-245). These are distribution-level semantics the e2e
+recovery tests can't distinguish from near-misses — pin them directly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.models.evolve import (
+    _accept_mutation,
+    _adjusted_mutation_logits,
+)
+from symbolicregression_jl_tpu.models.options import (
+    ADD_NODE,
+    INSERT_NODE,
+    MUTATE_CONSTANT,
+    make_options,
+)
+from symbolicregression_jl_tpu.models.trees import (
+    encode_tree,
+    parse_expression,
+)
+from symbolicregression_jl_tpu.ops.operators import make_operator_set
+
+OPS = make_operator_set(["+", "*"], ["cos"])
+OPT = make_options(
+    binary_operators=["+", "*"], unary_operators=["cos"], maxsize=10
+)
+
+
+def tree_of(s, max_len=16):
+    return jax.tree_util.tree_map(
+        jnp.asarray, encode_tree(parse_expression(s, OPS), max_len)
+    )
+
+
+def logits_of(s, curmaxsize=10):
+    return np.asarray(
+        _adjusted_mutation_logits(tree_of(s), jnp.int32(curmaxsize), OPT)
+    )
+
+
+def test_mutate_constant_weight_scales_with_constant_count():
+    """weights.mutate_constant *= min(8, #constants)/8 (src/Mutate.jl:54)."""
+    base = OPT.mutation_weights.mutate_constant
+    w1 = np.exp(logits_of("x0 + 1.5")[MUTATE_CONSTANT])
+    w2 = np.exp(logits_of("(x0 + 1.5) * (2.5 + 0.5)")[MUTATE_CONSTANT])
+    assert w1 == pytest.approx(base * 1 / 8, rel=1e-6)
+    assert w2 == pytest.approx(base * 3 / 8, rel=1e-6)
+    # zero constants -> impossible
+    assert logits_of("x0 + x1")[MUTATE_CONSTANT] == -np.inf
+
+
+def test_add_insert_zeroed_at_size_and_depth_caps():
+    """n >= curmaxsize OR depth >= maxdepth zeroes add/insert
+    (src/Mutate.jl:58-61)."""
+    # size cap: complexity 5 vs curmaxsize 5
+    lg = logits_of("(x0 + x1) * 1.5", curmaxsize=5)
+    assert lg[ADD_NODE] == -np.inf and lg[INSERT_NODE] == -np.inf
+    # depth cap: maxdepth defaults to maxsize=10; build depth-10 chain
+    deep = "cos(" * 9 + "x0" + ")" * 9
+    lg2 = logits_of(deep, curmaxsize=32)
+    assert lg2[ADD_NODE] == -np.inf and lg2[INSERT_NODE] == -np.inf
+    # under both caps: present
+    lg3 = logits_of("x0 + x1", curmaxsize=10)
+    assert np.isfinite(lg3[ADD_NODE]) and np.isfinite(lg3[INSERT_NODE])
+
+
+def _accept_prob(old_s, new_s, freqs, old_tree, new_tree, n=4096, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), n)
+    acc = jax.vmap(
+        lambda k: _accept_mutation(
+            k, old_tree, new_tree, jnp.float32(old_s), jnp.float32(new_s),
+            jnp.float32(0.5), freqs, OPT,
+        )
+    )(keys)
+    return float(np.mean(np.asarray(acc)))
+
+
+def test_acceptance_frequency_ratio_normalized_with_oob_constant():
+    """prob *= f_old/f_new with NORMALIZED in-range frequencies and the
+    exact constant 1e-6 out of range (src/Mutate.jl:231-245)."""
+    S = OPT.actual_maxsize
+    # complexity = node count here: 3 and 5
+    t3, t5 = tree_of("x0 + x1"), tree_of("x0 + (x1 * x0)")
+    freqs = jnp.ones(S, jnp.float32).at[2].set(8.0)  # size 3 bin = 8x
+    # equal scores -> annealing factor 1; ratio = f(3)/f(5)
+    tot = S - 1 + 8.0
+    expect = (8.0 / tot) / (1.0 / tot)  # = 8
+    p = _accept_prob(1.0, 1.0, freqs, t3, t5)
+    assert p == pytest.approx(min(1.0, expect), abs=0.05)  # ratio > 1 -> ~1
+    p_rev = _accept_prob(1.0, 1.0, freqs, t5, t3)
+    assert p_rev == pytest.approx(1.0 / 8.0, abs=0.03)
+    # out-of-range member (complexity 13 > maxsize 10, also beyond the
+    # maxsize+2 histogram): its frequency is the constant 1e-6 in
+    # NORMALIZED units -> old tiny, new in-range normal -> ratio
+    # ~ 1e-6/(1/tot) << 1 -> essentially never accepted
+    t13 = tree_of("((x0+x1)*(x0+x1))*((x0+x1)*1.5)")  # complexity 13 > maxsize
+    from symbolicregression_jl_tpu.models.complexity import (
+        compute_complexity,
+    )
+
+    assert int(compute_complexity(t13, OPT)) == 13
+    p_oob = _accept_prob(1.0, 1.0, jnp.ones(S, jnp.float32), t13, t3)
+    assert p_oob < 0.01
