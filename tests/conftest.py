@@ -24,17 +24,18 @@ import jax
 if os.environ.get("SRTPU_TPU_TESTS", "") != "1":
     jax.config.update("jax_platforms", "cpu")
 
-# Persistent compilation cache: ON by default since 2026-07-30 — two full
-# suite passes wrote ~100 CPU executables through `executable.serialize()`
-# without the segfault this image showed earlier (see the probe guard in
-# utils/precompile.py for the production-side screen), and a warm run cuts
-# the not-slow tier from ~30 min to ~11 min. If a pytest run ever dies
-# with a faulthandler dump ending in put_executable_and_time /
-# backend_compile_and_load, set SRTPU_TEST_CACHE=0 and delete the cache
-# dir. SRTPU_TEST_CACHE=<dir> overrides the location.
-_cache_dir = os.environ.get("SRTPU_TEST_CACHE", "")
-if _cache_dir != "0":
-    if not _cache_dir:
+# Persistent compilation cache: OFF by default since 2026-08-01. It was
+# default-on 2026-07-30..31 (two full passes wrote ~100 CPU executables
+# cleanly), but the round-3 search graphs deterministically crash this
+# image's executable serializer (`put_executable_and_time` abort at the
+# same test, 3/3 runs, fresh cache dir included) — the same jaxlib bug
+# utils/precompile.py probe-guards on the production side. A reliable
+# ~38-min suite beats a crashing ~15-min one. Opt back in with
+# SRTPU_TEST_CACHE=<dir> (or "1" for the default location) if a future
+# jaxlib fixes the serializer.
+_cache_dir = os.environ.get("SRTPU_TEST_CACHE", "0")
+if _cache_dir not in ("", "0"):
+    if _cache_dir == "1":
         _cache_dir = os.path.join(
             os.path.expanduser("~"), ".cache", "srtpu_test_xla"
         )
