@@ -31,10 +31,16 @@ _PALLAS_MIN_BATCH = 512
 # variant on hardware (benchmark/kernel_tune.py A/B history in BASELINE.md).
 _DEFAULT_PROGRAM = "postfix"
 
+# Slot-dispatch shape used when kernel_leaf_skip="auto": False until the
+# on-chip kernel_tune A/B of the skip variants shows a win (BASELINE.md
+# round-3 sweep slot); flip here to adopt a winner globally.
+_DEFAULT_LEAF_SKIP: "bool | str" = False
+
 
 def dispatch_eval(
     trees: TreeBatch, X: Array, operators: OperatorSet,
     backend: str = "auto", program: str = "auto",
+    leaf_skip: "str | bool" = "auto",
 ):
     """Choose the eval kernel. 'auto': the Pallas scalar-dispatch kernel for
     large float32/bfloat16 top-level batches on TPU (the bench /
@@ -70,9 +76,15 @@ def dispatch_eval(
         compute_dtype = (
             "bfloat16" if X.dtype == jnp.bfloat16 else "float32"
         )
+        resolved_program = _DEFAULT_PROGRAM if program == "auto" else program
+        resolved_skip = (
+            _DEFAULT_LEAF_SKIP if leaf_skip == "auto" else leaf_skip
+        )
+        if resolved_program != "postfix":
+            resolved_skip = False  # instr programs have no leaf slots
         y, ok = eval_trees_pallas(
             trees, X, operators, compute_dtype=compute_dtype,
-            program=_DEFAULT_PROGRAM if program == "auto" else program,
+            program=resolved_program, leaf_skip=resolved_skip,
         )
         # downstream scoring expects the working dtype; the kernel
         # accumulates/returns f32 (bf16-compute, f32-accumulate)
@@ -90,6 +102,7 @@ def eval_loss_trees(
     row_idx: Optional[Array] = None,
     backend: str = "auto",
     program: str = "auto",
+    leaf_skip: "str | bool" = "auto",
 ) -> Array:
     """Per-tree aggregated loss over all rows (or the row_idx minibatch).
 
@@ -99,7 +112,8 @@ def eval_loss_trees(
         X = X[:, row_idx]
         y = y[row_idx]
         weights = None if weights is None else weights[row_idx]
-    y_pred, ok = dispatch_eval(trees, X, operators, backend, program)
+    y_pred, ok = dispatch_eval(trees, X, operators, backend, program,
+                               leaf_skip)
     elem = loss_fn(y_pred, y)
     loss = aggregate_loss(elem, weights)
     loss = jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
@@ -161,6 +175,7 @@ def score_trees(
             trees, X, y, weights, options.operators, options.elementwise_loss,
             row_idx, backend=options.eval_backend,
             program=options.kernel_program,
+            leaf_skip=options.kernel_leaf_skip,
         )
     complexity = compute_complexity(trees, options)
     score = loss_to_score(loss, baseline, complexity, options)

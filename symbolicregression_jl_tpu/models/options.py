@@ -224,6 +224,15 @@ class Options:
     # pin a shape (shapes documented in ops/pallas_eval.py). Ignored on
     # the jnp interpreter path, like eval_backend="jnp".
     kernel_program: str = "auto"
+    # Slot-dispatch shape inside the postfix Pallas kernel: "auto" uses
+    # the measured default in models/fitness.py (_DEFAULT_LEAF_SKIP, set
+    # from the on-chip kernel_tune A/B of the skip variants); False pins
+    # the single branchless candidate mux; True adds a scalar-predicated
+    # 2-way branch that skips all operator candidates on leaf slots;
+    # "class" a 3-way branch (leaf | unary | binary) where the binary arm
+    # also skips the transcendental candidates. Applies to the postfix
+    # program only (the instr programs have no leaf slots).
+    kernel_leaf_skip: "str | bool" = "auto"
     # Constant-optimization eval path: "auto" routes BFGS through the
     # fused Pallas loss/grad kernels (ops/pallas_grad.py) at population
     # scale on TPU; "jnp" pins the vmapped-interpreter path; "pallas"
@@ -284,6 +293,17 @@ class Options:
             raise ValueError(
                 "kernel_program must be one of "
                 "auto/postfix/instr/instr_packed"
+            )
+        if self.kernel_leaf_skip not in ("auto", False, True, "class"):
+            raise ValueError(
+                "kernel_leaf_skip must be one of auto/False/True/'class'"
+            )
+        if self.kernel_leaf_skip not in ("auto", False) and (
+            self.kernel_program in ("instr", "instr_packed")
+        ):
+            raise ValueError(
+                "kernel_leaf_skip applies to the postfix program only; "
+                f"kernel_program={self.kernel_program!r} has no leaf slots"
             )
         if self.optimizer_backend not in ("auto", "jnp", "pallas"):
             raise ValueError(
@@ -376,7 +396,7 @@ class Options:
             self.topn, self.batching, self.batch_size,
             self.independent_island_batches,
             self.n_parallel_tournaments, self.eval_backend,
-            self.kernel_program, self.precision,
+            self.kernel_program, self.kernel_leaf_skip, self.precision,
             self.constraints, self.nested_constraints,
             self.complexity_of_operators, self.complexity_of_constants,
             self.complexity_of_variables, self.mutation_weights.as_tuple(),

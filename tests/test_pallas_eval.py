@@ -234,6 +234,49 @@ def test_leaf_skip_rejects_instr_program(rng):
         )
 
 
+def test_options_kernel_leaf_skip_validation():
+    """The Options knob mirrors the kernel's argument contract at
+    construction time, so a bad combination fails at make_options rather
+    than deep inside a jitted search step."""
+    from symbolicregression_jl_tpu.models.options import make_options
+
+    make_options(kernel_leaf_skip="class")  # postfix-auto: fine
+    make_options(kernel_leaf_skip=True, kernel_program="postfix")
+    # 'auto' resolves to the measured default, never conflicts
+    make_options(kernel_leaf_skip="auto", kernel_program="instr")
+    with pytest.raises(ValueError, match="kernel_leaf_skip"):
+        make_options(kernel_leaf_skip="always")
+    with pytest.raises(ValueError, match="leaf slots"):
+        make_options(kernel_leaf_skip=True, kernel_program="instr")
+
+
+def test_dispatch_routes_leaf_skip(rng, monkeypatch):
+    """options.kernel_leaf_skip reaches the kernel call: 'auto' resolves
+    to fitness._DEFAULT_LEAF_SKIP, explicit values pass through, and the
+    instr programs force False (they have no leaf slots)."""
+    from symbolicregression_jl_tpu.models import fitness
+    from symbolicregression_jl_tpu.ops import pallas_eval as pe
+
+    seen = {}
+
+    def fake_eval(trees, X, operators, **kw):
+        seen.update(kw)
+        return jnp.zeros((4, 16), jnp.float32), jnp.ones(4, bool)
+
+    monkeypatch.setattr(pe, "eval_trees_pallas", fake_eval)
+    trees = batch(rng, 4)
+    X = jnp.asarray(rng.standard_normal((NFEAT, 16)).astype(np.float32))
+
+    fitness.dispatch_eval(trees, X, OPS, backend="pallas",
+                          leaf_skip="class")
+    assert seen["leaf_skip"] == "class"
+    fitness.dispatch_eval(trees, X, OPS, backend="pallas")
+    assert seen["leaf_skip"] == fitness._DEFAULT_LEAF_SKIP
+    fitness.dispatch_eval(trees, X, OPS, backend="pallas",
+                          program="instr", leaf_skip=True)
+    assert seen["leaf_skip"] is False
+
+
 def test_pallas_bf16_compute_tolerance(rng):
     """bf16-compute / f32-accumulate kernel variant stays within bf16
     tolerance of the f32 oracle (the TPU-native analog of the reference's
