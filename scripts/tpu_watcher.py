@@ -7,14 +7,15 @@ The axon tunnel drops for hours at a time and — worse — hangs
 time (e.g. the driver's end-of-round capture) can miss every hardware
 window of a working day. This watcher inverts that: it polls the tunnel
 with a killable subprocess probe and, the first time the chip answers,
-runs the full hardware evidence list:
+runs the full hardware evidence list (short decisive steps first — see
+the STEPS comment):
 
   1. SRTPU_TPU_TESTS=1 pytest tests/test_tpu_hardware.py   (Mosaic tier)
   2. python bench.py                                        (headline)
-  3. python benchmark/suite.py          (north-star search iteration)
+  3. python benchmark/kernel_tune.py --tail 7   (leaf_skip/class variants)
   4. python benchmark/opset_sweep.py    (per-slot overhead decomposition)
-  5. python benchmark/kernel_tune.py --tail 7   (leaf_skip/class variants)
-  6. python benchmark/kernel_tune.py --rows-sweep  (lane-waste diagnostic)
+  5. python benchmark/kernel_tune.py --rows-sweep  (lane-waste diagnostic)
+  6. python benchmark/suite.py          (north-star search iteration)
   7. python benchmark/feynman_scale.py  (64x1000 quality at scale)
 
 After every completed step the accumulated results are written to
@@ -31,7 +32,12 @@ timing discipline).
 
 Exits after one complete capture.
 
-Usage:  python scripts/tpu_watcher.py [--poll SECONDS]
+A restarted watcher resumes: steps recorded CLEANLY in an incomplete,
+recent (<24 h) BENCH_TPU_LATEST.json are not re-run. A complete or stale
+capture file disables resume automatically (a new round must re-capture,
+not silently exit on last round's file); --fresh forces that manually.
+
+Usage:  python scripts/tpu_watcher.py [--poll SECONDS] [--fresh]
 """
 
 from __future__ import annotations
@@ -48,6 +54,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RESULT_PATH = os.path.join(REPO, "BENCH_TPU_LATEST.json")
 SENTINEL = "/tmp/srtpu_watcher_capturing"
 
+# Ordered by value-per-chip-minute: the 2026-08-01 morning window lasted
+# ~31 minutes (tpu_tests + bench exactly fit; the tunnel dropped the
+# moment suite started), so the short decisive sweeps go before the long
+# steps — any completed step is durable progress even if the window
+# closes mid-list.
 STEPS = [
     # (name, argv, timeout_s, extra_env)
     (
@@ -58,18 +69,18 @@ STEPS = [
         {"SRTPU_TPU_TESTS": "1"},
     ),
     ("bench", [sys.executable, "bench.py"], 3000, None),
-    ("suite", [sys.executable, "benchmark/suite.py"], 7200, None),
-    (
-        "opset_sweep",
-        [sys.executable, "benchmark/opset_sweep.py"],
-        3000,
-        None,
-    ),
     # the round-3 kernel variants only (leaf_skip sweep): --tail keeps
-    # it to the newly added grid entries
+    # it to the newly added grid entries; its outcome decides the
+    # kernel_leaf_skip default, so it runs early
     (
         "kernel_tune_tail",
         [sys.executable, "benchmark/kernel_tune.py", "--tail", "7"],
+        3000,
+        None,
+    ),
+    (
+        "opset_sweep",
+        [sys.executable, "benchmark/opset_sweep.py"],
         3000,
         None,
     ),
@@ -80,6 +91,7 @@ STEPS = [
         1800,
         None,
     ),
+    ("suite", [sys.executable, "benchmark/suite.py"], 7200, None),
     (
         "feynman_scale",
         [sys.executable, "benchmark/feynman_scale.py", "--seed", "0"],
@@ -158,8 +170,14 @@ def run_step(name, argv, timeout, extra_env):
     jl = parse_json_lines(out)
     rec = {
         "rc": rc,
+        "argv": list(argv),  # resume only honors records of the SAME command
         "seconds": dt,
         "timed_out": timed_out,
+        # per-step stamp: resumed payloads must not re-date carried-over
+        # steps to a window they did not run in
+        "captured_at": datetime.datetime.now().isoformat(
+            timespec="seconds"
+        ),
         "json": jl,
         "stdout_tail": "\n".join((out or "").splitlines()[-12:]),
         "stderr_tail": "\n".join((err or "").splitlines()[-8:]),
@@ -185,11 +203,13 @@ def step_on_chip(name, rec):
     return rec["rc"] == 0
 
 
-def save_and_commit(results, done):
+def save_and_commit(results, done, first_captured_at=None):
+    now = datetime.datetime.now().isoformat(timespec="seconds")
     payload = {
-        "captured_at": datetime.datetime.now().isoformat(
-            timespec="seconds"
-        ),
+        # last write time; per-step captured_at records when each step
+        # actually ran, first_captured_at when this capture began
+        "captured_at": now,
+        "first_captured_at": first_captured_at or now,
         "complete": done,
         "steps": results,
     }
@@ -219,15 +239,107 @@ def save_and_commit(results, done):
         time.sleep(10)
 
 
+def load_previous_results():
+    """Resume support: steps already captured CLEANLY (on-chip, rc=0, not
+    partial) in BENCH_TPU_LATEST.json survive a watcher restart — a
+    restarted watcher (step-list edit, reboot) must not burn a tunnel
+    window re-running finished work. Partial records are kept in the
+    payload but their steps re-run.
+
+    Guard rails: a COMPLETE capture or one older than 24 h disables
+    resume entirely — restarting the watcher then means a fresh capture
+    is wanted (a new round must not silently exit on last round's file).
+    Malformed files (merge-conflict damage) also fall back to fresh.
+    Returns (steps, first_captured_at)."""
+    try:
+        with open(RESULT_PATH) as f:
+            data = json.load(f)
+        if data.get("complete"):
+            return {}, None
+        started = data.get("first_captured_at") or data.get("captured_at")
+        age_h = (
+            datetime.datetime.now()
+            - datetime.datetime.fromisoformat(started)
+        ).total_seconds() / 3600.0
+        if age_h > 24:
+            return {}, None
+        steps = data.get("steps")
+        if not isinstance(steps, dict):
+            return {}, None
+        return (
+            {n: rec for n, rec in steps.items() if isinstance(rec, dict)},
+            started,
+        )
+    except Exception:
+        return {}, None
+
+
+MAX_ATTEMPTS = 3  # per step, across tunnel windows AND restarts
+
+
 def main():
     poll = 120
     if "--poll" in sys.argv:
         poll = int(sys.argv[sys.argv.index("--poll") + 1])
 
-    remaining = list(STEPS)
     results = {}
+    first_captured_at = None
     attempts = {}
-    MAX_ATTEMPTS = 3  # per step, across tunnel windows
+    done = set()
+    if "--fresh" not in sys.argv:
+        results, first_captured_at = load_previous_results()
+        # a record only counts for the step that would run NOW: same name
+        # AND same argv (a --tail width change between rounds must re-run
+        # the sweep, and a renamed step's orphan must not masquerade as
+        # current evidence). Mismatches are dropped from the payload —
+        # git history keeps the old capture.
+        current = {s[0]: [str(a) for a in s[1]] for s in STEPS}
+        stale = {
+            n for n, rec in results.items()
+            if n not in current or rec.get("argv") != current[n]
+        }
+        if stale:
+            log(f"dropping stale/mismatched records: {sorted(stale)}")
+            results = {
+                n: rec for n, rec in results.items() if n not in stale
+            }
+        # single source of truth for "clean": the partial flag the save
+        # path computed when the step ran (ok = on-chip && rc 0 && not
+        # timed out); exhausted steps (attempt cap hit) stay recorded as
+        # partial and must not burn another window's chip time either
+        attempts = {
+            n: rec.get("attempts", 0) for n, rec in results.items()
+        }
+        clean = {
+            n for n, rec in results.items()
+            if not rec.get("partial", True)
+        }
+        exhausted = {
+            n for n, rec in results.items()
+            if rec.get("partial") and attempts.get(n, 0) >= MAX_ATTEMPTS
+        }
+        done = clean | exhausted
+        if done:
+            log(
+                f"resuming: captured {sorted(clean)}"
+                + (f", exhausted {sorted(exhausted)}" if exhausted else "")
+            )
+    if first_captured_at is None:
+        # pin the capture epoch NOW: every later save reuses it, so the
+        # resume staleness guard measures from the true start, not the
+        # last write
+        first_captured_at = datetime.datetime.now().isoformat(
+            timespec="seconds"
+        )
+    remaining = [s for s in STEPS if s[0] not in done]
+    if not remaining:
+        # a step-list edit can make the previous capture fully cover the
+        # current STEPS: finalize the payload (complete=True) rather
+        # than exiting with the file stuck at complete=False
+        save_and_commit(results, done=True,
+                        first_captured_at=first_captured_at)
+        log("all evidence already captured — finalizing and exiting")
+        return
     while remaining:
         plat = probe_platform()
         if plat != "tpu":
@@ -248,6 +360,10 @@ def main():
                 ok = on_chip and rec["rc"] == 0 and not rec["timed_out"]
                 rec["on_chip"] = on_chip
                 rec["partial"] = not ok
+                # persisted so the attempt cap survives a restart: a
+                # deterministically failing step must not re-block the
+                # never-run steps behind it in the next window
+                rec["attempts"] = attempts[name]
                 log(
                     f"step {name}: rc={rec['rc']} {rec['seconds']}s "
                     f"on_chip={on_chip} ok={ok}"
@@ -257,16 +373,18 @@ def main():
                     # is (flagged partial) and stop burning chip time
                     results[name] = rec
                     remaining.pop(0)
-                    save_and_commit(results, done=not remaining)
+                    save_and_commit(results, done=not remaining,
+                                    first_captured_at=first_captured_at)
                     continue
-                # failed with attempts left: keep any on-chip JSON the
-                # step emitted before dying (hours of finished feynman
-                # cases must survive a drop), flagged partial, and
-                # retry — immediately if the tunnel is still up, else
-                # back to polling
-                if rec["json"] and on_chip:
-                    results[name] = rec
-                    save_and_commit(results, done=False)
+                # failed with attempts left: record the attempt (the
+                # attempts cap must survive a restart even for json-less
+                # crashes, and any on-chip JSON the step emitted before
+                # dying — hours of finished feynman cases — must survive
+                # a drop), flagged partial, then retry — immediately if
+                # the tunnel is still up, else back to polling
+                results[name] = rec
+                save_and_commit(results, done=False,
+                                first_captured_at=first_captured_at)
                 if probe_platform() != "tpu":
                     log(f"tunnel dropped during {name}; back to polling")
                     break
