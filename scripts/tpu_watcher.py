@@ -277,6 +277,48 @@ def load_previous_results():
 MAX_ATTEMPTS = 3  # per step, across tunnel windows AND restarts
 
 
+def merge_retry_record(prev, rec):
+    """A json-less failed attempt (e.g. JAX init dying in seconds on a
+    flapping tunnel) must not destroy an earlier attempt's on-chip JSON —
+    hours of finished feynman cases live there. Mutates rec in place,
+    carrying the prior attempt's json forward (flagged) and keeping the
+    on-chip attribution that came with it."""
+    if prev and prev.get("json") and not rec.get("json"):
+        rec["json"] = prev["json"]
+        rec["json_from_earlier_attempt"] = True
+        rec["on_chip"] = rec.get("on_chip", False) or prev.get(
+            "on_chip", False
+        )
+
+
+def compute_resume_state(results):
+    """The single derivation both main() and the tests use: drop records
+    that don't match the step that would run NOW (same name AND argv — a
+    --tail width change between rounds must re-run the sweep, and a
+    renamed step's orphan must not masquerade as current evidence; git
+    history keeps dropped captures), then partition the survivors.
+
+    "Clean" is read straight off the partial flag the save path computed
+    when the step ran (ok = on-chip && rc 0 && not timed out); exhausted
+    steps (attempt cap hit) stay recorded as partial and must not burn
+    another window's chip time either.
+
+    Returns (kept_results, done_names, attempts, stale_names)."""
+    current = {s[0]: [str(a) for a in s[1]] for s in STEPS}
+    stale = {
+        n for n, rec in results.items()
+        if n not in current or rec.get("argv") != current[n]
+    }
+    kept = {n: rec for n, rec in results.items() if n not in stale}
+    attempts = {n: rec.get("attempts", 0) for n, rec in kept.items()}
+    clean = {n for n, rec in kept.items() if not rec.get("partial", True)}
+    exhausted = {
+        n for n, rec in kept.items()
+        if rec.get("partial") and attempts.get(n, 0) >= MAX_ATTEMPTS
+    }
+    return kept, clean | exhausted, attempts, stale
+
+
 def main():
     poll = 120
     if "--poll" in sys.argv:
@@ -288,42 +330,17 @@ def main():
     done = set()
     if "--fresh" not in sys.argv:
         results, first_captured_at = load_previous_results()
-        # a record only counts for the step that would run NOW: same name
-        # AND same argv (a --tail width change between rounds must re-run
-        # the sweep, and a renamed step's orphan must not masquerade as
-        # current evidence). Mismatches are dropped from the payload —
-        # git history keeps the old capture.
-        current = {s[0]: [str(a) for a in s[1]] for s in STEPS}
-        stale = {
-            n for n, rec in results.items()
-            if n not in current or rec.get("argv") != current[n]
-        }
+        results, done, attempts, stale = compute_resume_state(results)
         if stale:
             log(f"dropping stale/mismatched records: {sorted(stale)}")
-            results = {
-                n: rec for n, rec in results.items() if n not in stale
-            }
-        # single source of truth for "clean": the partial flag the save
-        # path computed when the step ran (ok = on-chip && rc 0 && not
-        # timed out); exhausted steps (attempt cap hit) stay recorded as
-        # partial and must not burn another window's chip time either
-        attempts = {
-            n: rec.get("attempts", 0) for n, rec in results.items()
-        }
-        clean = {
-            n for n, rec in results.items()
-            if not rec.get("partial", True)
-        }
-        exhausted = {
-            n for n, rec in results.items()
-            if rec.get("partial") and attempts.get(n, 0) >= MAX_ATTEMPTS
-        }
-        done = clean | exhausted
+        if not results:
+            # nothing usable carried over: this is a fresh capture, so
+            # its epoch must not inherit the dropped file's age (a
+            # 23h-old inherited stamp would spuriously trip the 24h
+            # guard on the very next restart)
+            first_captured_at = None
         if done:
-            log(
-                f"resuming: captured {sorted(clean)}"
-                + (f", exhausted {sorted(exhausted)}" if exhausted else "")
-            )
+            log(f"resuming: already have {sorted(done)}")
     if first_captured_at is None:
         # pin the capture epoch NOW: every later save reuses it, so the
         # resume staleness guard measures from the true start, not the
@@ -364,6 +381,7 @@ def main():
                 # deterministically failing step must not re-block the
                 # never-run steps behind it in the next window
                 rec["attempts"] = attempts[name]
+                merge_retry_record(results.get(name), rec)
                 log(
                     f"step {name}: rc={rec['rc']} {rec['seconds']}s "
                     f"on_chip={on_chip} ok={ok}"
