@@ -92,9 +92,14 @@ STEPS = [
         None,
     ),
     ("suite", [sys.executable, "benchmark/suite.py"], 7200, None),
+    # --resume: skip (case, seed) pairs already captured on chip in
+    # BENCH_TPU_LATEST.json (main() persists the guard-railed resume
+    # state to that file BEFORE any step runs, so the script can trust
+    # it) — a retry after a drop spends its window on unfinished cases
     (
         "feynman_scale",
-        [sys.executable, "benchmark/feynman_scale.py", "--seed", "0"],
+        [sys.executable, "benchmark/feynman_scale.py", "--seed", "0",
+         "--resume"],
         10800,
         None,
     ),
@@ -333,6 +338,15 @@ def main():
         results, done, attempts, stale = compute_resume_state(results)
         if stale:
             log(f"dropping stale/mismatched records: {sorted(stale)}")
+            # persist the cleaned payload NOW: scripts that read the
+            # file under --resume (feynman_scale) must never see records
+            # this guard just rejected. (Epoch: if nothing survived this
+            # is a fresh capture — stamp it as such, not with the
+            # dropped file's age.)
+            save_and_commit(
+                results, done=False,
+                first_captured_at=first_captured_at if results else None,
+            )
         if not results:
             # nothing usable carried over: this is a fresh capture, so
             # its epoch must not inherit the dropped file's age (a
