@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Drop named steps from BENCH_TPU_LATEST.json so a restarted
+scripts/tpu_watcher.py re-captures them in the next tunnel window.
+
+Needed when a step's failure was caused by a code bug that is now fixed:
+the watcher's resume logic deliberately refuses to re-run a step that
+exhausted its attempt cap (so a deterministically failing step cannot
+burn every future window), which means a *fixed* step must have its
+record cleared by hand — that is an explicit human decision, recorded in
+git by the file change this script makes.
+
+Usage: python scripts/reset_capture_steps.py step [step ...]
+"""
+
+import json
+import os
+import sys
+
+PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "BENCH_TPU_LATEST.json",
+)
+
+
+def main():
+    names = sys.argv[1:]
+    if not names:
+        sys.exit(__doc__)
+    with open(PATH) as f:
+        data = json.load(f)
+    steps = data.get("steps", {})
+    dropped = [n for n in names if steps.pop(n, None) is not None]
+    missing = [n for n in names if n not in dropped]
+    # the capture is no longer complete once anything is dropped
+    if dropped:
+        data["complete"] = False
+    with open(PATH, "w") as f:
+        json.dump(data, f, indent=1)
+    print(f"dropped: {dropped}; not present: {missing}; "
+          f"complete={data.get('complete')}")
+
+
+if __name__ == "__main__":
+    main()

@@ -252,6 +252,263 @@ BINARY_REGISTRY: Dict[str, Callable] = {
     "atan2": jnp.arctan2,
 }
 
+# ---------------------------------------------------------------------------
+# Mosaic-safe kernel substitutes
+# ---------------------------------------------------------------------------
+# The Pallas TPU (Mosaic) lowering supports only a subset of lax's
+# elementwise transcendentals (exp/log/log1p/sqrt/rsqrt/sin/cos/tan/tanh/
+# pow/logistic and arithmetic/compare/select — see
+# jax/_src/pallas/mosaic/lowering.py's rule table). jnp.cosh, jnp.sinh,
+# the inverse trig/hyperbolic family, erf/erfc, gamma (lgamma), atan2 and
+# rem (jnp.mod) all hit `NotImplementedError: Unimplemented primitive in
+# Pallas TPU lowering`. So the compiled-kernel path routes these names to
+# compositions built ONLY from Mosaic-lowerable primitives. The jnp
+# interpreter path keeps the exact lax implementations; the compositions
+# below are f32-accurate to a few ulp (each is parity-tested against its
+# lax counterpart over a domain grid in tests/test_operators.py), which is
+# within the kernel's existing f32-vs-f64-oracle comparison tolerances.
+#
+# The substitutions also keep the library's NaN-domain semantics
+# (reference src/Operators.jl:8-73) bit-identical: every guard is applied
+# to the composition exactly as it is to the lax version.
+#
+# Derivatives: the |x|-based compositions have a zero subgradient at
+# x == 0 under plain autodiff (the odd-sign select routes the cotangent
+# into a constant branch), so every substitute whose true derivative at 0
+# is nonzero carries a custom_jvp with the EXACT closed-form derivative —
+# itself Mosaic-lowerable, and more accurate than differentiating the
+# approximation. The Pallas grad kernel's per-step `jax.vjp` picks these
+# up automatically.
+
+_LN2 = 0.6931471805599453
+
+
+def _odd_sign(x: Array, r: Array) -> Array:
+    """sign(x) * r for an odd function's |x|-based magnitude r, with
+    f(0) = 0 preserved (including -0.0 and NaN passthrough)."""
+    return jnp.where(x < 0, -r, jnp.where(x > 0, r, x * 0.0))
+
+
+def _exact_grad(dfn):
+    """Attach `dfn` as the exact derivative of a unary composition."""
+    def deco(fn):
+        f = jax.custom_jvp(fn)
+
+        @f.defjvp
+        def _jvp(primals, tangents):
+            (x,), (t,) = primals, tangents
+            return fn(x), dfn(x) * t
+
+        return f
+    return deco
+
+
+def _atan_poly(z: Array) -> Array:
+    # minimax for (atan(t) - t)/t^3 on |t| <= tan(pi/8) (classic 4-term
+    # Cephes-style coefficients, ~2 ulp f32)
+    return (
+        (8.05374449538e-2 * z - 1.38776856032e-1) * z + 1.99777106478e-1
+    ) * z - 3.33329491539e-1
+
+
+@_exact_grad(lambda x: 1.0 / (1.0 + x * x))
+def atan_kernel(x: Array) -> Array:
+    """arctan from +,*,/,select only: octant reduction + odd minimax poly."""
+    ax = jnp.abs(x)
+    big = ax > 2.414213562373095  # tan(3pi/8): atan(t) = pi/2 - atan(1/t)
+    med = ax > 0.41421356237309503  # tan(pi/8): atan(t)=pi/4+atan((t-1)/(t+1))
+    t = jnp.where(
+        big,
+        -1.0 / jnp.where(big, ax, 1.0),
+        jnp.where(med, (ax - 1.0) / (ax + 1.0), ax),
+    )
+    y0 = jnp.where(big, jnp.pi / 2, jnp.where(med, jnp.pi / 4, 0.0))
+    z = t * t
+    r = y0 + t + t * z * _atan_poly(z)
+    return _odd_sign(x, r)
+
+
+def _dasin(x: Array) -> Array:
+    # 1/sqrt(1-x^2); inf at |x|==1 and NaN outside, matching lax.asin's vjp
+    return jax.lax.rsqrt((1.0 - x) * (1.0 + x))
+
+
+@_exact_grad(_dasin)
+def asin_kernel(x: Array) -> Array:
+    """safe_asin semantics (NaN outside [-1,1]) via atan composition."""
+    ok = jnp.abs(x) <= 1
+    xc = jnp.clip(x, -1, 1)
+    s = jnp.sqrt((1.0 - xc) * (1.0 + xc))
+    edge = s == 0
+    r = atan_kernel(xc / jnp.where(edge, 1.0, s))
+    r = jnp.where(edge, jnp.sign(xc) * (jnp.pi / 2), r)
+    return jnp.where(ok, r, jnp.nan)
+
+
+def acos_kernel(x: Array) -> Array:
+    # pi/2 - asin: correct exact gradient flows through asin's custom rule
+    return jnp.pi / 2 - asin_kernel(x)
+
+
+def cosh_kernel(x: Array) -> Array:
+    # e' = exp(|x|)/2 so the largest finite cosh (|x| ~ 89.4) stays finite:
+    # exp(|x|) itself overflows f32 from |x| ~ 88.7 while cosh is still
+    # representable up to ~3.4e38. (Autodiff is exact here: cosh' = sinh
+    # is odd with sinh(0) = 0, so the |x| subgradient-0 point is correct.)
+    e = jnp.exp(jnp.abs(x) - _LN2)
+    return e + 0.25 / e
+
+
+def sinh_kernel(x: Array) -> Array:
+    # tanh (natively lowerable) carries the near-0 accuracy and the sign;
+    # cosh the range. Product-rule autodiff is exact incl. at 0.
+    return jnp.tanh(x) * cosh_kernel(x)
+
+
+@_exact_grad(lambda x: jax.lax.rsqrt(1.0 + x * x))
+def asinh_kernel(x: Array) -> Array:
+    ax = jnp.abs(x)
+    big = ax > 1e8  # x*x would overflow f32; asinh ~ log(2|x|)
+    axs = jnp.where(big, 1.0, ax)
+    x2 = axs * axs
+    small = jnp.log1p(axs + x2 / (1.0 + jnp.sqrt(x2 + 1.0)))
+    large = jnp.log(jnp.where(big, ax, 1.0)) + _LN2
+    return _odd_sign(x, jnp.where(big, large, small))
+
+
+def acosh_kernel(x: Array) -> Array:
+    """safe_acosh semantics (NaN for x<1). Reference: src/Operators.jl:66-69.
+
+    No zero-crossing, so autodiff through the composition is correct
+    (inf slope at x=1, NaN below, ~1/x above — matching lax.acosh's vjp
+    under the same domain guard).
+    """
+    ok = x >= 1
+    xs = jnp.where(ok, x, 1.0)
+    big = xs > 1e8
+    xb = jnp.where(big, 1.0, xs)
+    small = jnp.log1p((xb - 1.0) + jnp.sqrt((xb - 1.0) * (xb + 1.0)))
+    large = jnp.log(jnp.where(big, xs, 1.0)) + _LN2
+    return jnp.where(ok, jnp.where(big, large, small), jnp.nan)
+
+
+def mod_kernel(x: Array, y: Array) -> Array:
+    """Floor-mod (jnp.mod semantics) from div/floor/mul; rem_p doesn't lower.
+
+    Autodiff gives d/dx = 1 and d/dy = -floor(x/y) a.e., the same
+    gradients as jnp.mod's.
+    """
+    return x - jnp.floor(x / y) * y
+
+
+def atanh_clip_kernel(x: Array) -> Array:
+    """atanh of x wrapped to (-1, 1). Reference: src/Operators.jl:14."""
+    w = mod_kernel(x + 1.0, 2.0) - 1.0
+    # atanh(w) = 0.5 log1p(2w / (1-w)); w == 1 is unreachable from the wrap
+    return 0.5 * jnp.log1p(2.0 * w / jnp.where(w == 1.0, 1.0, 1.0 - w))
+
+
+@_exact_grad(lambda x: 1.1283791670955126 * jnp.exp(-x * x))  # 2/sqrt(pi)
+def erf_kernel(x: Array) -> Array:
+    """Abramowitz-Stegun 7.1.26 rational approximation (|err| < 1.5e-7)."""
+    ax = jnp.abs(x)
+    t = 1.0 / (1.0 + 0.3275911 * ax)
+    poly = (
+        (((1.061405429 * t - 1.453152027) * t + 1.421413741) * t
+         - 0.284496736) * t + 0.254829592
+    ) * t
+    r = 1.0 - poly * jnp.exp(-ax * ax)
+    return _odd_sign(x, r)
+
+
+def erfc_kernel(x: Array) -> Array:
+    # absolute error matches erf_kernel (~1.5e-7); relative error in the
+    # far x>0 tail is worse than lax.erfc's — acceptable for f32 fitness
+    return 1.0 - erf_kernel(x)
+
+
+# Lanczos g=7, n=9 coefficients (standard published set; f32-accurate)
+_LANCZOS = (
+    676.5203681218851, -1259.1392167224028, 771.32342877765313,
+    -176.61502916214059, 12.507343278686905, -0.13857109526572012,
+    9.9843695780195716e-6, 1.5056327351493116e-7,
+)
+
+
+def gamma_kernel(x: Array) -> Array:
+    """gamma(x) with poles/Inf -> NaN via Lanczos; lgamma doesn't lower.
+
+    Same semantics as gamma_op (reference src/Operators.jl:8-12).
+    """
+    refl = x < 0.5
+    xx = jnp.where(refl, 1.0 - x, x) - 1.0
+    a = jnp.full_like(x, 0.99999999999980993)
+    for i, c in enumerate(_LANCZOS):
+        a = a + c / (xx + (i + 1.0))
+    t = xx + 7.5
+    # t^(xx+0.5) e^-t in log space: the factored form overflows f32 at
+    # x ~ 26 while the true value (~1e25) is still representable
+    y = 2.5066282746310002 * a * jnp.exp(
+        (xx + 0.5) * jnp.log(t) - t
+    )
+    sin_pix = jnp.sin(jnp.pi * x)
+    out = jnp.where(
+        refl, jnp.pi / (sin_pix * jnp.where(refl, y, 1.0)), y
+    )
+    is_pole = (x <= 0) & (x == jnp.round(x))
+    return jnp.where(is_pole | ~isfinite_(out), jnp.nan, out)
+
+
+def _atan2_comp(y: Array, x: Array) -> Array:
+    r = atan_kernel(y / jnp.where(x == 0, 1.0, x))
+    r = jnp.where(x == 0, jnp.sign(y) * (jnp.pi / 2), r)
+    ysign = jnp.where(y < 0, -1.0, 1.0)
+    return jnp.where(x < 0, r + ysign * jnp.pi, r)
+
+
+@jax.custom_jvp
+def atan2_kernel(y: Array, x: Array) -> Array:
+    """Quadrant-corrected atan composition (atan2_p doesn't lower).
+
+    Matches lax.atan2 on finite inputs with x != 0 or y != 0 off the
+    negative-real axis; the +-0 / double-inf IEEE edge cases differ.
+    Exact closed-form jvp (d/dy = x/r^2, d/dx = -y/r^2) replaces the
+    composition's where-masked autodiff.
+    """
+    return _atan2_comp(y, x)
+
+
+@atan2_kernel.defjvp
+def _atan2_jvp(primals, tangents):
+    (y, x), (ty, tx) = primals, tangents
+    r2 = x * x + y * y
+    return _atan2_comp(y, x), (x * ty - y * tx) / r2
+
+
+# name -> Mosaic-lowerable replacement used by the Pallas kernels only.
+# Unary and binary tables are separate because the registries are separate
+# namespaces: a custom binary op named like a built-in unary (or vice
+# versa) must not clobber the other arity's substitute.
+KERNEL_SUBSTITUTES_UNARY: Dict[str, Callable] = {
+    "sinh": sinh_kernel,
+    "cosh": cosh_kernel,
+    "atan": atan_kernel,
+    "asin": asin_kernel,
+    "acos": acos_kernel,
+    "asinh": asinh_kernel,
+    "acosh": acosh_kernel,
+    "atanh": atanh_clip_kernel,
+    "erf": erf_kernel,
+    "erfc": erfc_kernel,
+    "gamma": gamma_kernel,
+}
+
+KERNEL_SUBSTITUTES_BINARY: Dict[str, Callable] = {
+    "mod": mod_kernel,
+    "atan2": atan2_kernel,
+}
+
+
 # Aliases accepted on input (reference maps raw -> safe ops in
 # src/Options.jl:86-120 binopmap/unaopmap).
 _ALIASES = {
@@ -273,14 +530,33 @@ _ALIASES = {
 INFIX = {"+", "-", "*", "/", "^"}
 
 
-def register_unary(name: str, fn: Callable) -> None:
-    """Register a custom unary operator (jnp elementwise fn)."""
+def register_unary(
+    name: str, fn: Callable, kernel_fn: Callable | None = None
+) -> None:
+    """Register a custom unary operator (jnp elementwise fn).
+
+    `kernel_fn` optionally supplies a Mosaic-lowerable variant for the
+    compiled Pallas path (needed only if `fn` uses lax primitives outside
+    Mosaic's lowering set — see KERNEL_SUBSTITUTES_UNARY). Re-registering
+    a name drops any stale substitute so the kernel path never pairs an
+    old substitute with a new fn.
+    """
     UNARY_REGISTRY[name] = fn
+    if kernel_fn is not None:
+        KERNEL_SUBSTITUTES_UNARY[name] = kernel_fn
+    else:
+        KERNEL_SUBSTITUTES_UNARY.pop(name, None)
 
 
-def register_binary(name: str, fn: Callable) -> None:
+def register_binary(
+    name: str, fn: Callable, kernel_fn: Callable | None = None
+) -> None:
     """Register a custom binary operator (jnp elementwise fn)."""
     BINARY_REGISTRY[name] = fn
+    if kernel_fn is not None:
+        KERNEL_SUBSTITUTES_BINARY[name] = kernel_fn
+    else:
+        KERNEL_SUBSTITUTES_BINARY.pop(name, None)
 
 
 def canonical_name(name: str) -> str:
@@ -306,6 +582,21 @@ class OperatorSet:
     @property
     def binary_fns(self) -> List[Callable]:
         return [BINARY_REGISTRY[n] for n in self.binary_names]
+
+    @property
+    def kernel_unary_fns(self) -> List[Callable]:
+        """unary_fns with Mosaic-lowerable substitutes for the Pallas path."""
+        return [
+            KERNEL_SUBSTITUTES_UNARY.get(n, UNARY_REGISTRY[n])
+            for n in self.unary_names
+        ]
+
+    @property
+    def kernel_binary_fns(self) -> List[Callable]:
+        return [
+            KERNEL_SUBSTITUTES_BINARY.get(n, BINARY_REGISTRY[n])
+            for n in self.binary_names
+        ]
 
     @property
     def n_unary(self) -> int:

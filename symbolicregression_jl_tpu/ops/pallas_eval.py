@@ -363,6 +363,36 @@ def instr_dispatch(code, a, b, unary_fns, binary_fns, dispatch="mux"):
     return _balanced_mux(code, cands)
 
 
+def kernel_row_validity(nrows_ref, r_sub):
+    """Shared kernel-top-level preamble: the row-grid index and the
+    row-validity mask for this grid step.
+
+    pid_j is read ONCE here and threaded to the loop bodies — a fresh
+    pl.program_id() call inside a fori_loop body does not survive
+    interpret-mode lowering. The mask zeroes padded tail rows so they
+    cannot poison a tree. Returns (pid_j, valid_f).
+    """
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+
+    pid_j = pl.program_id(1)
+    sub = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 0)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 1)
+    row = (pid_j * r_sub + sub) * 128 + lane
+    return pid_j, jnp.where(row < nrows_ref[0], 1.0, 0.0)
+
+
+def accum_tile(ref, idx, pid_j, val):
+    """Init-or-accumulate a per-tree scalar across the row-tile sweep.
+
+    The scalar output blocks' index maps ignore the row-grid index j, so
+    the block stays resident while j advances sequentially (j is the
+    minor grid dim): tile 0 initializes, later tiles add. Shared by the
+    eval kernels' poison outputs and the grad kernel's loss/grad/poison
+    outputs so the init condition lives in exactly one place.
+    """
+    ref[idx] = jnp.where(pid_j == 0, 0.0, ref[idx]) + val
+
+
 def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                  max_len: int, slot_loop: str, dispatch: str,
                  tree_unroll: int, compute_dtype=jnp.float32,
@@ -381,8 +411,8 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
             f"got {tree_unroll}"
         )
 
-    unary_fns = operators.unary_fns
-    binary_fns = operators.binary_fns
+    unary_fns = operators.kernel_unary_fns
+    binary_fns = operators.kernel_binary_fns
     U = len(unary_fns)
     r_sub = r_block // 128
     cdt = compute_dtype
@@ -391,11 +421,7 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                cval_ref, lidx_ref, ridx_ref,  # SMEM, transposed (L, t_block)
                X_ref, out_ref, bad_ref,  # VMEM in / VMEM out / SMEM out
                *val_refs):  # scratch VMEM (max_len, r_sub, 128) x tree_unroll
-        # row-validity mask: padded tail rows must not poison the tree
-        sub = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 0)
-        lane = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 1)
-        row = (pl.program_id(1) * r_sub + sub) * 128 + lane
-        valid_f = jnp.where(row < nrows_ref[0], 1.0, 0.0)
+        pid_j, valid_f = kernel_row_validity(nrows_ref, r_sub)
 
         def slot_body(si, ti, bad, val_ref):
             """One postfix slot: branchless dispatch over the operator set.
@@ -541,7 +567,7 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                 out_ref[tis[t]] = val_refs[t][
                     jnp.maximum(ns[t] - 1, 0)
                 ].astype(jnp.float32)
-                bad_ref[0, tis[t]] = jnp.sum(bads[t])
+                accum_tile(bad_ref, (0, tis[t]), pid_j, jnp.sum(bads[t]))
             return 0
 
         jax.lax.fori_loop(0, t_block // tree_unroll, tree_group_body, 0)
@@ -579,8 +605,8 @@ def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
             f"got {tree_unroll}"
         )
 
-    unary_fns = operators.unary_fns
-    binary_fns = operators.binary_fns
+    unary_fns = operators.kernel_unary_fns
+    binary_fns = operators.kernel_binary_fns
     r_sub = r_block // 128
     cdt = compute_dtype
     base = nfeat if packed else 0  # scratch offset of instruction results
@@ -613,7 +639,8 @@ def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
 
         return instr_body
 
-    def run_groups(instr_body, ninstr_ref, out_ref, bad_ref, val_refs):
+    def run_groups(instr_body, ninstr_ref, out_ref, bad_ref, val_refs,
+                   pid_j):
         """Interleaved tree-group loop shared by both layouts."""
         zero = jnp.zeros((r_sub, 128), jnp.float32)
 
@@ -640,21 +667,15 @@ def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
                 out_ref[tis[t]] = val_refs[t][
                     base + jnp.maximum(ns[t] - 1, 0)
                 ].astype(jnp.float32)
-                bad_ref[0, tis[t]] = jnp.sum(bads[t])
+                accum_tile(bad_ref, (0, tis[t]), pid_j, jnp.sum(bads[t]))
             return 0
 
         jax.lax.fori_loop(0, t_block // tree_unroll, tree_group_body, 0)
 
-    def valid_rows(nrows_ref):
-        sub = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 0)
-        lane = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 1)
-        row = (pl.program_id(1) * r_sub + sub) * 128 + lane
-        return jnp.where(row < nrows_ref[0], 1.0, 0.0)
-
     if packed:
         def kernel(nrows_ref, word_ref, lcval_ref, rcval_ref, ninstr_ref,
                    X_ref, out_ref, bad_ref, *val_refs):
-            valid_f = valid_rows(nrows_ref)
+            pid_j, valid_f = kernel_row_validity(nrows_ref, r_sub)
             # preload features into every interleave slot's scratch once
             # per grid cell; instruction results only ever write at
             # nfeat+k so these stay valid across all tree groups
@@ -675,7 +696,7 @@ def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
 
             run_groups(
                 make_body(read_operands, val_refs, valid_f),
-                ninstr_ref, out_ref, bad_ref, val_refs,
+                ninstr_ref, out_ref, bad_ref, val_refs, pid_j,
             )
 
         return kernel
@@ -686,7 +707,7 @@ def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
                ninstr_ref,
                X_ref, out_ref, bad_ref,
                *val_refs):
-        valid_f = valid_rows(nrows_ref)
+        pid_j, valid_f = kernel_row_validity(nrows_ref, r_sub)
 
         def fetch(src, idx, cv, val_ref):
             """Source mux: previous result / feature column / constant.
@@ -711,7 +732,7 @@ def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
 
         run_groups(
             make_body(read_operands, val_refs, valid_f),
-            ninstr_ref, out_ref, bad_ref, val_refs,
+            ninstr_ref, out_ref, bad_ref, val_refs, pid_j,
         )
 
     return kernel
@@ -893,11 +914,18 @@ def eval_trees_pallas(
         ],
         out_specs=[
             pl.BlockSpec((t_block, r_sub, 128), lambda i, j: (i, j, 0)),
-            smem_spec((1, t_block), lambda i, j: (j, i)),
+            # single poison row, accumulated across row tiles inside the
+            # kernel (the index map ignores j, so the block stays resident
+            # for the whole j sweep). A per-tile (1, t_block) block over a
+            # (grid_j, T_pad) array would be an ILLEGAL Mosaic block shape
+            # for grid_j > 1 (sublane dim must be a multiple of 8 or equal
+            # the array's), and a (grid_j, t_block) resident block would
+            # grow SMEM linearly with the row-tile count.
+            smem_spec((1, t_block), lambda i, j: (0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((T_pad, NR, 128), jnp.float32),
-            jax.ShapeDtypeStruct((grid[1], T_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, T_pad), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((L, r_sub, 128), cdt)
@@ -907,7 +935,7 @@ def eval_trees_pallas(
     )(nrows_arr, pcode, feat, length, cval, lidx, ridx, Xp)
 
     y = y.reshape(T_pad, R_pad)[:T, :nrows]
-    ok = (jnp.sum(bad[:, :T], axis=0) == 0) & (flat.length > 0)
+    ok = (bad[0, :T] == 0) & (flat.length > 0)
     if inv_perm is not None:
         y = y[inv_perm]
         ok = ok[inv_perm]
@@ -1013,11 +1041,13 @@ def _eval_instr(flat, X, operators, t_block, r_block, interpret, dispatch,
     common_out = dict(
         out_specs=[
             pl.BlockSpec((t_block, r_sub, 128), lambda i, j: (i, j, 0)),
-            smem_spec((1, t_block), lambda i, j: (j, i)),
+            # single row-tile-accumulated poison row — see the postfix
+            # path's out_specs comment
+            smem_spec((1, t_block), lambda i, j: (0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((T_pad, NR, 128), jnp.float32),
-            jax.ShapeDtypeStruct((grid[1], T_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, T_pad), jnp.float32),
         ],
         interpret=interpret,
     )
@@ -1073,7 +1103,7 @@ def _eval_instr(flat, X, operators, t_block, r_block, interpret, dispatch,
           tbl["rsrc"], tbl["ridx"], tbl["rcval"], ninstr_p, Xp)
 
     y = y.reshape(T_pad, R_pad)[:T, :nrows]
-    ok = (jnp.sum(bad[:, :T], axis=0) == 0) & (length > 0)
+    ok = (bad[0, :T] == 0) & (length > 0)
     if inv_perm is not None:
         y = y[inv_perm]
         ok = ok[inv_perm]

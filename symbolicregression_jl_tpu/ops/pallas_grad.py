@@ -50,8 +50,10 @@ from .pallas_eval import (
     _balanced_mux,
     _check_r_block,
     _round_up,
+    accum_tile,
     decode_packed_word,
     instr_dispatch,
+    kernel_row_validity,
     pack_instr_tables,
     prep_instr_tables,
 )
@@ -70,15 +72,13 @@ def _make_grad_kernel(operators: OperatorSet, t_block: int, r_block: int,
     the line-search evaluator of the batched constant optimizer, which
     needs thousands of candidate losses per step WITHOUT materializing
     (trees, rows) predictions in HBM the way eval_trees_pallas would."""
-    from jax.experimental import pallas as pl  # noqa: PLC0415
-
     if tree_unroll not in (1, 2, 4, 8, 16) or t_block % tree_unroll:
         raise ValueError(
             "tree_unroll must be 1/2/4/8/16 and divide t_block, "
             f"got {tree_unroll}"
         )
-    unary_fns = operators.unary_fns
-    binary_fns = operators.binary_fns
+    unary_fns = operators.kernel_unary_fns
+    binary_fns = operators.kernel_binary_fns
     r_sub = r_block // 128
     const_base = nfeat + L
     A = const_base + ML + 1  # adjoint scratch slots (incl. trash)
@@ -100,10 +100,7 @@ def _make_grad_kernel(operators: OperatorSet, t_block: int, r_block: int,
         # genuinely zero-weighted VALID row must still poison a tree
         # whose evaluation is non-finite there, exactly like
         # eval_trees_pallas and the jnp scoring path
-        sub = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 0)
-        lane = jax.lax.broadcasted_iota(jnp.int32, (r_sub, 128), 1)
-        row = (pl.program_id(1) * r_sub + sub) * 128 + lane
-        valid_f = jnp.where(row < nrows_ref[0], 1.0, 0.0)
+        pid_j, valid_f = kernel_row_validity(nrows_ref, r_sub)
         wn = wn_ref[...]
         y_t = y_ref[...]
 
@@ -186,8 +183,10 @@ def _make_grad_kernel(operators: OperatorSet, t_block: int, r_block: int,
                     lambda yp: loss_fn(yp, y_t), y_pred
                 )
                 masked = jnp.where(wn != 0.0, elem * wn, 0.0)
-                loss_ref[0, tis[t]] = jnp.sum(masked)
-                bad_ref[0, tis[t]] = jnp.sum(bads[t])
+                # accumulate across the row-tile sweep (accum_tile: tile 0
+                # initializes, later tiles add)
+                accum_tile(loss_ref, (0, tis[t]), pid_j, jnp.sum(masked))
+                accum_tile(bad_ref, (0, tis[t]), pid_j, jnp.sum(bads[t]))
                 if with_grad:
                     (seed,) = vloss(wn)
                     seed = jnp.where(wn != 0.0, seed, 0.0)
@@ -217,11 +216,14 @@ def _make_grad_kernel(operators: OperatorSet, t_block: int, r_block: int,
             # through the interpreter on the same data.
             for t in range(tree_unroll):
                 for s in range(ML):
-                    cgrad_ref[0, s, tis[t]] = jnp.sum(
-                        jnp.where(
-                            valid_f != 0.0,
-                            adj_refs[t][const_base + s], 0.0,
-                        )
+                    accum_tile(
+                        cgrad_ref, (0, s, tis[t]), pid_j,
+                        jnp.sum(
+                            jnp.where(
+                                valid_f != 0.0,
+                                adj_refs[t][const_base + s], 0.0,
+                            )
+                        ),
                     )
             return 0
 
@@ -372,17 +374,25 @@ def make_loss_kernel(trees, X, y, weights, operators, loss_fn=None,
         shape, imap, memory_space=pltpu.SMEM
     )
     tree_tbl = lambda: smem_spec((L, t_block), lambda i, j: (0, i))
-    scalar_out = lambda: smem_spec((1, t_block), lambda i, j: (j, i))
-    scalar_shape = jax.ShapeDtypeStruct((grid[1], T_pad), jnp.float32)
+    # scalar outputs are single rows accumulated across the row-tile sweep
+    # inside the kernel (index maps ignore j, so the blocks stay resident;
+    # row tile 0 initializes, later tiles add). A per-tile (1, t_block)
+    # block over a (grid_j, T_pad) array would be an ILLEGAL Mosaic block
+    # shape for grid_j > 1, and a (grid_j, ...) resident block would grow
+    # SMEM linearly with the row-tile count — same design as
+    # pallas_eval's poison output.
+    scalar_out = lambda: smem_spec((1, t_block), lambda i, j: (0, i))
+    scalar_shape = jax.ShapeDtypeStruct((1, T_pad), jnp.float32)
     if with_grad:
         out_specs = [
             scalar_out(),                                       # loss
-            smem_spec((1, ML, t_block), lambda i, j: (j, 0, i)),  # cgrad
+            smem_spec((1, ML, t_block),
+                      lambda i, j: (0, 0, i)),                  # cgrad
             scalar_out(),                                       # bad
         ]
         out_shape = [
             scalar_shape,
-            jax.ShapeDtypeStruct((grid[1], ML, T_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, ML, T_pad), jnp.float32),
             scalar_shape,
         ]
         scratch = (
@@ -432,12 +442,12 @@ def make_loss_kernel(trees, X, y, weights, operators, loss_fn=None,
             loss_p, bad = outs
             cgrad_p = None
 
-        loss = jnp.sum(loss_p[:, :T], axis=0)
-        ok = (jnp.sum(bad[:, :T], axis=0) == 0) & (flat.length > 0)
+        loss = loss_p[0, :T]
+        ok = (bad[0, :T] == 0) & (flat.length > 0)
         if cgrad_p is None:
             grad = None
         else:
-            grad = jnp.sum(cgrad_p[:, :, :T], axis=0).T  # (T, ML)
+            grad = cgrad_p[0, :, :T].T  # (T, ML)
             # only CONST slots carry gradients; the rest is stale scratch
             grad = jnp.where(flat.kind == CONST, grad, 0.0)
         if inv_perm is not None:

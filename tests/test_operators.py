@@ -83,3 +83,240 @@ def test_gamma_pole_is_nan():
     assert np.isnan(u("gamma", 0.0, jnp.float32))
     assert np.isnan(u("gamma", -1.0, jnp.float32))
     assert u("gamma", 4.0, jnp.float32) == pytest.approx(6.0, rel=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Mosaic-safe kernel substitutes (KERNEL_SUBSTITUTES_UNARY / _BINARY)
+# ---------------------------------------------------------------------------
+# Each substitute must match its lax-backed registry twin — same NaN-domain
+# guards bit-for-bit, values within an op-specific f32 tolerance. Relative
+# tolerance is the primary bar; the abs floor covers regions where the
+# reference value itself is ~0 (erf near 0, mod near multiples) and the
+# substitute's absolute error (<~1.5e-7 for erf) dominates the ratio.
+
+_SUBSTITUTE_CASES = [
+    # (name, rel_tol, abs_floor)
+    ("cosh", 2e-5, 0.0),
+    ("sinh", 2e-4, 1e-6),
+    ("atan", 2e-6, 1e-7),
+    ("asin", 2e-6, 1e-7),
+    ("acos", 2e-6, 1e-7),
+    ("asinh", 2e-6, 1e-7),
+    ("acosh", 1e-5, 1e-6),
+    ("atanh", 1e-3, 1e-5),  # wrap boundaries sit next to the poles
+    ("erf", 1e-5, 2e-7),
+    ("erfc", 1e-5, 2e-7),
+]
+
+
+def _unary_grid():
+    return np.concatenate([
+        np.linspace(-30.0, 30.0, 1501),
+        np.linspace(-1.5, 1.5, 751),
+        # the cosh/sinh near-overflow window: exp(|x|) overflows f32 from
+        # ~88.72 but cosh/sinh stay finite to ~89.42 — the composition
+        # must match the interpreter's validity flag there
+        np.linspace(85.0, 95.0, 101),
+        np.linspace(-95.0, -85.0, 101),
+        [0.0, -0.0, 1e-8, -1e-8, 1e8, -1e8, np.inf, -np.inf, np.nan],
+    ]).astype(np.float32)
+
+
+def _agree(a, b, rel_tol, abs_floor):
+    a = np.asarray(a, np.float64)
+    b = np.asarray(b, np.float64)
+    # NaN-domain semantics must agree exactly
+    np.testing.assert_array_equal(np.isnan(a), np.isnan(b))
+    same_inf = np.isinf(a) & np.isinf(b) & (np.sign(a) == np.sign(b))
+    fin = ~(np.isnan(a) | same_inf)
+    dev = np.abs(a - b) / np.maximum(np.abs(b), abs_floor / rel_tol)
+    assert np.all(dev[fin] <= rel_tol), (
+        f"max rel dev {np.max(dev[fin]):.3e}"
+    )
+
+
+@pytest.mark.parametrize("name,rel_tol,abs_floor", _SUBSTITUTE_CASES)
+def test_kernel_substitute_unary_parity(name, rel_tol, abs_floor):
+    from symbolicregression_jl_tpu.ops.operators import (
+        KERNEL_SUBSTITUTES_UNARY,
+    )
+
+    x = jnp.asarray(_unary_grid())
+    _agree(KERNEL_SUBSTITUTES_UNARY[name](x), UNARY_REGISTRY[name](x),
+           rel_tol, abs_floor)
+
+
+def test_kernel_substitute_gamma_parity():
+    """gamma: both f32 routes carry ~1e-3 noise (exp(lgamma) amplifies
+    lgamma's error; Lanczos pays cancellation), so compare each against
+    the f64 truth instead of against each other, and require identical
+    NaN semantics (poles and overflow -> NaN)."""
+    import math
+
+    from symbolicregression_jl_tpu.ops.operators import (
+        KERNEL_SUBSTITUTES_UNARY,
+    )
+
+    xs = np.concatenate([
+        np.linspace(-34.0, 34.0, 1701),
+        [0.5, 1.0, 4.0, 33.0, -2.5, 0.0, -1.0, np.inf, -np.inf, np.nan],
+    ]).astype(np.float32)
+
+    def truth(v):
+        try:
+            r = math.gamma(float(v))
+        except (ValueError, OverflowError):
+            return np.nan
+        return r if abs(r) < 3.4e38 else np.nan  # f32 overflow -> NaN
+
+    t = np.array([truth(v) for v in xs.astype(np.float64)])
+    a = np.asarray(KERNEL_SUBSTITUTES_UNARY["gamma"](jnp.asarray(xs)), np.float64)
+    b = np.asarray(UNARY_REGISTRY["gamma"](jnp.asarray(xs)), np.float64)
+    np.testing.assert_array_equal(np.isnan(a), np.isnan(t))
+    np.testing.assert_array_equal(np.isnan(b), np.isnan(t))
+    fin = ~np.isnan(t)
+    dev = np.abs(a - t)[fin] / np.maximum(np.abs(t[fin]), 1e-30)
+    assert np.max(dev) < 5e-3
+
+
+def test_kernel_substitute_binary_parity():
+    from symbolicregression_jl_tpu.ops.operators import (
+        KERNEL_SUBSTITUTES_BINARY,
+    )
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.uniform(-40, 40, 4096).astype(np.float32))
+    y = jnp.asarray(rng.uniform(-40, 40, 4096).astype(np.float32))
+    # mod: floor-mod identity; error grows with |x/y|, bounded on this grid
+    _agree(KERNEL_SUBSTITUTES_BINARY["mod"](x, y), BINARY_REGISTRY["mod"](x, y),
+           1e-3, 1e-4)
+    # atan2: finite non-axis inputs
+    _agree(KERNEL_SUBSTITUTES_BINARY["atan2"](x, y), jnp.arctan2(x, y),
+           1e-5, 1e-7)
+    # atan2 axis/quadrant table (finite edges the composition must get right)
+    pts = [(0.0, 1.0), (0.0, -1.0), (1.0, 0.0), (-1.0, 0.0),
+           (1.0, 1.0), (1.0, -1.0), (-1.0, -1.0), (-1.0, 1.0), (0.0, 0.0)]
+    for yy, xx in pts:
+        got = float(KERNEL_SUBSTITUTES_BINARY["atan2"](
+            jnp.float32(yy), jnp.float32(xx)))
+        want = float(np.arctan2(np.float32(yy), np.float32(xx)))
+        assert got == pytest.approx(want, abs=1e-6), (yy, xx)
+
+
+def test_kernel_substitutes_only_use_mosaic_primitives():
+    """Every substitute must trace to lax primitives Mosaic can lower —
+    the entire point of the table. Guards against someone 'simplifying' a
+    composition back to jnp.cosh and silently breaking the compiled path."""
+    from symbolicregression_jl_tpu.ops.operators import (
+        KERNEL_SUBSTITUTES_BINARY,
+        KERNEL_SUBSTITUTES_UNARY,
+    )
+
+    # the elementwise subset of jax/_src/pallas/mosaic/lowering.py's rule
+    # table (checked 2026-08-01) plus structural prims jaxprs always carry
+    allowed = {
+        "abs", "add", "and", "ceil", "clamp", "cos", "div", "eq", "exp",
+        "exp2", "floor", "ge", "gt", "integer_pow", "is_finite", "le",
+        "log", "log1p", "logistic", "lt", "max", "min", "mul", "ne",
+        "neg", "not", "or", "pow", "round", "rsqrt", "select_n", "sign",
+        "sin", "sqrt", "square", "sub", "tan", "tanh", "xor",
+        "broadcast_in_dim", "convert_element_type", "reduce_sum",
+        "reduce_max", "reduce_min", "stop_gradient", "iota", "pjit",
+        # cotangent accumulation in transposed jaxprs; Mosaic registers a
+        # rule for ad_util.add_any_p (lowering.py:2576)
+        "add_any",
+    }
+
+    def prims_of(jaxpr, acc):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in ("pjit", "jit"):
+                prims_of(eqn.params["jaxpr"].jaxpr, acc)
+            elif name in ("custom_jvp_call", "custom_vjp_call",
+                          "custom_jvp_call_jaxpr"):
+                prims_of(eqn.params["call_jaxpr"].jaxpr, acc)
+            else:
+                acc.add(name)
+        return acc
+
+    x = jnp.ones((8,), jnp.float32)
+    both = [(n, f, 1) for n, f in KERNEL_SUBSTITUTES_UNARY.items()] + [
+        (n, f, 2) for n, f in KERNEL_SUBSTITUTES_BINARY.items()
+    ]
+    for name, fn, arity in both:
+        args = (x,) * arity
+        used = prims_of(jax.make_jaxpr(fn)(*args).jaxpr, set())
+        illegal = used - allowed
+        assert not illegal, f"{name} uses non-Mosaic primitives {illegal}"
+        # the grad kernel lowers jax.vjp of every substitute INSIDE the
+        # Pallas kernel (pallas_grad bwd_body), so the backward jaxpr must
+        # be Mosaic-clean too — incl. the custom_jvp exact-derivative rules
+        def vjp_apply(*a):
+            out, pull = jax.vjp(fn, *a)
+            return pull(jnp.ones_like(out))
+        used_b = prims_of(jax.make_jaxpr(vjp_apply)(*args).jaxpr, set())
+        illegal_b = used_b - allowed
+        assert not illegal_b, (
+            f"{name} vjp uses non-Mosaic primitives {illegal_b}"
+        )
+
+
+def test_kernel_substitute_gradients_match_lax():
+    """d/dx of each differentiable substitute vs its lax twin — ON a grid
+    INCLUDING x = 0, where the |x|-based compositions' plain autodiff
+    would give a spurious zero subgradient (the custom_jvp exact rules
+    exist precisely for this)."""
+    from symbolicregression_jl_tpu.ops.operators import (
+        KERNEL_SUBSTITUTES_BINARY,
+        KERNEL_SUBSTITUTES_UNARY,
+    )
+
+    xs = jnp.asarray(
+        np.array([0.0, -0.0, 0.3, -0.7, 1.5, -2.5, 5.0], np.float32)
+    )
+    twins = {
+        "atan": jnp.arctan, "asin": jnp.arcsin, "acos": jnp.arccos,
+        "sinh": jnp.sinh, "cosh": jnp.cosh, "asinh": jnp.arcsinh,
+        "erf": jax.lax.erf, "erfc": jax.lax.erfc,
+    }
+    for name, lax_fn in twins.items():
+        sub = KERNEL_SUBSTITUTES_UNARY[name]
+        g_sub = jax.vmap(jax.grad(lambda v, f=sub: f(v).sum()))(xs)
+        g_lax = jax.vmap(jax.grad(lambda v, f=lax_fn: f(v).sum()))(xs)
+        dom = np.isfinite(np.asarray(g_lax))  # asin/acos NaN outside [-1,1]
+        np.testing.assert_allclose(
+            np.asarray(g_sub)[dom], np.asarray(g_lax)[dom],
+            rtol=1e-5, atol=1e-6, err_msg=name,
+        )
+    # atan2: both partials at generic points AND on the y-axis (x=0)
+    pts = [(1.0, 2.0), (-1.5, 0.5), (1.0, 0.0), (-2.0, 0.0), (0.5, -1.0)]
+    f_sub = KERNEL_SUBSTITUTES_BINARY["atan2"]
+    for yy, xx in pts:
+        gs = jax.grad(lambda a, b: f_sub(a, b), argnums=(0, 1))(
+            jnp.float32(yy), jnp.float32(xx))
+        gl = jax.grad(jnp.arctan2, argnums=(0, 1))(
+            jnp.float32(yy), jnp.float32(xx))
+        np.testing.assert_allclose(
+            np.asarray(gs), np.asarray(gl), rtol=1e-5, atol=1e-6,
+            err_msg=f"atan2 at {(yy, xx)}",
+        )
+
+
+def test_register_does_not_clobber_other_arity_substitute():
+    """register_binary('atan', ...) must not delete the unary atan's
+    Mosaic substitute (the registries are separate namespaces)."""
+    from symbolicregression_jl_tpu.ops.operators import (
+        BINARY_REGISTRY,
+        KERNEL_SUBSTITUTES_BINARY,
+        KERNEL_SUBSTITUTES_UNARY,
+        register_binary,
+    )
+
+    assert "atan" in KERNEL_SUBSTITUTES_UNARY
+    try:
+        register_binary("atan", lambda x, y: x + y)
+        assert "atan" in KERNEL_SUBSTITUTES_UNARY
+        assert "atan" not in KERNEL_SUBSTITUTES_BINARY
+    finally:
+        BINARY_REGISTRY.pop("atan", None)
+        KERNEL_SUBSTITUTES_BINARY.pop("atan", None)

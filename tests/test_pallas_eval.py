@@ -416,3 +416,71 @@ def test_instruction_schedule_compression(rng):
     expect = np.maximum(n_ops, 1)
     np.testing.assert_array_equal(np.asarray(n_instr), expect)
     assert tables["icode"].shape == trees.kind.shape
+
+
+def test_mosaic_substituted_opset_matches_jnp(rng):
+    """Op sets whose lax impls Mosaic cannot lower (cosh/sinh/atan/erf/
+    gamma/mod...) must still run through the kernel via the
+    KERNEL_SUBSTITUTES compositions, matching the jnp interpreter (which
+    keeps the exact lax fns) within the compositions' accuracy."""
+    ops = make_operator_set(
+        ["+", "-", "*", "mod"],
+        ["cosh", "sinh", "atan", "erf", "atanh", "gamma"],
+    )
+    trees = batch(rng, 12, max_size=10, ops=ops)
+    X = jnp.asarray(rng.uniform(-3, 3, (NFEAT, 64)).astype(np.float32))
+    y_ref, ok_ref = eval_trees(trees, X, ops)
+    y, ok = eval_trees_pallas(
+        trees, X, ops, t_block=8, r_block=128, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+    m = np.asarray(ok_ref)
+    np.testing.assert_allclose(
+        np.asarray(y)[m], np.asarray(y_ref)[m], rtol=2e-4, atol=1e-5
+    )
+
+
+@pytest.mark.parametrize("program", ["postfix", "instr"])
+def test_rows_beyond_one_block_accumulate(rng, program):
+    """nrows > r_block splits the row grid (grid_j > 1); the poison row
+    must accumulate across row tiles — a NaN in the LAST tile must still
+    poison the tree, and valid trees must match the interpreter."""
+    trees = batch(rng, 9, max_size=12)
+    n_rows = 300  # 3 row tiles at r_block=128
+    X_h = (rng.standard_normal((NFEAT, n_rows)) * 2).astype(np.float32)
+    y_ref, ok_ref = eval_trees(trees, jnp.asarray(X_h), OPS)
+    y, ok = eval_trees_pallas(
+        trees, jnp.asarray(X_h), OPS, t_block=8, r_block=128,
+        interpret=True, program=program,
+    )
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+    m = np.asarray(ok_ref)
+    np.testing.assert_allclose(
+        np.asarray(y)[m], np.asarray(y_ref)[m], rtol=1e-5, atol=1e-5
+    )
+    # force a poison visible ONLY in the final row tile: log of a
+    # negative feature value placed past row 256. A deterministic
+    # log(x0) tree guarantees the scenario actually fires (random trees
+    # might not apply log to a feature at all).
+    from symbolicregression_jl_tpu.models.trees import Expr
+
+    ops = make_operator_set(["+", "-", "*", "/"], ["log"])
+    log_tree = encode_tree(Expr.unary(ops.unary_index("log"),
+                                      Expr.var(0)), L)
+    t2 = stack_trees(
+        [log_tree]
+        + [encode_tree(
+            random_expr_fixed_size(rng, ops, NFEAT, 6), L
+        ) for _ in range(5)]
+    )
+    X2 = np.abs(X_h) + 0.5
+    X2[:, -1] = -1.0  # row 299 -> tile 2
+    y2_ref, ok2_ref = eval_trees(t2, jnp.asarray(X2), ops)
+    assert not bool(np.asarray(ok2_ref)[0]), (
+        "log(x0) over a negative final-tile row must poison tree 0"
+    )
+    y2, ok2 = eval_trees_pallas(
+        t2, jnp.asarray(X2), ops, t_block=8, r_block=128,
+        interpret=True, program=program,
+    )
+    np.testing.assert_array_equal(np.asarray(ok2), np.asarray(ok2_ref))

@@ -220,3 +220,31 @@ def test_grad_kernel_zero_weight_row_still_poisons():
         jnp.asarray(w), OPS, interpret=True, t_block=8, tree_unroll=1,
     )
     assert not bool(ok[0])
+
+
+def test_grad_kernel_rows_beyond_one_block():
+    """nrows > r_block splits the row grid; loss/grad/poison must
+    accumulate across row tiles and match the autodiff oracle."""
+    n = 12
+    sizes = jax.random.randint(jax.random.PRNGKey(5), (n,), 1, 14)
+    trees = jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(k, s, NFEAT, OPS, L)
+    )(jax.random.split(jax.random.PRNGKey(4), n), sizes)
+    n_rows = 300  # 3 row tiles at r_block=128
+    X = jax.random.normal(
+        jax.random.PRNGKey(6), (NFEAT, n_rows), jnp.float32
+    )
+    y = jax.random.normal(jax.random.PRNGKey(7), (n_rows,), jnp.float32)
+    loss, grad, ok = eval_loss_grad_pallas(
+        trees, X, y, None, OPS, interpret=True, t_block=4, r_block=128,
+        tree_unroll=2,
+    )
+    loss_ref, grad_ref = _autodiff_oracle(trees, X, y)
+    kmask = np.asarray(trees.kind) == CONST
+    m = np.asarray(jax.device_get(ok))
+    _, ok_ref = jax.device_get(eval_trees(trees, X, OPS))
+    np.testing.assert_array_equal(m, np.asarray(ok_ref))
+    np.testing.assert_allclose(
+        np.asarray(loss)[m], loss_ref[m], rtol=1e-5, atol=1e-6
+    )
+    _check_grads(trees, X, y, np.asarray(grad), m, grad_ref, kmask)
