@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Bisect the at-scale (64-island) TPU device fault stage by stage.
+
+Background (2026-08-01): `equation_search` at npopulations>=64 dies on the
+real chip with `UNAVAILABLE: TPU device error — often a kernel fault`,
+while <=16x256 searches, the 16384-tree eval kernel, and the identical
+64x1000 program on XLA-CPU all run clean. The fault reproduces with
+eval_backend="jnp" and with the constant optimizer disabled, so it lives
+somewhere else in the jitted iteration. This script runs each stage of
+`api._make_iteration_fn`'s pipeline in a FRESH subprocess (a faulted TPU
+client wedges its process — later calls fail instantly) and reports
+OK/FAIL per stage, so one tunnel window pinpoints the faulting stage.
+
+Usage: python scripts/scale_fault_bisect.py [--islands 64] [--npop 256]
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+STAGE_CODE = """
+import numpy as np, jax, jax.numpy as jnp
+import symbolicregression_jl_tpu as sr
+from symbolicregression_jl_tpu.models.options import make_options
+from symbolicregression_jl_tpu.models.evolve import (
+    s_r_cycle_islands, simplify_population_islands, optimize_islands_constants,
+)
+from symbolicregression_jl_tpu.parallel.migration import (
+    merge_hofs_across_islands,
+    migrate,
+)
+from symbolicregression_jl_tpu.api import _make_init_fn
+
+ISLANDS, NPOP, NCYC = {islands}, {npop}, {ncyc}
+STAGE = {stage!r}
+
+options = make_options(
+    binary_operators=["+", "-", "*", "/"],
+    unary_operators=["cos", "exp", "sqrt", "square"],
+    npop=NPOP, npopulations=ISLANDS, ncycles_per_iteration=NCYC,
+    maxsize=18, seed=0,
+)
+rng = np.random.default_rng(0)
+X = jnp.asarray(rng.uniform(1, 3, (2, 1000)).astype(np.float32))
+y = jnp.asarray(np.asarray(X[0] * X[1]))
+baseline = jnp.asarray(float(np.var(np.asarray(y))), jnp.float32)
+scalars = options.traced_scalars()
+keys = jax.random.split(jax.random.PRNGKey(0), ISLANDS)
+
+init = _make_init_fn(options, 2, False)
+states = init(keys, X, y, baseline, scalars)
+jax.block_until_ready(states.pop.scores)
+print("MARK init ok", flush=True)
+if STAGE == "init":
+    raise SystemExit(0)
+
+curmaxsize = jnp.asarray(options.maxsize, jnp.int32)
+opts_b = options.bind_scalars(scalars)
+
+if STAGE in ("cycle", "cycle_long"):
+    f = jax.jit(lambda s: s_r_cycle_islands(
+        s, curmaxsize, X, y, None, baseline, opts_b))
+    states = f(states)
+    jax.block_until_ready(states.pop.scores)
+elif STAGE == "simplify":
+    f = jax.jit(lambda s: simplify_population_islands(
+        s, curmaxsize, X, y, None, baseline, opts_b))
+    states = f(states)
+    jax.block_until_ready(states.pop.scores)
+elif STAGE == "optimize":
+    okeys = jax.random.split(jax.random.PRNGKey(1), ISLANDS)
+    f = jax.jit(lambda k, s: optimize_islands_constants(
+        k, s, X, y, None, baseline, opts_b))
+    states = f(okeys, states)
+    jax.block_until_ready(states.pop.scores)
+elif STAGE == "merge_migrate":
+    def mm(k, s):
+        ghof = merge_hofs_across_islands(s.hof)
+        return migrate(k, s, ghof, opts_b), ghof
+    f = jax.jit(mm)
+    states, ghof = f(jax.random.PRNGKey(2), states)
+    jax.block_until_ready(ghof.losses)
+elif STAGE == "full":
+    from symbolicregression_jl_tpu.api import _make_iteration_fn
+    it = _make_iteration_fn(options, False)
+    states, ghof = it(states, jax.random.PRNGKey(3), curmaxsize,
+                      X, y, baseline, scalars)
+    jax.block_until_ready(ghof.losses)
+print("MARK stage ok", flush=True)
+"""
+
+STAGES = [
+    ("init", 2), ("cycle", 2), ("cycle_long", 100), ("simplify", 2),
+    ("optimize", 2), ("merge_migrate", 2), ("full", 100),
+]
+
+
+def _run_stage(code, timeout=900):
+    """Run one stage in its own process GROUP and kill the whole group on
+    timeout — a wedged axon client must not keep holding the tunnel's one
+    slot after the probe gives up (same guard as tpu_watcher's
+    probe_platform)."""
+    p = subprocess.Popen(
+        [sys.executable, "-c", code], stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, start_new_session=True,
+    )
+    try:
+        out, err = p.communicate(timeout=timeout)
+        return p.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except Exception:
+            p.kill()
+        try:
+            p.communicate(timeout=10)
+        except Exception:
+            pass
+        return None, "", ""
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--islands", type=int, default=64)
+    ap.add_argument("--npop", type=int, default=256)
+    ap.add_argument("--stage", choices=[s for s, _ in STAGES], default=None)
+    ns = ap.parse_args()
+    for stage, ncyc in STAGES:
+        if ns.stage and stage != ns.stage:
+            continue
+        t0 = time.time()
+        code = STAGE_CODE.format(
+            islands=ns.islands, npop=ns.npop, ncyc=ncyc, stage=stage
+        )
+        rc, out, err = _run_stage(code)
+        if rc is None:
+            print(f"{stage}: HANG (900s) — tunnel likely down", flush=True)
+            break
+        ok = rc == 0 and (
+            "MARK stage ok" in out
+            or (stage == "init" and "MARK init ok" in out)
+        )
+        tail = [ln for ln in (err or "").splitlines() if ln.strip()][-2:]
+        print(
+            f"{stage}: {'OK' if ok else 'FAIL'} {time.time() - t0:.0f}s"
+            + ("" if ok else f"  | {' / '.join(tail)[:200]}"),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
