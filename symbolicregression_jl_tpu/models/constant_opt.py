@@ -428,18 +428,73 @@ def _select_and_starts(key, pop, options, K, n_starts):
     return sel_idx, sub_trees, sub_losses, eligible, starts, cmask
 
 
-def _run_vmapped(sub_trees, starts, cmask, X, y, weights, options,
-                 optimizer):
-    """The portable path: one `jax.grad`/loss closure per member, vmapped
-    over restarts then members. Returns (xs (n_starts, K, L),
-    fs (n_starts, K))."""
+# Portable-path memory bound: `jax.grad` through the lockstep interpreter
+# saves the per-slot candidate stacks as residuals — O(L x n_ops x rows)
+# per instance, ~0.8MB at maxsize 18 x 9 ops x 1000 rows — so one flat
+# vmap over every (island x restart x member) instance peaks at 11.7GB of
+# XLA temp at 64 islands x npop 256 (measured 2026-08-02; v5e HBM is
+# 16GB, and the resulting on-chip OOM surfaces through the axon tunnel as
+# an opaque UNAVAILABLE device error). Chunking with lax.map bounds the
+# live residual set to `chunk` instances; the chunks run sequentially,
+# which costs nothing here — each instance is already a serial fori_loop,
+# and the chunk width keeps the device saturated.
+_PORTABLE_OPT_CHUNK = 2048
+
+
+def _flatten_island_instances(sub_trees, starts, cmask, I, K, n_starts, L):
+    """(I, K, ...) member arrays + (I, n_starts, K, L) starts ->
+    restart-major flat instances of length n_starts*I*K (the layout both
+    the fused-kernel launch and the chunked portable path consume)."""
+    flat_sub = jax.tree_util.tree_map(
+        lambda a: a.reshape((I * K,) + a.shape[2:]), sub_trees
+    )
+    tiled = jax.tree_util.tree_map(
+        lambda a: jnp.tile(a, (n_starts,) + (1,) * (a.ndim - 1)), flat_sub
+    )
+    starts_flat = jnp.moveaxis(starts, 1, 0).reshape(n_starts * I * K, L)
+    cmask_flat = jnp.tile(cmask.reshape(I * K, L), (n_starts, 1))
+    return tiled, starts_flat, cmask_flat
+
+
+def _run_vmapped_chunked(trees_flat, starts_flat, cmask_flat, X, y,
+                         weights, options, optimizer,
+                         chunk=_PORTABLE_OPT_CHUNK):
+    """The portable path over flat instances: one `jax.grad`/loss closure
+    per instance, vmapped within fixed-size chunks, lax.map over chunks
+    (see _PORTABLE_OPT_CHUNK). Returns (xs (N, L), fs (N,))."""
 
     def run_one(tree, x0, cm):
         f = _member_loss_fn(tree, X, y, weights, options)
         return optimizer(f, x0, cm, options.optimizer_iterations)
 
-    run_members = jax.vmap(run_one)
-    return jax.vmap(lambda s: run_members(sub_trees, s, cmask))(starts)
+    N, L = starts_flat.shape
+    if N <= chunk:
+        return jax.vmap(run_one)(trees_flat, starts_flat, cmask_flat)
+    # whole chunks through lax.map, the remainder as one smaller vmap —
+    # padding the remainder up to a whole chunk would burn up to chunk-1
+    # full dummy optimizer runs (~16% of the work at the 64x256 default)
+    n_chunks, rem = divmod(N, chunk)
+    head = lambda a: a[: n_chunks * chunk].reshape(
+        (n_chunks, chunk) + a.shape[1:]
+    )
+    xs, fs = jax.lax.map(
+        lambda ch: jax.vmap(run_one)(*ch),
+        (
+            jax.tree_util.tree_map(head, trees_flat),
+            head(starts_flat),
+            head(cmask_flat),
+        ),
+    )
+    xs, fs = xs.reshape(-1, L), fs.reshape(-1)
+    if rem:
+        tail = lambda a: a[n_chunks * chunk:]
+        xs_t, fs_t = jax.vmap(run_one)(
+            jax.tree_util.tree_map(tail, trees_flat),
+            tail(starts_flat), tail(cmask_flat),
+        )
+        xs = jnp.concatenate([xs, xs_t])
+        fs = jnp.concatenate([fs, fs_t])
+    return xs, fs
 
 
 def _write_back(pop, sel_idx, sub_trees, sub_losses, eligible, xs, fs,
@@ -558,34 +613,24 @@ def optimize_constants_islands(
     # shapes: sel_idx (I, K), sub_trees (I, K, ...), starts
     # (I, n_starts, K, L), cmask (I, K, L)
 
+    # both paths consume the same restart-major flat instance layout
+    tiled, starts_flat, cmask_flat = _flatten_island_instances(
+        sub_trees, starts, cmask, I, K, n_starts, L
+    )
     if _use_fused_kernels(options, I * n_starts * K, X):
-        # flatten islands into the member axis, restart-major like the
-        # single-population path
-        flat_sub = jax.tree_util.tree_map(
-            lambda a: a.reshape((I * K,) + a.shape[2:]), sub_trees
-        )
-        tiled = jax.tree_util.tree_map(
-            lambda a: jnp.tile(a, (n_starts,) + (1,) * (a.ndim - 1)),
-            flat_sub,
-        )
-        starts_flat = jnp.moveaxis(starts, 1, 0).reshape(
-            n_starts * I * K, L
-        )
-        cmask_flat = jnp.tile(cmask.reshape(I * K, L), (n_starts, 1))
         x_flat, f_flat = _bfgs_batched(
             tiled, starts_flat, cmask_flat, X, y, weights, options,
             options.optimizer_iterations,
         )
-        xs = jnp.moveaxis(
-            x_flat.reshape(n_starts, I, K, L), 0, 1
-        )  # (I, n_starts, K, L)
-        fs = jnp.moveaxis(f_flat.reshape(n_starts, I, K), 0, 1)
     else:
-        xs, fs = jax.vmap(
-            lambda st, s, cm: _run_vmapped(
-                st, s, cm, X, y, weights, options, optimizer
-            )
-        )(sub_trees, starts, cmask)
+        x_flat, f_flat = _run_vmapped_chunked(
+            tiled, starts_flat, cmask_flat, X, y, weights, options,
+            optimizer,
+        )
+    xs = jnp.moveaxis(
+        x_flat.reshape(n_starts, I, K, L), 0, 1
+    )  # (I, n_starts, K, L)
+    fs = jnp.moveaxis(f_flat.reshape(n_starts, I, K), 0, 1)
 
     return jax.vmap(
         lambda p, si, st, sl, el, x, f: _write_back(

@@ -409,3 +409,52 @@ def test_optimize_constants_islands_fused_matches_vmapped(rng, monkeypatch):
         np.asarray(pops_j.losses), np.asarray(pops_v.losses)
     )
     np.testing.assert_array_equal(np.asarray(ev_j), np.asarray(ev_v))
+
+
+def test_chunked_portable_path_matches_unchunked(rng):
+    """_run_vmapped_chunked with a tiny chunk (forcing padding + lax.map)
+    must reproduce the single-vmap fast path exactly — the chunking only
+    bounds XLA temp memory (the 64-island HBM OOM), never results."""
+    from symbolicregression_jl_tpu.models.constant_opt import (
+        _bfgs_single,
+        _run_vmapped_chunked,
+    )
+
+    opt = make_options(
+        binary_operators=["+", "*"], unary_operators=["cos"], maxsize=10,
+        optimizer_iterations=6, optimizer_nrestarts=0,
+    )
+    ops = opt.operators
+    plus, mult = ops.binary_index("+"), ops.binary_index("*")
+    cos = ops.unary_index("cos")
+    X = jnp.asarray(rng.standard_normal((1, 30)).astype(np.float32))
+    y = 1.7 * jnp.cos(X[0]) - 0.3
+
+    trees = stack_trees([
+        encode_tree(
+            Expr.binary(
+                plus,
+                Expr.binary(
+                    mult, Expr.const(float(c)), Expr.unary(cos, Expr.var(0))
+                ),
+                Expr.const(0.1 * i),
+            ),
+            opt.max_len,
+        )
+        for i, c in enumerate(rng.uniform(0.5, 3.0, 10))
+    ])
+    L = opt.max_len
+    starts = trees.cval
+    idx = jnp.arange(L)
+    cmask = (
+        (trees.kind == 1) & (idx < trees.length[:, None])
+    ).astype(jnp.float32)
+
+    xs_fast, fs_fast = _run_vmapped_chunked(
+        trees, starts, cmask, X, y, None, opt, _bfgs_single, chunk=64
+    )
+    xs_chunk, fs_chunk = _run_vmapped_chunked(
+        trees, starts, cmask, X, y, None, opt, _bfgs_single, chunk=4
+    )
+    np.testing.assert_array_equal(np.asarray(fs_fast), np.asarray(fs_chunk))
+    np.testing.assert_array_equal(np.asarray(xs_fast), np.asarray(xs_chunk))
