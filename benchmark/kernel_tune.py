@@ -171,6 +171,15 @@ def main():
     for unroll in (4, 8):
         grid.append(dict(dispatch="mux", tree_unroll=unroll,
                          sort_trees=True, leaf_skip="class"))
+    # packed-scalar postfix: the 2026-08-01 opset_sweep decomposition put
+    # the FIXED per-slot cost at ~62% of step time (intercept 5.1ms vs
+    # 0.068ms/vec-op slope at 8192x1000); this variant attacks its
+    # scalar-fetch share — 1 SMEM word + shifts instead of 4 reads per
+    # (slot, tree), dataflow otherwise identical (unlike instr_packed,
+    # which also changed the operand mux and was refuted on chip)
+    for unroll in (4, 8, 16):
+        grid.append(dict(dispatch="mux", tree_unroll=unroll,
+                         sort_trees=True, scalar_pack=True))
 
     if tail_n is not None:  # only the last N grid entries (quick probes)
         grid = grid[-tail_n:]

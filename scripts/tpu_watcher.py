@@ -69,12 +69,14 @@ STEPS = [
         {"SRTPU_TPU_TESTS": "1"},
     ),
     ("bench", [sys.executable, "bench.py"], 3000, None),
-    # the round-3 kernel variants only (leaf_skip sweep): --tail keeps
-    # it to the newly added grid entries; its outcome decides the
-    # kernel_leaf_skip default, so it runs early
+    # newest kernel variants only (--tail N = last N grid entries): the
+    # scalar_pack probes — the leaf_skip family was measured on-chip
+    # 2026-08-01 (all regress; defaults unchanged). An argv change here
+    # deliberately invalidates the previous record so the new variants
+    # re-run in the next window.
     (
         "kernel_tune_tail",
-        [sys.executable, "benchmark/kernel_tune.py", "--tail", "7"],
+        [sys.executable, "benchmark/kernel_tune.py", "--tail", "3"],
         3000,
         None,
     ),

@@ -396,7 +396,8 @@ def accum_tile(ref, idx, pid_j, val):
 def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                  max_len: int, slot_loop: str, dispatch: str,
                  tree_unroll: int, compute_dtype=jnp.float32,
-                 leaf_skip: "bool | str" = False):
+                 leaf_skip: "bool | str" = False,
+                 scalar_pack: bool = False):
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
     if slot_loop not in ("dynamic", "unrolled"):
@@ -417,22 +418,41 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
     r_sub = r_block // 128
     cdt = compute_dtype
 
-    def kernel(nrows_ref, pcode_ref, feat_ref, length_ref,
-               cval_ref, lidx_ref, ridx_ref,  # SMEM, transposed (L, t_block)
-               X_ref, out_ref, bad_ref,  # VMEM in / VMEM out / SMEM out
-               *val_refs):  # scratch VMEM (max_len, r_sub, 128) x tree_unroll
-        pid_j, valid_f = kernel_row_validity(nrows_ref, r_sub)
+    def make_kernel_with_fetch(fetch_of_refs, n_tbl_refs):
+        """Shared postfix body around a per-slot scalar fetch.
 
+        `fetch_of_refs(tbl_refs)(si, ti) -> (code, feat, lidx, ridx)` —
+        scalar_pack=True reads ONE packed word per (slot, tree) instead
+        of four table entries, halving the scalar-unit SMEM traffic the
+        opset_sweep decomposition identified as part of the dominant
+        fixed per-slot cost."""
+
+        def kernel(nrows_ref, *rest):
+            tbl_refs = rest[:n_tbl_refs]
+            length_ref, cval_ref = rest[n_tbl_refs:n_tbl_refs + 2]
+            X_ref, out_ref, bad_ref = rest[n_tbl_refs + 2:n_tbl_refs + 5]
+            val_refs = rest[n_tbl_refs + 5:]
+            fetch = fetch_of_refs(tbl_refs)
+            pid_j, valid_f = kernel_row_validity(nrows_ref, r_sub)
+            run_postfix_body(
+                fetch, length_ref, cval_ref, X_ref, out_ref, bad_ref,
+                val_refs, pid_j, valid_f,
+            )
+
+        return kernel
+
+    def run_postfix_body(fetch, length_ref, cval_ref, X_ref, out_ref,
+                         bad_ref, val_refs, pid_j, valid_f):
         def slot_body(si, ti, bad, val_ref):
             """One postfix slot: branchless dispatch over the operator set.
 
             PAD slots execute harmlessly: code 0 is masked out of the
             poison flag, writes land in dead val_ref slots, and operand
             indices are stack-clipped by construction."""
-            code = pcode_ref[si, ti]
-            a = val_ref[ridx_ref[si, ti]]  # top of stack: right arg
-            b = val_ref[lidx_ref[si, ti]]  # second: left arg
-            x = X_ref[feat_ref[si, ti]]
+            code, fidx, lidx, ridx = fetch(si, ti)
+            a = val_ref[ridx]  # top of stack: right arg
+            b = val_ref[lidx]  # second: left arg
+            x = X_ref[fidx]
             if cdt != jnp.float32:
                 # bf16 is a STORAGE dtype only: operands upcast to f32 for
                 # the candidate ops (Mosaic cannot lower cos/sin/sqrt/round
@@ -572,7 +592,60 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
 
         jax.lax.fori_loop(0, t_block // tree_unroll, tree_group_body, 0)
 
-    return kernel
+    if scalar_pack:
+        def fetch_packed(tbls):
+            (pword_ref,) = tbls
+
+            def fetch(si, ti):
+                return decode_postfix_word(pword_ref[si, ti])
+
+            return fetch
+
+        return make_kernel_with_fetch(fetch_packed, 1)
+
+    def fetch_tables(tbls):
+        pcode_ref, feat_ref, lidx_ref, ridx_ref = tbls
+
+        def fetch(si, ti):
+            return (pcode_ref[si, ti], feat_ref[si, ti],
+                    lidx_ref[si, ti], ridx_ref[si, ti])
+
+        return fetch
+
+    return make_kernel_with_fetch(fetch_tables, 4)
+
+
+def pack_postfix_scalars(pcode, feat, lidx, ridx, n_codes, nfeat, L):
+    """Pack the four per-slot scalar tables into one i32 word table
+    (6+8+9+9 bits): the packed postfix kernel reads 1 SMEM scalar per
+    (slot, tree) instead of 4. Raises when a field exceeds its width —
+    an explicit failure, not a silent fallback (benchmark attribution).
+    decode_postfix_word is the matching (and only) decoder."""
+    if n_codes > 64 or nfeat > 256 or L > 512:
+        raise ValueError(
+            "scalar_pack needs n_codes <= 64, nfeat <= 256, max_len <= "
+            f"512; got {n_codes} codes, {nfeat} features, {L} slots"
+        )
+    return (
+        pcode.astype(jnp.int32)
+        | (feat.astype(jnp.int32) << 6)
+        | (lidx.astype(jnp.int32) << 14)
+        | (ridx.astype(jnp.int32) << 23)
+    )
+
+
+def decode_postfix_word(w):
+    """(pcode, feat, lidx, ridx) from one packed postfix word — the single
+    decoder for pack_postfix_scalars' layout, shared so a field-width
+    change cannot silently diverge encoder and kernel (same discipline as
+    decode_packed_word for the instr program). The mask after the
+    (arithmetic) shift also clears sign-extension when bit 31 is set."""
+    return (
+        w & 0x3F,
+        (w >> 6) & 0xFF,
+        (w >> 14) & 0x1FF,
+        (w >> 23) & 0x1FF,
+    )
 
 
 def _make_instr_kernel(operators: OperatorSet, t_block: int, r_block: int,
@@ -767,7 +840,8 @@ def _check_r_block(r_block: int, nrows: int, interpret: bool):
     jax.jit,
     static_argnames=("operators", "t_block", "r_block", "interpret",
                      "slot_loop", "dispatch", "tree_unroll", "sort_trees",
-                     "compute_dtype", "program", "leaf_skip"),
+                     "compute_dtype", "program", "leaf_skip",
+                     "scalar_pack"),
 )
 def eval_trees_pallas(
     trees: TreeBatch,
@@ -783,6 +857,7 @@ def eval_trees_pallas(
     compute_dtype: str = "float32",
     program: str = "postfix",
     leaf_skip: "bool | str" = False,
+    scalar_pack: bool = False,
 ) -> Tuple[Array, Array]:
     """Evaluate a flat batch of trees over X (nfeat, nrows).
 
@@ -811,7 +886,15 @@ def eval_trees_pallas(
     postfix program), "class" = 3-way (leaf | unary | binary; the cheap-
     arithmetic binary arm also skips the transcendental candidates) — A/B
     levers for the per-slot overhead question (BASELINE.md roofline
-    section; sweep with kernel_tune.py)."""
+    section; sweep with kernel_tune.py).
+
+    scalar_pack (postfix only) packs the four per-slot scalar tables
+    (pcode/feat/lidx/ridx, 6+8+9+9 bits) into one i32 word so each
+    (slot, tree) step issues 1 scalar SMEM read + shifts instead of 4
+    reads — an attack on the measured fixed per-slot cost. Unlike
+    program="instr_packed" (refuted on chip), the dataflow is untouched:
+    only the scalar fetch changes. Requires n_codes <= 64, nfeat <= 256,
+    max_len <= 512 (raises otherwise)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -828,6 +911,11 @@ def eval_trees_pallas(
         raise ValueError(
             "leaf_skip applies to the postfix program only (the instr "
             "programs have no leaf slots to skip)"
+        )
+    if scalar_pack and program != "postfix":
+        raise ValueError(
+            "scalar_pack applies to the postfix program only "
+            "(instr_packed is the instr program's packed layout)"
         )
     batch_shape = trees.length.shape
     flat = jax.tree_util.tree_map(
@@ -892,24 +980,30 @@ def eval_trees_pallas(
     nrows_arr = jnp.asarray([nrows], jnp.int32)
 
     kernel = _make_kernel(operators, t_block, r_block, L, slot_loop,
-                          dispatch, tree_unroll, cdt, leaf_skip=leaf_skip)
+                          dispatch, tree_unroll, cdt, leaf_skip=leaf_skip,
+                          scalar_pack=scalar_pack)
 
     grid = (T_pad // t_block, NR // r_sub)
     smem_spec = lambda shape, imap: pl.BlockSpec(
         shape, imap, memory_space=pltpu.SMEM
     )
     tree_tbl = lambda: smem_spec((L, t_block), lambda i, j: (0, i))
+    if scalar_pack:
+        n_codes = 3 + operators.n_unary + operators.n_binary
+        tbl_args = (
+            pack_postfix_scalars(pcode, feat, lidx, ridx, n_codes,
+                                 nfeat, L),
+        )
+    else:
+        tbl_args = (pcode, feat, lidx, ridx)
     y, bad = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),  # nrows scalar
-            tree_tbl(),  # pcode
-            tree_tbl(),  # feat
+            *[tree_tbl() for _ in tbl_args],  # scalar table(s)
             smem_spec((1, t_block), lambda i, j: (0, i)),  # length
             tree_tbl(),  # cval
-            tree_tbl(),  # lidx
-            tree_tbl(),  # ridx
             pl.BlockSpec((nfeat, r_sub, 128), lambda i, j: (0, j, 0)),
         ],
         out_specs=[
@@ -932,7 +1026,7 @@ def eval_trees_pallas(
             for _ in range(tree_unroll)
         ],
         interpret=interpret,
-    )(nrows_arr, pcode, feat, length, cval, lidx, ridx, Xp)
+    )(nrows_arr, *tbl_args, length, cval, Xp)
 
     y = y.reshape(T_pad, R_pad)[:T, :nrows]
     ok = (bad[0, :T] == 0) & (flat.length > 0)

@@ -484,3 +484,43 @@ def test_rows_beyond_one_block_accumulate(rng, program):
         interpret=True, program=program,
     )
     np.testing.assert_array_equal(np.asarray(ok2), np.asarray(ok2_ref))
+
+
+@pytest.mark.parametrize("leaf_skip", [False, True, "class"])
+def test_scalar_pack_matches_jnp(rng, leaf_skip):
+    """The packed-scalar postfix variant (one SMEM word per slot instead
+    of four table reads) must be numerically identical to the unpacked
+    kernel — only the scalar fetch changes, never the dataflow. Covers
+    composition with every leaf_skip mode and a multi-row-tile grid."""
+    trees = batch(rng, 15)
+    X = jnp.asarray(
+        (rng.standard_normal((NFEAT, 300)) * 2).astype(np.float32)
+    )
+    y_ref, ok_ref = eval_trees(trees, X, OPS)
+    y, ok = eval_trees_pallas(
+        trees, X, OPS, t_block=8, r_block=128, interpret=True,
+        scalar_pack=True, leaf_skip=leaf_skip,
+    )
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+    m = np.asarray(ok_ref)
+    np.testing.assert_allclose(
+        np.asarray(y)[m], np.asarray(y_ref)[m], rtol=1e-5, atol=1e-5
+    )
+
+
+def test_scalar_pack_width_validation(rng):
+    """Fields beyond the packed word's widths must fail loudly, not
+    silently fall back (benchmark attribution), and scalar_pack is a
+    postfix-only knob."""
+    trees = batch(rng, 4)
+    X_wide = jnp.zeros((300, 8), jnp.float32)  # 300 features > 8-bit field
+    with pytest.raises(ValueError, match="scalar_pack"):
+        eval_trees_pallas(
+            trees, X_wide, OPS, interpret=True, scalar_pack=True
+        )
+    X = jnp.zeros((NFEAT, 8), jnp.float32)
+    with pytest.raises(ValueError, match="postfix"):
+        eval_trees_pallas(
+            trees, X, OPS, interpret=True, scalar_pack=True,
+            program="instr",
+        )
