@@ -403,7 +403,16 @@ def main():
         try:
             results.extend(fn())
         except Exception as e:  # pragma: no cover
+            # stderr for the human; a JSON error entry for the record —
+            # a partially-failed suite must be visibly partial in the
+            # watcher's captured artifact, not silently missing entries
             print(f"# {fn.__name__} failed: {e}", file=sys.stderr)
+            results.append(
+                {
+                    "suite": fn.__name__.removeprefix("bench_"),
+                    "error": f"{type(e).__name__}: {str(e)[:200]}",
+                }
+            )
     for r in results:
         r["platform"] = platform
         print(json.dumps(r))
