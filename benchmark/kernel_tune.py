@@ -180,6 +180,16 @@ def main():
     for unroll in (4, 8, 16):
         grid.append(dict(dispatch="mux", tree_unroll=unroll,
                          sort_trees=True, scalar_pack=True))
+    # top_carry: the postfix invariant ridx == si-1 lets the top-of-stack
+    # operand ride a loop register instead of a dynamic scratch read —
+    # one dynamic VMEM read + one scalar read fewer per step AND a
+    # shorter serial chain per tree (so the optimal interleave may drop)
+    grid.append(dict(dispatch="mux", tree_unroll=8, sort_trees=True,
+                     top_carry=True))
+    for unroll in (4, 8, 16):
+        grid.append(dict(dispatch="mux", tree_unroll=unroll,
+                         sort_trees=True, top_carry=True,
+                         scalar_pack=True))
 
     if tail_n is not None:  # only the last N grid entries (quick probes)
         grid = grid[-tail_n:]

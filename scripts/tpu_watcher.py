@@ -12,7 +12,7 @@ the STEPS comment):
 
   1. SRTPU_TPU_TESTS=1 pytest tests/test_tpu_hardware.py   (Mosaic tier)
   2. python bench.py                                        (headline)
-  3. python benchmark/kernel_tune.py --tail 7   (leaf_skip/class variants)
+  3. python benchmark/kernel_tune.py --tail 7   (scalar_pack + top_carry)
   4. python benchmark/opset_sweep.py    (per-slot overhead decomposition)
   5. python benchmark/kernel_tune.py --rows-sweep  (lane-waste diagnostic)
   6. python benchmark/suite.py          (north-star search iteration)
@@ -69,14 +69,14 @@ STEPS = [
         {"SRTPU_TPU_TESTS": "1"},
     ),
     ("bench", [sys.executable, "bench.py"], 3000, None),
-    # newest kernel variants only (--tail N = last N grid entries): the
-    # scalar_pack probes — the leaf_skip family was measured on-chip
-    # 2026-08-01 (all regress; defaults unchanged). An argv change here
-    # deliberately invalidates the previous record so the new variants
-    # re-run in the next window.
+    # newest kernel variants only (--tail N = last N grid entries):
+    # the 3 scalar_pack probes + 4 top_carry combos. (The leaf_skip
+    # family was measured on-chip 2026-08-01: all regress; defaults
+    # unchanged.) An argv change here deliberately invalidates the
+    # previous record so the new variants re-run in the next window.
     (
         "kernel_tune_tail",
-        [sys.executable, "benchmark/kernel_tune.py", "--tail", "3"],
+        [sys.executable, "benchmark/kernel_tune.py", "--tail", "7"],
         3000,
         None,
     ),

@@ -397,7 +397,8 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                  max_len: int, slot_loop: str, dispatch: str,
                  tree_unroll: int, compute_dtype=jnp.float32,
                  leaf_skip: "bool | str" = False,
-                 scalar_pack: bool = False):
+                 scalar_pack: bool = False,
+                 top_carry: bool = False):
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
     if slot_loop not in ("dynamic", "unrolled"):
@@ -443,14 +444,29 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
 
     def run_postfix_body(fetch, length_ref, cval_ref, X_ref, out_ref,
                          bad_ref, val_refs, pid_j, valid_f):
-        def slot_body(si, ti, bad, val_ref):
+        def slot_body(si, ti, bad, val_ref, v_prev):
             """One postfix slot: branchless dispatch over the operator set.
 
             PAD slots execute harmlessly: code 0 is masked out of the
             poison flag, writes land in dead val_ref slots, and operand
-            indices are stack-clipped by construction."""
+            indices are stack-clipped by construction.
+
+            Returns (bad', stored): the slot's stored value feeds the
+            next slot's `v_prev` — in postfix order the TOP of stack (an
+            operator's right/unary operand) is ALWAYS the immediately
+            preceding slot's result (encode-time invariant: ridx == si-1
+            for every operator slot), so top_carry=True replaces the
+            dynamic `val_ref[ridx]` scratch read with this loop-carried
+            register, dropping one dynamic VMEM read per step AND taking
+            the scratch write->read round-trip out of the tree's serial
+            dependence chain (the chain tree-interleaving exists to
+            hide). PAD tail slots clobber v_prev harmlessly: padding is
+            only ever trailing, so no real slot consumes it."""
             code, fidx, lidx, ridx = fetch(si, ti)
-            a = val_ref[ridx]  # top of stack: right arg
+            if top_carry:
+                a = v_prev  # top of stack == previous slot's result
+            else:
+                a = val_ref[ridx]  # top of stack: right arg
             b = val_ref[lidx]  # second: left arg
             x = X_ref[fidx]
             if cdt != jnp.float32:
@@ -509,14 +525,15 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                         val_ref[si] = v.astype(jnp.float32).astype(cdt)
 
                 stored = val_ref[si]
+                stored_f32 = stored
                 if cdt != jnp.float32:
-                    stored = stored.astype(jnp.float32)
+                    stored_f32 = stored.astype(jnp.float32)
                 return jnp.maximum(
                     bad,
                     jnp.where(
-                        isfinite_(stored) | (code == 0), 0.0, valid_f
+                        isfinite_(stored_f32) | (code == 0), 0.0, valid_f
                     ),
-                )
+                ), stored
             if dispatch == "chain":
                 # serial select chain: n_codes dependent `where`s
                 v = jnp.where(code == 1, cv, x)
@@ -542,9 +559,10 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
             return jnp.maximum(
                 bad,
                 jnp.where(isfinite_(stored) | (code == 0), 0.0, valid_f),
-            )
+            ), stored
 
         zero = jnp.zeros((r_sub, 128), jnp.float32)
+        vzero = jnp.zeros((r_sub, 128), cdt)
 
         def tree_group_body(p, _):
             """tree_unroll independent trees advanced in lockstep: their
@@ -559,29 +577,53 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                 n_max = ns[0]
                 for n in ns[1:]:
                     n_max = jnp.maximum(n_max, n)
-
-                def slot_group(g, bads):
-                    bads = list(bads)
-                    for k in range(_SLOT_UNROLL):
-                        si = g * _SLOT_UNROLL + k
-                        for t in range(tree_unroll):
-                            bads[t] = slot_body(
-                                si, tis[t], bads[t], val_refs[t]
-                            )
-                    return tuple(bads)
-
                 n_groups = (n_max + _SLOT_UNROLL - 1) // _SLOT_UNROLL
-                bads = jax.lax.fori_loop(
-                    0, n_groups, slot_group, (zero,) * tree_unroll
-                )
+                if top_carry:
+                    def slot_group(g, carry):
+                        bads, vprevs = list(carry[0]), list(carry[1])
+                        for k in range(_SLOT_UNROLL):
+                            si = g * _SLOT_UNROLL + k
+                            for t in range(tree_unroll):
+                                bads[t], vprevs[t] = slot_body(
+                                    si, tis[t], bads[t], val_refs[t],
+                                    vprevs[t],
+                                )
+                        return (tuple(bads), tuple(vprevs))
+
+                    bads, _ = jax.lax.fori_loop(
+                        0, n_groups, slot_group,
+                        ((zero,) * tree_unroll, (vzero,) * tree_unroll),
+                    )
+                else:
+                    # no carried v_prev when the variant is off: dead
+                    # loop-carried vregs would shift baseline codegen
+                    # (register pressure) on every previously measured
+                    # variant
+                    def slot_group(g, bads):
+                        bads = list(bads)
+                        for k in range(_SLOT_UNROLL):
+                            si = g * _SLOT_UNROLL + k
+                            for t in range(tree_unroll):
+                                bads[t], _ = slot_body(
+                                    si, tis[t], bads[t], val_refs[t],
+                                    None,
+                                )
+                        return tuple(bads)
+
+                    bads = jax.lax.fori_loop(
+                        0, n_groups, slot_group, (zero,) * tree_unroll
+                    )
             else:
                 # Full static unroll: every slot executes for every tree —
                 # more straight-line overlap, no loop overhead, but pays
                 # for padded tails and compiles slower. (A/B alternative.)
                 bads = [zero] * tree_unroll
+                vprevs = [vzero] * tree_unroll
                 for si in range(max_len):
                     for t in range(tree_unroll):
-                        bads[t] = slot_body(si, tis[t], bads[t], val_refs[t])
+                        bads[t], vprevs[t] = slot_body(
+                            si, tis[t], bads[t], val_refs[t], vprevs[t]
+                        )
             for t in range(tree_unroll):
                 # output/accumulation stays float32 regardless of cdt
                 out_ref[tis[t]] = val_refs[t][
@@ -597,6 +639,8 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
             (pword_ref,) = tbls
 
             def fetch(si, ti):
+                # top_carry never consumes the decoded ridx field; XLA
+                # DCEs its (pure) shift+mask
                 return decode_postfix_word(pword_ref[si, ti])
 
             return fetch
@@ -607,8 +651,11 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
         pcode_ref, feat_ref, lidx_ref, ridx_ref = tbls
 
         def fetch(si, ti):
+            # top_carry replaces the per-slot ridx scalar read with the
+            # loop-carried register (see slot_body)
+            r = 0 if top_carry else ridx_ref[si, ti]
             return (pcode_ref[si, ti], feat_ref[si, ti],
-                    lidx_ref[si, ti], ridx_ref[si, ti])
+                    lidx_ref[si, ti], r)
 
         return fetch
 
@@ -841,7 +888,7 @@ def _check_r_block(r_block: int, nrows: int, interpret: bool):
     static_argnames=("operators", "t_block", "r_block", "interpret",
                      "slot_loop", "dispatch", "tree_unroll", "sort_trees",
                      "compute_dtype", "program", "leaf_skip",
-                     "scalar_pack"),
+                     "scalar_pack", "top_carry"),
 )
 def eval_trees_pallas(
     trees: TreeBatch,
@@ -858,6 +905,7 @@ def eval_trees_pallas(
     program: str = "postfix",
     leaf_skip: "bool | str" = False,
     scalar_pack: bool = False,
+    top_carry: bool = False,
 ) -> Tuple[Array, Array]:
     """Evaluate a flat batch of trees over X (nfeat, nrows).
 
@@ -894,7 +942,17 @@ def eval_trees_pallas(
     reads — an attack on the measured fixed per-slot cost. Unlike
     program="instr_packed" (refuted on chip), the dataflow is untouched:
     only the scalar fetch changes. Requires n_codes <= 64, nfeat <= 256,
-    max_len <= 512 (raises otherwise)."""
+    max_len <= 512 (raises otherwise).
+
+    top_carry (postfix only) carries each tree's previous slot value in
+    a loop register instead of re-reading it from scratch: postfix
+    order guarantees an operator's top-of-stack operand IS the previous
+    slot's result (encode-time invariant ridx == si-1, asserted by
+    operand_schedule's tests), so this removes one dynamic VMEM read +
+    one scalar table read per step and takes a scratch write->read
+    round-trip off the tree's serial dependence chain — the latency
+    chain that tree-interleaving exists to hide. Composable with
+    scalar_pack and leaf_skip."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -916,6 +974,11 @@ def eval_trees_pallas(
         raise ValueError(
             "scalar_pack applies to the postfix program only "
             "(instr_packed is the instr program's packed layout)"
+        )
+    if top_carry and program != "postfix":
+        raise ValueError(
+            "top_carry applies to the postfix program only (the instr "
+            "program's operands are not stack-adjacent)"
         )
     batch_shape = trees.length.shape
     flat = jax.tree_util.tree_map(
@@ -981,7 +1044,7 @@ def eval_trees_pallas(
 
     kernel = _make_kernel(operators, t_block, r_block, L, slot_loop,
                           dispatch, tree_unroll, cdt, leaf_skip=leaf_skip,
-                          scalar_pack=scalar_pack)
+                          scalar_pack=scalar_pack, top_carry=top_carry)
 
     grid = (T_pad // t_block, NR // r_sub)
     smem_spec = lambda shape, imap: pl.BlockSpec(

@@ -524,3 +524,42 @@ def test_scalar_pack_width_validation(rng):
             trees, X, OPS, interpret=True, scalar_pack=True,
             program="instr",
         )
+
+
+def test_operand_schedule_top_invariant(rng):
+    """Encode-time invariant the top_carry kernel relies on: in postfix
+    order every operator slot's right/unary operand (stack top) is the
+    immediately preceding slot's result — ridx == si - 1."""
+    from symbolicregression_jl_tpu.ops.pallas_eval import operand_schedule
+
+    trees = batch(rng, 64, max_size=22)
+    _, ridx = operand_schedule(trees.kind)
+    kind = np.asarray(trees.kind)
+    is_op = (kind == 3) | (kind == 4)
+    si = np.broadcast_to(np.arange(kind.shape[1]), kind.shape)
+    np.testing.assert_array_equal(np.asarray(ridx)[is_op], si[is_op] - 1)
+
+
+@pytest.mark.parametrize("kw", [
+    dict(top_carry=True),
+    dict(top_carry=True, scalar_pack=True),
+    dict(top_carry=True, leaf_skip="class"),
+    dict(top_carry=True, slot_loop="unrolled"),
+])
+def test_top_carry_matches_jnp(rng, kw):
+    """The register-carried top-of-stack variant must match the
+    interpreter exactly across its composable knobs (the invariant test
+    above is why the carry is sound)."""
+    trees = batch(rng, 13)
+    X = jnp.asarray(
+        (rng.standard_normal((NFEAT, 60)) * 2).astype(np.float32)
+    )
+    y_ref, ok_ref = eval_trees(trees, X, OPS)
+    y, ok = eval_trees_pallas(
+        trees, X, OPS, t_block=8, r_block=128, interpret=True, **kw
+    )
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(ok_ref))
+    m = np.asarray(ok_ref)
+    np.testing.assert_allclose(
+        np.asarray(y)[m], np.asarray(y_ref)[m], rtol=1e-5, atol=1e-5
+    )
