@@ -267,6 +267,12 @@ BINARY_REGISTRY: Dict[str, Callable] = {
 # below are f32-accurate to a few ulp (each is parity-tested against its
 # lax counterpart over a domain grid in tests/test_operators.py), which is
 # within the kernel's existing f32-vs-f64-oracle comparison tolerances.
+# Two exceptions to "few ulp": mod_kernel's x - floor(x/y)*y error grows
+# with |x/y| (unbounded for huge ratios; parity-tested to rtol 1e-3 on a
+# +-40 grid), and erfc_kernel's relative error degrades in the positive
+# tail where the true value underflows. Kernel-path fitness can therefore
+# diverge from the jnp-interpreter path for mod/erfc-heavy expressions in
+# those regimes, enough to flip near-tie rankings between backends.
 #
 # The substitutions also keep the library's NaN-domain semantics
 # (reference src/Operators.jl:8-73) bit-identical: every guard is applied

@@ -1046,6 +1046,12 @@ def eval_trees_pallas(
                           dispatch, tree_unroll, cdt, leaf_skip=leaf_skip,
                           scalar_pack=scalar_pack, top_carry=top_carry)
 
+    # INVARIANT (accum_tile soundness): the row-tile index j MUST stay the
+    # trailing, sequentially-iterated grid dimension, and the scalar
+    # outputs' index maps must ignore j so their blocks stay resident
+    # across the j sweep (tile 0 initializes, later tiles accumulate).
+    # Reordering this grid or marking j parallel via dimension_semantics
+    # would silently corrupt poison/loss outputs.
     grid = (T_pad // t_block, NR // r_sub)
     smem_spec = lambda shape, imap: pl.BlockSpec(
         shape, imap, memory_space=pltpu.SMEM

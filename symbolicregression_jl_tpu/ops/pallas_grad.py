@@ -369,6 +369,11 @@ def make_loss_kernel(trees, X, y, weights, operators, loss_fn=None,
         operators, t_block, r_block, L, ML, tree_unroll, nfeat, loss_fn,
         with_grad=with_grad,
     )
+    # INVARIANT (accum_tile soundness): j (row tiles) must remain the
+    # trailing sequential grid dimension — see the matching note at
+    # pallas_eval's grid construction; a reorder or a parallel
+    # dimension_semantics annotation here silently corrupts
+    # loss/cgrad/poison accumulation.
     grid = (T_pad // t_block, NR // r_sub)
     smem_spec = lambda shape, imap: pl.BlockSpec(
         shape, imap, memory_space=pltpu.SMEM
