@@ -1,0 +1,231 @@
+"""bench.py accelerator-acquisition state machine (VERDICT r3 #6).
+
+All tunnel contact is mocked — these tests must be safe to run while the
+watcher holds the single tunnel slot. The invariants under test:
+
+- every attempt is bounded (probe/init timeouts <= 60 s constants);
+- a successful probe + TPU init records tunnel_state='up';
+- an init that silently lands on CPU (sitecustomize's 'axon,cpu' fallback
+  when the tunnel drops between probe and init) is NEVER recorded as
+  'up' — the process re-execs to continue the schedule;
+- a fresh memo-up verdict skips the throwaway probe subprocess;
+- the CPU-fallback re-entry classifies half-open (hang somewhere in the
+  attempts) vs down (fast errors only) by exact result constants.
+"""
+
+import importlib
+import sys
+import types
+
+import pytest
+
+bench = importlib.import_module("bench")
+
+
+class _Dev:
+    def __init__(self, platform):
+        self.platform = platform
+
+    def __repr__(self):
+        return f"<dev {self.platform}>"
+
+
+class _Reexec(Exception):
+    def __init__(self, resume_at):
+        self.resume_at = resume_at
+
+
+@pytest.fixture()
+def acq(monkeypatch, tmp_path):
+    """Fresh ACQUISITION + memo isolated to tmp; os.execve trapped."""
+    monkeypatch.setattr(bench, "ACQUISITION",
+                        {"attempts": [], "tunnel_state": "unknown"})
+    monkeypatch.setattr(bench, "_MEMO_PATH", str(tmp_path / "memo.json"))
+    monkeypatch.setattr(
+        bench, "_reexec",
+        lambda resume_at: (_ for _ in ()).throw(_Reexec(resume_at)),
+    )
+    monkeypatch.delenv("_SRTPU_BENCH_CPU_FALLBACK", raising=False)
+    monkeypatch.delenv("_SRTPU_BENCH_RESUME_AT", raising=False)
+    monkeypatch.delenv("_SRTPU_BENCH_ACQ", raising=False)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    return bench.ACQUISITION
+
+
+def test_timeout_constants_bounded():
+    assert bench._PROBE_TIMEOUT <= 60.0
+    assert bench._INIT_TIMEOUT <= 60.0
+
+
+def test_probe_ok_init_tpu_records_up(acq, monkeypatch):
+    monkeypatch.setattr(bench, "_probe_tpu_subprocess",
+                        lambda t: ("tpu", "ok"))
+    monkeypatch.setattr(bench, "_init_backend_with_watchdog",
+                        lambda t: ([_Dev("tpu")], None))
+    devices = bench._devices_or_cpu_fallback(verbose=False)
+    assert devices[0].platform == "tpu"
+    assert acq["tunnel_state"] == "up"
+    assert bench._read_memo() == "up"
+    assert acq["attempts"][0]["result"] == "tpu"
+    assert "init_s" in acq["attempts"][0]
+
+
+def test_probe_ok_but_init_lands_on_cpu_is_not_up(acq, monkeypatch):
+    """The review-caught hazard: TPU-positive probe, tunnel drops, init
+    falls back to CPU without raising — must re-exec, never return the
+    CPU devices as an 'up' capture."""
+    monkeypatch.setattr(bench, "_probe_tpu_subprocess",
+                        lambda t: ("tpu", "ok"))
+    monkeypatch.setattr(bench, "_init_backend_with_watchdog",
+                        lambda t: ([_Dev("cpu")], None))
+    with pytest.raises(_Reexec) as ei:
+        bench._devices_or_cpu_fallback(verbose=False)
+    assert ei.value.resume_at == 0
+    assert acq["tunnel_state"] != "up"
+    assert bench._read_memo() != "up"
+    assert acq["attempts"][0]["result"] == "probe-ok-cpu-fallback"
+
+
+def test_probe_cpu_means_absent(acq, monkeypatch):
+    monkeypatch.setattr(bench, "_probe_tpu_subprocess",
+                        lambda t: ("cpu", "ok"))
+    fake_jax = types.SimpleNamespace(
+        config=types.SimpleNamespace(update=lambda *a: None),
+        devices=lambda: [_Dev("cpu")],
+    )
+    monkeypatch.setitem(sys.modules, "jax", fake_jax)
+    devices = bench._devices_or_cpu_fallback(verbose=False)
+    assert devices[0].platform == "cpu"
+    assert acq["tunnel_state"] == "absent"
+
+
+def test_probe_hang_skips_zero_sleep_slot(acq, monkeypatch):
+    """After a failed fast-path probe the loop must start at slot 1 (a
+    zero-sleep identical re-probe learns nothing) — and a later good
+    probe+init still succeeds."""
+    calls = []
+
+    def probe(t):
+        calls.append("probe")
+        return (None, "hang") if len(calls) == 1 else ("tpu", "ok")
+
+    monkeypatch.setattr(bench, "_probe_tpu_subprocess", probe)
+    monkeypatch.setattr(bench, "_init_backend_with_watchdog",
+                        lambda t: ([_Dev("tpu")], None))
+    sleeps = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: sleeps.append(s))
+    devices = bench._devices_or_cpu_fallback(verbose=False)
+    assert devices[0].platform == "tpu"
+    # fast-path probe failed; the first loop attempt used slot 1's backoff
+    assert acq["attempts"][0]["result"] == "probe-hang"
+    assert acq["attempts"][1]["sleep_s"] == bench._PROBE_BACKOFFS[1]
+
+
+def test_probe_ok_init_error_retries_init_without_reprobe(acq, monkeypatch):
+    """A retryable init error after a good probe retries the init
+    DIRECTLY — the tunnel answered seconds ago; a second throwaway probe
+    subprocess would waste ~20 s of a chip window."""
+    inits = []
+    probes = []
+
+    def init(t):
+        inits.append(1)
+        if len(inits) == 1:
+            return None, "init-error: transient"
+        return [_Dev("tpu")], None
+
+    def probe(t):
+        probes.append(1)
+        return ("tpu", "ok")
+
+    monkeypatch.setattr(bench, "_probe_tpu_subprocess", probe)
+    monkeypatch.setattr(bench, "_init_backend_with_watchdog", init)
+    devices = bench._devices_or_cpu_fallback(verbose=False)
+    assert devices[0].platform == "tpu"
+    assert len(probes) == 1 and len(inits) == 2
+
+
+def test_single_slot_schedule_still_gets_one_retry(acq, monkeypatch):
+    """With a 1-element probe schedule, a failed fast-path probe must not
+    skip the whole loop (that would mean zero retries and an immediate
+    memo='down' CPU fallback)."""
+    calls = []
+
+    def probe(t):
+        calls.append(1)
+        return (None, "hang") if len(calls) == 1 else ("tpu", "ok")
+
+    monkeypatch.setattr(bench, "_PROBE_BACKOFFS", (0,))
+    monkeypatch.setattr(bench, "_probe_tpu_subprocess", probe)
+    monkeypatch.setattr(bench, "_init_backend_with_watchdog",
+                        lambda t: ([_Dev("tpu")], None))
+    devices = bench._devices_or_cpu_fallback(verbose=False)
+    assert devices[0].platform == "tpu"
+    assert len(calls) == 2
+
+
+def test_memo_up_skips_probe(acq, monkeypatch):
+    bench._write_memo("up")
+    monkeypatch.setattr(
+        bench, "_probe_tpu_subprocess",
+        lambda t: pytest.fail("memo-up must skip the probe subprocess"),
+    )
+    monkeypatch.setattr(bench, "_init_backend_with_watchdog",
+                        lambda t: ([_Dev("tpu")], None))
+    devices = bench._devices_or_cpu_fallback(verbose=False, use_memo=True)
+    assert devices[0].platform == "tpu"
+    assert acq["attempts"][0]["result"] == "memo-up-tpu"
+    assert acq["attempts"][0]["probe_s"] == 0.0
+
+
+def test_memo_up_stale_tunnel_reexecs(acq, monkeypatch):
+    bench._write_memo("up")
+    monkeypatch.setattr(bench, "_init_backend_with_watchdog",
+                        lambda t: (None, "init-hung"))
+    with pytest.raises(_Reexec) as ei:
+        bench._devices_or_cpu_fallback(verbose=False, use_memo=True)
+    assert ei.value.resume_at == 0
+    assert acq["attempts"][0]["result"] == "memo-up-init-hung"
+
+
+def test_memo_down_goes_straight_to_fallback(acq, monkeypatch):
+    bench._write_memo("down")
+    monkeypatch.setattr(
+        bench, "_fallback_to_cpu",
+        lambda verbose: (_ for _ in ()).throw(SystemExit(0)),
+    )
+    with pytest.raises(SystemExit):
+        bench._devices_or_cpu_fallback(verbose=False, use_memo=True)
+    assert acq["attempts"][0]["result"] == "memo-down"
+
+
+@pytest.mark.parametrize(
+    "attempts,want",
+    [
+        ([{"result": "probe-hang"}], "half-open"),
+        ([{"result": "probe-ok-init-hung"}], "half-open"),
+        ([{"result": "memo-up-init-hung"}], "half-open"),
+        ([{"result": "probe-error: channel hung up"}], "down"),
+        ([{"result": "probe-error: connection refused"},
+          {"result": "probe-error: connection refused"}], "down"),
+    ],
+)
+def test_cpu_fallback_reentry_classifies_tunnel(acq, monkeypatch, attempts,
+                                                want):
+    """Half-open (something hangs) vs down (fast errors) keyed on exact
+    recorder constants, never on free-form error text."""
+    import json
+    import os
+
+    monkeypatch.setenv("_SRTPU_BENCH_CPU_FALLBACK", "1")
+    monkeypatch.setenv("_SRTPU_BENCH_ACQ", json.dumps(
+        {"attempts": attempts, "tunnel_state": "unknown"}
+    ))
+    fake_jax = types.SimpleNamespace(
+        config=types.SimpleNamespace(update=lambda *a: None),
+        devices=lambda: [_Dev("cpu")],
+    )
+    monkeypatch.setitem(sys.modules, "jax", fake_jax)
+    devices = bench._devices_or_cpu_fallback(verbose=False)
+    assert devices[0].platform == "cpu"
+    assert bench.ACQUISITION["tunnel_state"] == want
