@@ -7,15 +7,16 @@ The axon tunnel drops for hours at a time and — worse — hangs
 time (e.g. the driver's end-of-round capture) can miss every hardware
 window of a working day. This watcher inverts that: it polls the tunnel
 with a killable subprocess probe and, the first time the chip answers,
-runs the full hardware evidence list (short decisive steps first — see
-the STEPS comment):
+runs the full hardware evidence list (round-4 order — two short
+canaries, then the north-star suite, then the sweeps; see the STEPS
+comment for the rationale):
 
   1. SRTPU_TPU_TESTS=1 pytest tests/test_tpu_hardware.py   (Mosaic tier)
   2. python bench.py                                        (headline)
-  3. python benchmark/kernel_tune.py --tail 7   (scalar_pack + top_carry)
-  4. python benchmark/opset_sweep.py    (per-slot overhead decomposition)
-  5. python benchmark/kernel_tune.py --rows-sweep  (lane-waste diagnostic)
-  6. python benchmark/suite.py          (north-star search iteration)
+  3. python benchmark/suite.py          (north-star search iteration)
+  4. python benchmark/kernel_tune.py --tail 7   (scalar_pack + top_carry)
+  5. python benchmark/opset_sweep.py    (per-slot overhead decomposition)
+  6. python benchmark/kernel_tune.py --rows-sweep  (lane-waste diagnostic)
   7. python benchmark/feynman_scale.py  (64x1000 quality at scale)
 
 After every completed step the accumulated results are written to
@@ -54,11 +55,13 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RESULT_PATH = os.path.join(REPO, "BENCH_TPU_LATEST.json")
 SENTINEL = "/tmp/srtpu_watcher_capturing"
 
-# Ordered by value-per-chip-minute: the 2026-08-01 morning window lasted
-# ~31 minutes (tpu_tests + bench exactly fit; the tunnel dropped the
-# moment suite started), so the short decisive sweeps go before the long
-# steps — any completed step is durable progress even if the window
-# closes mid-list.
+# Round-4 order (VERDICT r3 #1/#2): after the two short canaries, the
+# 64x1000 north-star suite runs FIRST — it is the round's defining
+# artifact and has never completed on chip (the OOM fix was confirmed by
+# TPU-target memory analysis 2026-08-02: optimize temp 45GB -> 1.2GB).
+# The short kernel sweeps follow; feynman_scale goes last because its
+# --resume makes partial progress durable across tunnel windows, so it
+# can soak whatever chip time remains.
 STEPS = [
     # (name, argv, timeout_s, extra_env)
     (
@@ -69,6 +72,7 @@ STEPS = [
         {"SRTPU_TPU_TESTS": "1"},
     ),
     ("bench", [sys.executable, "bench.py"], 3000, None),
+    ("suite", [sys.executable, "benchmark/suite.py"], 7200, None),
     # newest kernel variants only (--tail N = last N grid entries):
     # the 3 scalar_pack probes + 4 top_carry combos. (The leaf_skip
     # family was measured on-chip 2026-08-01: all regress; defaults
@@ -93,7 +97,6 @@ STEPS = [
         1800,
         None,
     ),
-    ("suite", [sys.executable, "benchmark/suite.py"], 7200, None),
     # --resume: skip (case, seed) pairs already captured on chip in
     # BENCH_TPU_LATEST.json (main() persists the guard-railed resume
     # state to that file BEFORE any step runs, so the script can trust

@@ -144,11 +144,16 @@ def test_exhausted_partial_not_rerun_and_attempts_restored(watcher):
     assert attempts["suite"] == 1  # cap continues, not reset
 
 
-def test_step_order_short_before_long(watcher):
+def test_step_order_round4_policy(watcher):
+    """Short canaries first, then the north-star suite (the round's
+    defining artifact — VERDICT r3 #1), then the short sweeps;
+    feynman_scale last because its per-case --resume makes it the only
+    step whose partial progress survives a tunnel drop."""
     names = [s[0] for s in watcher.STEPS]
-    assert names.index("kernel_tune_tail") < names.index("suite")
-    assert names.index("opset_sweep") < names.index("suite")
-    assert names.index("suite") < names.index("feynman_scale")
+    assert names.index("tpu_tests") < names.index("bench")
+    assert names.index("bench") < names.index("suite")
+    assert names.index("suite") < names.index("kernel_tune_tail")
+    assert names[-1] == "feynman_scale"
 
 
 def test_all_records_stale_resets_epoch(watcher, monkeypatch):
