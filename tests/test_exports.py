@@ -53,6 +53,35 @@ def test_operator_library_importable():
         assert callable(getattr(O, name)), name
 
 
+def test_api_reference_current():
+    """The generated API page covers __all__ exactly and is committed in
+    sync with the docstrings (the reference's generated-docs guarantee,
+    /root/reference/docs/make.jl:8-35)."""
+    import importlib
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    scripts_dir = os.path.join(repo, "scripts")
+    sys.path.insert(0, scripts_dir)
+    try:
+        gen = importlib.import_module("gen_api_reference")
+    finally:
+        # remove the exact entry: the module's own body inserts REPO at
+        # index 0, so a positional pop would strip that instead and leak
+        # scripts/ onto sys.path for the rest of the session
+        sys.path.remove(scripts_dir)
+    text = gen.generate()
+    for name in sr.__all__:
+        assert f"### `{name}`" in text, f"{name} missing from generated page"
+    with open(os.path.join(repo, "docs", "api_reference.md")) as f:
+        committed = f.read()
+    assert committed == text, (
+        "docs/api_reference.md out of date — run "
+        "python scripts/gen_api_reference.py"
+    )
+
+
 def test_simplify_combine_roundtrip():
     import jax
 
