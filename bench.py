@@ -30,7 +30,17 @@ _CPU_FALLBACK = 3.85e6  # measured on this image's XLA CPU (2026-07-29)
 
 N_POPULATIONS = 64
 NPOP = 1000
-N_ROWS = 1000
+# 2048 rows since round 5: the 2026-08-02 on-chip rows sweep measured the
+# default kernel at 1.393e9 t-r/s with 2048 rows vs 1.054e9 at 1024 —
+# past full sublane occupancy (>=1024 rows) extra row tiles amortize the
+# kernel's fixed per-step cost (the 42% overhead term in the opset
+# decomposition), so the larger dataset is the better operating point
+# users should pick when they have the rows. The CPU anchors are
+# co-measured at the SAME shape; their per-(tree,row) cost is linear in
+# rows, so their trees-rows/s rates (including the last-resort
+# _CPU_FALLBACK constant above) are ~shape-independent and vs_baseline
+# stays an apples-to-apples ratio.
+N_ROWS = 2048
 MAXSIZE = 20
 CHUNK = 8192
 REPS = 3
@@ -474,6 +484,17 @@ def _read_memo():
     return None
 
 
+def _clear_memo():
+    """Drop a memo that live evidence just contradicted (a memo-trusted
+    init hung or landed on CPU): the tunnel's real state is unknown, so
+    the next entry point must re-probe rather than inherit a stale 'up'
+    and burn its own full init timeout on it."""
+    try:
+        os.remove(_MEMO_PATH)
+    except OSError:
+        pass
+
+
 def _fallback_to_cpu(verbose):
     """Re-exec this script pinned to CPU, carrying the diagnostics."""
     if verbose:
@@ -563,10 +584,13 @@ def _devices_or_cpu_fallback(verbose, use_memo=False):
         # plainly dead tunnel (fast errors): the two have different
         # recovery timescales and the artifact should say which we saw.
         # Exact-match the recorder's own constants — free-form error text
-        # (result = "error: <stderr tail>") must not key the diagnosis.
+        # (e.g. "probe-ok-init-error: <stderr tail>" whose truncated tail
+        # could end in "init-hung") must not key the diagnosis.
+        _HUNG_RESULTS = {
+            "probe-hang", "memo-up-init-hung", "probe-ok-init-hung",
+        }
         hung = any(
-            a.get("result") == "probe-hang"
-            or str(a.get("result", "")).endswith("init-hung")
+            a.get("result") in _HUNG_RESULTS
             for a in ACQUISITION["attempts"]
         )
         ACQUISITION["tunnel_state"] = "half-open" if hung else "down"
@@ -605,7 +629,13 @@ def _devices_or_cpu_fallback(verbose, use_memo=False):
                 return devices
             # hung or silently-CPU: this process's backend is poisoned —
             # continue the full schedule in a fresh process (init errors
-            # could retry in-process, but re-exec keeps one code path)
+            # could retry in-process, but re-exec keeps one code path).
+            # The memo that promised 'up' is contradicted by what just
+            # happened: clear it so the re-exec'd schedule (and any
+            # sibling entry point) re-probes instead of trusting it —
+            # and so a killed re-exec can't leave the stale 'up' behind
+            # to cost every later entry point a full hung init.
+            _clear_memo()
             _reexec(0)
 
     if not resumed:

@@ -25,6 +25,7 @@ linearly with rows = it is not.
 from __future__ import annotations
 
 import itertools
+import json
 import os
 import sys
 
@@ -61,6 +62,13 @@ def main():
         args = args[:i] + args[i + 2:]
     rows_sweep = "--rows-sweep" in args
     args = [a for a in args if a != "--rows-sweep"]
+    rows_max = 2048
+    if "--rows-max" in args:
+        i = args.index("--rows-max")
+        if i + 1 >= len(args):
+            sys.exit("--rows-max requires a value")
+        rows_max = int(args[i + 1])
+        args = args[:i] + args[i + 2:]
     n_inner = int(args[0]) if args else 20
     N_TREES, MAXSIZE = 8192, 20
 
@@ -86,20 +94,34 @@ def main():
 
     if rows_sweep:
         # lane-utilization diagnostic: rows under 1024 under-fill the
-        # (8, 128) vreg sublanes ((nrows/128) of 8 used)
+        # (8, 128) vreg sublanes ((nrows/128) of 8 used); rows beyond
+        # 1024 amortize the fixed per-step cost over more row tiles
+        # (2026-08-02 capture: 2048 rows -> 1.39e9, ABOVE the 1024-row
+        # plateau — hence --rows-max to find the knee)
         rng = np.random.default_rng(0)
-        for nrows in (128, 256, 512, 1024, 2048):
+        sweep = [r for r in (128, 256, 512, 1024, 2048, 4096, 8192)
+                 if r <= rows_max]
+        for nrows in sweep:
             Xr = jnp.asarray(
                 rng.uniform(1.0, 3.0, nrows).astype("f4")[None, :]
             )
             rate, per_iter, compile_s = time_pallas_variant(
                 jax, jnp, trees, Xr, ops, overhead, n_inner
             )
+            # one JSON line per measurement: the watcher's `json`
+            # capture must keep sweep data even when stdout_tail scrolls
+            print(json.dumps({
+                "sweep": "rows", "rows": nrows,
+                "sublanes": min(nrows // 128, 8),
+                "trees_rows_per_s": rate, "per_iter_s": per_iter,
+                "compile_s": compile_s,
+                "platform": jax.devices()[0].platform,
+            }), flush=True)
             print(
-                f"rows={nrows:5d}  sublanes={min(nrows // 128, 8)}/8  "
+                f"# rows={nrows:5d}  sublanes={min(nrows // 128, 8)}/8  "
                 f"{rate:.3e} t-r/s  {per_iter*1e3:7.2f} ms/iter  "
                 f"(compile {compile_s:.0f}s)",
-                flush=True,
+                file=sys.stderr, flush=True,
             )
         return
 

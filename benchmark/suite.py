@@ -20,6 +20,22 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+_EMIT_PLATFORM = [None]  # set by the runner before cases execute
+
+
+def _emit(rec, out):
+    """Print a result row the moment it exists (stamped with the
+    platform) AND collect it: a later fault in the same process — a
+    wedged TPU client can take the whole interpreter down — must not
+    erase rows already measured (r04 lost the precision_ratio row to
+    exactly that)."""
+    if _EMIT_PLATFORM[0] is not None:
+        rec = {**rec, "platform": _EMIT_PLATFORM[0]}
+    print(json.dumps(rec), flush=True)
+    out.append(rec)
+    return rec
+
+
 def _median_time(fn, reps=5):
     fn()  # warmup/compile
     ts = []
@@ -214,20 +230,35 @@ def bench_search_iteration_northstar():
     _PALLAS_MIN_BATCH, so on TPU the evolution cycles themselves run
     through the Pallas eval kernel and constant optimization through the
     fused loss/grad kernels (optimizer_backend='auto'). Heavy — runs on
-    non-CPU platforms or with SRTPU_SUITE_BIG=1."""
+    non-CPU platforms or with SRTPU_SUITE_BIG=1.
+
+    Measurement order is fault-aware (r04: the fused single-call form is
+    the only program shape that has ever faulted the chip, and a faulted
+    client wedges its process): the CHUNKED-dispatch form
+    (max_cycles_per_dispatch=5, numerically identical — see
+    tests/test_dispatch_chunking.py) runs FIRST, then the optimizer-off
+    breakdown (also chunked), and the fused single-call attempt runs
+    LAST so its fault cannot blank the rows before it. Each entry is
+    printed by the runner as soon as its sub-measurement returns."""
     import jax
 
     if jax.devices()[0].platform == "cpu" and not os.environ.get(
         "SRTPU_SUITE_BIG"
     ):
-        return []
+        return [_emit({
+            "suite": "search_iteration_northstar",
+            "skipped": "cpu platform (set SRTPU_SUITE_BIG=1 to force)",
+        }, [])]
     import jax.numpy as jnp
     import numpy as np
 
-    from symbolicregression_jl_tpu.api import _make_init_fn, _make_iteration_fn
+    from symbolicregression_jl_tpu.api import (
+        _make_init_fn,
+        _make_iteration_driver,
+    )
     from symbolicregression_jl_tpu.models.options import make_options
 
-    options = make_options(
+    shape_kwargs = dict(
         binary_operators=["+", "-", "*", "/"],
         unary_operators=["cos", "exp"],
         npop=1000,
@@ -235,6 +266,7 @@ def bench_search_iteration_northstar():
         ncycles_per_iteration=25,
         maxsize=20,
     )
+    options = make_options(**shape_kwargs)
     n_feat, n_rows = 1, 1000
     rng = np.random.default_rng(0)
     theta = rng.uniform(1.0, 3.0, n_rows).astype(np.float32)
@@ -250,70 +282,67 @@ def bench_search_iteration_northstar():
         jax.random.split(jax.random.PRNGKey(0), options.npopulations),
         X, y, baseline, scalars,
     )
-    it_fn = _make_iteration_fn(options, False)
     cm = jnp.int32(options.maxsize)
-
-    def run():
-        s2, ghof = it_fn(
-            states, jax.random.PRNGKey(1), cm, X, y, baseline, scalars
-        )
-        jax.block_until_ready(ghof.losses)
-
-    dt = _median_time(run, reps=3)
+    case = (
+        f"islands{options.npopulations}_npop{options.npop}_"
+        f"cycles{options.ncycles_per_iteration}_rows{n_rows}"
+    )
     cand_evals = (
         options.ncycles_per_iteration
         * options.n_parallel_tournaments
         * options.npopulations
     )
-    out = [
-        {
-            "suite": "search_iteration_northstar",
-            "case": (
-                f"islands{options.npopulations}_npop{options.npop}_"
-                f"cycles{options.ncycles_per_iteration}_rows{n_rows}"
-            ),
-            "median_s": dt,
-            "candidate_evals_per_s": cand_evals / dt,
-        }
-    ]
 
-    # breakdown (VERDICT r2 #2): where does the iteration go — evolve
-    # cycles vs constant optimization? Re-time with the optimizer off
-    # (one extra compile); the BFGS share is the difference. Host share
-    # is negligible by construction (the whole iteration is ONE jit
-    # call; host work happens between calls and is excluded by timing
-    # block_until_ready around the call itself).
-    try:
-        opt_off = make_options(
-            binary_operators=["+", "-", "*", "/"],
-            unary_operators=["cos", "exp"],
-            npop=1000,
-            npopulations=64,
-            ncycles_per_iteration=25,
-            maxsize=20,
-            should_optimize_constants=False,
-        )
-        it2 = _make_iteration_fn(opt_off, False)
-        sc2 = opt_off.traced_scalars()
+    def _time_variant(opts):
+        it = _make_iteration_driver(opts, False)
+        sc = opts.traced_scalars()
 
-        def run2():
-            s2, ghof = it2(
-                states, jax.random.PRNGKey(1), cm, X, y, baseline, sc2
+        def run():
+            s2, ghof = it(
+                states, jax.random.PRNGKey(1), cm, X, y, baseline, sc
             )
             jax.block_until_ready(ghof.losses)
 
-        dt2 = _median_time(run2, reps=3)
-        out.append(
-            {
+        return _median_time(run, reps=3)
+
+    out = []
+    dt_chunked = None
+    variants = [
+        ("chunked5", dict(max_cycles_per_dispatch=5)),
+        ("chunked5_no_optimizer", dict(
+            max_cycles_per_dispatch=5, should_optimize_constants=False
+        )),
+        ("fused", {}),
+    ]
+    for dispatch, extra in variants:
+        try:
+            dt = _time_variant(make_options(**shape_kwargs, **extra))
+        except Exception as e:
+            _emit({
+                "suite": "search_iteration_northstar",
+                "case": case,
+                "dispatch": dispatch,
+                "error": f"{type(e).__name__}: {str(e)[:200]}",
+            }, out)
+            continue
+        if dispatch == "chunked5":
+            dt_chunked = dt
+        _emit({
+            "suite": "search_iteration_northstar",
+            "case": case,
+            "dispatch": dispatch,
+            "median_s": dt,
+            "candidate_evals_per_s": cand_evals / dt,
+        }, out)
+        if dispatch == "chunked5_no_optimizer" and dt_chunked:
+            _emit({
                 "suite": "search_iteration_northstar",
                 "case": "breakdown",
-                "full_s": dt,
-                "no_optimizer_s": dt2,
-                "bfgs_share": max(0.0, 1.0 - dt2 / dt),
-            }
-        )
-    except Exception as e:  # pragma: no cover
-        print(f"# northstar breakdown failed: {e}", file=sys.stderr)
+                "dispatch": "chunked5",
+                "full_s": dt_chunked,
+                "no_optimizer_s": dt,
+                "bfgs_share": max(0.0, 1.0 - dt / dt_chunked),
+            }, out)
     return out
 
 
@@ -386,36 +415,135 @@ def bench_precision_ratio():
     return out
 
 
-def main():
-    from bench import _devices_or_cpu_fallback
+# (fn, per-case subprocess timeout). northstar LAST: it is the one case
+# with a device-fault history (r04/r03), and even in its own process it
+# is the longest.
+_CASES = [
+    (bench_eval_fixed_tree, 600),
+    (bench_single_eval_48_nodes, 600),
+    (bench_population_scoring, 600),
+    (bench_search_iteration, 1200),
+    (bench_precision_ratio, 1200),
+    (bench_search_iteration_northstar, 4800),
+]
+_CASE_BY_NAME = {fn.__name__: (fn, t) for fn, t in _CASES}
 
-    devices = _devices_or_cpu_fallback(verbose=True, use_memo=True)  # hung-tunnel watchdog
-    platform = devices[0].platform
-    results = []
-    for fn in (
-        bench_eval_fixed_tree,
-        bench_single_eval_48_nodes,
-        bench_population_scoring,
-        bench_search_iteration,
-        bench_search_iteration_northstar,
-        bench_precision_ratio,  # keep last: flips jax_enable_x64
-    ):
-        try:
-            results.extend(fn())
-        except Exception as e:  # pragma: no cover
-            # stderr for the human; a JSON error entry for the record —
-            # a partially-failed suite must be visibly partial in the
-            # watcher's captured artifact, not silently missing entries
-            print(f"# {fn.__name__} failed: {e}", file=sys.stderr)
-            results.append(
-                {
-                    "suite": fn.__name__.removeprefix("bench_"),
-                    "error": f"{type(e).__name__}: {str(e)[:200]}",
-                }
+
+def _run_case_inline(fn):
+    """Run one case in THIS process, emitting rows incrementally."""
+    try:
+        rows = fn()
+    except Exception as e:  # pragma: no cover
+        print(f"# {fn.__name__} failed: {e}", file=sys.stderr)
+        _emit(
+            {
+                "suite": fn.__name__.removeprefix("bench_"),
+                "error": f"{type(e).__name__}: {str(e)[:200]}",
+            },
+            [],
+        )
+        return
+    for r in rows:
+        # northstar emits its own rows incrementally; everything else
+        # returns them. _emit de-dups nothing, so emit only rows that
+        # did not already go through it (they carry the platform stamp).
+        if "platform" not in r:
+            _emit(r, [])
+
+
+def main():
+    import argparse
+    import subprocess
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--case", default=None, help="child mode: one case")
+    ap.add_argument(
+        "--in-process", action="store_true",
+        help="run all cases in this process (no subprocess isolation)",
+    )
+    ap.add_argument(
+        "--isolate", action="store_true",
+        help="(default behavior; flag exists so the watcher's argv "
+        "records distinguish the isolated suite from pre-r5 captures)",
+    )
+    ns = ap.parse_args()
+
+    if ns.case or ns.in_process:
+        # child / legacy mode: this process owns the device
+        from bench import _devices_or_cpu_fallback
+
+        devices = _devices_or_cpu_fallback(verbose=True, use_memo=True)
+        _EMIT_PLATFORM[0] = devices[0].platform
+        if ns.case:
+            fn, _ = _CASE_BY_NAME[ns.case]
+            _run_case_inline(fn)
+        else:
+            # in-process: precision_ratio LAST — it flips the
+            # process-global jax_enable_x64 (subprocess isolation is
+            # what normally contains that)
+            ordered = sorted(
+                _CASES, key=lambda c: c[0] is bench_precision_ratio
             )
-    for r in results:
-        r["platform"] = platform
-        print(json.dumps(r))
+            for fn, _ in ordered:
+                _run_case_inline(fn)
+        return
+
+    # parent mode (default): one FRESH subprocess per case so a device
+    # fault (a faulted axon client wedges its process) costs exactly one
+    # case's rows, never the window's (VERDICT r4 weak #1: r04's
+    # northstar fault blanked precision_ratio). The parent deliberately
+    # never initializes jax — the tunnel has one slot and each child
+    # needs it.
+    script = os.path.abspath(__file__)
+    for fn, timeout in _CASES:
+        t0 = time.time()
+        # own process GROUP + killpg on timeout (same guard as
+        # scale_fault_bisect._run_stage / bench._probe_tpu_subprocess):
+        # a wedged axon client's helper processes must not keep holding
+        # the tunnel's one slot after the case is given up on
+        p = subprocess.Popen(
+            [sys.executable, script, "--case", fn.__name__],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            cwd=os.path.dirname(os.path.dirname(script)),
+            start_new_session=True,
+        )
+        try:
+            out, err = p.communicate(timeout=timeout)
+            rc = p.returncode
+        except subprocess.TimeoutExpired:
+            import signal as _signal
+
+            try:
+                os.killpg(p.pid, _signal.SIGKILL)
+            except Exception:
+                p.kill()
+            try:
+                out, err = p.communicate(timeout=10)
+            except Exception:
+                out, err = "", ""
+            rc, err = -9, "timeout"
+        # forward the child's JSON rows verbatim (they are the record)
+        emitted = 0
+        for line in (out or "").splitlines():
+            line = line.strip()
+            if line.startswith("{") and line.endswith("}"):
+                print(line, flush=True)
+                emitted += 1
+            elif line.startswith("#"):
+                print(line, file=sys.stderr)
+        if rc != 0:
+            tail = [ln for ln in (err or "").splitlines() if ln.strip()][-2:]
+            print(json.dumps({
+                "suite": fn.__name__.removeprefix("bench_"),
+                "error": f"case subprocess rc={rc}: "
+                         + " / ".join(tail)[:200],
+                "seconds": round(time.time() - t0, 1),
+            }), flush=True)
+        elif emitted == 0:
+            print(json.dumps({
+                "suite": fn.__name__.removeprefix("bench_"),
+                "error": "case subprocess produced no rows",
+            }), flush=True)
 
 
 if __name__ == "__main__":

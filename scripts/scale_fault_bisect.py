@@ -1,55 +1,140 @@
 #!/usr/bin/env python
-"""Bisect the at-scale (64-island) TPU device fault stage by stage.
+"""Bisect the at-scale (64x1000) TPU device fault, stage by stage.
 
-Background (2026-08-01): `equation_search` at npopulations>=64 dies on the
-real chip with `UNAVAILABLE: TPU device error — often a kernel fault`,
-while <=16x256 searches, the 16384-tree eval kernel, and the identical
-64x1000 program on XLA-CPU all run clean. The fault reproduces with
-eval_backend="jnp" and with the constant optimizer disabled, so it lives
-somewhere else in the jitted iteration. This script runs each stage of
-`api._make_iteration_fn`'s pipeline in a FRESH subprocess (a faulted TPU
-client wedges its process — later calls fail instantly) and reports
-OK/FAIL per stage, so one tunnel window pinpoints the faulting stage.
+History: `equation_search` at npopulations>=64 died on chip with
+`UNAVAILABLE: TPU device error` in rounds 3 and 4. Round 3's instance was
+an HBM OOM in the portable constant-opt path (fixed: 2048-instance
+chunking; confirmed gone by TPU-target compile-time memory analysis,
+BASELINE.md 2026-08-02). Round 4's recurrence is execution-level and
+undiagnosed: the same 64x1000x25 iteration (suite
+search_iteration_northstar) faulted at 15:58 2026-08-02 with the fix in
+the build, while every stage fits in HBM at compile time.
 
-Usage: python scripts/scale_fault_bisect.py [--islands 64] [--npop 256]
+This script localizes it. Each stage runs the EXACT suite-northstar
+configuration (binary +,-,*,/; unary cos,exp; npop 1000 x 64 islands x
+25 cycles; maxsize 20; 1x1000 gaussian-pdf dataset — matching
+benchmark/suite.py bench_search_iteration_northstar) in a FRESH
+subprocess group (a faulted TPU client wedges its process; a wedged axon
+client must not hold the tunnel slot), and reports one JSON line per
+stage so the tpu_watcher's `json` capture keeps every verdict even if a
+later stage kills the window.
+
+The `kernel_macro_*` duration ladder tests the leading hypothesis
+directly: every program that has ever completed on this tunnel runs a
+few seconds per device call; the northstar iteration is the only
+program shape that faults AND the only one whose single fused call runs
+much longer. The ladder runs the known-good eval kernel — nothing else —
+inside ONE jit call stretched to ~5 s / ~30 s / ~90 s / ~240 s of device
+time. If the fault is a per-call deadline in the tunnel/runtime, the
+ladder faults at some duration with zero search machinery involved; if
+the ladder is clean at 240 s, the fault is in a search stage and the
+stage rows below localize it.
+
+`full` is the exact fused single-call iteration the suite runs;
+`full_chunked` is the same iteration under max_cycles_per_dispatch=5
+(api._make_iteration_driver) — the production mitigation if long single
+calls are the trigger.
+
+Usage: python scripts/scale_fault_bisect.py [--islands 64] [--npop 1000]
+       [--stage NAME] [--skip-ladder]
 """
 
+import json
 import os
 import signal
 import subprocess
 import sys
 import time
 
-STAGE_CODE = """
+COMMON_SETUP = """
 import numpy as np, jax, jax.numpy as jnp
 import symbolicregression_jl_tpu as sr
 from symbolicregression_jl_tpu.models.options import make_options
-from symbolicregression_jl_tpu.models.evolve import (
-    s_r_cycle_islands, simplify_population_islands, optimize_islands_constants,
-)
-from symbolicregression_jl_tpu.parallel.migration import (
-    merge_hofs_across_islands,
-    migrate,
-)
-from symbolicregression_jl_tpu.api import _make_init_fn
 
 ISLANDS, NPOP, NCYC = {islands}, {npop}, {ncyc}
 STAGE = {stage!r}
+print("MARK platform=" + jax.devices()[0].platform, flush=True)
 
-options = make_options(
-    binary_operators=["+", "-", "*", "/"],
-    unary_operators=["cos", "exp", "sqrt", "square"],
-    npop=NPOP, npopulations=ISLANDS, ncycles_per_iteration=NCYC,
-    maxsize=18, seed=0,
+def northstar_options(**kw):
+    # EXACTLY benchmark/suite.py bench_search_iteration_northstar
+    base = dict(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp"],
+        npop=NPOP, npopulations=ISLANDS, ncycles_per_iteration=NCYC,
+        maxsize=20,
+    )
+    base.update(kw)
+    return make_options(**base)
+
+def northstar_dataset():
+    rng = np.random.default_rng(0)
+    theta = rng.uniform(1.0, 3.0, 1000).astype(np.float32)
+    X = jnp.asarray(theta[None, :])
+    y = jnp.asarray(
+        (np.exp(-(theta ** 2) / 2.0) / np.sqrt(2 * np.pi)).astype(np.float32)
+    )
+    baseline = jnp.float32(float(jnp.var(y)))
+    return X, y, baseline
+"""
+
+LADDER_CODE = COMMON_SETUP + """
+# Duration ladder: the production Pallas eval kernel (the program shape
+# proven at 1.0e9 t-r/s in every bench run) stretched to a target
+# single-call duration with a fori_loop. The tree constants depend on
+# the loop index so XLA cannot hoist the kernel out of the loop.
+import time
+from symbolicregression_jl_tpu.models.fitness import score_trees
+from symbolicregression_jl_tpu.models.mutate_device import (
+    gen_random_tree_fixed_size,
 )
-rng = np.random.default_rng(0)
-X = jnp.asarray(rng.uniform(1, 3, (2, 1000)).astype(np.float32))
-y = jnp.asarray(np.asarray(X[0] * X[1]))
-baseline = jnp.asarray(float(np.var(np.asarray(y))), jnp.float32)
+
+TARGET_S = {target_s}
+options = northstar_options()
+n_trees, n_rows = 8192, 1000
+sizes = jax.random.randint(jax.random.PRNGKey(1), (n_trees,), 3, 20)
+trees = jax.vmap(
+    lambda k, s: gen_random_tree_fixed_size(
+        k, s, 1, options.operators, options.max_len
+    )
+)(jax.random.split(jax.random.PRNGKey(0), n_trees), sizes)
+X, y, baseline = northstar_dataset()
+
+def one(i, acc):
+    t = trees._replace(cval=trees.cval + (acc * 0 + i).astype(jnp.float32) * 1e-9)
+    s, l = score_trees(t, X, y, None, baseline, options)
+    return acc + jnp.nansum(jnp.where(jnp.isfinite(l), l, 0.0))
+
+@jax.jit
+def macro(n):
+    return jax.lax.fori_loop(0, n, one, jnp.float32(0.0))
+
+# calibrate per-iter cost with a short call, then one long call
+t0 = time.time(); jax.block_until_ready(macro(3)); cal3 = time.time() - t0
+t0 = time.time(); jax.block_until_ready(macro(10)); cal = (time.time() - t0) / 10
+n = max(10, int(TARGET_S / max(cal, 1e-4)))
+print(f"MARK calibrated {{cal*1e3:.1f}} ms/iter -> n={{n}}", flush=True)
+t0 = time.time()
+jax.block_until_ready(macro(n))
+dt = time.time() - t0
+print(f"MARK ladder ok single_call_s={{dt:.1f}}", flush=True)
+"""
+
+STAGE_CODE = COMMON_SETUP + """
+from symbolicregression_jl_tpu.models.evolve import (
+    s_r_cycle_islands, simplify_population_islands,
+    optimize_islands_constants,
+)
+from symbolicregression_jl_tpu.parallel.migration import (
+    merge_hofs_across_islands, migrate,
+)
+from symbolicregression_jl_tpu.api import _make_init_fn
+
+options = northstar_options(**({opt_kwargs!r}))
+X, y, baseline = northstar_dataset()
 scalars = options.traced_scalars()
 keys = jax.random.split(jax.random.PRNGKey(0), ISLANDS)
 
-init = _make_init_fn(options, 2, False)
+init = _make_init_fn(options, 1, False)
 states = init(keys, X, y, baseline, scalars)
 jax.block_until_ready(states.pop.scores)
 print("MARK init ok", flush=True)
@@ -59,9 +144,9 @@ if STAGE == "init":
 curmaxsize = jnp.asarray(options.maxsize, jnp.int32)
 opts_b = options.bind_scalars(scalars)
 
-if STAGE in ("cycle", "cycle_long"):
+if STAGE.startswith("cycle"):
     f = jax.jit(lambda s: s_r_cycle_islands(
-        s, curmaxsize, X, y, None, baseline, opts_b))
+        s, curmaxsize, X, y, None, baseline, opts_b, ncycles=NCYC))
     states = f(states)
     jax.block_until_ready(states.pop.scores)
 elif STAGE == "simplify":
@@ -69,7 +154,7 @@ elif STAGE == "simplify":
         s, curmaxsize, X, y, None, baseline, opts_b))
     states = f(states)
     jax.block_until_ready(states.pop.scores)
-elif STAGE == "optimize":
+elif STAGE.startswith("optimize"):
     okeys = jax.random.split(jax.random.PRNGKey(1), ISLANDS)
     f = jax.jit(lambda k, s: optimize_islands_constants(
         k, s, X, y, None, baseline, opts_b))
@@ -82,26 +167,38 @@ elif STAGE == "merge_migrate":
     f = jax.jit(mm)
     states, ghof = f(jax.random.PRNGKey(2), states)
     jax.block_until_ready(ghof.losses)
-elif STAGE == "full":
-    from symbolicregression_jl_tpu.api import _make_iteration_fn
-    it = _make_iteration_fn(options, False)
+elif STAGE.startswith("full"):
+    from symbolicregression_jl_tpu.api import _make_iteration_driver
+    it = _make_iteration_driver(options, False)
     states, ghof = it(states, jax.random.PRNGKey(3), curmaxsize,
                       X, y, baseline, scalars)
     jax.block_until_ready(ghof.losses)
 print("MARK stage ok", flush=True)
 """
 
+# (name, ncyc override, options kwargs, timeout_s). ncyc matters only for
+# the cycle/full stages; 25 is the production northstar count.
 STAGES = [
-    ("init", 2), ("cycle", 2), ("cycle_long", 100), ("simplify", 2),
-    ("optimize", 2), ("merge_migrate", 2), ("full", 100),
+    ("init", 25, {}, 600),
+    ("kernel_macro_5s", 25, {"target_s": 5}, 600),
+    ("kernel_macro_30s", 25, {"target_s": 30}, 600),
+    ("kernel_macro_90s", 25, {"target_s": 90}, 900),
+    ("kernel_macro_240s", 25, {"target_s": 240}, 1200),
+    ("cycle_2", 2, {}, 900),
+    ("cycle_25", 25, {}, 1800),
+    ("cycle_2_jnp", 2, {"eval_backend": "jnp"}, 900),
+    ("simplify", 25, {}, 900),
+    ("optimize", 25, {}, 1800),
+    ("optimize_jnp", 25, {"optimizer_backend": "jnp"}, 1800),
+    ("merge_migrate", 25, {}, 600),
+    ("full_chunked", 25, {"max_cycles_per_dispatch": 5}, 2400),
+    ("full", 25, {}, 2400),
 ]
 
 
-def _run_stage(code, timeout=900):
-    """Run one stage in its own process GROUP and kill the whole group on
-    timeout — a wedged axon client must not keep holding the tunnel's one
-    slot after the probe gives up (same guard as tpu_watcher's
-    probe_platform)."""
+def _run_stage(code, timeout):
+    """Own process GROUP, killed wholesale on timeout — a wedged axon
+    client must not keep holding the tunnel's one slot."""
     p = subprocess.Popen(
         [sys.executable, "-c", code], stdout=subprocess.PIPE,
         stderr=subprocess.PIPE, text=True, start_new_session=True,
@@ -126,30 +223,64 @@ def main():
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--islands", type=int, default=64)
-    ap.add_argument("--npop", type=int, default=256)
-    ap.add_argument("--stage", choices=[s for s, _ in STAGES], default=None)
+    ap.add_argument("--npop", type=int, default=1000)
+    ap.add_argument("--stage", choices=[s[0] for s in STAGES], default=None)
+    ap.add_argument("--skip-ladder", action="store_true")
     ns = ap.parse_args()
-    for stage, ncyc in STAGES:
+    any_fail = False
+    for stage, ncyc, kwargs, timeout in STAGES:
         if ns.stage and stage != ns.stage:
             continue
+        if ns.skip_ladder and stage.startswith("kernel_macro"):
+            continue
         t0 = time.time()
-        code = STAGE_CODE.format(
-            islands=ns.islands, npop=ns.npop, ncyc=ncyc, stage=stage
+        if stage.startswith("kernel_macro"):
+            code = LADDER_CODE.format(
+                islands=ns.islands, npop=ns.npop, ncyc=ncyc, stage=stage,
+                target_s=kwargs["target_s"],
+            )
+        else:
+            code = STAGE_CODE.format(
+                islands=ns.islands, npop=ns.npop, ncyc=ncyc, stage=stage,
+                opt_kwargs=kwargs,
+            )
+        rc, out, err = _run_stage(code, timeout)
+        dt = round(time.time() - t0, 1)
+        marks = [ln for ln in (out or "").splitlines()
+                 if ln.startswith("MARK")]
+        plat = next(
+            (m.split("platform=", 1)[1] for m in marks if "platform=" in m),
+            None,
         )
-        rc, out, err = _run_stage(code)
         if rc is None:
-            print(f"{stage}: HANG (900s) — tunnel likely down", flush=True)
-            break
+            rec = {"bisect": stage, "ok": False, "hang": True,
+                   "seconds": dt, "timeout_s": timeout, "marks": marks,
+                   "platform": plat}
+            print(json.dumps(rec), flush=True)
+            # a hang usually means the tunnel died mid-stage: stop
+            # burning the window on stages that can no longer answer,
+            # and exit nonzero so the watcher retries the bisect in the
+            # next window (attempt-capped there)
+            print(json.dumps({"bisect": "verdict", "all_ok": False,
+                              "aborted_on_hang": stage}), flush=True)
+            raise SystemExit(2)
         ok = rc == 0 and (
             "MARK stage ok" in out
+            or "MARK ladder ok" in out
             or (stage == "init" and "MARK init ok" in out)
         )
-        tail = [ln for ln in (err or "").splitlines() if ln.strip()][-2:]
-        print(
-            f"{stage}: {'OK' if ok else 'FAIL'} {time.time() - t0:.0f}s"
-            + ("" if ok else f"  | {' / '.join(tail)[:200]}"),
-            flush=True,
-        )
+        any_fail = any_fail or not ok
+        tail = [ln for ln in (err or "").splitlines() if ln.strip()][-3:]
+        rec = {
+            "bisect": stage, "ok": ok, "rc": rc, "seconds": dt,
+            "islands": ns.islands, "npop": ns.npop, "marks": marks,
+            "platform": plat,
+        }
+        if not ok:
+            rec["err_tail"] = " / ".join(tail)[:400]
+        print(json.dumps(rec), flush=True)
+    print(json.dumps({"bisect": "verdict",
+                      "all_ok": not any_fail}), flush=True)
 
 
 if __name__ == "__main__":

@@ -55,15 +55,30 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RESULT_PATH = os.path.join(REPO, "BENCH_TPU_LATEST.json")
 SENTINEL = "/tmp/srtpu_watcher_capturing"
 
-# Round-4 order (VERDICT r3 #1/#2): after the two short canaries, the
-# 64x1000 north-star suite runs FIRST — it is the round's defining
-# artifact and has never completed on chip (the OOM fix was confirmed by
-# TPU-target memory analysis 2026-08-02: optimize temp 45GB -> 1.2GB).
-# The short kernel sweeps follow; feynman_scale goes last because its
-# --resume makes partial progress durable across tunnel windows, so it
-# can soak whatever chip time remains.
+# Round-5 order (VERDICT r4 #1/#2/#3): after the ONE short canary, the
+# scale-fault bisect runs FIRST — the 64x1000 northstar iteration has
+# faulted the chip two rounds running, and the bisect (fresh process per
+# stage, duration ladder for the long-single-call hypothesis, chunked-
+# dispatch mitigation stage) is the diagnosis loop built for exactly
+# this. The suite (now one fresh subprocess per case, northstar last,
+# chunked-first measurement) follows; then the remaining short sweep
+# (rows at 4096/8192); feynman_scale goes last because its --resume
+# makes partial progress durable across tunnel windows, so it can soak
+# whatever chip time remains. bench is known-good two rounds running —
+# it stays a canary but after the bisect so the window's first minutes
+# go to the unknown, not the known.
 STEPS = [
     # (name, argv, timeout_s, extra_env)
+    ("bench", [sys.executable, "bench.py"], 3000, None),
+    (
+        "scale_bisect",
+        [sys.executable, "scripts/scale_fault_bisect.py",
+         "--islands", "64", "--npop", "1000"],
+        10800,
+        None,
+    ),
+    ("suite", [sys.executable, "benchmark/suite.py", "--isolate"],
+     10800, None),
     (
         "tpu_tests",
         [sys.executable, "-m", "pytest", "tests/test_tpu_hardware.py",
@@ -71,29 +86,13 @@ STEPS = [
         3000,
         {"SRTPU_TPU_TESTS": "1"},
     ),
-    ("bench", [sys.executable, "bench.py"], 3000, None),
-    ("suite", [sys.executable, "benchmark/suite.py"], 7200, None),
-    # newest kernel variants only (--tail N = last N grid entries):
-    # the 3 scalar_pack probes + 4 top_carry combos. (The leaf_skip
-    # family was measured on-chip 2026-08-01: all regress; defaults
-    # unchanged.) An argv change here deliberately invalidates the
-    # previous record so the new variants re-run in the next window.
-    (
-        "kernel_tune_tail",
-        [sys.executable, "benchmark/kernel_tune.py", "--tail", "7"],
-        3000,
-        None,
-    ),
-    (
-        "opset_sweep",
-        [sys.executable, "benchmark/opset_sweep.py"],
-        3000,
-        None,
-    ),
-    # lane-utilization diagnostic for the in-search (256-row) regime
+    # lane-utilization: the 2026-08-02 capture showed rows=2048 at
+    # 1.39e9 > the 1024-row plateau — extend to 4096/8192 to find the
+    # true knee before re-shaping bench.py's headline config.
     (
         "rows_sweep",
-        [sys.executable, "benchmark/kernel_tune.py", "--rows-sweep"],
+        [sys.executable, "benchmark/kernel_tune.py", "--rows-sweep",
+         "--rows-max", "8192"],
         1800,
         None,
     ),
@@ -201,7 +200,8 @@ def step_on_chip(name, rec):
     a partially-finished suite still attributes its finished cases; the
     pytest tier passes only when not skipped; text-only steps count by
     exit code.)"""
-    if name in ("bench", "suite", "feynman_scale"):
+    if name in ("bench", "suite", "feynman_scale", "scale_bisect",
+                "rows_sweep"):
         plats = {j.get("platform") for j in rec["json"] if "platform" in j}
         return "tpu" in plats
     if name == "tpu_tests":

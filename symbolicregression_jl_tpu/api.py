@@ -244,6 +244,142 @@ def _make_iteration_fn(options: Options, has_weights: bool):
 
 
 @functools.lru_cache(maxsize=32)
+def _make_phase_fns(options: Options, has_weights: bool):
+    """Jitted per-phase sub-programs of one evolution iteration, for the
+    chunked-dispatch driver (options.max_cycles_per_dispatch): cycle
+    chunks, simplify, constant-opt passes, and merge+migrate each compile
+    as their OWN XLA program so no single device call runs longer than a
+    cycle chunk. With batching=False numerics match the fused
+    one_iteration exactly — the phases run in the same order on the same
+    arrays; the chunked cycle scan receives its slice of the one
+    iteration-wide annealing schedule and only the final chunk applies
+    the stats-window decay. (Under batching=True the minibatch key chain
+    restarts per chunk — deterministic and equally distributed draws,
+    but not bit-equal to the fused scan's; see the Options field doc.)"""
+
+    def _bind(scalars):
+        return options.bind_scalars(scalars)
+
+    def cycle_chunk(states, curmaxsize, X, y, weights, baseline, scalars,
+                    temperatures, is_last):
+        # `temperatures` values are traced, but the chunk LENGTH is part
+        # of the jit cache key (array shape) and `is_last` is static —
+        # so at most three compiles: full chunk, remainder chunk (when k
+        # doesn't divide ncycles), and the last chunk's is_last=True
+        # variant.
+        return s_r_cycle_islands(
+            states, curmaxsize, X, y, weights, baseline, _bind(scalars),
+            ncycles=temperatures.shape[0],
+            collect_events=options.recorder,
+            temperatures=temperatures,
+            apply_move_window=is_last,
+        )
+
+    def simplify(states, curmaxsize, X, y, weights, baseline, scalars):
+        return simplify_population_islands(
+            states, curmaxsize, X, y, weights, baseline, _bind(scalars)
+        )
+
+    def optimize(okeys, states, X, y, weights, baseline, scalars):
+        return optimize_islands_constants(
+            okeys, states, X, y, weights, baseline, _bind(scalars)
+        )
+
+    # the optimize-mutation pass's selection probability is static (it
+    # sizes the selected-member gather): derive it here exactly as the
+    # fused one_iteration does
+    _n_opt_mut = expected_optimize_count(options)
+    _p_sel = min(1.0, _n_opt_mut / options.npop) if _n_opt_mut > 0 else 0.0
+
+    def optimize_mut(okeys, states, X, y, weights, baseline, scalars):
+        return optimize_islands_constants(
+            okeys, states, X, y, weights, baseline, _bind(scalars),
+            probability=_p_sel, count_optimize_telemetry=True,
+        )
+
+    def merge_migrate(k_mig, states, scalars):
+        ghof = merge_hofs_across_islands(states.hof)
+        states = migrate(k_mig, states, ghof, _bind(scalars))
+        return states, ghof
+
+    return {
+        "cycle": jax.jit(cycle_chunk, static_argnames=("is_last",)),
+        "simplify": jax.jit(simplify),
+        "optimize": jax.jit(optimize),
+        "optimize_mut": jax.jit(optimize_mut),
+        "merge_migrate": jax.jit(merge_migrate),
+    }
+
+
+def _make_iteration_driver(options: Options, has_weights: bool):
+    """The production iteration entry: returns a callable with the same
+    signature/outputs as _make_iteration_fn's. With
+    options.max_cycles_per_dispatch=None (default) that IS the fused
+    single-jit iteration; with an int k it is a host-level driver issuing
+    phased dispatches of at most k cycles each (see _make_phase_fns)."""
+    k = options.max_cycles_per_dispatch
+    if k is None:
+        return _make_iteration_fn(options, has_weights)
+    fns = _make_phase_fns(options, has_weights)
+    ncycles = options.ncycles_per_iteration
+    # One iteration-wide schedule, built EXACTLY as s_r_cycle_islands
+    # builds it (jnp.linspace: f32 math — np.linspace computes in f64 and
+    # rounds differently for most lengths), sliced once at driver
+    # construction. Each (chunk, is_last) pair is fixed for the life of
+    # the driver.
+    if options.annealing and ncycles > 1:
+        _sched = jnp.linspace(1.0, 0.0, ncycles)
+    else:
+        _sched = jnp.ones((ncycles,))
+    _chunks = [
+        (_sched[pos:pos + k], pos + k >= ncycles)
+        for pos in range(0, ncycles, k)
+    ]
+
+    def driver(states, key, curmaxsize, X, y, *rest):
+        if has_weights:
+            weights, baseline, scalars = rest
+        else:
+            (baseline, scalars), weights = rest, None
+
+        k_mig, k_opt, k_opt_mut = jax.random.split(key, 3)
+        events_chunks = []
+        for chunk, is_last in _chunks:
+            out = fns["cycle"](
+                states, curmaxsize, X, y, weights, baseline, scalars,
+                chunk, is_last=is_last,
+            )
+            if options.recorder:
+                states, ev = out
+                events_chunks.append(ev)
+            else:
+                states = out
+        states = fns["simplify"](
+            states, curmaxsize, X, y, weights, baseline, scalars
+        )
+        I = states.birth_counter.shape[0]
+        if options.should_optimize_constants and options.optimizer_probability > 0:
+            states = fns["optimize"](
+                jax.random.split(k_opt, I), states, X, y, weights,
+                baseline, scalars,
+            )
+        if expected_optimize_count(options) > 0:
+            states = fns["optimize_mut"](
+                jax.random.split(k_opt_mut, I), states, X, y, weights,
+                baseline, scalars,
+            )
+        states, ghof = fns["merge_migrate"](k_mig, states, scalars)
+        if options.recorder:
+            events = jax.tree_util.tree_map(
+                lambda *xs: jnp.concatenate(xs, axis=0), *events_chunks
+            )
+            return states, ghof, events
+        return states, ghof
+
+    return driver
+
+
+@functools.lru_cache(maxsize=32)
 def _make_init_fn(options: Options, nfeatures: int, has_weights: bool):
     """Like _make_iteration_fn: the trailing REQUIRED `scalars` argument
     is `options.traced_scalars()` (initial scoring reads parsimony
@@ -462,7 +598,7 @@ def equation_search(
     mesh = make_mesh(options, I, row_shards=options.row_shards)
     t_start = time.time()
     early_stop = options.early_stop_fn()
-    iteration_fn = _make_iteration_fn(options, weights is not None)
+    iteration_fn = _make_iteration_driver(options, weights is not None)
     # this Options' trace-irrelevant scalar knobs, passed to every jitted
     # call (the factories' lru_caches dedup Options differing only in
     # these, so the values MUST come from here, not the closure)

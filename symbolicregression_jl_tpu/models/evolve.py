@@ -675,6 +675,8 @@ def s_r_cycle_islands(
     options: Options,
     ncycles: Optional[int] = None,
     collect_events: bool = False,
+    temperatures: Optional[Array] = None,
+    apply_move_window: bool = True,
 ):
     """ncycles fused evolution cycles over the annealing temperature
     schedule LinRange(1, 0) (reference src/SingleIteration.jl:17-61), all
@@ -688,12 +690,23 @@ def s_r_cycle_islands(
     draws fresh rows). options.independent_island_batches=True matches
     the reference exactly — an independent draw per island per cycle
     (src/LossFunctions.jl:95-115) — at the cost of the fused flat
-    scoring call (per-island vmapped scoring; no Pallas on that path)."""
+    scoring call (per-island vmapped scoring; no Pallas on that path).
+
+    `temperatures` overrides the internally-built schedule and
+    `apply_move_window=False` suppresses the end-of-iteration adaptive-
+    parsimony window decay: both exist for the chunked-dispatch driver
+    (api._make_iteration_driver), which splits one logical iteration's
+    cycle scan across several shorter jit calls — each chunk receives its
+    slice of the ONE iteration-wide LinRange(1,0) schedule, and only the
+    last chunk applies the once-per-iteration stats decay
+    (reference src/AdaptiveParsimony.jl move_window: once per cycle
+    group, not per scan chunk)."""
     ncycles = ncycles or options.ncycles_per_iteration
-    if options.annealing and ncycles > 1:
-        temperatures = jnp.linspace(1.0, 0.0, ncycles)
-    else:
-        temperatures = jnp.ones((ncycles,))
+    if temperatures is None:
+        if options.annealing and ncycles > 1:
+            temperatures = jnp.linspace(1.0, 0.0, ncycles)
+        else:
+            temperatures = jnp.ones((ncycles,))
 
     n_rows = X.shape[1]
     I = states.birth_counter.shape[0]
@@ -725,7 +738,8 @@ def s_r_cycle_islands(
 
     batch_key = jax.random.fold_in(states.key[0], 0x5F3759DF)
     (states, _), events = jax.lax.scan(step, (states, batch_key), temperatures)
-    states = states._replace(stats=jax.vmap(move_window)(states.stats))
+    if apply_move_window:
+        states = states._replace(stats=jax.vmap(move_window)(states.stats))
     if collect_events:
         return states, events
     return states

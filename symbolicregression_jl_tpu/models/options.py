@@ -238,6 +238,25 @@ class Options:
     # scale on TPU; "jnp" pins the vmapped-interpreter path; "pallas"
     # forces the fused path (TPU-only; requires BFGS + elementwise loss).
     optimizer_backend: str = "auto"
+    # Cap on evolution cycles fused into ONE jit dispatch. None (default)
+    # keeps the whole iteration — cycle scan + simplify + constant opt +
+    # migration — a single XLA program (minimum dispatch overhead; the
+    # right setting everywhere a single call stays short). An int k
+    # splits the iteration into phased dispatches with at most k cycles
+    # per call (api._make_iteration_driver): with batching=False
+    # (the default) results are bit-identical to the fused form (the
+    # chunks share one iteration-wide annealing schedule and a single
+    # end-of-iteration stats decay; tests/test_dispatch_chunking.py),
+    # at the cost of ~60-70 ms dispatch overhead per extra call on a
+    # tunneled device. With batching=True each chunk re-derives its
+    # minibatch key chain from the evolved state key, so the chunked
+    # path draws different (equally-distributed, deterministic)
+    # minibatch rows than the fused scan would.
+    # Exists because very-long-running single calls are where the
+    # at-scale (64x1000) TPU `UNAVAILABLE` device fault lives — shorter
+    # dispatches both bound per-call device time and localize a fault to
+    # a phase instead of poisoning the whole iteration.
+    max_cycles_per_dispatch: Optional[int] = None
     # Dataset-row sharding width of the device mesh: with row_shards=r the
     # mesh is (n_devices//r, r) (islands x rows) and X/y shard their row
     # dim, loss reductions becoming cross-chip psums (the mesh analog of
@@ -311,6 +330,11 @@ class Options:
             )
         if self.row_shards < 1:
             raise ValueError("row_shards must be >= 1")
+        if (
+            self.max_cycles_per_dispatch is not None
+            and self.max_cycles_per_dispatch < 1
+        ):
+            raise ValueError("max_cycles_per_dispatch must be >= 1 or None")
         if self.tournament_selection_n > self.npop:
             raise ValueError("tournament_selection_n must be <= npop")
         # build and cache derived structures
@@ -411,6 +435,9 @@ class Options:
             None if self.loss_function is None else id(self.loss_function),
             # recorder mode adds the event-collection outputs to the graph
             self.recorder,
+            # dispatch chunking changes which compiled programs exist
+            # (fused single call vs phased sub-programs)
+            self.max_cycles_per_dispatch,
         )
 
     def traced_scalars(self) -> Tuple:

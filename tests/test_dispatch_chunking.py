@@ -1,0 +1,152 @@
+"""Chunked-dispatch iteration driver (options.max_cycles_per_dispatch):
+phased dispatches must reproduce the fused single-jit iteration exactly.
+
+The knob exists for the at-scale TPU fault story (BASELINE.md): a 64x1000
+iteration as ONE device call is the only program shape that has ever
+faulted the chip, so the production driver can split it into bounded
+calls — but only if the split is a pure dispatch decision with zero
+numerical effect. These tests pin that equivalence (annealing ON so the
+iteration-wide LinRange(1,0) schedule slicing is exercised, ncycles not
+divisible by the chunk so the remainder path runs).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.api import (
+    _make_init_fn,
+    _make_iteration_driver,
+    _make_iteration_fn,
+)
+from symbolicregression_jl_tpu.models.options import make_options
+
+
+def _opts(**kw):
+    base = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        npop=12,
+        npopulations=3,
+        ncycles_per_iteration=7,
+        tournament_selection_n=4,
+        maxsize=10,
+        annealing=True,
+        seed=0,
+    )
+    base.update(kw)
+    return make_options(**base)
+
+
+def _setup(options):
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((2, 64)).astype(np.float32))
+    y = jnp.asarray(np.asarray(2.0 * jnp.cos(X[1]) + X[0]))
+    baseline = jnp.float32(float(jnp.var(y)))
+    init = _make_init_fn(options, 2, False)
+    scalars = options.traced_scalars()
+    states = init(
+        jax.random.split(jax.random.PRNGKey(0), options.npopulations),
+        X, y, baseline, scalars,
+    )
+    return states, X, y, baseline, scalars
+
+
+@pytest.mark.fast
+def test_chunked_matches_fused():
+    fused_o = _opts()
+    chunk_o = _opts(max_cycles_per_dispatch=3)  # 7 cycles -> 3+3+1
+    states, X, y, baseline, scalars = _setup(fused_o)
+    cm = jnp.int32(fused_o.maxsize)
+    key = jax.random.PRNGKey(7)
+
+    s1, g1 = _make_iteration_fn(fused_o, False)(
+        states, key, cm, X, y, baseline, scalars
+    )
+    s2, g2 = _make_iteration_driver(chunk_o, False)(
+        states, key, cm, X, y, baseline, scalars
+    )
+
+    np.testing.assert_array_equal(np.asarray(g1.losses), np.asarray(g2.losses))
+    for a, b in zip(jax.tree_util.tree_leaves(g1.trees),
+                    jax.tree_util.tree_leaves(g2.trees)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # every leaf of the island state — populations, HoFs, adaptive-
+    # parsimony stats windows, PRNG keys, telemetry — must be bit-equal
+    for a, b in zip(jax.tree_util.tree_leaves(s1),
+                    jax.tree_util.tree_leaves(s2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.fast
+def test_chunked_driver_is_fused_when_unset():
+    o = _opts()
+    assert _make_iteration_driver(o, False) is _make_iteration_fn(o, False)
+
+
+@pytest.mark.fast
+def test_chunked_recorder_events_concatenate():
+    chunk_o = _opts(max_cycles_per_dispatch=4, recorder=True)
+    fused_o = _opts(recorder=True)
+    states, X, y, baseline, scalars = _setup(fused_o)
+    cm = jnp.int32(fused_o.maxsize)
+    key = jax.random.PRNGKey(3)
+
+    s1, g1, ev1 = _make_iteration_fn(fused_o, False)(
+        states, key, cm, X, y, baseline, scalars
+    )
+    s2, g2, ev2 = _make_iteration_driver(chunk_o, False)(
+        states, key, cm, X, y, baseline, scalars
+    )
+    for a, b in zip(jax.tree_util.tree_leaves(ev1),
+                    jax.tree_util.tree_leaves(ev2)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(g1.losses), np.asarray(g2.losses))
+
+
+@pytest.mark.fast
+def test_chunked_batching_runs_deterministically():
+    """batching=True under chunking: NOT bit-equal to fused (each chunk
+    re-derives its minibatch key chain — documented on the Options
+    field), but it must run and be deterministic call-over-call."""
+    o = _opts(max_cycles_per_dispatch=3, batching=True, batch_size=16)
+    states, X, y, baseline, scalars = _setup(o)
+    cm = jnp.int32(o.maxsize)
+    key = jax.random.PRNGKey(11)
+    drv = _make_iteration_driver(o, False)
+    _, g1 = drv(states, key, cm, X, y, baseline, scalars)
+    _, g2 = drv(states, key, cm, X, y, baseline, scalars)
+    np.testing.assert_array_equal(np.asarray(g1.losses), np.asarray(g2.losses))
+    assert np.isfinite(np.asarray(g1.losses)).any()
+
+
+@pytest.mark.fast
+def test_chunked_equation_search_end_to_end():
+    """The knob through the public API: same tiny search, fused vs
+    chunked, identical hall of fame."""
+    import symbolicregression_jl_tpu as sr
+
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((2, 48)).astype(np.float32)
+    y = (X[0] * X[0] + 0.5).astype(np.float32)
+    common = dict(
+        binary_operators=["+", "*"],
+        npop=10,
+        npopulations=2,
+        ncycles_per_iteration=5,
+        tournament_selection_n=4,
+        maxsize=8,
+        progress=False,
+        verbosity=0,
+        save_to_file=False,
+        seed=0,
+        deterministic=True,
+    )
+    h1 = sr.equation_search(X, y, niterations=2, **common)
+    h2 = sr.equation_search(
+        X, y, niterations=2, max_cycles_per_dispatch=2, **common
+    )
+    b1, b2 = h1.best(), h2.best()
+    assert b1.loss == b2.loss
+    assert b1.equation == b2.equation

@@ -110,9 +110,9 @@ def test_non_dict_record_skipped(watcher):
 
 
 def test_argv_mismatch_is_stale(watcher):
-    rec = clean_rec(watcher, "kernel_tune_tail")
-    rec["argv"] = rec["argv"][:-1] + ["5"]  # old --tail width
-    write_capture(watcher, {"kernel_tune_tail": rec})
+    rec = clean_rec(watcher, "rows_sweep")
+    rec["argv"] = rec["argv"][:-2]  # pre-r5 sweep without --rows-max
+    write_capture(watcher, {"rows_sweep": rec})
     done, _, _ = resume_state(watcher)
     assert done == set()
 
@@ -133,26 +133,28 @@ def test_orphan_step_name_is_stale(watcher):
 
 
 def test_exhausted_partial_not_rerun_and_attempts_restored(watcher):
-    bad = clean_rec(watcher, "opset_sweep")
+    bad = clean_rec(watcher, "scale_bisect")
     bad.update(partial=True, rc=1, on_chip=False,
                attempts=watcher.MAX_ATTEMPTS)
     retry = clean_rec(watcher, "suite")
     retry.update(partial=True, rc=1, attempts=1)
-    write_capture(watcher, {"opset_sweep": bad, "suite": retry})
+    write_capture(watcher, {"scale_bisect": bad, "suite": retry})
     done, attempts, _ = resume_state(watcher)
-    assert done == {"opset_sweep"}  # cap hit: recorded, never re-run
+    assert done == {"scale_bisect"}  # cap hit: recorded, never re-run
     assert attempts["suite"] == 1  # cap continues, not reset
 
 
-def test_step_order_round4_policy(watcher):
-    """Short canaries first, then the north-star suite (the round's
-    defining artifact — VERDICT r3 #1), then the short sweeps;
-    feynman_scale last because its per-case --resume makes it the only
-    step whose partial progress survives a tunnel drop."""
+def test_step_order_round5_policy(watcher):
+    """One short canary (bench), then the scale-fault bisect FIRST —
+    localizing the two-round 64x1000 fault is the round's defining job
+    (VERDICT r4 #1) — then the isolated suite whose northstar rows the
+    bisect unblocks; feynman_scale last because its per-case --resume
+    makes it the only step whose partial progress survives a tunnel
+    drop."""
     names = [s[0] for s in watcher.STEPS]
-    assert names.index("tpu_tests") < names.index("bench")
-    assert names.index("bench") < names.index("suite")
-    assert names.index("suite") < names.index("kernel_tune_tail")
+    assert names.index("bench") < names.index("scale_bisect")
+    assert names.index("scale_bisect") < names.index("suite")
+    assert names.index("suite") < names.index("tpu_tests")
     assert names[-1] == "feynman_scale"
 
 
