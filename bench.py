@@ -384,12 +384,18 @@ _PROBE_BACKOFFS = _parse_schedule(
 # the tunnel passes the probe then hangs the init) and the common hang
 # mode costs one probe timeout. A healthy tunnel probes in ~3-25 s and,
 # once probed, inits in seconds.
+# The shipped default, exposed as its own constant so tests can assert
+# the bound directly instead of regex-scanning source text; the effective
+# _PROBE_TIMEOUT still honors SRTPU_BENCH_PROBE_TIMEOUT at import time.
+_PROBE_TIMEOUT_DEFAULT = 55.0
 try:
     _PROBE_TIMEOUT = float(
-        os.environ.get("SRTPU_BENCH_PROBE_TIMEOUT", "55")
+        os.environ.get(
+            "SRTPU_BENCH_PROBE_TIMEOUT", str(_PROBE_TIMEOUT_DEFAULT)
+        )
     )
 except ValueError:
-    _PROBE_TIMEOUT = 55.0
+    _PROBE_TIMEOUT = _PROBE_TIMEOUT_DEFAULT
 _INIT_TIMEOUT = 60.0  # in-process backend init watchdog
 
 
@@ -675,7 +681,13 @@ def _devices_or_cpu_fallback(verbose, use_memo=False):
                     # xla_bridge's one-shot init holding its lock;
                     # cpu-fallback: the backend initialized, but as CPU.
                     # Either way nothing in this process can init the
-                    # TPU backend again — continue in a fresh one.
+                    # TPU backend again — continue in a fresh one. As in
+                    # the memo-up branch above: live evidence just showed
+                    # the tunnel poisoned, so drop any memo before the
+                    # re-exec — a sibling suite child trusting a stale
+                    # 'up' would burn a full init timeout on this same
+                    # known-poisoned tunnel.
+                    _clear_memo()
                     _reexec(0)
             # two init errors in a row → fall through to the schedule
             # loop from slot 0 (its zero sleep is still right: the
@@ -718,9 +730,14 @@ def _devices_or_cpu_fallback(verbose, use_memo=False):
             rec["result"] = f"probe-ok-{kind}"
             # as in the fast path: a hang (or a silent CPU init) poisons
             # this process's backend forever; an init error is retryable
-            # in-process
-            if kind in ("init-hung", "cpu-fallback") and i + 1 < n:
-                _reexec(i + 1)
+            # in-process. Clear the memo either way (even when the
+            # schedule is exhausted and no re-exec follows): the tunnel
+            # just proved poisoned, and sibling entry points must
+            # re-probe rather than inherit a stale 'up'.
+            if kind in ("init-hung", "cpu-fallback"):
+                _clear_memo()
+                if i + 1 < n:
+                    _reexec(i + 1)
         elif plat == "cpu":
             return _pin_cpu_absent()
         # A hang may heal with time. Three identical fast errors in a row

@@ -99,8 +99,19 @@ def main():
         # (2026-08-02 capture: 2048 rows -> 1.39e9, ABOVE the 1024-row
         # plateau — hence --rows-max to find the knee)
         rng = np.random.default_rng(0)
-        sweep = [r for r in (128, 256, 512, 1024, 2048, 4096, 8192)
-                 if r <= rows_max]
+        sweep_points = (128, 256, 512, 1024, 2048, 4096, 8192)
+        sweep = [r for r in sweep_points if r <= rows_max]
+        if not sweep:
+            # exiting 0 with no JSON rows would read as a clean-but-empty
+            # capture to the watcher; make a filtered-to-nothing sweep an
+            # explicit operator error instead
+            print(
+                f"# --rows-max {rows_max} filters the rows sweep to "
+                f"empty (smallest sweep point is {sweep_points[0]}); "
+                "no measurements to run",
+                file=sys.stderr,
+            )
+            sys.exit(2)
         for nrows in sweep:
             Xr = jnp.asarray(
                 rng.uniform(1.0, 3.0, nrows).astype("f4")[None, :]

@@ -415,6 +415,58 @@ def bench_precision_ratio():
     return out
 
 
+def bench_fitness_cache():
+    """Evaluation memo bank (ISSUE 1): a seeded search with
+    cache_fitness=True, reporting per-iteration unique-ratio, memo hit
+    rate and eval-batch shrinkage, cached-vs-uncached wall time, and the
+    bit-identical hall-of-fame check (docs/memo_bank.md guarantee)."""
+    import symbolicregression_jl_tpu as sr
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((3, 256)).astype(np.float32)
+    y = 2.0 * np.cos(X[2]) + X[0] ** 2 - 0.5
+    kw = dict(
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        npopulations=6, npop=33, ncycles_per_iteration=80, maxsize=16,
+        seed=3, verbosity=0, progress=False, niterations=5,
+    )
+    t0 = time.perf_counter()
+    r_off = sr.equation_search(X, y, **kw)
+    uncached_s = time.perf_counter() - t0
+    sr.clear_memo_banks()  # cold bank: measure warm-up behavior too
+    t0 = time.perf_counter()
+    r_on = sr.equation_search(X, y, cache_fitness=True, **kw)
+    cached_s = time.perf_counter() - t0
+
+    frontier = lambda r: [
+        (c.complexity, float(c.loss), float(c.score), c.equation)
+        for c in r.frontier()
+    ]
+    out = []
+    for row in r_on.cache_stats["per_iteration"]:
+        out.append({
+            "suite": "fitness_cache",
+            "case": f"iteration{row['iteration'] + 1}",
+            "scored": row["scored"],
+            "unique": row["unique"],
+            "memo_hits": row["memo_hits"],
+            "evaluated": row["evaluated"],
+            "unique_ratio": row["unique_ratio"],
+            "memo_hit_rate": row["memo_hit_rate"],
+            # 1 - fill = eval-batch shrinkage the dedup realized
+            "eval_batch_fill": row["eval_batch_fill"],
+        })
+    out.append({
+        "suite": "fitness_cache",
+        "case": "summary",
+        "hof_identical": frontier(r_off) == frontier(r_on),
+        "uncached_s": uncached_s,
+        "cached_s": cached_s,
+        **r_on.cache_stats["totals"],
+    })
+    return out
+
+
 # (fn, per-case subprocess timeout). northstar LAST: it is the one case
 # with a device-fault history (r04/r03), and even in its own process it
 # is the longest.
@@ -423,6 +475,7 @@ _CASES = [
     (bench_single_eval_48_nodes, 600),
     (bench_population_scoring, 600),
     (bench_search_iteration, 1200),
+    (bench_fitness_cache, 1200),
     (bench_precision_ratio, 1200),
     (bench_search_iteration_northstar, 4800),
 ]
@@ -507,9 +560,42 @@ def main():
             cwd=os.path.dirname(os.path.dirname(script)),
             start_new_session=True,
         )
+        # Stream-forward the child's stdout LINE BY LINE instead of
+        # buffering via communicate(): rows a case emitted before a
+        # mid-case kill (watcher window close, this parent's own
+        # timeout) are already part of the record instead of dying in
+        # the pipe. The reader threads keep both pipes drained (no
+        # deadlock on a full stderr buffer); the timeout wraps the
+        # readline loops via p.wait.
+        import threading
+
+        emitted = [0]
+        err_lines = []
+        err_frozen = threading.Event()
+
+        def _pump_stdout(stream=p.stdout):
+            for line in stream:
+                line = line.strip()
+                # forward the child's JSON rows verbatim (they are the
+                # record)
+                if line.startswith("{") and line.endswith("}"):
+                    print(line, flush=True)
+                    emitted[0] += 1
+                elif line.startswith("#"):
+                    print(line, file=sys.stderr)
+
+        def _pump_stderr(stream=p.stderr):
+            for line in stream:
+                if not err_frozen.is_set():
+                    err_lines.append(line.rstrip("\n"))
+
+        t_out = threading.Thread(target=_pump_stdout, daemon=True)
+        t_err = threading.Thread(target=_pump_stderr, daemon=True)
+        t_out.start()
+        t_err.start()
+        timed_out = False
         try:
-            out, err = p.communicate(timeout=timeout)
-            rc = p.returncode
+            rc = p.wait(timeout=timeout)
         except subprocess.TimeoutExpired:
             import signal as _signal
 
@@ -518,19 +604,22 @@ def main():
             except Exception:
                 p.kill()
             try:
-                out, err = p.communicate(timeout=10)
+                p.wait(timeout=10)
             except Exception:
-                out, err = "", ""
-            rc, err = -9, "timeout"
-        # forward the child's JSON rows verbatim (they are the record)
-        emitted = 0
-        for line in (out or "").splitlines():
-            line = line.strip()
-            if line.startswith("{") and line.endswith("}"):
-                print(line, flush=True)
-                emitted += 1
-            elif line.startswith("#"):
-                print(line, file=sys.stderr)
+                pass
+            rc, timed_out = -9, True
+        # helper grandchildren may inherit the pipes and keep them open
+        # after the kill: bounded joins, never a hang
+        t_out.join(timeout=10)
+        t_err.join(timeout=10)
+        if timed_out:
+            # AFTER the joins AND with the pump frozen: a grandchild
+            # that kept the pipe open past the bounded join must not
+            # push the kill reason out of the reported 2-line tail
+            err_frozen.set()
+            err_lines[:] = ["timeout"]
+        err = "\n".join(err_lines)
+        emitted = emitted[0]
         if rc != 0:
             tail = [ln for ln in (err or "").splitlines() if ln.strip()][-2:]
             print(json.dumps({

@@ -75,6 +75,9 @@ from .models.population import (
     init_population,
 )
 
+# Evaluation memo bank (opt-in via Options.cache_fitness).
+from .cache import FitnessMemoBank, clear_memo_banks, tree_hash_host
+
 __version__ = "0.1.0"
 
 # Populated lazily to avoid importing heavy modules at package import:
@@ -140,4 +143,7 @@ __all__ = [
     "simplify_tree",
     "combine_operators",
     "s_r_cycle",
+    "FitnessMemoBank",
+    "clear_memo_banks",
+    "tree_hash_host",
 ]

@@ -85,6 +85,10 @@ class EquationSearchResult:
     state: Optional[List[SearchState]] = None
     num_evals: float = 0.0
     search_time_s: float = 0.0
+    # evaluation memo-bank telemetry (options.cache_fitness; None
+    # otherwise): {"totals": {scored, unique, memo_hits, evaluated,
+    # hit_rate, unique_ratio}, "per_iteration": [...], "banks": [...]}
+    cache_stats: Optional[dict] = None
 
     @property
     def multi_output(self) -> bool:
@@ -184,7 +188,19 @@ def _make_iteration_fn(options: Options, has_weights: bool):
     knobs; the caller's own values must flow in at every call.
 
     With options.recorder the returned function yields a third output:
-    the per-cycle MutationEvents for the lineage recorder."""
+    the per-cycle MutationEvents for the lineage recorder.
+
+    With options.cache_fitness the function takes ONE more trailing
+    argument — the cache.DeviceMemo snapshot of the host memo bank
+    (traced: a refreshed snapshot per iteration costs zero recompiles) —
+    and yields ONE more trailing output: the post-simplify
+    (trees, losses) absorb snapshot. The snapshot is captured AFTER the
+    full-data rescore and BEFORE constant optimization on purpose: the
+    optimizer writes its own objective's f_best into pop.losses, and
+    that value can differ in ULPs from what the scoring path computes
+    for the same tree (different kernel/reduction order on TPU) — the
+    bank must only ever hold scoring-path values or a later memo hit
+    would break the bit-identity guarantee."""
 
     def one_iteration(
         states: IslandState,
@@ -195,6 +211,7 @@ def _make_iteration_fn(options: Options, has_weights: bool):
         weights,
         baseline: Array,
         scalars,
+        memo=None,
     ):
         options_ = options.bind_scalars(scalars)
         k_mig, k_opt, k_opt_mut = jax.random.split(key, 3)
@@ -202,13 +219,25 @@ def _make_iteration_fn(options: Options, has_weights: bool):
         # whole archipelago (Pallas-sized batches on TPU). Static,
         # graph-shaping decisions (recorder, optimizer gating) read the
         # closure `options`; everything numeric reads the bound copy.
+        # The memo is served ONLY to the population rescore below, never
+        # to the cycle scan: the rescore's batch shape (I*npop) is the
+        # same shape the absorb snapshot was scored at, so with
+        # eval_backend='auto' both resolve to the SAME kernel — serving
+        # a Pallas-computed value into a jnp-sized children batch would
+        # be ULP-wrong on TPU. The cycle scan still dedups intra-batch.
         out = s_r_cycle_islands(
             states, curmaxsize, X, y, weights, baseline, options_,
             collect_events=options.recorder,
         )
         states, events = out if options.recorder else (out, None)
         states = simplify_population_islands(
-            states, curmaxsize, X, y, weights, baseline, options_
+            states, curmaxsize, X, y, weights, baseline, options_,
+            memo=memo,
+        )
+        # scoring-path-only values for the memo bank (see factory doc)
+        absorb_snap = (
+            (states.pop.trees, states.pop.losses)
+            if options.cache_fitness else None
         )
         if options.should_optimize_constants and options.optimizer_probability > 0:
             I = states.birth_counter.shape[0]
@@ -230,10 +259,22 @@ def _make_iteration_fn(options: Options, has_weights: bool):
             )
         ghof = merge_hofs_across_islands(states.hof)
         states = migrate(k_mig, states, ghof, options_)
+        outs = (states, ghof)
         if options.recorder:
-            return states, ghof, events
-        return states, ghof
+            outs = outs + (events,)
+        if options.cache_fitness:
+            outs = outs + (absorb_snap,)
+        return outs
 
+    if options.cache_fitness:
+        if has_weights:
+            return jax.jit(one_iteration)
+        return jax.jit(
+            lambda states, key, cm, X, y, baseline, scalars, memo:
+            one_iteration(
+                states, key, cm, X, y, None, baseline, scalars, memo
+            )
+        )
     if has_weights:
         return jax.jit(one_iteration)
     return jax.jit(
@@ -266,7 +307,10 @@ def _make_phase_fns(options: Options, has_weights: bool):
         # of the jit cache key (array shape) and `is_last` is static —
         # so at most three compiles: full chunk, remainder chunk (when k
         # doesn't divide ncycles), and the last chunk's is_last=True
-        # variant.
+        # variant. The memo bank feeds only the simplify phase (see
+        # _make_iteration_fn: cycle batches resolve eval_backend='auto'
+        # at a different batch size than the rescore the bank's values
+        # came from).
         return s_r_cycle_islands(
             states, curmaxsize, X, y, weights, baseline, _bind(scalars),
             ncycles=temperatures.shape[0],
@@ -275,9 +319,11 @@ def _make_phase_fns(options: Options, has_weights: bool):
             apply_move_window=is_last,
         )
 
-    def simplify(states, curmaxsize, X, y, weights, baseline, scalars):
+    def simplify(states, curmaxsize, X, y, weights, baseline, scalars,
+                 memo=None):
         return simplify_population_islands(
-            states, curmaxsize, X, y, weights, baseline, _bind(scalars)
+            states, curmaxsize, X, y, weights, baseline, _bind(scalars),
+            memo=memo,
         )
 
     def optimize(okeys, states, X, y, weights, baseline, scalars):
@@ -337,6 +383,8 @@ def _make_iteration_driver(options: Options, has_weights: bool):
     ]
 
     def driver(states, key, curmaxsize, X, y, *rest):
+        rest = list(rest)
+        memo = rest.pop() if options.cache_fitness else None
         if has_weights:
             weights, baseline, scalars = rest
         else:
@@ -355,7 +403,14 @@ def _make_iteration_driver(options: Options, has_weights: bool):
             else:
                 states = out
         states = fns["simplify"](
-            states, curmaxsize, X, y, weights, baseline, scalars
+            states, curmaxsize, X, y, weights, baseline, scalars,
+            memo=memo,
+        )
+        # post-simplify, pre-optimize: scoring-path values only (same
+        # capture point as the fused one_iteration's absorb snapshot)
+        absorb_snap = (
+            (states.pop.trees, states.pop.losses)
+            if options.cache_fitness else None
         )
         I = states.birth_counter.shape[0]
         if options.should_optimize_constants and options.optimizer_probability > 0:
@@ -369,12 +424,15 @@ def _make_iteration_driver(options: Options, has_weights: bool):
                 baseline, scalars,
             )
         states, ghof = fns["merge_migrate"](k_mig, states, scalars)
+        outs = (states, ghof)
         if options.recorder:
             events = jax.tree_util.tree_map(
                 lambda *xs: jnp.concatenate(xs, axis=0), *events_chunks
             )
-            return states, ghof, events
-        return states, ghof
+            outs = outs + (events,)
+        if options.cache_fitness:
+            outs = outs + (absorb_snap,)
+        return outs
 
     return driver
 
@@ -641,6 +699,38 @@ def equation_search(
     out_keys = []          # per-output PRNG stream
     start_iters = []
 
+    # ---- evaluation memo bank (options.cache_fitness) ----
+    use_cache = options.cache_fitness
+    banks: List[Optional[object]] = []
+    if use_cache:
+        from .cache.memo import dataset_fingerprint, get_memo_bank
+
+        for j in range(nout):
+            # one bank per evaluation context. Multi-host runs keep the
+            # intra-batch dedup but skip the host bank (every host must
+            # feed the SPMD program an identical memo snapshot, and the
+            # empty one is the only snapshot that is free to agree on).
+            # A custom full-tree loss_function also skips it: serving is
+            # already bypassed in score_trees_cached, and absorbing its
+            # objective values under an elementwise-loss fingerprint
+            # would poison a later search's bank.
+            if jax.process_count() == 1 and options.loss_function is None:
+                banks.append(
+                    get_memo_bank(
+                        dataset_fingerprint(X, ys[j], weights, options),
+                        options.cache_capacity,
+                    )
+                )
+            else:
+                banks.append(None)
+    # cumulative per-output [scored, unique, memo_hits] for per-iteration
+    # deltas (IslandState.cache_counts is cumulative on device);
+    # cache_base holds the resume baseline so a saved_state's carried
+    # counts are excluded from THIS search's reported totals
+    cache_prev = [np.zeros(3, np.int64) for _ in range(nout)]
+    cache_base = [np.zeros(3, np.int64) for _ in range(nout)]
+    cache_iter_rows: List[dict] = []
+
     for j in range(nout):
         ds = make_dataset(
             X, ys[j], weights, variable_names, dtype=options.dtype
@@ -700,6 +790,13 @@ def equation_search(
             ghof = merge_hofs_across_islands(states.hof)
             start_iter = 0
         states = shard_island_states(states, mesh, options)
+        if use_cache:
+            # a resumed saved_state carries its run's cumulative counters:
+            # baseline both the delta tracking and the totals on them
+            cache_prev[j] = np.asarray(
+                jnp.sum(states.cache_counts, axis=0), np.int64
+            )
+            cache_base[j] = cache_prev[j].copy()
         out_data.append((Xj, yj, wj, bl))
         live_states.append(states)
         live_hofs.append(ghof)
@@ -733,10 +830,35 @@ def equation_search(
             cm = jnp.int32(_curmaxsize(options, it, max(niterations, 1)))
             out_keys[j], k_it = jax.random.split(out_keys[j])
             t_dev = time.time()
-            if wj is not None:
-                out = iteration_fn(states, k_it, cm, Xj, yj, wj, bl, scalars)
+            if use_cache:
+                # refreshed device snapshot of the memo bank (traced
+                # arguments: same shapes every iteration, no recompile)
+                if banks[j] is not None:
+                    memo = banks[j].device_snapshot(
+                        options.cache_device_slots, options.dtype
+                    )
+                else:
+                    from .cache.dedup import empty_device_memo
+
+                    memo = empty_device_memo(
+                        options.cache_device_slots, options.dtype
+                    )
+                memo_args = (memo,)
             else:
-                out = iteration_fn(states, k_it, cm, Xj, yj, bl, scalars)
+                memo_args = ()
+            if wj is not None:
+                out = iteration_fn(
+                    states, k_it, cm, Xj, yj, wj, bl, scalars, *memo_args
+                )
+            else:
+                out = iteration_fn(
+                    states, k_it, cm, Xj, yj, bl, scalars, *memo_args
+                )
+            if use_cache:
+                absorb_snap = out[-1]
+                out = out[:-1]
+            else:
+                absorb_snap = None
             if options.recorder:
                 states, ghof, events = out
             else:
@@ -747,12 +869,58 @@ def equation_search(
             live_hofs[j] = ghof
 
             # ---- host-side orchestration (off the hot path) ----
+            cache_row = None
+            if use_cache:
+                # absorb the post-simplify snapshot — the full-data,
+                # SCORING-PATH rescore of every member, captured before
+                # constant optimization overwrote selected losses with
+                # its own objective's values (see _make_iteration_fn
+                # doc: the bank must only ever hold values the scoring
+                # path itself produces, bit-for-bit — this also makes
+                # the absorb safe under batching=True, where the
+                # snapshot is still a full-data rescore).
+                if banks[j] is not None and absorb_snap is not None:
+                    from .cache.hashing import tree_hash_host
+
+                    snap_trees, snap_losses = absorb_snap
+                    snap_trees = jax.tree_util.tree_map(
+                        np.asarray, snap_trees
+                    )
+                    banks[j].absorb(
+                        tree_hash_host(snap_trees).ravel(),
+                        np.asarray(snap_losses).ravel(),
+                    )
+                counts = np.asarray(
+                    jnp.sum(states.cache_counts, axis=0), np.int64
+                )
+                delta = counts - cache_prev[j]
+                cache_prev[j] = counts
+                scored, unique, hits = (int(v) for v in delta)
+                evaluated = unique - hits
+                cache_row = {
+                    "output": j,
+                    "iteration": it,
+                    "scored": scored,
+                    "unique": unique,
+                    "memo_hits": hits,
+                    "evaluated": evaluated,
+                    "unique_ratio": unique / scored if scored else 0.0,
+                    "memo_hit_rate": hits / scored if scored else 0.0,
+                    # fraction of eval-batch slots that still needed real
+                    # evaluation (1 - this = eval-batch shrinkage)
+                    "eval_batch_fill": (
+                        evaluated / scored if scored else 0.0
+                    ),
+                }
+                cache_iter_rows.append(cache_row)
             progress.note_iteration(I)
             global_it += 1
             cands = hof_to_candidates(ghof, options, variable_names)
             latest_cands[j] = cands
             if recorder is not None:
                 recorder.record_hall_of_fame(j, it, cands)
+                if cache_row is not None:
+                    recorder.record_cache(j, it, cache_row)
                 if events is not None:
                     recorder.record_mutation_events(j, it, events)
                 for isl in range(I):
@@ -777,7 +945,14 @@ def equation_search(
                 prefix = f"[output {j}] " if multi else ""
                 print(
                     prefix
-                    + progress.status_line(global_it - 1, best_loss, evals)
+                    + progress.status_line(
+                        global_it - 1, best_loss, evals,
+                        # this search's own work: exclude a resumed
+                        # saved_state's carried counters, matching
+                        # result.cache_stats["totals"]
+                        cache_counts=tuple(cache_prev[j] - cache_base[j])
+                        if use_cache else None,
+                    )
                 )
                 if options.progress:
                     bar.update(global_it, pareto_table(cands))
@@ -834,6 +1009,32 @@ def equation_search(
         recorder.record_final(total_evals, time.time() - t_start)
         recorder.save()
 
+    cache_stats = None
+    if use_cache:
+        # this search's own work only: cumulative minus resume baseline,
+        # so totals always equal the sum of the per_iteration rows
+        tot = np.sum(np.stack(cache_prev), axis=0) - np.sum(
+            np.stack(cache_base), axis=0
+        )
+        scored, unique, hits = (int(v) for v in tot)
+        evaluated = unique - hits
+        cache_stats = {
+            "totals": {
+                "scored": scored,
+                "unique": unique,
+                "memo_hits": hits,
+                "evaluated": evaluated,
+                # fraction of scored trees answered without evaluation
+                # (intra-batch duplicates + memo hits)
+                "hit_rate": (
+                    (scored - evaluated) / scored if scored else 0.0
+                ),
+                "unique_ratio": unique / scored if scored else 0.0,
+            },
+            "per_iteration": cache_iter_rows,
+            "banks": [b.stats if b is not None else None for b in banks],
+        }
+
     return EquationSearchResult(
         candidates=results,
         options=options,
@@ -841,4 +1042,5 @@ def equation_search(
         state=out_states if return_state else None,
         num_evals=total_evals,
         search_time_s=time.time() - t_start,
+        cache_stats=cache_stats,
     )

@@ -1,0 +1,52 @@
+"""Evaluation memo bank — fitness caching for the batched GP engine.
+
+Two tiers (ISSUE 1; the caching answer to the reference engine tolerating
+structural duplicates because Julia-side evals are cheap per tree —
+src/SingleIteration.jl rescoring passim — where on TPU every redundant
+tree burns a slot in the batched eval launch):
+
+* **Intra-batch dedup** (`dedup.py`): inside the jitted cycle, content-hash
+  the flat eval batch, sort-and-segment to find unique programs, evaluate
+  only the unique representatives through the interpreter/Pallas path, and
+  scatter each representative's loss back to all duplicates. Segment
+  boundaries come from EXACT content comparison (the hash is only the sort
+  key), so hash collisions can never merge distinct trees.
+
+* **Cross-iteration memo bank** (`memo.py`): a host-side fixed-capacity
+  LRU keyed by (64-bit content hash, dataset fingerprint, loss config).
+  A device-resident snapshot of the most-recent entries pre-fills known
+  full-data fitnesses before dispatch; the host loop absorbs each
+  iteration's rescored populations afterwards. Keys include constant
+  values, so constant mutation/optimization invalidates naturally (the
+  re-optimized tree is a new key); explicit `invalidate()` exists for
+  callers that rewrite constants in place.
+
+Both tiers preserve bit-identical search trajectories versus the uncached
+path: a memo/dedup hit substitutes a value that the deterministic
+evaluator would have produced for the identical program on the identical
+rows. Telemetry (scored / unique / memo-hit counters) rides in
+`IslandState.cache_counts` and surfaces through progress + recorder.
+"""
+
+from .dedup import DedupStats, DeviceMemo, dedup_eval_losses, empty_device_memo
+from .hashing import canonical_fields_device, tree_hash_device, tree_hash_host
+from .memo import (
+    FitnessMemoBank,
+    clear_memo_banks,
+    dataset_fingerprint,
+    get_memo_bank,
+)
+
+__all__ = [
+    "DedupStats",
+    "DeviceMemo",
+    "FitnessMemoBank",
+    "canonical_fields_device",
+    "clear_memo_banks",
+    "dataset_fingerprint",
+    "dedup_eval_losses",
+    "empty_device_memo",
+    "get_memo_bank",
+    "tree_hash_device",
+    "tree_hash_host",
+]

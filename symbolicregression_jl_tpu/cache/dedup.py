@@ -1,0 +1,167 @@
+"""Intra-batch dedup + device memo lookup — the jitted tier of the memo
+bank (ISSUE 1 tentpole, tier 1).
+
+In tree-based GP a large fraction of each generation's candidates are
+structural duplicates of trees already in the batch (tournament winners
+repeat; do_nothing/failed mutations resubmit parents; crossover clones
+subtrees). The reference tolerates this — per-tree Julia evals are cheap —
+but here every duplicate burns a slot in the batched eval launch. This
+module removes them *inside* the jitted cycle with static shapes:
+
+    hash -> stable lexicographic sort -> exact-equality segmenting ->
+    compact unique representatives to the front -> device-memo lookup on
+    the representatives -> evaluate the remainder -> scatter every
+    segment's loss back to all duplicates.
+
+Shape discipline: XLA needs static shapes, so the compact buffer keeps the
+full batch size N; slots past the unique count U (and memo-hit slots) hold
+`filler_trees` — length-1 constant programs. The lockstep jnp interpreter
+prices every tree identically so fillers save nothing there, but the
+Pallas kernel's length-bounded slot loop (ops/pallas_eval.py design note
+3b) runs fillers in ONE step instead of ceil(max_len/4): on TPU the
+eval-batch shrinkage telemetry translates into proportional kernel-time
+shrinkage. Either way the dedup guarantees bit-identical losses — each
+duplicate receives exactly the value the deterministic evaluator produces
+for that program (per-tree computation is position-independent in both
+backends).
+
+Collision safety: the 64-bit hash is only the SORT KEY. Segment boundaries
+come from exact comparison of the canonicalized program bytes, so two
+distinct trees with equal hashes land in different segments and are both
+evaluated — a collision costs a missed dedup, never a wrong loss. The
+device-memo tier matches on the full 64-bit key (see hashing.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .hashing import canonical_fields_device, tree_hash_device
+
+Array = jax.Array
+
+
+class DeviceMemo(NamedTuple):
+    """Device-resident snapshot of the host LRU's most-recent entries.
+
+    Fixed-capacity (K static, part of the compiled graph); `count` live
+    entries occupy slots [0, count) — dead slots are excluded by index
+    masking, so no hash sentinel can collide with a real key."""
+
+    h1: Array  # (K,) uint32 — key lane 1
+    h2: Array  # (K,) uint32 — key lane 2
+    loss: Array  # (K,) working dtype — memoized full-data loss
+    count: Array  # () int32 — live entries
+
+
+class DedupStats(NamedTuple):
+    """Per-call counters (int32 scalars; (I,) under per-island vmap)."""
+
+    total: Array  # trees submitted for scoring
+    unique: Array  # distinct programs found (segments)
+    memo_hits: Array  # unique programs answered by the device memo
+
+
+def empty_device_memo(slots: int, dtype=jnp.float32) -> DeviceMemo:
+    return DeviceMemo(
+        h1=jnp.zeros((slots,), jnp.uint32),
+        h2=jnp.zeros((slots,), jnp.uint32),
+        loss=jnp.zeros((slots,), dtype),
+        count=jnp.int32(0),
+    )
+
+
+def _lex_order(h1: Array, h2: Array) -> Array:
+    """Stable argsort by (h1, h2) lexicographic — equal 64-bit keys (hence
+    all copies of one program) end up adjacent, ties broken by original
+    index so the permutation is deterministic."""
+    order = jnp.argsort(h2, stable=True)
+    return order[jnp.argsort(h1[order], stable=True)]
+
+
+def dedup_eval_losses(
+    trees,
+    eval_loss_fn: Callable,
+    memo: Optional[DeviceMemo] = None,
+):
+    """Evaluate per-tree losses for a flat (N,) TreeBatch with intra-batch
+    dedup and optional device-memo prefill. Jittable / vmappable.
+
+    eval_loss_fn: TreeBatch (N,) -> loss (N,) — the full scoring closure
+    (dispatch_eval + elementwise loss + aggregation + inf-on-incomplete).
+    Returns (loss (N,), DedupStats). loss is bit-identical to
+    eval_loss_fn(trees) as long as eval_loss_fn is deterministic per tree
+    and memo entries hold values that evaluator produced (both hold for
+    the interpreter/Pallas paths and the memo bank's absorb discipline).
+    """
+    from ..ops.interpreter import filler_trees
+
+    N = trees.length.shape[0]
+    h1, h2 = tree_hash_device(trees)
+    order = _lex_order(h1, h2)
+
+    # exact-equality segmenting over the canonical program bytes
+    kindm, opm, featm, cwords, length = canonical_fields_device(trees)
+    kind_s, op_s, feat_s = kindm[order], opm[order], featm[order]
+    cw_s, len_s = cwords[order], length[order]
+    eq = (len_s[1:] == len_s[:-1])
+    eq &= jnp.all(kind_s[1:] == kind_s[:-1], axis=-1)
+    eq &= jnp.all(op_s[1:] == op_s[:-1], axis=-1)
+    eq &= jnp.all(feat_s[1:] == feat_s[:-1], axis=-1)
+    eq &= jnp.all(cw_s[1:] == cw_s[:-1], axis=(-2, -1))
+    is_head = jnp.concatenate([jnp.ones((1,), jnp.bool_), ~eq])
+    seg = jnp.cumsum(is_head.astype(jnp.int32)) - 1  # (N,) segment per pos
+    n_unique = seg[-1] + 1
+
+    # original index of each segment's representative, compacted to the
+    # front of an N-slot buffer (heads scatter to their segment slot;
+    # non-heads aim past the end and fall off)
+    rep_src = (
+        jnp.zeros((N + 1,), jnp.int32)
+        .at[jnp.where(is_head, seg, N)]
+        .set(order.astype(jnp.int32))[:N]
+    )
+    slot_live = jnp.arange(N) < n_unique
+
+    # device memo: answer representatives whose 64-bit key is memoized
+    if memo is not None and memo.h1.shape[0] > 0:
+        rh1, rh2 = h1[rep_src], h2[rep_src]
+        live_k = jnp.arange(memo.h1.shape[0]) < memo.count
+        m = (
+            (rh1[:, None] == memo.h1[None, :])
+            & (rh2[:, None] == memo.h2[None, :])
+            & live_k[None, :]
+        )
+        hit = jnp.any(m, axis=1) & slot_live
+        memo_loss = memo.loss[jnp.argmax(m, axis=1)]
+    else:
+        hit = jnp.zeros((N,), jnp.bool_)
+        memo_loss = jnp.zeros((N,), trees.cval.dtype)
+
+    # evaluate only live, non-hit representatives; everything else is the
+    # cheapest valid program (see module note on the Pallas length bound)
+    eval_mask = slot_live & ~hit
+    fillers = filler_trees((N,), trees.kind.shape[-1], trees.cval.dtype)
+    rep_trees = jax.tree_util.tree_map(lambda x: x[rep_src], trees)
+    buf = jax.tree_util.tree_map(
+        lambda r, f: jnp.where(
+            jnp.reshape(eval_mask, eval_mask.shape + (1,) * (r.ndim - 1)),
+            r, f,
+        ),
+        rep_trees,
+        fillers,
+    )
+    loss_buf = eval_loss_fn(buf)  # (N,)
+    seg_loss = jnp.where(hit, memo_loss.astype(loss_buf.dtype), loss_buf)
+
+    # scatter each segment's loss to every duplicate's original position
+    loss = jnp.zeros((N,), loss_buf.dtype).at[order].set(seg_loss[seg])
+    stats = DedupStats(
+        total=jnp.int32(N),
+        unique=n_unique.astype(jnp.int32),
+        memo_hits=jnp.sum(hit).astype(jnp.int32),
+    )
+    return loss, stats

@@ -1,0 +1,168 @@
+"""64-bit structural content hashing for TreeBatch — device and host twins.
+
+`models.trees.tree_hash` (blake2b, host-only) gives the recorder its
+lineage refs; the memo bank needs the SAME digest computable both inside
+a jitted graph (to key the intra-batch dedup and the device memo lookup)
+and on the host (to key the LRU that absorbs scored populations). blake2b
+cannot run on device, so this module defines a two-lane 32-bit FNV-1a
+fold over the canonicalized program and implements it twice:
+
+* `tree_hash_device` — jittable jnp/uint32 (vmappable over batch dims);
+* `tree_hash_host`   — vectorized numpy, bit-for-bit identical digests
+  (uint64 accumulators masked to 32 bits so numpy's overflow behavior
+  never enters the picture).
+
+Canonicalization matches `tree_hash` (test/test_hash.jl semantics): only
+the `length` live slots plus length itself feed the digest; dead fields
+(op on leaves, feat on non-VAR, cval on non-CONST) are zeroed, so two
+encodings of one program digest equal regardless of padded-tail garbage.
+Constant values hash by their exact storage bits (bf16/f16 widen to f32 —
+exact — f64 contributes both words), so trees differing only in constants
+get distinct keys: constant mutation/optimization *naturally* invalidates
+memo entries by changing the key.
+
+Collision note: the two lanes give a 64-bit digest. The intra-batch dedup
+uses it only as a sort key (segments come from exact content comparison),
+so collisions there are harmless. The memo tier matches on the full 64
+bits — a false hit needs a 2^-64 pair collision between live keys, the
+standard memoization trade documented in docs/memo_bank.md.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.trees import CONST, UNA, VAR, TreeBatch
+
+Array = jax.Array
+
+# lane 1: classic FNV-1a basis/prime; lane 2: independent odd constants
+_BASIS1, _PRIME1 = 0x811C9DC5, 0x01000193
+_BASIS2, _PRIME2 = 0x9E3779B9, 0x85EBCA6B
+_MASK32 = 0xFFFFFFFF
+
+
+def canonical_fields_device(trees: TreeBatch):
+    """(kind, op, feat, const-words, length) with dead fields and the
+    padded tail zeroed — the exact byte content of the program. Returns
+    uint32 arrays: kind/op/feat (..., L), cwords (..., L, W), length (...,)
+    with W = 2 for float64 constants, 1 otherwise. Jittable; also the
+    equality domain for dedup's exact segment comparison."""
+    kind = trees.kind
+    L = kind.shape[-1]
+    live = jnp.arange(L) < trees.length[..., None]
+    kindm = jnp.where(live, kind, 0)
+    opm = jnp.where(live & (kind >= UNA), trees.op, 0)
+    featm = jnp.where(live & (kind == VAR), trees.feat, 0)
+    cval = jnp.where(live & (kind == CONST), trees.cval,
+                     jnp.zeros((), trees.cval.dtype))
+    if cval.dtype == jnp.float64:
+        cwords = jax.lax.bitcast_convert_type(cval, jnp.uint32)  # (..., L, 2)
+    else:
+        if cval.dtype != jnp.float32:
+            cval = cval.astype(jnp.float32)  # bf16/f16 -> f32 is exact
+        cwords = jax.lax.bitcast_convert_type(cval, jnp.uint32)[..., None]
+    return (
+        kindm.astype(jnp.uint32),
+        opm.astype(jnp.uint32),
+        featm.astype(jnp.uint32),
+        cwords,
+        trees.length.astype(jnp.uint32),
+    )
+
+
+def tree_hash_device(trees: TreeBatch) -> Tuple[Array, Array]:
+    """Two-lane 32-bit content hash, shape = batch shape. Jittable.
+
+    The fold is unrolled over the (static, small) slot axis: ~4L wrapping
+    uint32 mul/xor ops on batch-shaped arrays — noise next to one tree
+    evaluation."""
+    kindm, opm, featm, cwords, length = canonical_fields_device(trees)
+    L = kindm.shape[-1]
+    W = cwords.shape[-1]
+    p1 = jnp.uint32(_PRIME1)
+    p2 = jnp.uint32(_PRIME2)
+    h1 = jnp.full(length.shape, _BASIS1, jnp.uint32)
+    h2 = jnp.full(length.shape, _BASIS2, jnp.uint32)
+
+    def fold(h1, h2, v):
+        return (h1 ^ v) * p1, (h2 ^ v) * p2
+
+    h1, h2 = fold(h1, h2, length)
+    for i in range(L):
+        h1, h2 = fold(h1, h2, kindm[..., i])
+        h1, h2 = fold(h1, h2, opm[..., i])
+        h1, h2 = fold(h1, h2, featm[..., i])
+        for w in range(W):
+            h1, h2 = fold(h1, h2, cwords[..., i, w])
+    return h1, h2
+
+
+def _canonical_fields_host(trees: TreeBatch):
+    """numpy twin of canonical_fields_device (same shapes/dtypes)."""
+    kind = np.asarray(trees.kind, np.int32)
+    op = np.asarray(trees.op, np.int32)
+    feat = np.asarray(trees.feat, np.int32)
+    cval = np.asarray(trees.cval)
+    length = np.asarray(trees.length, np.int32)
+    L = kind.shape[-1]
+    live = np.arange(L) < length[..., None]
+    kindm = np.where(live, kind, 0)
+    opm = np.where(live & (kind >= UNA), op, 0)
+    featm = np.where(live & (kind == VAR), feat, 0)
+    cval = np.where(live & (kind == CONST), cval, cval.dtype.type(0))
+    if cval.dtype == np.float64:
+        cwords = cval.view(np.uint32).reshape(cval.shape + (2,))
+        if np.little_endian is False:  # pragma: no cover
+            cwords = cwords[..., ::-1]
+    else:
+        if cval.dtype != np.float32:
+            cval = cval.astype(np.float32)
+        cwords = cval.view(np.uint32)[..., None]
+    return (
+        kindm.astype(np.uint64),
+        opm.astype(np.uint64),
+        featm.astype(np.uint64),
+        cwords.astype(np.uint64),
+        length.astype(np.uint64),
+    )
+
+
+def tree_hash_host(trees: TreeBatch) -> np.ndarray:
+    """Combined 64-bit key (lane1 << 32 | lane2) as uint64, shape = batch
+    shape — bit-identical lanes to tree_hash_device (unit-tested). This is
+    the key form the FitnessMemoBank stores."""
+    kindm, opm, featm, cwords, length = _canonical_fields_host(trees)
+    L = kindm.shape[-1]
+    W = cwords.shape[-1]
+    h1 = np.full(length.shape, _BASIS1, np.uint64)
+    h2 = np.full(length.shape, _BASIS2, np.uint64)
+    m = np.uint64(_MASK32)
+    p1 = np.uint64(_PRIME1)
+    p2 = np.uint64(_PRIME2)
+
+    def fold(h1, h2, v):
+        return ((h1 ^ v) * p1) & m, ((h2 ^ v) * p2) & m
+
+    h1, h2 = fold(h1, h2, length)
+    for i in range(L):
+        h1, h2 = fold(h1, h2, kindm[..., i])
+        h1, h2 = fold(h1, h2, opm[..., i])
+        h1, h2 = fold(h1, h2, featm[..., i])
+        for w in range(W):
+            h1, h2 = fold(h1, h2, cwords[..., i, w])
+    return (h1 << np.uint64(32)) | h2
+
+
+def split_key(key) -> Tuple[np.ndarray, np.ndarray]:
+    """uint64 combined key(s) -> (lane1, lane2) uint32 — the device-table
+    layout (TPU jit default has no uint64; the device memo stores lanes)."""
+    key = np.asarray(key, np.uint64)
+    return (
+        (key >> np.uint64(32)).astype(np.uint32),
+        (key & np.uint64(_MASK32)).astype(np.uint32),
+    )

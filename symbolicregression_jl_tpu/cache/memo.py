@@ -1,0 +1,255 @@
+"""Cross-iteration fitness memo bank — the host tier (ISSUE 1 tentpole,
+tier 2).
+
+A fixed-capacity LRU mapping 64-bit tree content keys (hashing.py) to
+full-dataset losses, scoped by a dataset fingerprint + loss config +
+eval-path shape. The host loop (api.py) absorbs each iteration's
+POST-SIMPLIFY population snapshot — the full-data rescore through the
+scoring path, captured before constant optimization overwrites selected
+losses with its own objective's (ULP-different on TPU) values — and
+ships a device snapshot of the most-recently-used entries into the next
+jitted iteration, where dedup.py answers matching trees without
+evaluating them. Populations change slowly between iterations (npop
+members, a handful replaced per cycle group), so the per-iteration
+full-data rescore (simplify_population_islands) is mostly memo hits
+after warm-up.
+
+Keying / invalidation rules (docs/memo_bank.md):
+
+* keys hash the full program INCLUDING constant bits — re-optimizing a
+  tree's constants produces a new key, so BFGS passes invalidate
+  naturally (the stale entry still correctly describes the OLD program
+  and ages out of the LRU);
+* the fingerprint covers X/y/weights bytes, the loss config (callables
+  by live object identity — a name like '<lambda>' is not an identity),
+  the working precision and the eval backend/kernel shape — a memoized
+  loss is only ever replayed against the exact evaluation context it
+  came from;
+* only SCORING-PATH values enter the bank (the post-simplify snapshot);
+  optimizer-written f_best values never do, and custom loss_function
+  searches get no bank at all;
+* minibatch (`batching=True` cycle) losses are NEVER absorbed or served:
+  the absorb snapshot is always a full-data rescore, and the memo
+  applies only to row_idx=None scoring (enforced in models/fitness.py),
+  so a fresh minibatch draw can't collide with a full-data value;
+* `invalidate(keys)` / `clear()` exist for callers that rewrite cval in
+  place on a tree whose key they computed earlier (set_constants-style
+  surgery outside the engine).
+
+Thread-safety: none needed — the bank lives on the host loop's thread,
+like the recorder.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .dedup import DeviceMemo
+from .hashing import split_key, tree_hash_host
+
+
+def dataset_fingerprint(X, y, weights, options) -> str:
+    """Identity of one evaluation context: dataset bytes + loss config +
+    working precision + eval-path shape. Two searches sharing a
+    fingerprint may share a bank (get_memo_bank); anything that can
+    change a full-data loss VALUE — even in ULPs — must change the
+    fingerprint, or a served entry would differ from what the evaluator
+    computes and break the bit-identity guarantee."""
+    h = hashlib.blake2b(digest_size=16)
+    for arr in (X, y, weights):
+        if arr is None:
+            h.update(b"\x00none")
+        else:
+            a = np.ascontiguousarray(arr)
+            h.update(str(a.dtype).encode())
+            h.update(str(a.shape).encode())
+            h.update(a.tobytes())
+    # op codes in a tree are INDICES into the operator set: identical
+    # program bytes mean different programs under different operator
+    # lists, so the set is part of the evaluation context
+    h.update(repr(options.binary_operators).encode())
+    h.update(repr(options.unary_operators).encode())
+    loss = options.loss
+    if isinstance(loss, str):
+        h.update(loss.encode())
+    else:
+        # a callable's name is not its identity (every lambda is
+        # '<lambda>'): key the live object. id() reuse after GC is the
+        # residual risk — acceptable for a cache scoped to one process,
+        # wrong for anything persisted.
+        h.update(f"callable:{getattr(loss, '__name__', '')}:{id(loss)}"
+                 .encode())
+    h.update(options.precision.encode())
+    # different eval backends/kernel shapes may differ in reduction order
+    # (interpreter vs Pallas, postfix vs instr): ULP-distinct contexts.
+    # 'auto' is RESOLVED here the way dispatch_eval resolves it at the
+    # bank's one serve/absorb site — the I*npop population rescore —
+    # so two searches whose 'auto' lands on different kernels (different
+    # npop, or CPU vs TPU process) never share a bank.
+    backend = options.eval_backend
+    if backend == "auto":
+        from ..models.fitness import _PALLAS_MIN_BATCH
+        from ..ops.pallas_eval import pallas_available
+
+        rescore_batch = options.npopulations * options.npop
+        backend = "pallas" if (
+            pallas_available()
+            and options.precision in ("float32", "bfloat16")
+            and rescore_batch >= _PALLAS_MIN_BATCH
+        ) else "jnp"
+    h.update(
+        f"{backend}:{options.kernel_program}:"
+        f"{options.kernel_leaf_skip}:{options.row_shards}".encode()
+    )
+    return h.hexdigest()
+
+
+class FitnessMemoBank:
+    """Fixed-capacity LRU of (tree content key -> full-data loss)."""
+
+    def __init__(self, capacity: int = 65536, fingerprint: str = ""):
+        if capacity < 1:
+            raise ValueError("cache capacity must be >= 1")
+        self.capacity = int(capacity)
+        self.fingerprint = fingerprint
+        self._entries: "OrderedDict[int, float]" = OrderedDict()
+        self.n_absorbed = 0  # insert attempts (including refreshes)
+        self.n_inserted = 0  # new keys added
+        self.n_evicted = 0
+        self.n_invalidated = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # -- write side ---------------------------------------------------------
+    def absorb(self, keys, losses) -> int:
+        """Insert/refresh (key, loss) pairs — the post-dispatch side of the
+        bank. keys: uint64 array (tree_hash_host) or iterable of ints;
+        losses: matching floats (inf is a valid value: a known-bad tree
+        stays known-bad). NaN losses are skipped (a NaN never equals the
+        evaluator's replayed output, so it must not be served). Returns
+        the number of new keys inserted."""
+        keys = np.atleast_1d(np.asarray(keys, np.uint64))
+        losses = np.atleast_1d(np.asarray(losses, np.float64))
+        new = 0
+        for k, v in zip(keys.tolist(), losses.tolist()):
+            self.n_absorbed += 1
+            if v != v:  # NaN
+                continue
+            if k in self._entries:
+                self._entries.move_to_end(k)
+                self._entries[k] = v
+                continue
+            self._entries[k] = v
+            self.n_inserted += 1
+            new += 1
+            if len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.n_evicted += 1
+        return new
+
+    def absorb_trees(self, trees, losses) -> int:
+        """Hash a host-side TreeBatch and absorb its losses."""
+        return self.absorb(tree_hash_host(trees), losses)
+
+    # -- read side ----------------------------------------------------------
+    def lookup(self, keys) -> Tuple[np.ndarray, np.ndarray]:
+        """Host-side probe: (values float64, hit bool) per key. Hits are
+        refreshed to most-recently-used."""
+        keys = np.atleast_1d(np.asarray(keys, np.uint64))
+        vals = np.zeros(keys.shape, np.float64)
+        hits = np.zeros(keys.shape, bool)
+        for i, k in enumerate(keys.tolist()):
+            v = self._entries.get(k)
+            if v is not None:
+                self._entries.move_to_end(k)
+                vals[i] = v
+                hits[i] = True
+        return vals, hits
+
+    def device_snapshot(self, slots: int, dtype=np.float32) -> DeviceMemo:
+        """The `slots` most-recently-used entries as a DeviceMemo (numpy
+        leaves; jit consumes them as traced arguments, so a refreshed
+        snapshot each iteration costs zero recompiles)."""
+        import jax.numpy as jnp
+
+        n = min(len(self._entries), int(slots))
+        h1 = np.zeros((slots,), np.uint32)
+        h2 = np.zeros((slots,), np.uint32)
+        loss = np.zeros((slots,), dtype)
+        if n:
+            # OrderedDict iterates oldest->newest; take the newest n
+            items = list(self._entries.items())[-n:]
+            keys = np.array([k for k, _ in items], np.uint64)
+            h1[:n], h2[:n] = split_key(keys)
+            loss[:n] = np.array([v for _, v in items], np.float64).astype(
+                dtype
+            )
+        return DeviceMemo(
+            h1=h1, h2=h2, loss=loss, count=jnp.int32(n)
+        )
+
+    # -- invalidation -------------------------------------------------------
+    def invalidate(self, keys) -> int:
+        """Drop entries whose keys are listed (e.g. trees about to get
+        their constants rewritten in place). Returns entries dropped."""
+        keys = np.atleast_1d(np.asarray(keys, np.uint64))
+        dropped = 0
+        for k in keys.tolist():
+            if self._entries.pop(k, None) is not None:
+                dropped += 1
+        self.n_invalidated += dropped
+        return dropped
+
+    def invalidate_trees(self, trees) -> int:
+        return self.invalidate(tree_hash_host(trees))
+
+    def clear(self) -> None:
+        self.n_invalidated += len(self._entries)
+        self._entries.clear()
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        return {
+            "size": len(self._entries),
+            "capacity": self.capacity,
+            "absorbed": self.n_absorbed,
+            "inserted": self.n_inserted,
+            "evicted": self.n_evicted,
+            "invalidated": self.n_invalidated,
+        }
+
+
+# -- bank registry: one bank per evaluation context, shared across searches
+_BANKS: Dict[str, FitnessMemoBank] = {}
+_MAX_BANKS = 8  # oldest context dropped past this (host memory bound)
+
+
+def get_memo_bank(
+    fingerprint: str, capacity: int = 65536
+) -> FitnessMemoBank:
+    """Bank for an evaluation context, created on first use. Repeated
+    searches on the same (dataset, loss, precision) share one bank, so the
+    cache is warm across equation_search calls, not just iterations."""
+    bank = _BANKS.get(fingerprint)
+    if bank is None:
+        if len(_BANKS) >= _MAX_BANKS:
+            _BANKS.pop(next(iter(_BANKS)))
+        bank = _BANKS[fingerprint] = FitnessMemoBank(
+            capacity=capacity, fingerprint=fingerprint
+        )
+    elif capacity > bank.capacity:
+        # honor a raised cache_capacity knob on re-use (grow-only: a
+        # lowered knob must not silently evict a warmer sibling's
+        # entries mid-flight)
+        bank.capacity = int(capacity)
+    return bank
+
+
+def clear_memo_banks() -> None:
+    """Drop every registered bank (tests / benchmarks)."""
+    _BANKS.clear()

@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from .complexity import compute_complexity
 from .constant_opt import optimize_constants_islands
 from .constraints import check_constraints_single
-from .fitness import sample_batch_idx, score_trees
+from .fitness import sample_batch_idx, score_trees, score_trees_cached
 from .mutate_device import (
     append_random_op,
     combine_operators,
@@ -106,6 +106,12 @@ class IslandState(NamedTuple):
     birth_counter: Array  # int32 scalar
     num_evals: Array  # float32 scalar
     mut_counts: Array  # (len(MUTATION_NAMES), 2) int32: proposed / accepted
+    # evaluation memo-bank telemetry (options.cache_fitness; stays zero
+    # otherwise): cumulative [trees scored, unique programs evaluated,
+    # device-memo hits] — fused multi-island scoring spreads its global
+    # counts evenly over islands (remainder on island 0), so per-island
+    # values are bookkeeping shares and the cross-island SUM is exact
+    cache_counts: Array  # (3,) int32
 
 
 # ---------------------------------------------------------------------------
@@ -544,6 +550,7 @@ def _integrate_children(
         birth_counter=state.birth_counter + B,
         num_evals=state.num_evals + B * eval_fraction,
         mut_counts=new_counts,
+        cache_counts=state.cache_counts,
     )
     if not collect_events:
         return new_state
@@ -614,6 +621,22 @@ def _flatten2(tree_batch: TreeBatch) -> TreeBatch:
     )
 
 
+def _spread_stats(stats, I: int) -> Array:
+    """DedupStats from one fused multi-island scoring call -> per-island
+    (I, 3) int32 increments. Global counts are spread evenly with the
+    remainder on island 0 so the cross-island sum stays exact."""
+    vec = jnp.stack(
+        [stats.total, stats.unique, stats.memo_hits]
+    ).astype(jnp.int32)  # (3,)
+    base = vec // I
+    rem = vec - base * I
+    return jnp.tile(base[None, :], (I, 1)).at[0].add(rem)
+
+
+def _add_cache_counts(states: IslandState, add: Array) -> IslandState:
+    return states._replace(cache_counts=states.cache_counts + add)
+
+
 def reg_evol_cycle_islands(
     states: IslandState,  # leading (I,)
     temperature: Array,
@@ -625,10 +648,20 @@ def reg_evol_cycle_islands(
     options: Options,
     row_idx: Optional[Array] = None,
     collect_events: bool = False,
+    memo=None,
 ):
     """row_idx: None (full data), (batch,) shared minibatch, or
     (I, batch) per-island independent minibatches (the reference's
-    per-island score_func_batch draws, src/LossFunctions.jl:95-115)."""
+    per-island score_func_batch draws, src/LossFunctions.jl:95-115).
+
+    memo: optional cache.DeviceMemo consumed only with
+    options.cache_fitness (and only on full-data scoring — the cached
+    scorer drops it for minibatch rows). CAUTION: only pass a memo whose
+    values were scored at THIS call's batch shape — with
+    eval_backend='auto' the kernel choice is batch-size-dependent, and a
+    value from another kernel can be ULP-different. The production
+    driver (api.py) therefore feeds the bank only to the population
+    rescore and leaves this memo None."""
     nfeatures = X.shape[0]
     I = states.birth_counter.shape[0]
     props = jax.vmap(
@@ -637,27 +670,51 @@ def reg_evol_cycle_islands(
         )
     )(states)
     B = props.parent_scores.shape[1]
+    cache_add = None
     if row_idx is not None and row_idx.ndim == 2:
         # per-island draws: score each island's children against its own
         # minibatch (vmapped — forgoes the one fused flat call, so the
         # Pallas kernel does not engage on this path)
-        s, l = jax.vmap(
-            lambda ch, ri: score_trees(
-                ch, X, y, weights, baseline, options, ri
-            )
-        )(props.children, row_idx)
+        if options.cache_fitness:
+            s, l, stats = jax.vmap(
+                lambda ch, ri: score_trees_cached(
+                    ch, X, y, weights, baseline, options, ri
+                )
+            )(props.children, row_idx)
+            cache_add = jnp.stack(
+                [stats.total, stats.unique, stats.memo_hits], axis=-1
+            ).astype(jnp.int32)  # (I, 3): per-island dedup within B
+        else:
+            s, l = jax.vmap(
+                lambda ch, ri: score_trees(
+                    ch, X, y, weights, baseline, options, ri
+                )
+            )(props.children, row_idx)
     else:
         flat_children = _flatten2(props.children)  # (I*B, ...)
-        s, l = score_trees(
-            flat_children, X, y, weights, baseline, options, row_idx
-        )
+        if options.cache_fitness:
+            s, l, stats = score_trees_cached(
+                flat_children, X, y, weights, baseline, options, row_idx,
+                memo=memo,
+            )
+            cache_add = _spread_stats(stats, I)
+        else:
+            s, l = score_trees(
+                flat_children, X, y, weights, baseline, options, row_idx
+            )
         s, l = s.reshape(I, B), l.reshape(I, B)
-    return jax.vmap(
+    out = jax.vmap(
         lambda st, pr, cs, cl: _integrate_children(
             st, pr, cs, cl, temperature, X.shape[1], options,
             collect_events=collect_events,
         )
     )(states, props, s, l)
+    if cache_add is None:
+        return out
+    if collect_events:
+        new_states, events = out
+        return _add_cache_counts(new_states, cache_add), events
+    return _add_cache_counts(out, cache_add)
 
 
 # ---------------------------------------------------------------------------
@@ -677,6 +734,7 @@ def s_r_cycle_islands(
     collect_events: bool = False,
     temperatures: Optional[Array] = None,
     apply_move_window: bool = True,
+    memo=None,
 ):
     """ncycles fused evolution cycles over the annealing temperature
     schedule LinRange(1, 0) (reference src/SingleIteration.jl:17-61), all
@@ -728,7 +786,7 @@ def s_r_cycle_islands(
             row_idx = None
         out = reg_evol_cycle_islands(
             sts, temperature, curmaxsize, X, y, weights, baseline, options,
-            row_idx, collect_events=collect_events,
+            row_idx, collect_events=collect_events, memo=memo,
         )
         if collect_events:
             sts, events = out
@@ -772,11 +830,17 @@ def simplify_population_islands(
     weights: Optional[Array],
     baseline: float,
     options: Options,
+    memo=None,
 ) -> IslandState:
     """Simplify every member of every island then rescore on the full
     dataset in one fused call (the simplify + finalize_scores parts of
     optimize_and_simplify_population, reference src/SingleIteration.jl:63-127;
-    constant optimization is applied separately by constant_opt.py)."""
+    constant optimization is applied separately by constant_opt.py).
+
+    This full-data rescore is the cross-iteration memo bank's main
+    customer (options.cache_fitness + memo): populations change by a few
+    members per iteration, so most of the npop x I rescored programs were
+    absorbed by the bank last iteration and skip evaluation."""
     I = states.birth_counter.shape[0]
     npop = states.pop.scores.shape[1]
     def _simp(t):
@@ -785,9 +849,15 @@ def simplify_population_islands(
         return t
 
     trees = jax.vmap(jax.vmap(_simp))(states.pop.trees)
-    s, l = score_trees(
-        _flatten2(trees), X, y, weights, baseline, options
-    )
+    if options.cache_fitness:
+        s, l, stats = score_trees_cached(
+            _flatten2(trees), X, y, weights, baseline, options, memo=memo
+        )
+        states = _add_cache_counts(states, _spread_stats(stats, I))
+    else:
+        s, l = score_trees(
+            _flatten2(trees), X, y, weights, baseline, options
+        )
     scores, losses = s.reshape(I, npop), l.reshape(I, npop)
     new_pop = states.pop._replace(trees=trees, scores=scores, losses=losses)
     new_hofs = jax.vmap(
@@ -942,4 +1012,5 @@ def init_island_state(
         birth_counter=jnp.int32(pop.npop),
         num_evals=jnp.float32(pop.npop),
         mut_counts=jnp.zeros((len(MUTATION_NAMES), 2), jnp.int32),
+        cache_counts=jnp.zeros((3,), jnp.int32),
     )

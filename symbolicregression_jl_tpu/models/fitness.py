@@ -92,6 +92,33 @@ def dispatch_eval(
     return eval_trees(trees, X, operators)
 
 
+def _make_eval_loss_fn(
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    operators: OperatorSet,
+    loss_fn: Callable,
+    backend: str,
+    program: str,
+    leaf_skip: "str | bool",
+) -> Callable:
+    """TreeBatch -> per-tree aggregated loss (Inf on NaN/Inf evals,
+    reference src/LossFunctions.jl:36-39). The ONE definition of the
+    scoring composition: both the plain and the deduped/memoized paths
+    call this exact closure, which is what makes the cache subsystem's
+    bit-identity guarantee a structural property instead of a
+    keep-two-copies-in-sync obligation."""
+
+    def eval_fn(trees: TreeBatch) -> Array:
+        y_pred, ok = dispatch_eval(trees, X, operators, backend, program,
+                                   leaf_skip)
+        elem = loss_fn(y_pred, y)
+        loss = aggregate_loss(elem, weights)
+        return jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
+
+    return eval_fn
+
+
 def eval_loss_trees(
     trees: TreeBatch,
     X: Array,
@@ -112,12 +139,86 @@ def eval_loss_trees(
         X = X[:, row_idx]
         y = y[row_idx]
         weights = None if weights is None else weights[row_idx]
-    y_pred, ok = dispatch_eval(trees, X, operators, backend, program,
-                               leaf_skip)
-    elem = loss_fn(y_pred, y)
-    loss = aggregate_loss(elem, weights)
-    loss = jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
-    return loss
+    return _make_eval_loss_fn(
+        X, y, weights, operators, loss_fn, backend, program, leaf_skip
+    )(trees)
+
+
+def eval_loss_trees_deduped(
+    trees: TreeBatch,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    operators: OperatorSet,
+    loss_fn: Callable,
+    row_idx: Optional[Array] = None,
+    backend: str = "auto",
+    program: str = "auto",
+    leaf_skip: "str | bool" = "auto",
+    memo=None,
+):
+    """eval_loss_trees through the cache subsystem: intra-batch dedup of
+    identical programs + optional device-memo prefill (cache/dedup.py).
+    Returns (loss, DedupStats) with loss bit-identical to eval_loss_trees.
+
+    The memo holds FULL-data losses, so it is consulted only when
+    row_idx is None — minibatch draws always evaluate (cache/memo.py
+    keying rules)."""
+    from ..cache.dedup import dedup_eval_losses
+
+    if row_idx is not None:
+        X = X[:, row_idx]
+        y = y[row_idx]
+        weights = None if weights is None else weights[row_idx]
+        memo = None
+
+    batch_shape = trees.length.shape
+    flat = jax.tree_util.tree_map(
+        lambda x: x.reshape((-1,) + x.shape[len(batch_shape):]), trees
+    )
+    eval_fn = _make_eval_loss_fn(
+        X, y, weights, operators, loss_fn, backend, program, leaf_skip
+    )
+    loss, stats = dedup_eval_losses(flat, eval_fn, memo)
+    return loss.reshape(batch_shape), stats
+
+
+def score_trees_cached(
+    trees: TreeBatch,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    baseline: float,
+    options: Options,
+    row_idx: Optional[Array] = None,
+    memo=None,
+):
+    """score_trees through the evaluation memo bank: (score, loss,
+    DedupStats). Identical numerics to score_trees — dedup/memo hits
+    substitute values the deterministic evaluator would produce for the
+    same program on the same rows. The custom full-tree loss_function
+    path bypasses the cache entirely (its objective may read the whole
+    tree, so program identity is the wrong memo key granularity);
+    stats report zero there."""
+    from ..cache.dedup import DedupStats
+
+    if options.loss_function is not None:
+        score, loss = score_trees(
+            trees, X, y, weights, baseline, options, row_idx
+        )
+        zero = jnp.int32(0)
+        return score, loss, DedupStats(zero, zero, zero)
+    loss, stats = eval_loss_trees_deduped(
+        trees, X, y, weights, options.operators, options.elementwise_loss,
+        row_idx, backend=options.eval_backend,
+        program=options.kernel_program,
+        leaf_skip=options.kernel_leaf_skip,
+        memo=memo,
+    )
+    complexity = compute_complexity(trees, options)
+    score = loss_to_score(loss, baseline, complexity, options)
+    score = jnp.where(jnp.isfinite(loss), score, jnp.inf)
+    return score, loss, stats
 
 
 def loss_to_score(

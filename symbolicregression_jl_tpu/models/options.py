@@ -215,6 +215,24 @@ class Options:
     define_helper_functions: bool = True
     recorder: bool = False
     recorder_file: str = "pysr_recorder.json"
+    # --- evaluation memo bank (cache/ subsystem) ---
+    # Opt-in fitness caching, two tiers: intra-batch dedup of every fused
+    # eval batch (duplicate programs evaluated once, losses scattered
+    # back) + a cross-iteration host-side LRU keyed by tree content hash
+    # x dataset fingerprint x loss config that pre-fills known full-data
+    # fitnesses before dispatch. Guaranteed bit-identical trajectories vs.
+    # the uncached path under a fixed seed (docs/memo_bank.md); hit-rate /
+    # unique-ratio counters surface in the progress line, the recorder,
+    # and EquationSearchResult.cache_stats. Elementwise-loss path only
+    # (a custom loss_function bypasses the cache).
+    cache_fitness: bool = False
+    # Host LRU capacity (entries) of the cross-iteration memo bank.
+    # Orchestration-only: not part of the compiled graph.
+    cache_capacity: int = 65536
+    # Device memo table slots shipped into each jitted iteration (static
+    # shape -> compiled into the graph). 0 keeps intra-batch dedup but
+    # disables the cross-iteration tier.
+    cache_device_slots: int = 1024
     # --- TPU-native knobs (no reference analog; replace Distributed.jl) ---
     n_parallel_tournaments: int = 0  # 0 => npop // tournament_selection_n
     eval_backend: str = "auto"  # "jnp" | "pallas" | "auto"
@@ -337,6 +355,10 @@ class Options:
             raise ValueError("max_cycles_per_dispatch must be >= 1 or None")
         if self.tournament_selection_n > self.npop:
             raise ValueError("tournament_selection_n must be <= npop")
+        if self.cache_capacity < 1:
+            raise ValueError("cache_capacity must be >= 1")
+        if self.cache_device_slots < 0:
+            raise ValueError("cache_device_slots must be >= 0")
         # build and cache derived structures
         object.__setattr__(self, "_operators", make_operator_set(
             self.binary_operators, self.unary_operators))
@@ -435,6 +457,9 @@ class Options:
             None if self.loss_function is None else id(self.loss_function),
             # recorder mode adds the event-collection outputs to the graph
             self.recorder,
+            # the dedup/memo scoring path and the device memo table shape
+            # are compiled in (cache_capacity is host-side and absent)
+            self.cache_fitness, self.cache_device_slots,
             # dispatch chunking changes which compiled programs exist
             # (fused single call vs phased sub-programs)
             self.max_cycles_per_dispatch,

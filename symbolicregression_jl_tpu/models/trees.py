@@ -436,7 +436,14 @@ def tree_hash(tree: TreeBatch) -> "np.ndarray":
     garbage AND of the encoding's max_len (the flat encoding's version of
     pointer-identity-free structural hashing). Works on a single tree
     (returns a 0-d uint64 array) or any batch shape. Host-side (numpy);
-    not jittable."""
+    not jittable.
+
+    The evaluation memo bank needs the same canonicalization contract but
+    a digest computable INSIDE jitted graphs: cache/hashing.py implements
+    a two-lane FNV fold as `tree_hash_device` (jnp) with a bit-identical
+    numpy twin `tree_hash_host`. This blake2b digest stays the recorder's
+    lineage-ref format; the FNV pair is the cache key format — both honor
+    the dead-field/padded-tail rules asserted by tests/test_hash.py."""
     kind = np.ascontiguousarray(tree.kind, dtype=np.int32)
     op = np.ascontiguousarray(tree.op, dtype=np.int32)
     feat = np.ascontiguousarray(tree.feat, dtype=np.int32)

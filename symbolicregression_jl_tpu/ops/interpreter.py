@@ -96,6 +96,29 @@ def _eval_single(
     return y, ok
 
 
+def filler_trees(
+    batch_shape: Tuple[int, ...], max_len: int, dtype=jnp.float32
+) -> TreeBatch:
+    """The cheapest VALID program this layer evaluates: length-1 `CONST 0`.
+
+    The cache subsystem's intra-batch dedup (cache/dedup.py) compacts
+    unique trees to the front of a fixed-shape buffer and must fill the
+    freed slots with something every backend accepts. Length-1 keeps
+    `ok=True` semantics uniform (an all-PAD length-0 tree reports
+    incomplete), prices at ONE step in the Pallas kernel's length-bounded
+    slot loop (ops/pallas_eval.py design note 3b — the filler's padded
+    tail is skipped), and costs the same as any tree in this lockstep
+    interpreter (which always scans all L slots). Jittable constants."""
+    shape = tuple(batch_shape) + (max_len,)
+    return TreeBatch(
+        kind=jnp.zeros(shape, jnp.int32).at[..., 0].set(CONST),
+        op=jnp.zeros(shape, jnp.int32),
+        feat=jnp.zeros(shape, jnp.int32),
+        cval=jnp.zeros(shape, dtype),
+        length=jnp.ones(batch_shape, jnp.int32),
+    )
+
+
 def eval_trees(
     trees: TreeBatch, X: Array, operators: OperatorSet
 ) -> Tuple[Array, Array]:

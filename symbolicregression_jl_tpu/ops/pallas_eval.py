@@ -944,6 +944,17 @@ def eval_trees_pallas(
     only the scalar fetch changes. Requires n_codes <= 64, nfeat <= 256,
     max_len <= 512 (raises otherwise).
 
+    Cache/dedup interplay: the intra-batch dedup (cache/dedup.py) hands
+    this kernel fixed-shape buffers where duplicate slots hold length-1
+    filler programs (ops/interpreter.filler_trees). The length-bounded
+    slot loop (design note 3b) runs a filler in one step, and sort_trees
+    clusters fillers into the same interleave groups — so the dedup
+    telemetry's eval-batch shrinkage is realized as proportional kernel
+    time here, without any dynamic shapes. Per-tree results do not depend
+    on batch position or neighbors (per-tree scratch, per-tree row
+    reductions), which is what lets a deduped batch reproduce the
+    uncached batch bit-for-bit.
+
     top_carry (postfix only) carries each tree's previous slot value in
     a loop register instead of re-reading it from scratch: postfix
     order guarantees an operator's top-of-stack operand IS the previous

@@ -105,14 +105,30 @@ class SearchProgress:
         return (e_b - e_a) / max(t_b - t_a, 1e-9)
 
     def status_line(self, iteration: int, best_loss: float,
-                    num_evals: float) -> str:
+                    num_evals: float,
+                    cache_counts: Optional[Tuple[int, int, int]] = None,
+                    ) -> str:
+        """cache_counts: cumulative (scored, unique, memo_hits) from the
+        evaluation memo bank (options.cache_fitness) — rendered as the
+        fraction of scored trees answered without evaluation, split into
+        intra-batch dedup and cross-iteration memo hits."""
         pct = 100.0 * (iteration + 1) / self.total
-        return (
+        line = (
             f"Cycles/second: {self.cycles_per_second:.3e}. "
             f"Progress: {iteration + 1}/{self.total} ({pct:.0f}%). "
             f"Best loss: {best_loss:.6g}. Evals: {num_evals:.3g}. "
             f"Elapsed: {time.time() - self.t0:.1f}s."
         )
+        if cache_counts is not None:
+            scored, unique, hits = (int(v) for v in cache_counts)
+            if scored > 0:
+                saved = scored - (unique - hits)
+                line += (
+                    f" Cache: {100.0 * saved / scored:.0f}% hits "
+                    f"(dedup {100.0 * (scored - unique) / scored:.0f}%, "
+                    f"memo {100.0 * hits / scored:.0f}%)."
+                )
+        return line
 
 
 class ProgressBar:

@@ -240,6 +240,19 @@ class Recorder:
                     }
                 )
 
+    # -- evaluation memo-bank telemetry --------------------------------------
+    def record_cache(self, output: int, iteration: int,
+                     row: RecordType) -> None:
+        """One iteration's memo-bank counters (options.cache_fitness):
+        scored / unique / memo_hits / evaluated plus the derived
+        unique-ratio, memo-hit-rate and eval-batch-fill fractions (the
+        observable savings of the cache subsystem — no reference analog;
+        the reference never deduplicates its evals)."""
+        key = f"out{output + 1}_cache"
+        self.record.setdefault(key, {})[f"iteration{iteration + 1}"] = {
+            k: v for k, v in row.items() if k not in ("output", "iteration")
+        }
+
     # -- hall of fame timeline ----------------------------------------------
     def record_hall_of_fame(self, output: int, iteration: int,
                             candidates) -> None:

@@ -58,14 +58,8 @@ def test_timeout_constants_bounded():
     # module constant — a developer running the suite with that env set
     # above 60 must not fail here spuriously.
     import os
-    import re
 
-    src = open(bench.__file__).read()
-    m = re.search(
-        r'SRTPU_BENCH_PROBE_TIMEOUT",\s*"([\d.]+)"', src
-    )
-    assert m, "default probe timeout literal not found"
-    assert float(m.group(1)) <= 60.0
+    assert bench._PROBE_TIMEOUT_DEFAULT <= 60.0
     if "SRTPU_BENCH_PROBE_TIMEOUT" not in os.environ:
         assert bench._PROBE_TIMEOUT <= 60.0
     assert bench._INIT_TIMEOUT <= 60.0
@@ -87,7 +81,11 @@ def test_probe_ok_init_tpu_records_up(acq, monkeypatch):
 def test_probe_ok_but_init_lands_on_cpu_is_not_up(acq, monkeypatch):
     """The review-caught hazard: TPU-positive probe, tunnel drops, init
     falls back to CPU without raising — must re-exec, never return the
-    CPU devices as an 'up' capture."""
+    CPU devices as an 'up' capture. A stale 'up' memo (e.g. from a
+    sibling moments before the drop) must be CLEARED on the way out so
+    other suite children re-probe instead of burning an init timeout on
+    the known-poisoned tunnel."""
+    bench._write_memo("up")
     monkeypatch.setattr(bench, "_probe_tpu_subprocess",
                         lambda t: ("tpu", "ok"))
     monkeypatch.setattr(bench, "_init_backend_with_watchdog",
@@ -96,7 +94,7 @@ def test_probe_ok_but_init_lands_on_cpu_is_not_up(acq, monkeypatch):
         bench._devices_or_cpu_fallback(verbose=False)
     assert ei.value.resume_at == 0
     assert acq["tunnel_state"] != "up"
-    assert bench._read_memo() != "up"
+    assert bench._read_memo() is None
     assert acq["attempts"][0]["result"] == "probe-ok-cpu-fallback"
 
 

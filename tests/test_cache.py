@@ -1,0 +1,383 @@
+"""Evaluation memo bank (cache/ subsystem, ISSUE 1): device/host hash
+twins, intra-batch dedup correctness under hash collisions, LRU
+eviction/invalidation, the device-memo bypass, and the headline
+guarantee — a seeded search with cache_fitness=True produces a
+bit-identical hall of fame to the uncached run while reporting a
+nonzero cache hit rate."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import symbolicregression_jl_tpu.cache.dedup as dedup_mod
+from symbolicregression_jl_tpu.cache.dedup import (
+    DeviceMemo,
+    dedup_eval_losses,
+    empty_device_memo,
+)
+from symbolicregression_jl_tpu.cache.hashing import (
+    split_key,
+    tree_hash_device,
+    tree_hash_host,
+)
+from symbolicregression_jl_tpu.cache.memo import (
+    FitnessMemoBank,
+    clear_memo_banks,
+    dataset_fingerprint,
+    get_memo_bank,
+)
+from symbolicregression_jl_tpu.models.trees import (
+    encode_tree,
+    parse_expression,
+    set_constants,
+    stack_trees,
+)
+from symbolicregression_jl_tpu.ops.interpreter import eval_trees, filler_trees
+from symbolicregression_jl_tpu.ops.operators import make_operator_set
+
+OPS = make_operator_set(["+", "-", "*", "/"], ["cos", "exp"])
+
+
+def _t(s, max_len=16):
+    return encode_tree(parse_expression(s, OPS), max_len)
+
+
+def _combined(h1, h2):
+    return (np.asarray(h1).astype(np.uint64) << np.uint64(32)) | np.asarray(
+        h2
+    ).astype(np.uint64)
+
+
+# ---------------------------------------------------------------------------
+# hashing: device/host twins + canonicalization
+# ---------------------------------------------------------------------------
+
+
+def test_device_host_hash_twins_agree():
+    batch = stack_trees(
+        [_t("(x0 + 1.5) * cos(x1)"), _t("x0 - 1.5"), _t("exp(x1) / x0")]
+    )
+    h1, h2 = jax.jit(tree_hash_device)(batch)
+    assert np.array_equal(_combined(h1, h2), tree_hash_host(batch))
+
+
+def test_hash_ignores_padding_and_dead_fields():
+    a = _t("x0 + 1.0", max_len=8)
+    b = _t("x0 + 1.0", max_len=8)
+    b = b._replace(
+        kind=b.kind.at[5:].set(4),
+        op=b.op.at[0].set(3),  # x0 is VAR: op slot is dead
+        cval=b.cval.at[5:].set(99.0),
+    )
+    assert tree_hash_host(a) == tree_hash_host(b)
+    ha = tree_hash_device(a)
+    hb = tree_hash_device(b)
+    assert _combined(*ha) == _combined(*hb)
+
+
+def test_hash_distinguishes_constants():
+    # constant bits feed the key: constant mutation/re-optimization makes
+    # a NEW key (the memo bank's natural invalidation rule)
+    assert tree_hash_host(_t("x0 + 1.5")) != tree_hash_host(_t("x0 + 1.6"))
+
+
+def test_split_key_roundtrip():
+    keys = tree_hash_host(stack_trees([_t("x0 + 1.5"), _t("cos(x1)")]))
+    h1, h2 = split_key(keys)
+    assert np.array_equal(_combined(h1, h2), keys)
+
+
+# ---------------------------------------------------------------------------
+# intra-batch dedup
+# ---------------------------------------------------------------------------
+
+
+def _batch_with_dups():
+    return stack_trees(
+        [
+            _t("x0 + 1.5"),
+            _t("cos(x1)"),
+            _t("x0 + 1.5"),
+            _t("x0 * x1"),
+            _t("cos(x1)"),
+            _t("x0 + 1.5"),
+        ]
+    )
+
+
+def _eval_fn(X):
+    def f(tb):
+        y, ok = eval_trees(tb, X, OPS)
+        loss = jnp.mean(y**2, axis=-1)
+        return jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
+
+    return f
+
+
+def test_dedup_bit_identical_and_counts(rng):
+    X = jnp.asarray(rng.standard_normal((2, 40)).astype(np.float32))
+    batch = _batch_with_dups()
+    direct = _eval_fn(X)(batch)
+    loss, stats = jax.jit(
+        lambda b: dedup_eval_losses(b, _eval_fn(X))
+    )(batch)
+    assert np.array_equal(np.asarray(direct), np.asarray(loss))
+    assert (int(stats.total), int(stats.unique), int(stats.memo_hits)) == (
+        6, 3, 0,
+    )
+
+
+def test_dedup_correct_under_total_hash_collision(rng, monkeypatch):
+    """The hash is only the sort key: a degenerate constant hash makes
+    EVERY pair collide, so distinct programs sort adjacent and duplicate
+    programs scatter apart. Exact content comparison must then (a) never
+    merge the adjacent distinct programs and (b) at worst miss dedup on
+    the scattered duplicates — a collision costs missed savings, never a
+    wrong loss."""
+    X = jnp.asarray(rng.standard_normal((2, 40)).astype(np.float32))
+    batch = _batch_with_dups()
+    direct = _eval_fn(X)(batch)
+
+    def degenerate(trees):
+        n = trees.length.shape
+        return jnp.zeros(n, jnp.uint32), jnp.zeros(n, jnp.uint32)
+
+    monkeypatch.setattr(dedup_mod, "tree_hash_device", degenerate)
+    loss, stats = dedup_eval_losses(batch, _eval_fn(X))
+    assert np.array_equal(np.asarray(direct), np.asarray(loss))
+    # the stable sort keeps original order, so no two equal trees are
+    # adjacent in this batch: every tree becomes its own segment (all
+    # dedup missed, all evaluated — degraded, not incorrect)
+    assert int(stats.unique) == int(stats.total) == 6
+    assert int(stats.memo_hits) == 0
+    # duplicates that happen to sit adjacent still merge under the
+    # colliding hash (the stable sort preserves their adjacency)
+    adj = stack_trees([_t("x0 + 1.5"), _t("x0 + 1.5"), _t("cos(x1)")])
+    loss2, stats2 = dedup_eval_losses(adj, _eval_fn(X))
+    assert np.array_equal(
+        np.asarray(_eval_fn(X)(adj)), np.asarray(loss2)
+    )
+    assert int(stats2.unique) == 2
+
+
+def test_dedup_memo_hits_bypass_evaluation(rng):
+    """A memo entry is SERVED, not recomputed: plant a poisoned loss for
+    one program and see it propagate to every duplicate."""
+    X = jnp.asarray(rng.standard_normal((2, 40)).astype(np.float32))
+    batch = _batch_with_dups()
+    direct = np.asarray(_eval_fn(X)(batch))
+    keys = tree_hash_host(batch)
+    bank = FitnessMemoBank(capacity=8)
+    bank.absorb(keys[0], 123.0)
+    memo = bank.device_snapshot(4, np.float32)
+    loss, stats = jax.jit(
+        lambda b, m: dedup_eval_losses(b, _eval_fn(X), m)
+    )(batch, memo)
+    loss = np.asarray(loss)
+    assert (loss[[0, 2, 5]] == 123.0).all()  # all dups of the planted tree
+    assert np.array_equal(loss[[1, 3, 4]], direct[[1, 3, 4]])
+    assert int(stats.memo_hits) == 1  # counted once per unique program
+
+
+def test_dedup_empty_memo_table_is_inert(rng):
+    X = jnp.asarray(rng.standard_normal((2, 40)).astype(np.float32))
+    batch = _batch_with_dups()
+    direct = _eval_fn(X)(batch)
+    loss, stats = dedup_eval_losses(
+        batch, _eval_fn(X), empty_device_memo(0, jnp.float32)
+    )
+    assert np.array_equal(np.asarray(direct), np.asarray(loss))
+    assert int(stats.memo_hits) == 0
+
+
+def test_filler_trees_are_valid_cheap_programs(rng):
+    X = jnp.asarray(rng.standard_normal((2, 8)).astype(np.float32))
+    f = filler_trees((3,), 16, jnp.float32)
+    y, ok = eval_trees(f, X, OPS)
+    assert bool(np.asarray(ok).all())
+    assert np.array_equal(np.asarray(y), np.zeros((3, 8), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# host LRU memo bank
+# ---------------------------------------------------------------------------
+
+
+def test_lru_eviction_order():
+    bank = FitnessMemoBank(capacity=3)
+    bank.absorb([1, 2, 3], [0.1, 0.2, 0.3])
+    _, hit = bank.lookup([1])  # refreshes key 1 to most-recent
+    assert hit.all()
+    bank.absorb([4], [0.4])  # evicts key 2 (oldest), not the refreshed 1
+    vals, hits = bank.lookup([1, 2, 3, 4])
+    assert hits.tolist() == [True, False, True, True]
+    assert bank.stats["evicted"] == 1
+    assert len(bank) == 3
+
+
+def test_absorb_refreshes_and_skips_nan():
+    bank = FitnessMemoBank(capacity=2)
+    bank.absorb([1], [0.5])
+    bank.absorb([1], [0.75])  # refresh, not insert
+    assert len(bank) == 1 and bank.stats["inserted"] == 1
+    vals, hits = bank.lookup([1])
+    assert hits[0] and vals[0] == 0.75
+    bank.absorb([2], [np.nan])  # NaN never equals a replayed eval: skip
+    assert not bank.lookup([2])[1][0]
+    bank.absorb([3], [np.inf])  # inf IS a valid value (known-bad tree)
+    vals, hits = bank.lookup([3])
+    assert hits[0] and np.isinf(vals[0])
+
+
+def test_invalidation_on_constant_reoptimization():
+    """Keys include constant bits, so rewriting constants in place (the
+    BFGS optimize pass's effect) makes a NEW key — the bank can never
+    serve a stale pre-optimization loss for the re-optimized tree. The
+    explicit invalidate() covers callers that rewrote cval under a key
+    they still hold."""
+    tree = _t("(x0 * 2.0) + 0.5")
+    bank = FitnessMemoBank(capacity=8)
+    bank.absorb_trees(tree, np.asarray(0.25))
+    # re-optimize the constants in place
+    new_cval = jnp.where(tree.kind == 1, tree.cval * 1.5, tree.cval)
+    reopt = set_constants(tree, new_cval)
+    assert tree_hash_host(reopt) != tree_hash_host(tree)
+    assert not bank.lookup(tree_hash_host(reopt))[1][0]  # no stale serve
+    # and the old entry can be dropped explicitly
+    assert bank.invalidate_trees(tree) == 1
+    assert not bank.lookup(tree_hash_host(tree))[1][0]
+    assert bank.stats["invalidated"] == 1
+
+
+def test_device_snapshot_takes_most_recent():
+    bank = FitnessMemoBank(capacity=8)
+    bank.absorb([10, 11, 12, 13], [1.0, 2.0, 3.0, 4.0])
+    snap = bank.device_snapshot(2, np.float32)
+    assert int(snap.count) == 2
+    keys = _combined(snap.h1[:2], snap.h2[:2])
+    assert set(keys.tolist()) == {12, 13}  # the two newest
+    assert set(np.asarray(snap.loss[:2]).tolist()) == {3.0, 4.0}
+
+
+def test_bank_registry_shares_by_fingerprint(rng):
+    from symbolicregression_jl_tpu.models.options import make_options
+
+    clear_memo_banks()
+    opts = make_options(verbosity=0, progress=False)
+    X = rng.standard_normal((2, 10)).astype(np.float32)
+    y = X[0] * 2
+    fp = dataset_fingerprint(X, y, None, opts)
+    assert get_memo_bank(fp) is get_memo_bank(fp)
+    fp2 = dataset_fingerprint(X, y + 1, None, opts)
+    assert fp2 != fp
+    # op codes are indices into the operator set: a different set is a
+    # different evaluation context even with identical data bytes
+    ob = make_options(binary_operators=["+", "*"], verbosity=0,
+                      progress=False)
+    assert dataset_fingerprint(X, y, None, ob) != fp
+    # two distinct callables must NOT share a context ('<lambda>' is a
+    # name, not an identity) — distinct lambdas, distinct fingerprints
+    la = make_options(loss=lambda p, t: (p - t) ** 2, verbosity=0,
+                      progress=False)
+    lb = make_options(loss=lambda p, t: abs(p - t), verbosity=0,
+                      progress=False)
+    assert dataset_fingerprint(X, y, None, la) != dataset_fingerprint(
+        X, y, None, lb
+    )
+    # eval-path shape is part of the context (ULP-distinct kernels):
+    # 'auto' is resolved the way the rescore resolves it — on this CPU
+    # test env that is 'jnp', so auto and jnp SHARE a context while a
+    # pinned 'pallas' names a different kernel and must not
+    oj = make_options(eval_backend="jnp", verbosity=0, progress=False)
+    assert dataset_fingerprint(X, y, None, oj) == fp
+    op = make_options(eval_backend="pallas", verbosity=0, progress=False)
+    assert dataset_fingerprint(X, y, None, op) != fp
+    # a raised capacity knob grows an existing bank; a lowered one is
+    # ignored (grow-only — never evict a warmer sibling's entries)
+    assert get_memo_bank(fp2, capacity=32).capacity == 32
+    assert get_memo_bank(fp2, capacity=128).capacity == 128
+    assert get_memo_bank(fp2, capacity=64).capacity == 128
+    clear_memo_banks()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the acceptance guarantee
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_search_cached_vs_uncached_identical(rng):
+    """cache_fitness=True on a seeded search: bit-identical hall of fame,
+    nonzero reported cache hit rate, per-iteration unique-ratio rows."""
+    from symbolicregression_jl_tpu import equation_search
+
+    X = rng.standard_normal((3, 48)).astype(np.float32)
+    y = 2.0 * np.cos(X[2]) + X[0] ** 2
+    # ncycles*B (= 10*2 replacements) < npop guarantees members survive
+    # verbatim between iterations, so the rescore-serving memo tier gets
+    # hits within the 3-iteration budget (the bank serves only the
+    # population rescore — see docs/memo_bank.md)
+    kw = dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        npopulations=2,
+        npop=33,
+        ncycles_per_iteration=10,
+        maxsize=10,
+        seed=11,
+        verbosity=0,
+        progress=False,
+        niterations=3,
+    )
+    r0 = equation_search(X, y, **kw)
+    clear_memo_banks()
+    r1 = equation_search(X, y, cache_fitness=True, **kw)
+
+    def frontier(r):
+        return [
+            (c.complexity, float(c.loss), float(c.score), c.equation)
+            for c in r.frontier()
+        ]
+
+    assert frontier(r0) == frontier(r1)
+    assert r0.cache_stats is None
+    totals = r1.cache_stats["totals"]
+    assert totals["scored"] > 0
+    assert totals["hit_rate"] > 0.0  # dedup finds duplicates even early
+    assert totals["memo_hits"] > 0  # population rescore hits the bank
+    rows = r1.cache_stats["per_iteration"]
+    assert len(rows) == 3
+    for row in rows:
+        assert 0 < row["unique"] <= row["scored"]
+        assert row["eval_batch_fill"] <= row["unique_ratio"]
+    # the bank absorbed this search's populations
+    assert r1.cache_stats["banks"][0]["size"] > 0
+    clear_memo_banks()
+
+
+def test_progress_line_and_recorder_surface_cache_counters():
+    from symbolicregression_jl_tpu.models.options import make_options
+    from symbolicregression_jl_tpu.utils.progress import SearchProgress
+    from symbolicregression_jl_tpu.utils.recorder import Recorder
+
+    opts = make_options(verbosity=0, progress=False, cache_fitness=True)
+    progress = SearchProgress(4, opts)
+    line = progress.status_line(
+        0, 0.5, 100.0, cache_counts=(200, 120, 30)
+    )
+    # saved = 200 - (120 - 30) = 110 -> 55%; dedup 40%; memo 15%
+    assert "Cache: 55% hits" in line
+    assert "dedup 40%" in line and "memo 15%" in line
+    # zero scored: no cache segment rather than a division error
+    assert "Cache" not in progress.status_line(
+        0, 0.5, 100.0, cache_counts=(0, 0, 0)
+    )
+
+    rec = Recorder(opts)
+    rec.record_cache(
+        0, 0, {"output": 0, "iteration": 0, "scored": 10, "unique": 8,
+               "memo_hits": 2, "evaluated": 6, "unique_ratio": 0.8,
+               "memo_hit_rate": 0.2, "eval_batch_fill": 0.6},
+    )
+    entry = rec.record["out1_cache"]["iteration1"]
+    assert entry["scored"] == 10 and "output" not in entry
