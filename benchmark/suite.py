@@ -467,10 +467,78 @@ def bench_fitness_cache():
     return out
 
 
+def bench_static_analysis():
+    """Static-analysis gate as a suite case (ISSUE 3): srlint violation
+    count, compile-surface baseline status, and docs/api_reference.md
+    drift, via scripts/lint.py --format json in its own subprocess (the
+    gate pins CPU for itself; this case never needs the device)."""
+    import subprocess
+
+    script = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "lint.py",
+    )
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, "--format", "json"],
+            capture_output=True, text=True, timeout=900,
+        )
+    except subprocess.TimeoutExpired:
+        return [{
+            "suite": "static_analysis",
+            "error": "lint.py timed out after 900s",
+            "seconds": round(time.time() - t0, 1),
+        }]
+    seconds = round(time.time() - t0, 1)
+    try:
+        payload = json.loads(proc.stdout)
+    except json.JSONDecodeError:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-2:]
+        return [{
+            "suite": "static_analysis",
+            "error": f"lint.py rc={proc.returncode}: "
+                     + " / ".join(tail)[:200],
+            "seconds": seconds,
+        }]
+    surface = payload.get("surface") or {}
+    docs = payload.get("docs") or {}
+    return [
+        {
+            "suite": "static_analysis",
+            "case": "srlint",
+            "ok": not payload.get("counts"),
+            "violations": sum(payload.get("counts", {}).values()),
+            "suppressed": payload.get("suppressed", 0),
+        },
+        {
+            "suite": "static_analysis",
+            "case": "compile_surface",
+            "ok": surface.get("ok", False),
+            "configs": len(surface.get("configs", {})),
+            "baseline_match": surface.get("baseline_match", False),
+            "problems": len(surface.get("problems", [])),
+        },
+        {
+            "suite": "static_analysis",
+            "case": "api_reference_drift",
+            "ok": docs.get("api_reference_current", False),
+        },
+        {
+            "suite": "static_analysis",
+            "case": "summary",
+            "ok": payload.get("ok", False),
+            "rc": proc.returncode,
+            "seconds": seconds,
+        },
+    ]
+
+
 # (fn, per-case subprocess timeout). northstar LAST: it is the one case
 # with a device-fault history (r04/r03), and even in its own process it
 # is the longest.
 _CASES = [
+    (bench_static_analysis, 1200),
     (bench_eval_fixed_tree, 600),
     (bench_single_eval_48_nodes, 600),
     (bench_population_scoring, 600),

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Repo-wide static-analysis gate: srlint + compile-surface + doc drift.
+
+The one command CI (and benchmark/suite.py's `static_analysis` case) runs:
+
+    python scripts/lint.py [--format text|json] [--only lint|surface]
+        [--update-baseline] [--skip-docs]
+
+Wraps `python -m symbolicregression_jl_tpu.analysis` and adds the
+doc-drift check: docs/api_reference.md must be exactly what
+scripts/gen_api_reference.py generates (the page is generated, never
+hand-edited — see that script's docstring). Exit 0 only when everything
+is clean.
+
+JSON mode prints ONE object: the analysis report
+(report.py schema) plus a "docs" section:
+    {"...", "docs": {"api_reference_current": bool, "detail": str}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def check_docs() -> dict:
+    """gen_api_reference.py --check in a subprocess (it imports the whole
+    package and renders docstrings; isolation keeps this process's jax
+    state and the analysis run independent of it)."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO, "scripts", "gen_api_reference.py"),
+            "--check",
+        ],
+        capture_output=True, text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=REPO, timeout=600,
+    )
+    detail = (proc.stdout + proc.stderr).strip().splitlines()
+    return {
+        "api_reference_current": proc.returncode == 0,
+        "detail": detail[-1] if detail else "",
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from symbolicregression_jl_tpu.analysis import (
+        add_engine_args,
+        pin_platform,
+        run_analysis,
+    )
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    add_engine_args(ap)
+    ap.add_argument(
+        "--skip-docs", action="store_true",
+        help="skip the docs/api_reference.md drift check",
+    )
+    ns = ap.parse_args(argv)
+
+    pin_platform()
+    report = run_analysis(
+        lint=ns.only in (None, "lint"),
+        surface=ns.only in (None, "surface"),
+        update_baseline=ns.update_baseline,
+    )
+    docs = None if ns.skip_docs else check_docs()
+    ok = report.ok and (docs is None or docs["api_reference_current"])
+
+    if ns.format == "json":
+        payload = report.to_dict()
+        payload["docs"] = docs
+        payload["ok"] = ok
+        print(json.dumps(payload, indent=2, sort_keys=True))
+    else:
+        print(report.to_text())
+        if docs is not None:
+            state = (
+                "current" if docs["api_reference_current"]
+                else f"OUT OF DATE ({docs['detail']})"
+            )
+            print(f"docs/api_reference.md: {state}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

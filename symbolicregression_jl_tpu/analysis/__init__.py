@@ -1,0 +1,87 @@
+"""Static analysis for the TPU hot path: srlint + compile-surface checker.
+
+Two engines, one CLI (``python -m symbolicregression_jl_tpu.analysis``):
+
+- **srlint** (lint.py / rules.py): a JAX-aware AST linter that builds a
+  call graph rooted at the package's ``jax.jit`` entry points and flags
+  host syncs, tracer control flow, nondeterministic dict iteration,
+  implicit dtypes, and stale ``static_argnames`` — with
+  ``# srlint: disable=RULE`` pragmas.
+- **compile-surface checker** (compile_surface.py): traces the jitted
+  iteration/phase closures over a matrix of Options configs, asserts aval
+  stability across iterations and the IslandState output contract, rejects
+  callback/float64 primitives leaking into the jaxpr, and diffs primitive
+  counts against the checked-in ``compile_baseline.json``.
+
+See docs/static_analysis.md for the rule catalog and workflows.
+"""
+
+from .lint import Linter, lint_package, lint_paths
+from .report import AnalysisReport
+from .rules import RULES, Rule, Violation
+
+__all__ = [
+    "AnalysisReport",
+    "Linter",
+    "RULES",
+    "Rule",
+    "Violation",
+    "add_engine_args",
+    "lint_package",
+    "lint_paths",
+    "pin_platform",
+    "run_analysis",
+]
+
+
+def pin_platform() -> None:
+    """Pin JAX to CPU before any backend initializes (the analysis only
+    parses and traces — platform-independent work — and this image's
+    sitecustomize would otherwise route backend init at the experimental
+    TPU tunnel and hang on its single slot; same guard as
+    tests/conftest.py). SRTPU_ANALYSIS_PLATFORM overrides; empty string
+    leaves the default resolution alone. Shared by the two CLI entry
+    points (analysis.__main__ and scripts/lint.py)."""
+    import os
+
+    platform = os.environ.get("SRTPU_ANALYSIS_PLATFORM", "cpu")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+
+def add_engine_args(parser) -> None:
+    """The engine-selection CLI options both entry points expose."""
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="report format (default: text)",
+    )
+    parser.add_argument(
+        "--only", choices=("lint", "surface"), default=None,
+        help="run a single engine (default: both)",
+    )
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite analysis/compile_baseline.json from this tree's "
+        "primitive census instead of diffing against it",
+    )
+
+
+def run_analysis(
+    lint: bool = True,
+    surface: bool = True,
+    update_baseline: bool = False,
+) -> AnalysisReport:
+    """Run srlint and/or the compile-surface checker on this repo.
+
+    Importing compile_surface pulls in jax; callers that only lint stay
+    AST-only (no backend initialization)."""
+    report = AnalysisReport()
+    if lint:
+        report.violations = lint_package()
+    if surface:
+        from .compile_surface import check_surface
+
+        report.surface = check_surface(update_baseline=update_baseline)
+    return report

@@ -1,0 +1,39 @@
+"""CLI for the static-analysis subsystem.
+
+    python -m symbolicregression_jl_tpu.analysis [--format text|json]
+        [--only lint|surface] [--update-baseline]
+
+Exit status: 0 when clean, 1 on violations / surface problems (CI
+contract — benchmark/suite.py and scripts/lint.py both rely on it).
+Platform handling: see `analysis.pin_platform`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    from . import add_engine_args, pin_platform, run_analysis
+
+    ap = argparse.ArgumentParser(
+        prog="python -m symbolicregression_jl_tpu.analysis",
+        description="srlint + compile-surface checker "
+        "(docs/static_analysis.md)",
+    )
+    add_engine_args(ap)
+    ns = ap.parse_args(argv)
+
+    pin_platform()
+    report = run_analysis(
+        lint=ns.only in (None, "lint"),
+        surface=ns.only in (None, "surface"),
+        update_baseline=ns.update_baseline,
+    )
+    print(report.to_json() if ns.format == "json" else report.to_text())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

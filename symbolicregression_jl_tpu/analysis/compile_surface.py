@@ -1,0 +1,431 @@
+"""Compile-surface contract checker for the jitted search closures.
+
+Traces the production iteration closures (``api._make_iteration_fn``) and
+the chunked-dispatch phase closures (``api._make_phase_fns``) over a
+matrix of Options configs WITHOUT running them — ``jax.eval_shape`` for
+the output-aval contract, ``jax.make_jaxpr`` for the primitive census —
+and enforces:
+
+- **aval stability**: the IslandState the iteration returns has exactly
+  the avals of the IslandState it consumed, so the host loop can feed
+  outputs back as inputs forever without a silent recompile (aval drift
+  is how "one iteration = one compile" quietly becomes "one iteration =
+  one compile *each time*");
+- **IslandState output contract**: same pytree structure in and out, and
+  the merged hall of fame is exactly the per-island HoF minus the island
+  axis;
+- **no host leaks**: no ``pure_callback``/``io_callback`` primitives in
+  any sub-jaxpr, and no float64 aval anywhere when the config's working
+  precision is float32 (an f64 leak means an accidental
+  weak-type/promotion escape that doubles VMEM and silently splits the
+  kernel cache);
+- **compile-size budget**: the recursive primitive count per config is
+  diffed against the checked-in ``compile_baseline.json`` — a graph that
+  grows primitives fails loudly instead of shipping a 2x compile-time
+  regression (refresh intentionally with ``--update-baseline``).
+
+Everything runs on CPU: tracing is platform-independent, so the check
+needs no TPU (the Pallas kernel path resolves away at the small matrix
+batch sizes — the traced graph is the jnp interpreter composition, which
+is the same program structure the kernel path feeds).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "compile_baseline.json"
+)
+
+#: The Options matrix: cache on/off, island count, pop size, chunked
+#: dispatch. Small shapes — tracing cost only, never executed.
+_BASE_KWARGS = dict(
+    binary_operators=("+", "-", "*"),
+    unary_operators=("cos",),
+    npopulations=2,
+    npop=12,
+    ncycles_per_iteration=2,
+    maxsize=8,
+    tournament_selection_n=4,
+    topn=4,
+    verbosity=0,
+    progress=False,
+)
+
+_MATRIX: Tuple[Tuple[str, dict], ...] = (
+    ("base", {}),
+    ("cache", dict(cache_fitness=True, cache_device_slots=8)),
+    ("islands4", dict(npopulations=4)),
+    ("pop32", dict(npop=32)),
+)
+
+#: config name for the phased (chunked-dispatch) closure set
+_CHUNKED = ("chunked", dict(max_cycles_per_dispatch=1))
+
+_NFEAT, _NROWS = 3, 32
+
+
+# ---------------------------------------------------------------------------
+# jaxpr walking
+# ---------------------------------------------------------------------------
+
+
+def count_primitives(jaxpr) -> Dict[str, int]:
+    """Recursive primitive census of a (Closed)Jaxpr: every sub-jaxpr in
+    eqn params (pjit bodies, scan/while/cond branches, custom_* rules) is
+    descended into, so the count reflects the whole compiled program."""
+    import jax.core as jcore
+
+    counts: Dict[str, int] = {}
+
+    def walk(jx) -> None:
+        if hasattr(jx, "jaxpr"):  # ClosedJaxpr
+            jx = jx.jaxpr
+        for eqn in jx.eqns:
+            counts[eqn.primitive.name] = (
+                counts.get(eqn.primitive.name, 0) + 1
+            )
+            for sub in _sub_jaxprs(eqn.params):
+                walk(sub)
+
+    def _sub_jaxprs(params):
+        for v in params.values():
+            if isinstance(v, (jcore.Jaxpr, jcore.ClosedJaxpr)):
+                yield v
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    if isinstance(item, (jcore.Jaxpr, jcore.ClosedJaxpr)):
+                        yield item
+
+    walk(jaxpr)
+    return counts
+
+
+def _walk_avals(jaxpr):
+    """Yield every variable aval in the jaxpr tree (inputs, outputs,
+    intermediates, all sub-jaxprs)."""
+    import jax.core as jcore
+
+    def walk(jx):
+        if hasattr(jx, "jaxpr"):
+            jx = jx.jaxpr
+        for v in jx.invars + jx.outvars + jx.constvars:
+            if hasattr(v, "aval"):
+                yield v.aval
+        for eqn in jx.eqns:
+            for v in list(eqn.invars) + list(eqn.outvars):
+                if hasattr(v, "aval"):
+                    yield v.aval
+            for pv in eqn.params.values():
+                subs = pv if isinstance(pv, (list, tuple)) else [pv]
+                for s in subs:
+                    if isinstance(s, (jcore.Jaxpr, jcore.ClosedJaxpr)):
+                        yield from walk(s)
+
+    return walk(jaxpr)
+
+
+def forbidden_primitives(counts: Dict[str, int]) -> List[str]:
+    return sorted(
+        name for name in counts
+        if "callback" in name or name in ("infeed", "outfeed")
+    )
+
+
+def float64_leaks(jaxpr) -> List[str]:
+    import numpy as np
+
+    leaks = set()
+    for aval in _walk_avals(jaxpr):
+        dt = getattr(aval, "dtype", None)
+        if dt is not None and dt in (np.float64, np.complex128):
+            leaks.add(f"{dt}{getattr(aval, 'shape', ())}")
+    return sorted(leaks)
+
+
+# ---------------------------------------------------------------------------
+# aval contracts
+# ---------------------------------------------------------------------------
+
+
+def _aval_mismatches(tag: str, got, want) -> List[str]:
+    """Structure + leaf shape/dtype equality of two eval_shape pytrees."""
+    import jax
+
+    problems: List[str] = []
+    tg = jax.tree_util.tree_structure(got)
+    tw = jax.tree_util.tree_structure(want)
+    if tg != tw:
+        return [f"{tag}: pytree structure changed: {tg} != {tw}"]
+    # structures are equal, so the flattened leaf orders correspond 1:1
+    got_leaves = jax.tree_util.tree_flatten_with_path(got)[0]
+    want_leaves = jax.tree_util.tree_leaves(want)
+    for (path, g), w in zip(got_leaves, want_leaves):
+        if g.shape != w.shape or g.dtype != w.dtype:
+            pstr = jax.tree_util.keystr(path)
+            problems.append(
+                f"{tag}{pstr}: aval drift {w.shape}/{w.dtype} -> "
+                f"{g.shape}/{g.dtype}"
+            )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# the checker
+# ---------------------------------------------------------------------------
+
+
+def _abstract_inputs(options, I: int):
+    """Aval-only inputs for one iteration: (states, key, cm, X, y, bl,
+    scalars, memo-or-None)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..api import _make_init_fn
+
+    X = jax.ShapeDtypeStruct((_NFEAT, _NROWS), jnp.float32)
+    y = jax.ShapeDtypeStruct((_NROWS,), jnp.float32)
+    bl = jax.ShapeDtypeStruct((), jnp.float32)
+    cm = jax.ShapeDtypeStruct((), jnp.int32)
+    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+    keys = jax.eval_shape(
+        lambda k: jax.random.split(k, I), jax.random.PRNGKey(0)
+    )
+    scalars = options.traced_scalars()
+    init_fn = _make_init_fn(options, _NFEAT, False)
+    states = jax.eval_shape(init_fn, keys, X, y, bl, scalars)
+    memo = None
+    if options.cache_fitness:
+        from ..cache.dedup import empty_device_memo
+
+        memo = jax.eval_shape(
+            lambda: empty_device_memo(
+                options.cache_device_slots, options.dtype
+            )
+        )
+    return states, key, cm, X, y, bl, scalars, memo, keys
+
+
+def _check_iteration_config(name: str, options) -> Tuple[dict, List[str]]:
+    """Fused single-jit iteration: aval stability + contract + census."""
+    import jax
+
+    from ..api import _make_iteration_fn
+
+    problems: List[str] = []
+    I = options.npopulations
+    states, key, cm, X, y, bl, scalars, memo, _ = _abstract_inputs(
+        options, I
+    )
+    it_fn = _make_iteration_fn(options, False)
+    args = (states, key, cm, X, y, bl, scalars) + (
+        (memo,) if memo is not None else ()
+    )
+    outs = jax.eval_shape(it_fn, *args)
+    out_states, ghof = outs[0], outs[1]
+    problems += _aval_mismatches(f"{name}: IslandState", out_states, states)
+    # merged HoF contract: per-island hof minus the leading island axis
+    want_ghof = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(l.shape[1:], l.dtype), states.hof
+    )
+    problems += _aval_mismatches(f"{name}: merged HoF", ghof, want_ghof)
+
+    jaxpr = jax.make_jaxpr(it_fn)(*args)
+    counts = count_primitives(jaxpr)
+    for p in forbidden_primitives(counts):
+        problems.append(
+            f"{name}: forbidden host-callback primitive {p!r} "
+            f"x{counts[p]} in the iteration jaxpr"
+        )
+    if options.precision == "float32":
+        for leak in float64_leaks(jaxpr):
+            problems.append(
+                f"{name}: float64 aval {leak} leaked into a float32 "
+                "iteration graph"
+            )
+    entry = {
+        "primitives": dict(sorted(counts.items())),
+        "total_primitives": int(sum(counts.values())),
+        "stable_avals": not any("aval drift" in p or "structure" in p
+                                for p in problems),
+    }
+    return entry, problems
+
+
+def _check_phase_config(name: str, options) -> Tuple[dict, List[str]]:
+    """Chunked-dispatch phase closures: each phase is its own program."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..api import _make_phase_fns
+
+    problems: List[str] = []
+    I = options.npopulations
+    states, key, cm, X, y, bl, scalars, memo, keys = _abstract_inputs(
+        options, I
+    )
+    fns = _make_phase_fns(options, False)
+    k = options.max_cycles_per_dispatch
+    temps = jax.ShapeDtypeStruct((k,), jnp.float32)
+    phase_args = {
+        "cycle": lambda f: f(
+            states, cm, X, y, None, bl, scalars, temps, is_last=True
+        ),
+        "simplify": lambda f: f(
+            states, cm, X, y, None, bl, scalars, memo=memo
+        ),
+        "optimize": lambda f: f(keys, states, X, y, None, bl, scalars),
+        "optimize_mut": lambda f: f(keys, states, X, y, None, bl, scalars),
+        "merge_migrate": lambda f: f(key, states, scalars),
+    }
+    entry: dict = {"phases": {}, "total_primitives": 0}
+    for phase, call in phase_args.items():
+        fn = fns[phase]
+        outs = jax.eval_shape(lambda *a, _c=call, _f=fn: _c(_f))
+        # cycle/simplify/optimize return the IslandState itself (a
+        # namedtuple); merge_migrate returns a plain (states, ghof) tuple
+        is_bare_tuple = (
+            isinstance(outs, tuple) and not hasattr(outs, "_fields")
+        )
+        out_states = outs[0] if is_bare_tuple else outs
+        tag = f"{name}.{phase}"
+        problems += _aval_mismatches(
+            f"{tag}: IslandState", out_states, states
+        )
+        jaxpr = jax.make_jaxpr(lambda _c=call, _f=fn: _c(_f))()
+        counts = count_primitives(jaxpr)
+        for p in forbidden_primitives(counts):
+            problems.append(
+                f"{tag}: forbidden host-callback primitive {p!r}"
+            )
+        if options.precision == "float32":
+            for leak in float64_leaks(jaxpr):
+                problems.append(
+                    f"{tag}: float64 aval {leak} in a float32 graph"
+                )
+        entry["phases"][phase] = {
+            "primitives": dict(sorted(counts.items())),
+            "total_primitives": int(sum(counts.values())),
+        }
+        entry["total_primitives"] += int(sum(counts.values()))
+    # flatten for the baseline diff
+    entry["primitives"] = {}
+    for phase, ph in entry["phases"].items():
+        for prim, n in ph["primitives"].items():
+            entry["primitives"][prim] = entry["primitives"].get(prim, 0) + n
+    entry["primitives"] = dict(sorted(entry["primitives"].items()))
+    entry["stable_avals"] = not any(
+        "aval drift" in p or "structure" in p for p in problems
+    )
+    return entry, problems
+
+
+def diff_baseline(
+    configs: Dict[str, dict], baseline: dict
+) -> List[str]:
+    """Primitive-count diff vs the checked-in baseline: any change fails
+    (refresh with --update-baseline when intentional)."""
+    problems: List[str] = []
+    base_configs = baseline.get("configs", {})
+    for name, entry in configs.items():
+        if name not in base_configs:
+            problems.append(
+                f"baseline has no config {name!r} — run with "
+                "--update-baseline"
+            )
+            continue
+        want = base_configs[name].get("primitives", {})
+        got = entry["primitives"]
+        for prim in sorted(set(want) | set(got)):
+            w, g = want.get(prim, 0), got.get(prim, 0)
+            if w != g:
+                problems.append(
+                    f"{name}: primitive count drift for {prim!r}: "
+                    f"baseline {w} -> now {g} (intentional? refresh with "
+                    "--update-baseline)"
+                )
+    for name in base_configs:
+        if name not in configs:
+            problems.append(
+                f"baseline config {name!r} no longer produced — refresh "
+                "with --update-baseline"
+            )
+    return problems
+
+
+def check_surface(
+    update_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    configs: Optional[Tuple[Tuple[str, dict], ...]] = None,
+    include_chunked: bool = True,
+) -> dict:
+    """Run the full compile-surface check; returns the report dict
+    (schema: report.render_surface_text / docs/static_analysis.md)."""
+    import jax
+
+    from ..models.options import make_options
+
+    baseline_path = baseline_path or BASELINE_PATH
+    matrix = list(configs if configs is not None else _MATRIX)
+    out_configs: Dict[str, dict] = {}
+    problems: List[str] = []
+    for name, extra in matrix:
+        options = make_options(**{**_BASE_KWARGS, **extra})
+        entry, probs = _check_iteration_config(name, options)
+        out_configs[name] = entry
+        problems += probs
+    if include_chunked and configs is None:
+        name, extra = _CHUNKED
+        options = make_options(**{**_BASE_KWARGS, **extra})
+        entry, probs = _check_phase_config(name, options)
+        out_configs[name] = entry
+        problems += probs
+
+    baseline_checked = baseline_match = False
+    if update_baseline:
+        payload = {
+            "schema_version": 1,
+            "jax_version": jax.__version__,
+            "configs": {
+                name: {"primitives": entry["primitives"],
+                       "total_primitives": entry["total_primitives"]}
+                for name, entry in out_configs.items()
+            },
+        }
+        with open(baseline_path, "w") as f:
+            json.dump(payload, f, indent=2, sort_keys=True)
+            f.write("\n")
+    elif os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+        baseline_checked = True
+        base_problems = diff_baseline(out_configs, baseline)
+        baseline_match = not base_problems
+        problems += base_problems
+        if baseline.get("jax_version") != jax.__version__:
+            # a jax upgrade legitimately moves primitive counts; make the
+            # remedy obvious instead of failing with raw drift lines
+            baseline_match = False
+            problems.append(
+                "baseline was written under jax "
+                f"{baseline.get('jax_version')} but this is "
+                f"{jax.__version__} — refresh with --update-baseline"
+            )
+    else:
+        problems.append(
+            f"no compile baseline at {baseline_path} — create one with "
+            "--update-baseline"
+        )
+
+    return {
+        "ok": not problems,
+        "problems": problems,
+        "configs": out_configs,
+        "baseline_checked": baseline_checked,
+        "baseline_match": baseline_match,
+        "baseline_path": baseline_path,
+        "jax_version": jax.__version__,
+    }

@@ -1,0 +1,778 @@
+"""srlint — JAX-aware AST linter for the TPU hot path.
+
+Builds a call graph rooted at the ``jax.jit`` entry points of the package
+(``api.py``, ``ops/``, ...) and checks the invariants in rules.py inside
+everything reachable from a jitted function. Pure AST work: nothing is
+imported or executed, so linting is fast, safe on broken trees, and
+independent of the installed accelerator.
+
+Resolution model (best-effort, precision over recall):
+
+- every module is parsed and its defs/imports indexed with full lexical
+  scoping (nested functions, function-local imports);
+- a call ``f(...)`` resolves through the scope chain to a local def, a
+  module-level def, or an imported symbol; ``mod.f(...)`` resolves through
+  the import table (``import jax.numpy as jnp`` => ``jnp.zeros`` is
+  ``jax.numpy.zeros``; ``from .models.evolve import s_r_cycle_islands``
+  resolves package-relative);
+- jit roots: ``jax.jit(f)`` / ``jax.jit(lambda: ...)`` calls, ``@jax.jit``
+  decorators, and ``@functools.partial(jax.jit, ...)`` decorators;
+- reachability additionally follows function-valued arguments (``vmap(f)``,
+  ``lax.scan(body, ...)``, ``tree_map(lambda ...)``), so closure bodies
+  that only ever run inside a trace are still covered.
+
+Unresolvable calls (attribute chains on objects, dynamic dispatch) are
+ignored rather than guessed at — srlint prefers a small number of real
+findings to a wall of maybes.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .rules import (
+    HOT_PATH_PREFIXES,
+    Violation,
+    parse_pragma,
+)
+
+# ---------------------------------------------------------------------------
+# indexing
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function (def or lambda) in the scanned tree."""
+
+    module: "ModuleInfo"
+    qualname: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef | Lambda
+    params: Tuple[str, ...]
+    has_var_kwargs: bool
+    scope: "Scope"
+    is_jit_root: bool = False
+    callees: Set[int] = dataclasses.field(default_factory=set)  # id(FuncInfo)
+
+    @property
+    def label(self) -> str:
+        return f"{self.module.relpath}:{self.qualname}"
+
+
+class Scope:
+    """Lexical scope: name -> ('func', FuncInfo) | ('import', dotted)."""
+
+    def __init__(self, parent: Optional["Scope"] = None):
+        self.parent = parent
+        self.bindings: Dict[str, Tuple[str, object]] = {}
+
+    def bind(self, name: str, kind: str, target) -> None:
+        self.bindings[name] = (kind, target)
+
+    def lookup(self, name: str) -> Optional[Tuple[str, object]]:
+        s: Optional[Scope] = self
+        while s is not None:
+            if name in s.bindings:
+                return s.bindings[name]
+            s = s.parent
+        return None
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    path: str
+    relpath: str  # relative to the scan root, posix separators
+    modname: str  # dotted, relative to the scan root ("models.evolve")
+    tree: ast.Module
+    lines: List[str]
+    is_pkg: bool = False  # this file is an __init__.py
+    scope: Scope = dataclasses.field(default_factory=Scope)
+    functions: Dict[int, FuncInfo] = dataclasses.field(default_factory=dict)
+    toplevel: Dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+
+
+def _params_of(node) -> Tuple[Tuple[str, ...], bool]:
+    a = node.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    return tuple(names), a.kwarg is not None
+
+
+def _resolve_relative_import(
+    modname: str, level: int, target: str, is_pkg: bool
+) -> str:
+    """'models.fitness' + from ..cache.dedup (level=2) -> 'cache.dedup'.
+
+    A plain module drops `level` trailing components of its own dotted
+    name (the first dot strips the module name itself); a package
+    __init__ drops level-1 (the first dot means the package)."""
+    parts = modname.split(".") if modname else []
+    drop = level - 1 if is_pkg else level
+    base = parts[: max(len(parts) - drop, 0)]
+    return ".".join(base + ([target] if target else [])).strip(".")
+
+
+class _IndexVisitor(ast.NodeVisitor):
+    """Pass 1: build the scope tree, FuncInfo index, and import tables."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.scope_stack = [mod.scope]
+        self.qual_stack: List[str] = []
+
+    # -- imports --------------------------------------------------------
+    def visit_Import(self, node: ast.Import):
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            target = alias.name if alias.asname else alias.name.split(".")[0]
+            self.scope_stack[-1].bind(name, "import", target)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        base = node.module or ""
+        if node.level:
+            base = _resolve_relative_import(
+                self.mod.modname, node.level, base, self.mod.is_pkg
+            )
+        for alias in node.names:
+            name = alias.asname or alias.name
+            target = f"{base}.{alias.name}" if base else alias.name
+            self.scope_stack[-1].bind(name, "import", target)
+
+    # -- defs -----------------------------------------------------------
+    def _enter_function(self, node, name: str):
+        qual = ".".join(self.qual_stack + [name]) if self.qual_stack else name
+        params, has_kw = _params_of(node)
+        scope = Scope(self.scope_stack[-1])
+        for p in params:
+            scope.bind(p, "param", None)
+        info = FuncInfo(
+            module=self.mod, qualname=qual, node=node,
+            params=params, has_var_kwargs=has_kw, scope=scope,
+        )
+        self.mod.functions[id(node)] = info
+        if len(self.scope_stack) == 1 and not isinstance(node, ast.Lambda):
+            self.mod.toplevel[name] = info
+        if not isinstance(node, ast.Lambda):
+            self.scope_stack[-1].bind(name, "func", info)
+        # decorators and argument defaults evaluate in the ENCLOSING scope
+        if not isinstance(node, ast.Lambda):
+            for deco in node.decorator_list:
+                self.visit(deco)
+        for d in node.args.defaults + [
+            d for d in node.args.kw_defaults if d is not None
+        ]:
+            self.visit(d)
+        self.scope_stack.append(scope)
+        self.qual_stack.append(name)
+        if isinstance(node, ast.Lambda):
+            self.visit(node.body)
+        else:
+            for stmt in node.body:
+                self.visit(stmt)
+        self.qual_stack.pop()
+        self.scope_stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._enter_function(node, node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        self._enter_function(node, f"<lambda:{node.lineno}>")
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.qual_stack.append(node.name)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.qual_stack.pop()
+
+
+def _dotted(node) -> Optional[str]:
+    """a.b.c attribute/name chain as a dotted string, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class Linter:
+    """Scan a directory tree of Python files and report rule violations."""
+
+    def __init__(self, root: str, repo_root: Optional[str] = None):
+        self.root = os.path.abspath(root)
+        self.repo_root = os.path.abspath(repo_root or self.root)
+        self.modules: List[ModuleInfo] = []
+        self.violations: List[Violation] = []
+        self._func_by_id: Dict[int, FuncInfo] = {}
+
+    # -- loading --------------------------------------------------------
+    def load(self, files: Optional[Sequence[str]] = None) -> "Linter":
+        if files is None:
+            files = []
+            for dirpath, dirnames, filenames in os.walk(self.root):
+                dirnames[:] = sorted(
+                    d for d in dirnames
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        files.append(os.path.join(dirpath, fn))
+        for path in files:
+            path = os.path.abspath(path)
+            rel = os.path.relpath(path, self.root).replace(os.sep, "/")
+            modname = rel[:-3].replace("/", ".")
+            is_pkg = modname == "__init__" or modname.endswith(".__init__")
+            if is_pkg:
+                modname = modname[: -len("__init__")].rstrip(".")
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=path)
+            mod = ModuleInfo(
+                path=path, relpath=rel, modname=modname, tree=tree,
+                lines=src.splitlines(), is_pkg=is_pkg,
+            )
+            _IndexVisitor(mod).visit(tree)
+            self.modules.append(mod)
+            for info in mod.functions.values():
+                self._func_by_id[id(info)] = info
+        return self
+
+    # -- resolution -----------------------------------------------------
+    def _resolve_target(
+        self, scope: Scope, dotted: str
+    ) -> Tuple[Optional[FuncInfo], Optional[str]]:
+        """(internal FuncInfo | None, canonical external/dotted name | None).
+
+        'jnp.zeros' -> (None, 'jax.numpy.zeros');
+        's_r_cycle_islands' -> (FuncInfo, 'models.evolve.s_r_cycle_islands').
+        """
+        head, _, rest = dotted.partition(".")
+        hit = scope.lookup(head)
+        if hit is None:
+            return None, dotted
+        kind, target = hit
+        if kind == "func":
+            return (target if not rest else None), dotted
+        if kind == "param":
+            return None, None  # call through a parameter: opaque
+        # import
+        full = f"{target}.{rest}" if rest else str(target)
+        func = self._lookup_module_symbol(full)
+        return func, full
+
+    def _lookup_module_symbol(self, full: str) -> Optional[FuncInfo]:
+        """'models.evolve.s_r_cycle_islands' -> FuncInfo if scanned."""
+        modname, _, sym = full.rpartition(".")
+        for mod in self.modules:
+            if mod.modname == modname and sym in mod.toplevel:
+                return mod.toplevel[sym]
+            if mod.modname == full:  # bare module import
+                return None
+        return None
+
+    # -- jit roots + call edges ----------------------------------------
+    _JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+    _PARTIAL_NAMES = {"functools.partial", "partial"}
+
+    def build_graph(self) -> None:
+        for mod in self.modules:
+            self._walk_calls(mod)
+        # BFS over callee edges from jit roots
+        frontier = [
+            f for f in self._func_by_id.values() if f.is_jit_root
+        ]
+        reachable: Set[int] = set(id(f) for f in frontier)
+        while frontier:
+            f = frontier.pop()
+            for cid in f.callees:
+                if cid not in reachable:
+                    reachable.add(cid)
+                    frontier.append(self._func_by_id[cid])
+        self.jit_reachable: Set[int] = reachable
+
+    def _walk_calls(self, mod: ModuleInfo) -> None:
+        linter = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                # module-level code gets a synthetic container so jit
+                # roots declared at import time are still discovered
+                self.func_stack: List[Optional[FuncInfo]] = [None]
+
+            def current(self) -> Optional[FuncInfo]:
+                return self.func_stack[-1]
+
+            def scope(self) -> Scope:
+                cur = self.current()
+                return cur.scope if cur is not None else mod.scope
+
+            def visit_FunctionDef(self, node):
+                info = mod.functions[id(node)]
+                for deco in node.decorator_list:
+                    linter._check_decorator(mod, info, deco, self.scope())
+                self.func_stack.append(info)
+                self.generic_visit(node)
+                self.func_stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, node):
+                info = mod.functions[id(node)]
+                self.func_stack.append(info)
+                self.generic_visit(node)
+                self.func_stack.pop()
+
+            def visit_Call(self, node: ast.Call):
+                linter._record_call(mod, self.current(), node, self.scope())
+                self.generic_visit(node)
+
+            def visit_Name(self, node: ast.Name):
+                # conservative closure edges: any reference to a known
+                # function inside a traced body probably runs at trace
+                # time (lax.switch branch lists, dict dispatch tables,
+                # tuples of callbacks)
+                cur = self.current()
+                if cur is not None and isinstance(node.ctx, ast.Load):
+                    hit = self.scope().lookup(node.id)
+                    if hit is not None and hit[0] == "func":
+                        cur.callees.add(id(hit[1]))
+
+            def visit_Attribute(self, node: ast.Attribute):
+                cur = self.current()
+                if cur is not None and isinstance(node.ctx, ast.Load):
+                    d = _dotted(node)
+                    if d is not None:
+                        f, _ = linter._resolve_target(self.scope(), d)
+                        if f is not None:
+                            cur.callees.add(id(f))
+                self.generic_visit(node)
+
+        V().visit(mod.tree)
+
+    def _canonical(self, scope: Scope, node) -> Optional[str]:
+        d = _dotted(node)
+        if d is None:
+            return None
+        _, full = self._resolve_target(scope, d)
+        return full
+
+    def _funcinfo_of_expr(self, scope: Scope, mod, node) -> Optional[FuncInfo]:
+        if isinstance(node, ast.Lambda):
+            return mod.functions.get(id(node))
+        d = _dotted(node)
+        if d is None:
+            return None
+        func, _ = self._resolve_target(scope, d)
+        return func
+
+    def _record_call(
+        self, mod: ModuleInfo, current: Optional[FuncInfo],
+        node: ast.Call, scope: Scope,
+    ) -> None:
+        callee = self._funcinfo_of_expr(scope, mod, node.func)
+        if callee is not None and current is not None:
+            current.callees.add(id(callee))
+        full = self._canonical(scope, node.func)
+        # jax.jit(f, ...) / jax.jit(lambda: ...) as an expression
+        if full in self._JIT_NAMES and node.args:
+            wrapped = self._funcinfo_of_expr(scope, mod, node.args[0])
+            if wrapped is not None:
+                wrapped.is_jit_root = True
+                self._check_static_argnames(mod, node, wrapped)
+        # function-valued arguments (vmap/scan/tree_map/closures)
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            f = self._funcinfo_of_expr(scope, mod, arg)
+            if f is not None and current is not None:
+                current.callees.add(id(f))
+
+    def _check_decorator(
+        self, mod: ModuleInfo, info: FuncInfo, deco, scope: Scope
+    ) -> None:
+        full = self._canonical(scope, deco)
+        if full in self._JIT_NAMES:
+            info.is_jit_root = True
+            return
+        if isinstance(deco, ast.Call):
+            cfull = self._canonical(scope, deco.func)
+            if cfull in self._JIT_NAMES:
+                info.is_jit_root = True
+                self._check_static_argnames(mod, deco, info)
+            elif cfull in self._PARTIAL_NAMES and deco.args:
+                inner = self._canonical(scope, deco.args[0])
+                if inner in self._JIT_NAMES:
+                    info.is_jit_root = True
+                    self._check_static_argnames(mod, deco, info)
+
+    # -- SR005 ----------------------------------------------------------
+    def _check_static_argnames(
+        self, mod: ModuleInfo, call: ast.Call, wrapped: FuncInfo
+    ) -> None:
+        for kw in call.keywords:
+            if kw.arg != "static_argnames":
+                continue
+            names = _literal_str_seq(kw.value)
+            if names is None or wrapped.has_var_kwargs:
+                return
+            missing = [n for n in names if n not in wrapped.params]
+            for n in missing:
+                self._add(
+                    mod, call, "SR005",
+                    f"static_argnames references {n!r} but "
+                    f"{wrapped.qualname}() has no such parameter "
+                    f"(params: {', '.join(wrapped.params) or 'none'})",
+                    function=wrapped.qualname,
+                )
+
+    # -- violation plumbing --------------------------------------------
+    def _add(
+        self, mod: ModuleInfo, node, rule_id: str, message: str,
+        function: Optional[str] = None,
+    ) -> None:
+        suppressed = False
+        for ln in {getattr(node, "lineno", 0),
+                   getattr(node, "end_lineno", 0) or 0}:
+            if 1 <= ln <= len(mod.lines):
+                ids = parse_pragma(mod.lines[ln - 1])
+                if ids and rule_id in ids:
+                    suppressed = True
+        self.violations.append(
+            Violation(
+                rule_id=rule_id,
+                path=os.path.relpath(mod.path, self.repo_root).replace(
+                    os.sep, "/"
+                ),
+                line=getattr(node, "lineno", 0),
+                col=getattr(node, "col_offset", 0),
+                message=message,
+                function=function,
+                suppressed=suppressed,
+            )
+        )
+
+    # -- rule scans -----------------------------------------------------
+    def run_checks(self) -> List[Violation]:
+        self.build_graph()
+        for mod in self.modules:
+            hot = any(
+                mod.relpath == p or mod.relpath.startswith(p)
+                for p in self._hot_prefixes()
+            )
+            if hot:
+                self._scan_implicit_dtype(mod)
+            for info in mod.functions.values():
+                if id(info) in self.jit_reachable:
+                    self._scan_jit_function(mod, info)
+        self.violations.sort(key=lambda v: (v.path, v.line, v.rule_id))
+        return self.violations
+
+    def _hot_prefixes(self) -> Tuple[str, ...]:
+        # package scan: api.py/ops/... live at the scan root. Fixture
+        # scans (tests) reuse the same prefixes plus everything at root.
+        return tuple(
+            p if p.endswith("/") else p + ".py" for p in HOT_PATH_PREFIXES
+        ) + ("fixture_",)
+
+    # SR004 ------------------------------------------------------------
+    # constructor -> positional index of its dtype parameter
+    _IMPLICIT_DTYPE_FNS = {
+        "jax.numpy.zeros": 1, "jax.numpy.ones": 1, "jax.numpy.empty": 1,
+        "jax.numpy.full": 2, "jax.numpy.arange": 3,
+    }
+
+    def _scan_implicit_dtype(self, mod: ModuleInfo) -> None:
+        linter = self
+
+        class V(ast.NodeVisitor):
+            def __init__(self):
+                self.scope_stack = [mod.scope]
+
+            def visit_FunctionDef(self, node):
+                self.scope_stack.append(mod.functions[id(node)].scope)
+                self.generic_visit(node)
+                self.scope_stack.pop()
+
+            visit_AsyncFunctionDef = visit_FunctionDef
+
+            def visit_Lambda(self, node):
+                self.scope_stack.append(mod.functions[id(node)].scope)
+                self.generic_visit(node)
+                self.scope_stack.pop()
+
+            def visit_Call(self, node: ast.Call):
+                full = linter._canonical(self.scope_stack[-1], node.func)
+                if (
+                    full in linter._IMPLICIT_DTYPE_FNS
+                    and not any(kw.arg == "dtype" for kw in node.keywords)
+                    and len(node.args) <= linter._IMPLICIT_DTYPE_FNS[full]
+                ):
+                    short = full.replace("jax.numpy.", "jnp.")
+                    linter._add(
+                        mod, node, "SR004",
+                        f"{short}(...) without an explicit dtype= in a "
+                        "hot-path module: the produced buffer's dtype "
+                        "follows jax_enable_x64 / weak-type promotion",
+                    )
+                self.generic_visit(node)
+
+        V().visit(mod.tree)
+
+    # SR001 + SR002 + SR003 (jit-reachable functions only) -------------
+    _HOST_SYNC_CALLS = {
+        "numpy.asarray", "numpy.array", "jax.device_get",
+        "jax.block_until_ready",
+    }
+    _HOST_SYNC_METHODS = {"item", "block_until_ready", "tolist"}
+    # jnp/jax calls that return host (static) values, not tracers
+    _STATIC_RESULT_FNS = {
+        "jax.numpy.issubdtype", "jax.numpy.result_type",
+        "jax.numpy.promote_types", "jax.numpy.dtype", "jax.numpy.shape",
+        "jax.numpy.ndim", "jax.numpy.iinfo", "jax.numpy.finfo",
+        "jax.eval_shape", "jax.dtypes.issubdtype", "jax.dtypes.result_type",
+    }
+    _TRACER_PREFIXES = (
+        "jax.numpy.", "jax.lax.", "jax.random.", "jax.nn.", "jax.scipy.",
+        "jax.ops.",
+    )
+    _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+    def _scan_jit_function(self, mod: ModuleInfo, info: FuncInfo) -> None:
+        scope = info.scope
+        tainted: Set[str] = set()
+        linter = self
+
+        def arrayish(expr) -> bool:
+            if isinstance(expr, ast.Name):
+                return expr.id in tainted
+            if isinstance(expr, ast.Call):
+                full = linter._canonical(scope, expr.func)
+                if full in linter._STATIC_RESULT_FNS:
+                    return False
+                if full is not None and full.startswith(
+                    linter._TRACER_PREFIXES
+                ):
+                    return True
+                # a call on an array-valued expression: x.at[i].set(v),
+                # x.astype(...), x.sum()
+                if isinstance(expr.func, ast.Attribute) and arrayish(
+                    expr.func.value
+                ):
+                    return True
+                return False
+            if isinstance(expr, ast.Attribute):
+                if expr.attr in linter._STATIC_ATTRS:
+                    return False
+                return arrayish(expr.value)
+            if isinstance(expr, ast.Subscript):
+                return arrayish(expr.value)
+            if isinstance(expr, ast.BinOp):
+                return arrayish(expr.left) or arrayish(expr.right)
+            if isinstance(expr, ast.UnaryOp):
+                return arrayish(expr.operand)
+            if isinstance(expr, ast.Compare):
+                if all(
+                    isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                    for op in expr.ops
+                ):
+                    return False
+                return arrayish(expr.left) or any(
+                    arrayish(c) for c in expr.comparators
+                )
+            if isinstance(expr, ast.BoolOp):
+                return any(arrayish(v) for v in expr.values)
+            if isinstance(expr, ast.IfExp):
+                return arrayish(expr.body) or arrayish(expr.orelse)
+            return False
+
+        def scan_expr(expr) -> None:
+            """SR001/SR002 checks on one expression subtree (skips nested
+            function bodies — they are scanned as their own functions when
+            reachable)."""
+            for node in ast.walk(expr):
+                if not isinstance(node, ast.Call):
+                    continue
+                full = linter._canonical(scope, node.func)
+                if full in linter._HOST_SYNC_CALLS:
+                    short = full.replace("numpy.", "np.")
+                    linter._add(
+                        mod, node, "SR001",
+                        f"{short}(...) in jit-reachable "
+                        f"{info.qualname}(): host sync / device round-trip"
+                        " if the value is traced",
+                        function=info.qualname,
+                    )
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in linter._HOST_SYNC_METHODS
+                    and not node.args
+                ):
+                    # method form: x.item(), arr.tolist(),
+                    # y.block_until_ready()
+                    linter._add(
+                        mod, node, "SR001",
+                        f".{node.func.attr}() in jit-reachable "
+                        f"{info.qualname}(): forces a blocking "
+                        "device->host transfer on traced values",
+                        function=info.qualname,
+                    )
+                elif (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id in ("bool", "float", "int")
+                    and len(node.args) == 1
+                    and arrayish(node.args[0])
+                    and linter._resolve_target(scope, node.func.id)[1]
+                    == node.func.id  # not shadowed by an import/def
+                ):
+                    linter._add(
+                        mod, node, "SR002",
+                        f"{node.func.id}() concretizes a traced array in "
+                        f"{info.qualname}(): TracerBoolConversionError "
+                        "under jit (host sync outside)",
+                        function=info.qualname,
+                    )
+
+        def scan_stmts(stmts) -> None:
+            for stmt in stmts:
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)
+                ):
+                    continue  # separate FuncInfo
+                if isinstance(stmt, (ast.If, ast.While)):
+                    if arrayish(stmt.test):
+                        kind = (
+                            "if" if isinstance(stmt, ast.If) else "while"
+                        )
+                        self._add(
+                            mod, stmt, "SR002",
+                            f"Python `{kind}` on a traced array value in "
+                            f"{info.qualname}(): use lax.cond/jnp.where "
+                            "or hoist to a static Option",
+                            function=info.qualname,
+                        )
+                    scan_expr(stmt.test)
+                    scan_stmts(stmt.body)
+                    scan_stmts(stmt.orelse)
+                    continue
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    self._check_dict_iter(mod, info, stmt.iter)
+                    scan_expr(stmt.iter)
+                    scan_stmts(stmt.body)
+                    scan_stmts(stmt.orelse)
+                    continue
+                if isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign, ast.Return)):
+                    value = getattr(stmt, "value", None)
+                    if value is not None:
+                        scan_expr(value)
+                        for comp in ast.walk(value):
+                            if isinstance(
+                                comp, (ast.ListComp, ast.SetComp,
+                                       ast.DictComp, ast.GeneratorExp)
+                            ):
+                                for gen in comp.generators:
+                                    self._check_dict_iter(
+                                        mod, info, gen.iter
+                                    )
+                        # taint propagation
+                        if isinstance(stmt, ast.Assign) and arrayish(value):
+                            for tgt in stmt.targets:
+                                for n in ast.walk(tgt):
+                                    if isinstance(n, ast.Name):
+                                        tainted.add(n.id)
+                        elif isinstance(
+                            stmt, (ast.AugAssign, ast.AnnAssign)
+                        ) and arrayish(value) and isinstance(
+                            stmt.target, ast.Name
+                        ):
+                            tainted.add(stmt.target.id)
+                    continue
+                # everything else: scan contained expressions + blocks
+                for field in ("test", "value", "exc"):
+                    v = getattr(stmt, field, None)
+                    if v is not None and isinstance(v, ast.expr):
+                        scan_expr(v)
+                if isinstance(stmt, ast.Expr):
+                    for comp in ast.walk(stmt.value):
+                        if isinstance(
+                            comp, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)
+                        ):
+                            for gen in comp.generators:
+                                self._check_dict_iter(mod, info, gen.iter)
+                for block in ("body", "orelse", "finalbody"):
+                    b = getattr(stmt, block, None)
+                    if isinstance(b, list) and b and isinstance(
+                        b[0], ast.stmt
+                    ):
+                        scan_stmts(b)
+                if isinstance(stmt, ast.Try):
+                    for h in stmt.handlers:
+                        scan_stmts(h.body)
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        scan_expr(item.context_expr)
+
+        if isinstance(info.node, ast.Lambda):
+            scan_expr(info.node.body)
+        else:
+            scan_stmts(info.node.body)
+
+    # SR003 ------------------------------------------------------------
+    def _check_dict_iter(self, mod: ModuleInfo, info: FuncInfo, it) -> None:
+        if (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Attribute)
+            and it.func.attr in ("items", "keys", "values")
+            and not it.args
+        ):
+            self._add(
+                mod, it, "SR003",
+                f"unsorted .{it.func.attr}() iteration in jit-reachable "
+                f"{info.qualname}(): wrap in sorted(...) so pytree/jaxpr "
+                "construction order is deterministic across hosts",
+                function=info.qualname,
+            )
+
+
+def _literal_str_seq(node) -> Optional[List[str]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    return None
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def lint_paths(
+    root: str,
+    files: Optional[Sequence[str]] = None,
+    repo_root: Optional[str] = None,
+) -> List[Violation]:
+    """Lint every .py under `root` (or just `files`); returns ALL
+    violations including pragma-suppressed ones (filter on .suppressed)."""
+    linter = Linter(root, repo_root=repo_root).load(files)
+    return linter.run_checks()
+
+
+def lint_package(repo_root: Optional[str] = None) -> List[Violation]:
+    """Lint the installed symbolicregression_jl_tpu package tree."""
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root is None:
+        repo_root = os.path.dirname(pkg_dir)
+    return lint_paths(pkg_dir, repo_root=repo_root)

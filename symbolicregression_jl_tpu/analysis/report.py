@@ -1,0 +1,112 @@
+"""Reporters shared by srlint and the compile-surface checker.
+
+Both engines produce one `AnalysisReport`; the CLI renders it as text
+(human, one finding per line, grep-friendly) or JSON (machine, stable
+schema — tests/test_analysis.py pins it).
+
+JSON schema (schema_version 1):
+
+    {
+      "schema_version": 1,
+      "tool": "srlint",
+      "ok": bool,                     # no active violations
+      "counts": {"SR001": n, ...},    # active (non-suppressed) per rule
+      "suppressed": int,              # pragma-suppressed findings
+      "violations": [Violation.to_dict(), ...],
+      "surface": {...} | null         # compile-surface section, if run
+    }
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, List, Optional
+
+from .rules import RULES, Violation
+
+
+@dataclasses.dataclass
+class AnalysisReport:
+    violations: List[Violation] = dataclasses.field(default_factory=list)
+    surface: Optional[dict] = None  # compile_surface.check_surface() output
+
+    @property
+    def active(self) -> List[Violation]:
+        return [v for v in self.violations if not v.suppressed]
+
+    @property
+    def ok(self) -> bool:
+        if self.active:
+            return False
+        if self.surface is not None and not self.surface.get("ok", True):
+            return False
+        return True
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for v in self.active:
+            out[v.rule_id] = out.get(v.rule_id, 0) + 1
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "schema_version": 1,
+            "tool": "srlint",
+            "ok": self.ok,
+            "counts": self.counts(),
+            "suppressed": sum(1 for v in self.violations if v.suppressed),
+            "violations": [v.to_dict() for v in self.violations],
+            "surface": self.surface,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    def to_text(self) -> str:
+        lines: List[str] = []
+        for v in self.active:
+            rule = RULES[v.rule_id]
+            where = f"{v.path}:{v.line}:{v.col}"
+            fn = f" [{v.function}]" if v.function else ""
+            lines.append(
+                f"{where}: {v.rule_id} ({rule.name}){fn}: {v.message}"
+            )
+        n_sup = sum(1 for v in self.violations if v.suppressed)
+        counts = self.counts()
+        if counts:
+            by_rule = ", ".join(
+                f"{rid} x{n}" for rid, n in sorted(counts.items())
+            )
+            lines.append(
+                f"srlint: {len(self.active)} violation(s) ({by_rule})"
+                + (f", {n_sup} suppressed by pragma" if n_sup else "")
+            )
+        else:
+            lines.append(
+                "srlint: clean"
+                + (f" ({n_sup} suppressed by pragma)" if n_sup else "")
+            )
+        if self.surface is not None:
+            lines.append(render_surface_text(self.surface))
+        return "\n".join(lines)
+
+
+def render_surface_text(surface: dict) -> str:
+    lines: List[str] = []
+    for problem in surface.get("problems", []):
+        lines.append(f"compile-surface: {problem}")
+    configs = surface.get("configs", {})
+    total = sum(c.get("total_primitives", 0) for c in configs.values())
+    status = "ok" if surface.get("ok", False) else "FAIL"
+    lines.append(
+        f"compile-surface: {status} — {len(configs)} config(s), "
+        f"{total} primitives total"
+        + (
+            " (baseline match)"
+            if surface.get("baseline_match") else
+            (" (baseline MISMATCH)" if surface.get("baseline_checked")
+             else " (no baseline check)")
+        )
+    )
+    return "\n".join(lines)

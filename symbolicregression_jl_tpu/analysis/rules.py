@@ -1,0 +1,176 @@
+"""srlint rule catalog: the compile-surface invariants the hot path relies
+on (see docs/static_analysis.md for the full catalog with examples).
+
+Each rule is a named, documented invariant; lint.py owns the AST machinery
+that detects violations. Keeping the catalog separate means the rule set is
+greppable, the reporter can render help text without importing the checker,
+and new rules register in exactly one place.
+
+Why these invariants matter (ISSUE 3 motivation): the engine's hot path is
+a handful of jitted closures whose TPU performance hinges on properties no
+stock linter checks — no host syncs inside the cycle, no Python control
+flow on tracers, deterministic pytree construction, explicit dtypes on
+device buffers, and jit wrappers whose static_argnames actually exist.
+Kozax (arXiv:2502.03047) and TensorGP (arXiv:2103.07512) both report that
+accidental retraces and host round-trips dominate GP-on-accelerator
+slowdowns; srlint enforces the invariants mechanically on every PR.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+#: Pragma spelling, e.g. ``x = np.asarray(v)  # srlint: disable=SR001``.
+#: Multiple rules: ``# srlint: disable=SR001,SR004``. A justification after
+#: the rule list (`` -- static table``) is conventional and encouraged.
+PRAGMA_PREFIX = "srlint:"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    """One checkable invariant."""
+
+    id: str  # "SR001"
+    name: str  # short kebab-case slug
+    summary: str  # one line for reports
+    rationale: str  # why violating it costs performance/correctness
+
+
+RULES: Dict[str, Rule] = {
+    r.id: r
+    for r in [
+        Rule(
+            id="SR001",
+            name="host-sync-in-jit",
+            summary=(
+                "host-synchronizing call (np.asarray/np.array, "
+                "jax.device_get, .item(), block_until_ready) reachable "
+                "from jitted code"
+            ),
+            rationale=(
+                "Inside a traced function these either fail on tracers or "
+                "— worse, when they sneak in via a host round-trip — force "
+                "a device sync per call, serializing the dispatch pipeline "
+                "the whole engine is built to keep full."
+            ),
+        ),
+        Rule(
+            id="SR002",
+            name="tracer-control-flow",
+            summary=(
+                "Python if/while (or bool()/float()/int()) on a value "
+                "produced by jax/jnp array math in jit-reachable code"
+            ),
+            rationale=(
+                "Concretizing a tracer raises TracerBoolConversionError "
+                "under jit; where the branch happens to run outside jit it "
+                "silently forces a blocking device->host transfer and "
+                "re-trace per distinct outcome. Use lax.cond/lax.select/"
+                "jnp.where, or hoist the decision to a static Option."
+            ),
+        ),
+        Rule(
+            id="SR003",
+            name="unsorted-dict-iteration",
+            summary=(
+                "iteration over dict .keys()/.values()/.items() without "
+                "sorted() in jit-reachable code"
+            ),
+            rationale=(
+                "Pytree registration and jaxpr construction consume "
+                "iteration order; insertion order that differs between "
+                "processes (multi-host SPMD) or between calls yields "
+                "different jaxprs for the same logical program — silent "
+                "recompiles at best, cross-host program divergence at "
+                "worst. Wrap the iterable in sorted()."
+            ),
+        ),
+        Rule(
+            id="SR004",
+            name="implicit-dtype",
+            summary=(
+                "jnp.zeros/ones/full/empty/arange without an explicit "
+                "dtype= in a hot-path module"
+            ),
+            rationale=(
+                "Default dtypes follow jax_enable_x64 and weak-type "
+                "promotion: the same line builds f32 buffers in one "
+                "process and f64 in another (the float64 search path "
+                "flips x64 on), changing avals and forcing recompiles — "
+                "or quietly doubling VMEM traffic. Hot-path buffers name "
+                "their dtype."
+            ),
+        ),
+        Rule(
+            id="SR005",
+            name="stale-static-argnames",
+            summary=(
+                "jax.jit static_argnames references a parameter the "
+                "wrapped function does not define"
+            ),
+            rationale=(
+                "jit only validates static_argnames when the name is "
+                "actually passed by keyword; a renamed parameter leaves a "
+                "stale name that silently stops being static — every call "
+                "with a new value then retraces (or traces a value that "
+                "was meant to be a Python constant)."
+            ),
+        ),
+    ]
+}
+
+#: Modules (package-relative path prefixes) where SR004 applies: the code
+#: that builds device buffers on the search hot path. utils/ and scripts
+#: are host-side orchestration and excluded by default.
+HOT_PATH_PREFIXES: Tuple[str, ...] = (
+    "api",
+    "ops/",
+    "models/",
+    "cache/",
+    "parallel/",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    """One rule hit, locatable and machine-renderable."""
+
+    rule_id: str
+    path: str  # repo-relative file path
+    line: int
+    col: int
+    message: str
+    function: Optional[str] = None  # enclosing function qualname
+    suppressed: bool = False  # True when a pragma disabled it
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule_id,
+            "name": RULES[self.rule_id].name,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "function": self.function,
+            "message": self.message,
+            "suppressed": self.suppressed,
+        }
+
+
+def parse_pragma(comment_text: str) -> Optional[Tuple[str, ...]]:
+    """Extract the disabled rule ids from a source line, or None.
+
+    Recognizes ``# srlint: disable=SR001`` and
+    ``# srlint: disable=SR001,SR004 -- justification text``.
+    """
+    idx = comment_text.find(PRAGMA_PREFIX)
+    if idx < 0:
+        return None
+    rest = comment_text[idx + len(PRAGMA_PREFIX):].strip()
+    if not rest.startswith("disable="):
+        return None
+    parts = rest[len("disable="):].split()
+    if not parts:  # malformed half-typed pragma: "# srlint: disable="
+        return None
+    ids = tuple(s.strip() for s in parts[0].split(",") if s.strip())
+    return ids or None

@@ -374,9 +374,9 @@ def _make_iteration_driver(options: Options, has_weights: bool):
     # construction. Each (chunk, is_last) pair is fixed for the life of
     # the driver.
     if options.annealing and ncycles > 1:
-        _sched = jnp.linspace(1.0, 0.0, ncycles)
+        _sched = jnp.linspace(1.0, 0.0, ncycles, dtype=jnp.float32)
     else:
-        _sched = jnp.ones((ncycles,))
+        _sched = jnp.ones((ncycles,), jnp.float32)
     _chunks = [
         (_sched[pos:pos + k], pos + k >= ncycles)
         for pos in range(0, ncycles, k)

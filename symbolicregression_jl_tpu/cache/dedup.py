@@ -124,12 +124,12 @@ def dedup_eval_losses(
         .at[jnp.where(is_head, seg, N)]
         .set(order.astype(jnp.int32))[:N]
     )
-    slot_live = jnp.arange(N) < n_unique
+    slot_live = jnp.arange(N, dtype=jnp.int32) < n_unique
 
     # device memo: answer representatives whose 64-bit key is memoized
     if memo is not None and memo.h1.shape[0] > 0:
         rh1, rh2 = h1[rep_src], h2[rep_src]
-        live_k = jnp.arange(memo.h1.shape[0]) < memo.count
+        live_k = jnp.arange(memo.h1.shape[0], dtype=jnp.int32) < memo.count
         m = (
             (rh1[:, None] == memo.h1[None, :])
             & (rh2[:, None] == memo.h2[None, :])

@@ -54,7 +54,7 @@ def canonical_fields_device(trees: TreeBatch):
     equality domain for dedup's exact segment comparison."""
     kind = trees.kind
     L = kind.shape[-1]
-    live = jnp.arange(L) < trees.length[..., None]
+    live = jnp.arange(L, dtype=jnp.int32) < trees.length[..., None]
     kindm = jnp.where(live, kind, 0)
     opm = jnp.where(live & (kind >= UNA), trees.op, 0)
     featm = jnp.where(live & (kind == VAR), trees.feat, 0)

@@ -182,8 +182,11 @@ class FitnessMemoBank:
         h2 = np.zeros((slots,), np.uint32)
         loss = np.zeros((slots,), dtype)
         if n:
-            # OrderedDict iterates oldest->newest; take the newest n
-            items = list(self._entries.items())[-n:]
+            # newest n in oldest->newest order, without materializing the
+            # whole LRU (O(n), not O(capacity), per iteration)
+            from itertools import islice
+
+            items = list(islice(reversed(self._entries.items()), n))[::-1]
             keys = np.array([k for k, _ in items], np.uint64)
             h1[:n], h2[:n] = split_key(keys)
             loss[:n] = np.array([v for _, v in items], np.float64).astype(

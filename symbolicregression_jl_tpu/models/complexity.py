@@ -19,7 +19,7 @@ Array = jax.Array
 def compute_complexity(trees: TreeBatch, options: Options) -> Array:
     """Complexity per tree; shape = batch shape of `trees`."""
     use, bin_c, una_c, var_c, const_c = options.complexity_arrays()
-    idx = jnp.arange(trees.max_len)
+    idx = jnp.arange(trees.max_len, dtype=jnp.int32)
     valid = idx < trees.length[..., None]
     if not use:
         return trees.length

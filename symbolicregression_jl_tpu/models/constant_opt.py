@@ -143,8 +143,8 @@ def _nelder_mead_single(
     # relative + absolute spread, like Optim.jl's AffineSimplexer
     # (x*(1+0.025) + 0.5): pure-relative offsets stall from near-zero starts
     base = 0.05 * x0 + 0.5
-    i_idx = jnp.arange(L)[:, None]
-    j_idx = jnp.arange(L)[None, :]
+    i_idx = jnp.arange(L, dtype=jnp.int32)[:, None]
+    j_idx = jnp.arange(L, dtype=jnp.int32)[None, :]
     pattern = (((i_idx * 31 + j_idx * 17) % 7) - 3).astype(x0.dtype) / 3.0
     offs = jnp.where(
         jnp.eye(L, dtype=bool), jnp.diag(base), pattern * base[None, :]
@@ -399,7 +399,7 @@ def _select_and_starts(key, pop, options, K, n_starts):
     L = pop.trees.max_len
     n_restarts = n_starts - 1
     k_sel, k_perturb = jax.random.split(key)
-    idx = jnp.arange(L)
+    idx = jnp.arange(L, dtype=jnp.int32)
     has_consts = jnp.sum(
         (pop.trees.kind == CONST) & (idx < pop.trees.length[:, None]), axis=-1
     ) > 0

@@ -28,7 +28,7 @@ Array = jax.Array
 
 
 def _op_occurrence_mask(tree: TreeBatch, kind: int, op_idx: int) -> Array:
-    live = jnp.arange(tree.max_len) < tree.length
+    live = jnp.arange(tree.max_len, dtype=jnp.int32) < tree.length
     return (tree.kind == kind) & (tree.op == op_idx) & live
 
 
@@ -60,7 +60,7 @@ def check_constraints_single(
                 caps = (caps, caps)
             l_cap, r_cap = caps
             mask = _op_occurrence_mask(tree, BIN, op_idx)
-            idx = jnp.arange(tree.max_len)
+            idx = jnp.arange(tree.max_len, dtype=jnp.int32)
             r_size = sizes[jnp.maximum(idx - 1, 0)]
             l_root = idx - 1 - r_size
             l_size = sizes[jnp.clip(l_root, 0, tree.max_len - 1)]
@@ -75,7 +75,7 @@ def check_constraints_single(
             cap = caps if isinstance(caps, int) else caps[0]
             if cap is not None and cap >= 0:
                 mask = _op_occurrence_mask(tree, UNA, op_idx)
-                idx = jnp.arange(tree.max_len)
+                idx = jnp.arange(tree.max_len, dtype=jnp.int32)
                 c_size = sizes[jnp.maximum(idx - 1, 0)]
                 ok &= ~jnp.any(mask & (c_size > cap))
 
@@ -91,7 +91,7 @@ def check_constraints_single(
         else:
             continue
         outer_mask = _op_occurrence_mask(tree, o_kind, o_idx)
-        idx = jnp.arange(tree.max_len)
+        idx = jnp.arange(tree.max_len, dtype=jnp.int32)
         span_start = idx - sizes + 1
         for inner_name, max_count in inner_rules:
             i_name = canonical_name(inner_name)

@@ -127,13 +127,13 @@ def _adjusted_mutation_logits(
     (more constants -> proportionally likelier, saturating at 8; zero
     constants -> impossible); at the size OR depth cap -> no add/insert."""
     w = jnp.asarray(options.mutation_weights.as_tuple(), jnp.float32)
-    idx = jnp.arange(tree.max_len)
+    idx = jnp.arange(tree.max_len, dtype=jnp.int32)
     n_const = count_constants(tree)
     n_ops = jnp.sum((tree.kind >= 3) & (idx < tree.length))
     complexity = compute_complexity(tree, options)
     depth = tree_depth(tree.kind, tree.length)
     at_cap = (complexity >= curmaxsize) | (depth >= options.maxdepth)
-    sel = jnp.arange(N_MUTATIONS)
+    sel = jnp.arange(N_MUTATIONS, dtype=jnp.int32)
     const_scale = jnp.minimum(n_const, 8).astype(jnp.float32) / 8.0
     w = jnp.where(sel == MUTATE_CONSTANT, w * const_scale, w)
     w = jnp.where((sel == MUTATE_OPERATOR) & (n_ops == 0), 0.0, w)
@@ -762,9 +762,9 @@ def s_r_cycle_islands(
     ncycles = ncycles or options.ncycles_per_iteration
     if temperatures is None:
         if options.annealing and ncycles > 1:
-            temperatures = jnp.linspace(1.0, 0.0, ncycles)
+            temperatures = jnp.linspace(1.0, 0.0, ncycles, dtype=jnp.float32)
         else:
-            temperatures = jnp.ones((ncycles,))
+            temperatures = jnp.ones((ncycles,), jnp.float32)
 
     n_rows = X.shape[1]
     I = states.birth_counter.shape[0]

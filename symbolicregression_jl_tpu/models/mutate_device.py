@@ -59,7 +59,7 @@ def select_node(key: Array, mask: Array) -> Tuple[Array, Array]:
 
 
 def valid_mask(tree: TreeBatch) -> Array:
-    return jnp.arange(tree.max_len) < tree.length
+    return jnp.arange(tree.max_len, dtype=jnp.int32) < tree.length
 
 
 def make_random_leaf(
@@ -101,7 +101,7 @@ def splice(
     new_len = tree.length - (end - start) + d_len
     ok = (new_len <= L) & (new_len >= 1)
 
-    i = jnp.arange(L)
+    i = jnp.arange(L, dtype=jnp.int32)
     in_pre = i < start
     in_donor = (i >= start) & (i < start + d_len)
     src_suffix = jnp.clip(i - (start + d_len) + end, 0, L - 1)
@@ -631,7 +631,7 @@ def _combine_pass(tree: TreeBatch, operators: OperatorSet):
     commutative rotation (constant left child moved to the right) — lowest
     slot first. Returns (tree', changed)."""
     L = tree.max_len
-    i = jnp.arange(L)
+    i = jnp.arange(L, dtype=jnp.int32)
     live = valid_mask(tree)
     kind, op, cval = tree.kind, tree.op, tree.cval
     rules = _combine_fold_table(operators)

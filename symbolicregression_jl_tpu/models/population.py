@@ -135,7 +135,7 @@ def tournament_winner(
     # tournament_selection_p may be a tracer (TRACED_SCALAR_FIELDS), so
     # clamp with jnp, not Python min
     p = jnp.minimum(options.tournament_selection_p, 1 - 1e-6)
-    ranks = jnp.arange(n)
+    ranks = jnp.arange(n, dtype=jnp.int32)
     logits = ranks * jnp.log1p(-p) + jnp.log(p)
     pick = jax.random.categorical(k2, logits)
     return idx[order[pick]]
@@ -170,7 +170,7 @@ def update_hall_of_fame(
     in_range = (complexity >= 1) & (complexity <= S) & jnp.isfinite(losses)
 
     # per-slot best candidate among the batch
-    masked_loss = jnp.where(in_range[None, :] & (slot[None, :] == jnp.arange(S)[:, None]),
+    masked_loss = jnp.where(in_range[None, :] & (slot[None, :] == jnp.arange(S, dtype=jnp.int32)[:, None]),
                             losses[None, :], jnp.inf)  # (S, B)
     best_idx = jnp.argmin(masked_loss, axis=1)  # (S,)
     best_loss = jnp.take_along_axis(masked_loss, best_idx[:, None], axis=1)[:, 0]
@@ -218,5 +218,7 @@ def calculate_pareto_frontier(hof: HallOfFame) -> Array:
     best_so_far = jax.lax.associative_scan(
         jnp.minimum, jnp.where(hof.exists, hof.losses, jnp.inf)
     )
-    prev_best = jnp.concatenate([jnp.full((1,), jnp.inf), best_so_far[:-1]])
+    prev_best = jnp.concatenate(
+        [jnp.full((1,), jnp.inf, best_so_far.dtype), best_so_far[:-1]]
+    )
     return hof.exists & (jnp.where(hof.exists, hof.losses, jnp.inf) < prev_best)

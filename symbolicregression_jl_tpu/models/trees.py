@@ -371,7 +371,7 @@ def subtree_sizes(kind: Array, length: Array) -> Array:
         return (new_stack, new_sp), out
 
     init_stack = jnp.zeros(L // 2 + 2, jnp.int32)
-    idx = jnp.arange(L)
+    idx = jnp.arange(L, dtype=jnp.int32)
     valid = idx < length
     (_, _), sizes = jax.lax.scan(step, (init_stack, jnp.int32(0)), (arity, valid))
     return sizes
@@ -394,7 +394,7 @@ def node_depths(kind: Array, length: Array) -> Array:
         return (new_stack, new_sp), jnp.where(valid, d, 0)
 
     init_stack = jnp.zeros(L // 2 + 2, jnp.int32)
-    idx = jnp.arange(L)
+    idx = jnp.arange(L, dtype=jnp.int32)
     valid = idx < length
     (_, _), depths = jax.lax.scan(step, (init_stack, jnp.int32(0)), (arity, valid))
     return depths
@@ -407,7 +407,7 @@ def tree_depth(kind: Array, length: Array) -> Array:
 
 
 def count_constants(tree: TreeBatch) -> Array:
-    idx = jnp.arange(tree.max_len)
+    idx = jnp.arange(tree.max_len, dtype=jnp.int32)
     valid = idx < tree.length[..., None]
     return jnp.sum((tree.kind == CONST) & valid, axis=-1)
 
@@ -416,7 +416,7 @@ def get_constants(tree: TreeBatch) -> Tuple[Array, Array]:
     """Return (cval, is_const_mask) — the analog of get_constants/set_constants
     (reference DynamicExpressions API, imported at src/SymbolicRegression.jl:68-86).
     Constants stay in-place in the cval field; mask selects them."""
-    idx = jnp.arange(tree.max_len)
+    idx = jnp.arange(tree.max_len, dtype=jnp.int32)
     valid = idx < tree.length[..., None]
     mask = (tree.kind == CONST) & valid
     return tree.cval, mask

@@ -113,7 +113,8 @@ def operand_schedule(kind: Array):
         new_sp = jnp.where(is_pad, sp, sp - jnp.maximum(ar, 0) + 1)
         w = jnp.clip(new_sp - 1, 0, depth - 1)
         new_stack = jnp.where(
-            (jnp.arange(depth) == w[..., None]) & ~is_pad[..., None],
+            (jnp.arange(depth, dtype=jnp.int32) == w[..., None])
+            & ~is_pad[..., None],
             si[..., None],
             stack,
         )
@@ -219,7 +220,8 @@ def instruction_schedule(trees: TreeBatch, operators: OperatorSet):
         pcval = jnp.where(k == CONST, c, 0.0)
         new_sp = jnp.where(is_pad, sp, sp - jnp.maximum(ar, 0) + 1)
         w = jnp.clip(new_sp - 1, 0, depth - 1)
-        at_w = (jnp.arange(depth) == w[:, None]) & ~is_pad[:, None]
+        at_w = (jnp.arange(depth, dtype=jnp.int32) == w[:, None]) \
+            & ~is_pad[:, None]
         new_state = (
             jnp.where(at_w, psrc[:, None], ssrc),
             jnp.where(at_w, pidx[:, None], sidx),
@@ -252,7 +254,7 @@ def instruction_schedule(trees: TreeBatch, operators: OperatorSet):
     # column k (batched scatter; dropped slots land in the L overflow col)
     pos = jnp.cumsum(is_op.astype(jnp.int32), axis=-1) - 1
     col = jnp.where(is_op, pos, L)
-    rows = jnp.arange(T)[:, None]
+    rows = jnp.arange(T, dtype=jnp.int32)[:, None]
 
     def compact(x, fill=0):
         out = jnp.full((T, L + 1), fill, x.dtype)
@@ -271,7 +273,7 @@ def instruction_schedule(trees: TreeBatch, operators: OperatorSet):
     top = jnp.clip(sp - 1, 0, depth - 1)[:, None]
     take = lambda s: jnp.take_along_axis(s, top, axis=-1)[:, 0]
     bare = (nins == 0) & (trees.length > 0)
-    first = jnp.arange(L) == 0
+    first = jnp.arange(L, dtype=jnp.int32) == 0
     sel = bare[:, None] & first
     tables["icode"] = jnp.where(sel, 1, tables["icode"])
     tables["rsrc"] = jnp.where(sel, take(ssrc)[:, None], tables["rsrc"])
@@ -1135,7 +1137,7 @@ def prep_instr_tables(flat, operators, sort_trees):
         inv_perm = jnp.zeros_like(perm).at[perm].set(
             jnp.arange(perm.shape[0], dtype=perm.dtype)
         )
-        tables = {k: v[perm] for k, v in tables.items()}
+        tables = {k: v[perm] for k, v in sorted(tables.items())}
         n_instr = n_instr[perm]
         flat = jax.tree_util.tree_map(lambda x: x[perm], flat)
 
@@ -1145,7 +1147,7 @@ def prep_instr_tables(flat, operators, sort_trees):
         tables = {
             k: jnp.pad(v, ((0, 0), (0, L - L0)),
                        constant_values=_SRC_CONST if k.endswith("src") else 0)
-            for k, v in tables.items()
+            for k, v in sorted(tables.items())
         }
     return tables, n_instr, flat, inv_perm, L
 
@@ -1200,7 +1202,7 @@ def _eval_instr(flat, X, operators, t_block, r_block, interpret, dispatch,
     tbl = {
         k: padT(v.astype(jnp.float32) if k.endswith("cval") else v,
                 _SRC_CONST if k.endswith("src") else 0)
-        for k, v in tables.items()
+        for k, v in sorted(tables.items())
     }
     ninstr_p = jnp.pad(n_instr, (0, T_pad - T))[None, :]
     Xp = jnp.pad(X.astype(cdt), ((0, 0), (0, R_pad - nrows)))

@@ -1,0 +1,33 @@
+"""srlint fixture: idiomatic jitted code that must produce ZERO findings
+(precision guard for the linter's heuristics).
+
+Never imported — parsed by tests/test_analysis.py only."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.jit, static_argnames=("reps",))
+def scan_step(x, reps: int = 4):
+    idx = jnp.arange(x.shape[0], dtype=jnp.int32)
+
+    def body(carry, i):
+        carry = carry + jnp.where(i % 2 == 0, 1.0, -1.0)
+        return carry, carry
+
+    init = jnp.zeros((), jnp.float32)
+    out, ys = lax.scan(body, init, idx)
+    sel = lax.cond(reps > 2, lambda: ys * 2.0, lambda: ys)
+    if x.ndim > 1:  # static rank check: fine
+        sel = sel[:, None] * x
+    return sel
+
+
+def helper(y):
+    # reachable from scan_step? no — host helper using host numpy is fine
+    import numpy as np
+
+    return np.asarray(y).item()

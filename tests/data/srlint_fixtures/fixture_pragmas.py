@@ -1,0 +1,24 @@
+"""srlint fixture: pragma suppression.
+
+Never imported — parsed by tests/test_analysis.py only."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def with_pragmas(x):
+    # suppressed: justified static-table conversion
+    table = np.asarray([1.0, 2.0])  # srlint: disable=SR001 -- static table
+    buf = jnp.zeros((4,))  # srlint: disable=SR004 -- weak-type on purpose
+    wrong = np.asarray(x)  # srlint: disable=SR004 -- wrong rule id: stays
+    return jnp.sum(buf) + table[0] + jnp.sum(wrong)
+
+
+@jax.jit
+def multi_rule(d):
+    out = jnp.arange(  # srlint: disable=SR004,SR003 -- multi-id spelling
+        4
+    )
+    return out

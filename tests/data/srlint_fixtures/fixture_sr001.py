@@ -1,0 +1,27 @@
+"""srlint fixture: SR001 host-sync calls reachable from jitted code.
+
+Never imported — parsed by tests/test_analysis.py only."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _inner(x):
+    # reachable through step() below: both must be flagged
+    host = np.asarray(x)  # SR001 (np.asarray)
+    return jnp.sum(host)
+
+
+def step(x):
+    y = _inner(x) + 1.0
+    jax.block_until_ready(y)  # SR001 (module call form)
+    return y.item()  # SR001 (method form)
+
+
+step_jit = jax.jit(step)
+
+
+def host_only(x):
+    # NOT jit-reachable: identical calls must NOT be flagged
+    return np.asarray(x).item()

@@ -1,0 +1,28 @@
+"""srlint fixture: SR002 Python control flow / concretization on tracers.
+
+Never imported — parsed by tests/test_analysis.py only."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branchy(x):
+    s = jnp.sum(x)
+    if s > 0:  # SR002 (if on a traced value)
+        x = x + 1.0
+    while jnp.max(x) > 2.0:  # SR002 (while on a traced expression)
+        x = x * 0.5
+    return float(jnp.mean(x))  # SR002 (float() concretizes)
+
+
+@jax.jit
+def fine(x, flag: bool):
+    if flag:  # static Python bool: not flagged
+        x = x + 1.0
+    if x is None:  # identity test: not flagged
+        return jnp.zeros((3,), jnp.float32)
+    n = x.shape[0]
+    if n > 4:  # shape math is static: not flagged
+        x = x[:4]
+    return jnp.where(jnp.sum(x) > 0, x, -x)  # traced select: correct form

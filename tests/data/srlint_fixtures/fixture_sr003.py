@@ -1,0 +1,21 @@
+"""srlint fixture: SR003 unsorted dict iteration in jit-reachable code.
+
+Never imported — parsed by tests/test_analysis.py only."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def build(table):
+    out = {}
+    for k, v in table.items():  # SR003 (statement form)
+        out[k] = v * 2.0
+    doubled = {k: v + 1.0 for k, v in table.items()}  # SR003 (comprehension)
+    ordered = {k: v for k, v in sorted(table.items())}  # sorted: not flagged
+    return out, doubled, ordered
+
+
+def host_side(table):
+    # NOT jit-reachable: not flagged
+    return [v for _, v in table.items()]

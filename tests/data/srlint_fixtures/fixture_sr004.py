@@ -1,0 +1,19 @@
+"""srlint fixture: SR004 implicit dtypes in hot-path buffer constructors.
+
+Never imported — parsed by tests/test_analysis.py only. The fixture file
+name starts with ``fixture_`` which the linter treats as a hot-path
+prefix, so SR004 applies here module-wide (no jit root needed)."""
+
+import jax.numpy as jnp
+
+
+def make_buffers(n):
+    a = jnp.zeros((n,))  # SR004
+    b = jnp.ones((n, 2))  # SR004
+    c = jnp.full((n,), 3.5)  # SR004
+    d = jnp.arange(n)  # SR004
+    e = jnp.zeros((n,), jnp.float32)  # positional dtype: not flagged
+    f = jnp.full((n,), 3.5, dtype=jnp.float32)  # kwarg dtype: not flagged
+    g = jnp.arange(n, dtype=jnp.int32)  # not flagged
+    h = jnp.zeros_like(e)  # inherits dtype: not flagged
+    return a, b, c, d, e, f, g, h

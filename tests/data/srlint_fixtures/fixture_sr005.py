@@ -1,0 +1,36 @@
+"""srlint fixture: SR005 static_argnames naming nonexistent parameters.
+
+Never imported — parsed by tests/test_analysis.py only."""
+
+import functools
+
+import jax
+
+
+def kernel(x, block_size: int = 8):
+    return x * block_size
+
+
+bad = jax.jit(kernel, static_argnames=("block_sz",))  # SR005 (typo)
+good = jax.jit(kernel, static_argnames=("block_size",))  # not flagged
+multi = jax.jit(  # SR005 (one of two stale)
+    kernel, static_argnames=("block_size", "tile")
+)
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def dispatch(x, mode: str = "fast"):  # decorator form: not flagged
+    return x
+
+
+@functools.partial(jax.jit, static_argnames=("modes",))  # SR005
+def dispatch2(x, mode: str = "fast"):
+    return x
+
+
+def flexible(x, **kwargs):
+    return x
+
+
+# **kwargs can absorb any name: not checked
+flex = jax.jit(flexible, static_argnames=("anything",))

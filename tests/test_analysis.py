@@ -1,0 +1,314 @@
+"""Static-analysis subsystem (ISSUE 3): srlint rule detection on known-bad
+fixtures, pragma suppression, reporter schema, compile-surface contracts,
+and the baseline drift gate.
+
+The srlint fixtures under tests/data/srlint_fixtures/ are parsed, never
+imported; each file documents inline which lines must (and must NOT) be
+flagged. Everything here is CPU-only AST/tracing work — no TPU, and the
+only jax executions are eval_shape/make_jaxpr traces."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from symbolicregression_jl_tpu.analysis import (
+    RULES,
+    AnalysisReport,
+    lint_package,
+    lint_paths,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "srlint_fixtures")
+
+
+def _lint_fixture(name):
+    return lint_paths(
+        FIXTURES, files=[os.path.join(FIXTURES, name)], repo_root=REPO
+    )
+
+
+def _active(violations, rule=None):
+    return [
+        v for v in violations
+        if not v.suppressed and (rule is None or v.rule_id == rule)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# srlint: one fixture per rule
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_sr001_host_sync_detected():
+    vs = _lint_fixture("fixture_sr001.py")
+    hits = _active(vs, "SR001")
+    assert len(hits) == 3, [v.to_dict() for v in vs]
+    # reachable through the call graph, not just the jitted def itself
+    assert any(v.function == "_inner" for v in hits)
+    # host-only helper with identical calls stays clean
+    assert not any(v.function == "host_only" for v in hits)
+
+
+@pytest.mark.fast
+def test_sr002_tracer_control_flow_detected():
+    vs = _lint_fixture("fixture_sr002.py")
+    hits = _active(vs, "SR002")
+    assert len(hits) == 3, [v.to_dict() for v in vs]
+    assert all(v.function == "branchy" for v in hits)
+    # static bool / identity / shape-math branches in fine() not flagged
+    assert not _active(vs, "SR001")
+
+
+@pytest.mark.fast
+def test_sr003_unsorted_dict_iteration_detected():
+    vs = _lint_fixture("fixture_sr003.py")
+    hits = _active(vs, "SR003")
+    assert len(hits) == 2, [v.to_dict() for v in vs]
+    assert all(v.function == "build" for v in hits)
+
+
+@pytest.mark.fast
+def test_sr004_implicit_dtype_detected():
+    vs = _lint_fixture("fixture_sr004.py")
+    hits = _active(vs, "SR004")
+    # zeros/ones/full/arange without dtype; positional+kwarg dtype and
+    # zeros_like stay clean
+    assert len(hits) == 4, [v.to_dict() for v in vs]
+
+
+@pytest.mark.fast
+def test_sr005_stale_static_argnames_detected():
+    vs = _lint_fixture("fixture_sr005.py")
+    hits = _active(vs, "SR005")
+    assert len(hits) == 3, [v.to_dict() for v in vs]
+    messages = " ".join(v.message for v in hits)
+    for stale in ("block_sz", "tile", "modes"):
+        assert stale in messages
+    # the valid wrapper, the decorator form and **kwargs are not flagged
+    assert not any("block_size'" in v.message for v in hits)
+
+
+@pytest.mark.fast
+def test_clean_fixture_produces_zero_findings():
+    vs = _lint_fixture("fixture_clean.py")
+    assert vs == [], [v.to_dict() for v in vs]
+
+
+# ---------------------------------------------------------------------------
+# pragmas + reporters
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_pragma_suppression():
+    vs = _lint_fixture("fixture_pragmas.py")
+    active = _active(vs)
+    suppressed = [v for v in vs if v.suppressed]
+    # the mismatched-rule pragma does NOT suppress
+    assert len(active) == 1 and active[0].rule_id == "SR001"
+    # single-rule, multi-rule, and justified pragmas all suppress
+    assert len(suppressed) == 3
+    assert {v.rule_id for v in suppressed} == {"SR001", "SR004"}
+
+
+@pytest.mark.fast
+def test_json_report_schema():
+    vs = _lint_fixture("fixture_pragmas.py")
+    report = AnalysisReport(violations=vs)
+    payload = json.loads(report.to_json())
+    assert payload["schema_version"] == 1
+    assert payload["tool"] == "srlint"
+    assert payload["ok"] is False
+    assert payload["counts"] == {"SR001": 1}
+    assert payload["suppressed"] == 3
+    assert payload["surface"] is None
+    for v in payload["violations"]:
+        assert set(v) == {
+            "rule", "name", "path", "line", "col", "function", "message",
+            "suppressed",
+        }
+        assert v["rule"] in RULES
+    # text renderer shows only active findings plus the summary line
+    text = report.to_text()
+    assert text.count("SR001") >= 1
+    assert "suppressed by pragma" in text
+
+
+@pytest.mark.fast
+def test_rule_catalog_documented():
+    for rule in RULES.values():
+        assert rule.summary and rule.rationale
+    # docs cross-check: every rule id appears in the rule catalog doc
+    doc = open(os.path.join(REPO, "docs", "static_analysis.md")).read()
+    for rid in RULES:
+        assert rid in doc, f"{rid} missing from docs/static_analysis.md"
+
+
+# ---------------------------------------------------------------------------
+# the repo itself must be clean (the lint lands green — ISSUE 3 satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_package_tree_is_srlint_clean():
+    vs = lint_package(repo_root=REPO)
+    active = _active(vs)
+    assert active == [], "\n".join(
+        f"{v.path}:{v.line} {v.rule_id} {v.message}" for v in active
+    )
+
+
+# ---------------------------------------------------------------------------
+# compile surface
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_compile_surface_single_config(tmp_path):
+    """One small config end-to-end under JAX_PLATFORMS=cpu (conftest):
+    aval stability, IslandState contract, no callbacks/f64, census
+    written and re-read as a baseline."""
+    from symbolicregression_jl_tpu.analysis.compile_surface import (
+        check_surface,
+    )
+
+    path = str(tmp_path / "baseline.json")
+    r = check_surface(
+        update_baseline=True, baseline_path=path,
+        configs=(("base", {}),), include_chunked=False,
+    )
+    assert r["problems"] == []
+    entry = r["configs"]["base"]
+    assert entry["stable_avals"]
+    assert entry["total_primitives"] > 100
+    assert not any("callback" in p for p in entry["primitives"])
+    # second run diffs clean against the just-written baseline
+    r2 = check_surface(
+        baseline_path=path, configs=(("base", {}),), include_chunked=False,
+    )
+    assert r2["ok"], r2["problems"]
+    assert r2["baseline_checked"] and r2["baseline_match"]
+
+
+@pytest.mark.fast
+def test_baseline_diff_catches_injected_primitive(tmp_path):
+    """Acceptance: an extra primitive in the census fails the diff."""
+    from symbolicregression_jl_tpu.analysis.compile_surface import (
+        diff_baseline,
+    )
+
+    baseline = {
+        "configs": {
+            "base": {"primitives": {"add": 10, "mul": 5}},
+        }
+    }
+    clean = {"base": {"primitives": {"add": 10, "mul": 5}}}
+    assert diff_baseline(clean, baseline) == []
+    injected = {"base": {"primitives": {"add": 10, "mul": 5,
+                                        "pure_callback": 1}}}
+    probs = diff_baseline(injected, baseline)
+    assert len(probs) == 1 and "pure_callback" in probs[0]
+    grown = {"base": {"primitives": {"add": 11, "mul": 5}}}
+    probs = diff_baseline(grown, baseline)
+    assert len(probs) == 1 and "baseline 10 -> now 11" in probs[0]
+    missing = {"other": {"primitives": {}}}
+    probs = diff_baseline(missing, baseline)
+    assert len(probs) == 2  # unknown config + config no longer produced
+
+
+@pytest.mark.fast
+def test_checked_in_baseline_exists_and_well_formed():
+    from symbolicregression_jl_tpu.analysis.compile_surface import (
+        BASELINE_PATH,
+    )
+
+    with open(BASELINE_PATH) as f:
+        payload = json.load(f)
+    assert payload["schema_version"] == 1
+    assert set(payload["configs"]) == {
+        "base", "cache", "islands4", "pop32", "chunked",
+    }
+    for entry in payload["configs"].values():
+        assert entry["total_primitives"] == sum(
+            entry["primitives"].values()
+        )
+        assert not any("callback" in p for p in entry["primitives"])
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_cli_lint_only_json():
+    """`python -m symbolicregression_jl_tpu.analysis --only lint` exits 0
+    on the repo at HEAD and prints the JSON schema."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "symbolicregression_jl_tpu.analysis",
+         "--only", "lint", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["counts"] == {}
+
+
+@pytest.mark.fast
+def test_cli_in_process_exit_codes(tmp_path, monkeypatch):
+    """main() returns nonzero when lint finds active violations."""
+    import symbolicregression_jl_tpu.analysis as ana
+    from symbolicregression_jl_tpu.analysis.__main__ import main
+
+    # clean repo: exit 0 (lint engine only; surface covered above)
+    assert main(["--only", "lint", "--format", "json"]) == 0
+
+    def bad_lint():
+        return lint_paths(
+            FIXTURES,
+            files=[os.path.join(FIXTURES, "fixture_sr001.py")],
+            repo_root=REPO,
+        )
+
+    monkeypatch.setattr(ana, "lint_package", bad_lint)
+    assert main(["--only", "lint", "--format", "text"]) == 1
+
+
+@pytest.mark.slow
+def test_cli_full_run_green_at_head():
+    """The full gate — srlint + compile surface vs the checked-in
+    baseline — exits 0 on the repo at HEAD (the ISSUE 3 acceptance
+    criterion). Slow: traces the whole Options matrix (~1 min)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "symbolicregression_jl_tpu.analysis",
+         "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["surface"]["baseline_match"] is True
+
+
+@pytest.mark.slow
+def test_scripts_lint_entry_point():
+    """scripts/lint.py (the suite-case entry) runs the same gate plus the
+    docs drift check and exits 0 at HEAD."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "lint.py"),
+         "--only", "lint", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["docs"]["api_reference_current"] is True
