@@ -468,10 +468,11 @@ def bench_fitness_cache():
 
 
 def bench_static_analysis():
-    """Static-analysis gate as a suite case (ISSUE 3): srlint violation
-    count, compile-surface baseline status, and docs/api_reference.md
-    drift, via scripts/lint.py --format json in its own subprocess (the
-    gate pins CPU for itself; this case never needs the device)."""
+    """Static-analysis gate as a suite case (ISSUEs 3+4): srlint
+    violation count, compile-surface baseline status, the srmem
+    HBM-footprint gate, and docs/api_reference.md drift, via
+    scripts/lint.py --format json in its own subprocess (the gate pins
+    CPU for itself; this case never needs the device)."""
     import subprocess
 
     script = os.path.join(
@@ -502,7 +503,9 @@ def bench_static_analysis():
             "seconds": seconds,
         }]
     surface = payload.get("surface") or {}
+    memory = payload.get("memory") or {}
     docs = payload.get("docs") or {}
+    mem_configs = memory.get("configs", {})
     return [
         {
             "suite": "static_analysis",
@@ -518,6 +521,21 @@ def bench_static_analysis():
             "configs": len(surface.get("configs", {})),
             "baseline_match": surface.get("baseline_match", False),
             "problems": len(surface.get("problems", [])),
+        },
+        {
+            "suite": "static_analysis",
+            "case": "srmem",
+            "ok": memory.get("ok", False),
+            "configs": len(mem_configs),
+            "baseline_match": memory.get("baseline_match", False),
+            "problems": len(memory.get("problems", [])),
+            # worst modeled resident footprint across the matrix, the
+            # number the HBM budget gates on
+            "max_footprint_mb": round(max(
+                (e.get("footprint_bytes", 0) for e in mem_configs.values()),
+                default=0,
+            ) / 1e6, 2),
+            "hbm_budget_gb": memory.get("hbm_budget_gb", 0),
         },
         {
             "suite": "static_analysis",

@@ -1,10 +1,11 @@
 #!/usr/bin/env python
-"""Repo-wide static-analysis gate: srlint + compile-surface + doc drift.
+"""Repo-wide static-analysis gate: srlint + compile-surface + srmem HBM
+gate + doc drift.
 
 The one command CI (and benchmark/suite.py's `static_analysis` case) runs:
 
-    python scripts/lint.py [--format text|json] [--only lint|surface]
-        [--update-baseline] [--skip-docs]
+    python scripts/lint.py [--format text|json] [--only lint|surface|memory]
+        [--update-baseline] [--hbm-budget-gb G] [--xla-memory] [--skip-docs]
 
 Wraps `python -m symbolicregression_jl_tpu.analysis` and adds the
 doc-drift check: docs/api_reference.md must be exactly what
@@ -70,7 +71,10 @@ def main(argv=None) -> int:
     report = run_analysis(
         lint=ns.only in (None, "lint"),
         surface=ns.only in (None, "surface"),
+        memory=ns.only in (None, "memory"),
         update_baseline=ns.update_baseline,
+        hbm_budget_gb=ns.hbm_budget_gb,
+        xla_memory=ns.xla_memory,
     )
     docs = None if ns.skip_docs else check_docs()
     ok = report.ok and (docs is None or docs["api_reference_current"])

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""AOT-compile the search iteration for the TPU target and print XLA's
+"""AOT-compile the search stages for the TPU target and print XLA's
 memory analysis — compile only, nothing executes, so a flaky tunnel
 window cannot be wedged by a faulting run.
 
@@ -11,7 +11,15 @@ CPU build routes eval/optimize through the jnp interpreter, so the
 TPU-target numbers (Pallas kernels, TPU layouts) must be measured to
 confirm HBM OOM as the fault and to attribute it per stage.
 
+The stage programs and the AOT plumbing live in
+symbolicregression_jl_tpu.analysis.memory (the srmem engine — this
+script is its on-TPU face; CI runs the same engine's modeled numbers on
+CPU via `python -m symbolicregression_jl_tpu.analysis --only memory`).
+Each stage also prints the srmem live-buffer model alongside XLA's
+number, so the model's tracking can be eyeballed against ground truth.
+
 Usage: python scripts/tpu_mem_analysis.py [--islands 64] [--npop 256]
+           [--rows 1000]
 """
 
 import argparse
@@ -23,28 +31,22 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--islands", type=int, default=64)
     ap.add_argument("--npop", type=int, default=256)
+    ap.add_argument("--rows", type=int, default=1000)
     args = ap.parse_args()
 
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     dev = jax.devices()[0]
     print(f"# device: {dev} ({dev.platform})", file=sys.stderr, flush=True)
     if dev.platform not in ("tpu", "axon"):
         sys.exit("# needs the TPU target — tunnel unavailable")
 
-    from symbolicregression_jl_tpu.api import _make_init_fn
-    from symbolicregression_jl_tpu.models.evolve import (
-        optimize_islands_constants,
-        s_r_cycle_islands,
-        simplify_population_islands,
+    from symbolicregression_jl_tpu.analysis.memory import (
+        build_stage_programs,
+        live_buffer_peak,
+        xla_stage_analysis,
     )
     from symbolicregression_jl_tpu.models.options import make_options
-    from symbolicregression_jl_tpu.parallel.migration import (
-        merge_hofs_across_islands,
-        migrate,
-    )
 
     options = make_options(
         binary_operators=["+", "-", "*", "/"],
@@ -52,71 +54,33 @@ def main():
         npop=args.npop, npopulations=args.islands,
         ncycles_per_iteration=100, maxsize=18, seed=0,
     )
-    rng = np.random.default_rng(0)
-    X = jnp.asarray(rng.uniform(1, 3, (2, 1000)).astype(np.float32))
-    y = jnp.asarray(np.asarray(X[0] * X[1]))
-    baseline = jnp.asarray(1.0, jnp.float32)
-    scalars = options.traced_scalars()
-    keys = jax.random.split(jax.random.PRNGKey(0), args.islands)
-    init = _make_init_fn(options, 2, False)
-    states = jax.eval_shape(
-        lambda k: init(k, X, y, baseline, scalars), keys
+    programs = build_stage_programs(
+        options, nfeatures=2, nrows=args.rows
     )
-    cm = jnp.asarray(options.maxsize, jnp.int32)
-    opts_b = options.bind_scalars(scalars)
-    kk = jax.random.PRNGKey(1)
-    okeys = jax.random.split(kk, args.islands)
-
-    def report(name, f, *fargs):
+    for name, (fn, fargs) in programs.items():
         t0 = time.time()
         try:
-            compiled = jax.jit(f).lower(*fargs).compile()
-        except Exception as e:
+            modeled = live_buffer_peak(jax.make_jaxpr(fn)(*fargs))
+        except Exception as e:  # keep reporting the remaining stages
+            print(f"{name}: TRACE-FAIL {type(e).__name__}: {e} "
+                  f"({time.time() - t0:.0f}s)", flush=True)
+            continue
+        res = xla_stage_analysis(fn, fargs)
+        dt = time.time() - t0
+        if "error" in res:
+            print(f"{name}: COMPILE-FAIL {res['error']} ({dt:.0f}s)",
+                  flush=True)
+        elif res.get("unavailable"):
+            print(f"{name}: compiled OK, memory_analysis unavailable "
+                  f"({dt:.0f}s)", flush=True)
+        else:
             print(
-                f"{name}: COMPILE-FAIL {type(e).__name__}: "
-                f"{str(e)[:160]} ({time.time() - t0:.0f}s)",
+                f"{name}: temp={res['temp_bytes'] / 1e6:.0f}MB "
+                f"args={res['argument_bytes'] / 1e6:.0f}MB "
+                f"modeled={modeled['peak_bytes'] / 1e6:.0f}MB "
+                f"({dt:.0f}s)",
                 flush=True,
             )
-            return
-        ma = compiled.memory_analysis()
-        if ma is None:  # runtime doesn't implement memory_analysis
-            print(f"{name}: compiled OK, memory_analysis unavailable "
-                  f"({time.time() - t0:.0f}s)", flush=True)
-            return
-        print(
-            f"{name}: temp={ma.temp_size_in_bytes / 1e6:.0f}MB "
-            f"args={ma.argument_size_in_bytes / 1e6:.0f}MB "
-            f"({time.time() - t0:.0f}s)",
-            flush=True,
-        )
-
-    report("init", lambda k: init(k, X, y, baseline, scalars), keys)
-    report(
-        "cycle100",
-        lambda s: s_r_cycle_islands(s, cm, X, y, None, baseline, opts_b),
-        states,
-    )
-    report(
-        "simplify",
-        lambda s: simplify_population_islands(
-            s, cm, X, y, None, baseline, opts_b
-        ),
-        states,
-    )
-    report(
-        "optimize",
-        lambda k, s: optimize_islands_constants(
-            k, s, X, y, None, baseline, opts_b
-        ),
-        okeys, states,
-    )
-    report(
-        "merge_migrate",
-        lambda k, s: migrate(
-            k, s, merge_hofs_across_islands(s.hof), opts_b
-        ),
-        kk, states,
-    )
 
 
 if __name__ == "__main__":

@@ -1,20 +1,28 @@
-"""Static analysis for the TPU hot path: srlint + compile-surface checker.
+"""Static analysis for the TPU hot path: srlint + compile-surface checker
++ srmem HBM-footprint analyzer.
 
-Two engines, one CLI (``python -m symbolicregression_jl_tpu.analysis``):
+Three engines, one CLI (``python -m symbolicregression_jl_tpu.analysis``):
 
 - **srlint** (lint.py / rules.py): a JAX-aware AST linter that builds a
   call graph rooted at the package's ``jax.jit`` entry points and flags
   host syncs, tracer control flow, nondeterministic dict iteration,
-  implicit dtypes, and stale ``static_argnames`` — with
+  implicit dtypes, stale ``static_argnames``, undonated carries, broadcast
+  materializations, and host round-trips into jitted code — with
   ``# srlint: disable=RULE`` pragmas.
 - **compile-surface checker** (compile_surface.py): traces the jitted
   iteration/phase closures over a matrix of Options configs, asserts aval
   stability across iterations and the IslandState output contract, rejects
   callback/float64 primitives leaking into the jaxpr, and diffs primitive
   counts against the checked-in ``compile_baseline.json``.
+- **srmem** (memory.py): a jaxpr-walking live-buffer estimator that models
+  peak temp HBM per config and per stage, diffs against the checked-in
+  ``memory_baseline.json`` (>10% regressions fail), and gates every config
+  against an HBM budget (default 16GB, one v5e).
 
 See docs/static_analysis.md for the rule catalog and workflows.
 """
+
+from typing import Optional
 
 from .lint import Linter, lint_package, lint_paths
 from .report import AnalysisReport
@@ -58,25 +66,40 @@ def add_engine_args(parser) -> None:
         help="report format (default: text)",
     )
     parser.add_argument(
-        "--only", choices=("lint", "surface"), default=None,
-        help="run a single engine (default: both)",
+        "--only", choices=("lint", "surface", "memory"), default=None,
+        help="run a single engine (default: all three)",
     )
     parser.add_argument(
         "--update-baseline", action="store_true",
-        help="rewrite analysis/compile_baseline.json from this tree's "
-        "primitive census instead of diffing against it",
+        help="rewrite the checked-in baselines (compile_baseline.json / "
+        "memory_baseline.json) for the engines being run, instead of "
+        "diffing against them",
+    )
+    parser.add_argument(
+        "--hbm-budget-gb", type=float, default=None, metavar="G",
+        help="srmem: fail any config whose modeled HBM footprint "
+        "exceeds G gigabytes (default: 16, one v5e chip)",
+    )
+    parser.add_argument(
+        "--xla-memory", action="store_true",
+        help="srmem: additionally AOT-compile each config on the "
+        "current backend and report XLA's own memory analysis (slower; "
+        "informational only — the gate diffs the modeled numbers)",
     )
 
 
 def run_analysis(
     lint: bool = True,
     surface: bool = True,
+    memory: bool = True,
     update_baseline: bool = False,
+    hbm_budget_gb: Optional[float] = None,
+    xla_memory: bool = False,
 ) -> AnalysisReport:
-    """Run srlint and/or the compile-surface checker on this repo.
+    """Run srlint / the compile-surface checker / srmem on this repo.
 
-    Importing compile_surface pulls in jax; callers that only lint stay
-    AST-only (no backend initialization)."""
+    Importing compile_surface or memory pulls in jax; callers that only
+    lint stay AST-only (no backend initialization)."""
     report = AnalysisReport()
     if lint:
         report.violations = lint_package()
@@ -84,4 +107,15 @@ def run_analysis(
         from .compile_surface import check_surface
 
         report.surface = check_surface(update_baseline=update_baseline)
+    if memory:
+        from .memory import DEFAULT_HBM_BUDGET_GB, check_memory
+
+        report.memory = check_memory(
+            update_baseline=update_baseline,
+            hbm_budget_gb=(
+                DEFAULT_HBM_BUDGET_GB if hbm_budget_gb is None
+                else hbm_budget_gb
+            ),
+            xla_memory=xla_memory,
+        )
     return report

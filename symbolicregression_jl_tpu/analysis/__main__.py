@@ -1,11 +1,13 @@
 """CLI for the static-analysis subsystem.
 
     python -m symbolicregression_jl_tpu.analysis [--format text|json]
-        [--only lint|surface] [--update-baseline]
+        [--only lint|surface|memory] [--update-baseline]
+        [--hbm-budget-gb G] [--xla-memory]
 
-Exit status: 0 when clean, 1 on violations / surface problems (CI
-contract — benchmark/suite.py and scripts/lint.py both rely on it).
-Platform handling: see `analysis.pin_platform`.
+Exit status: 0 when clean, 1 on violations / surface problems / HBM
+budget or baseline regressions (CI contract — benchmark/suite.py and
+scripts/lint.py both rely on it). Platform handling: see
+`analysis.pin_platform`.
 """
 
 from __future__ import annotations
@@ -19,8 +21,8 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(
         prog="python -m symbolicregression_jl_tpu.analysis",
-        description="srlint + compile-surface checker "
-        "(docs/static_analysis.md)",
+        description="srlint + compile-surface checker + srmem "
+        "HBM-footprint gate (docs/static_analysis.md)",
     )
     add_engine_args(ap)
     ns = ap.parse_args(argv)
@@ -29,7 +31,10 @@ def main(argv=None) -> int:
     report = run_analysis(
         lint=ns.only in (None, "lint"),
         surface=ns.only in (None, "surface"),
+        memory=ns.only in (None, "memory"),
         update_baseline=ns.update_baseline,
+        hbm_budget_gb=ns.hbm_budget_gb,
+        xla_memory=ns.xla_memory,
     )
     print(report.to_json() if ns.format == "json" else report.to_text())
     return 0 if report.ok else 1

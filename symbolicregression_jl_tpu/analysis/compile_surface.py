@@ -386,6 +386,8 @@ def check_surface(
 
     baseline_checked = baseline_match = False
     if update_baseline:
+        from .report import write_baseline_json
+
         payload = {
             "schema_version": 1,
             "jax_version": jax.__version__,
@@ -395,9 +397,7 @@ def check_surface(
                 for name, entry in out_configs.items()
             },
         }
-        with open(baseline_path, "w") as f:
-            json.dump(payload, f, indent=2, sort_keys=True)
-            f.write("\n")
+        write_baseline_json(baseline_path, payload)
     elif os.path.exists(baseline_path):
         with open(baseline_path) as f:
             baseline = json.load(f)

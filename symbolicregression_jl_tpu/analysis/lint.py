@@ -332,6 +332,27 @@ class Linter:
                 linter._record_call(mod, self.current(), node, self.scope())
                 self.generic_visit(node)
 
+            def visit_Assign(self, node: ast.Assign):
+                # `fast_step = jax.jit(step)`: bind the alias to the
+                # wrapped FuncInfo so later `fast_step(...)` calls resolve
+                # to the jit root (SR008 needs the call edge; the root
+                # marking itself happens in visit_Call below)
+                if (
+                    len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)
+                ):
+                    full = linter._canonical(self.scope(), node.value.func)
+                    if full in linter._JIT_NAMES and node.value.args:
+                        wrapped = linter._funcinfo_of_expr(
+                            self.scope(), mod, node.value.args[0]
+                        )
+                        if wrapped is not None:
+                            self.scope().bind(
+                                node.targets[0].id, "func", wrapped
+                            )
+                self.generic_visit(node)
+
             def visit_Name(self, node: ast.Name):
                 # conservative closure edges: any reference to a known
                 # function inside a traced body probably runs at trace
@@ -385,6 +406,7 @@ class Linter:
             if wrapped is not None:
                 wrapped.is_jit_root = True
                 self._check_static_argnames(mod, node, wrapped)
+                self._check_donation(mod, node, wrapped, node)
         # function-valued arguments (vmap/scan/tree_map/closures)
         for arg in list(node.args) + [kw.value for kw in node.keywords]:
             f = self._funcinfo_of_expr(scope, mod, arg)
@@ -397,17 +419,21 @@ class Linter:
         full = self._canonical(scope, deco)
         if full in self._JIT_NAMES:
             info.is_jit_root = True
+            # a bare @jax.jit cannot carry donate_argnums at all
+            self._check_donation(mod, deco, info, None)
             return
         if isinstance(deco, ast.Call):
             cfull = self._canonical(scope, deco.func)
             if cfull in self._JIT_NAMES:
                 info.is_jit_root = True
                 self._check_static_argnames(mod, deco, info)
+                self._check_donation(mod, deco, info, deco)
             elif cfull in self._PARTIAL_NAMES and deco.args:
                 inner = self._canonical(scope, deco.args[0])
                 if inner in self._JIT_NAMES:
                     info.is_jit_root = True
                     self._check_static_argnames(mod, deco, info)
+                    self._check_donation(mod, deco, info, deco)
 
     # -- SR005 ----------------------------------------------------------
     def _check_static_argnames(
@@ -428,6 +454,39 @@ class Linter:
                     f"(params: {', '.join(wrapped.params) or 'none'})",
                     function=wrapped.qualname,
                 )
+
+    # -- SR006 ----------------------------------------------------------
+    _DONATE_KWARGS = ("donate_argnums", "donate_argnames")
+
+    def _check_donation(
+        self, mod: ModuleInfo, node, wrapped: FuncInfo,
+        call: Optional[ast.Call],
+    ) -> None:
+        """jit entry with a rebuilt-and-returned parameter (the static
+        signature of a carry) but no donate_argnums/donate_argnames.
+        `call` is the jit/partial Call carrying the keywords; None for a
+        bare @jax.jit decorator (which cannot donate at all)."""
+        static: Tuple[str, ...] = ()
+        if call is not None:
+            kws = [kw.arg for kw in call.keywords]
+            if None in kws:  # **kwargs forwarding: opaque, skip
+                return
+            if any(k in self._DONATE_KWARGS for k in kws):
+                return
+            for kw in call.keywords:
+                if kw.arg == "static_argnames":
+                    static = tuple(_literal_str_seq(kw.value) or ())
+        for name in _rebuilt_returned_params(wrapped):
+            if name in static:  # static config values, not carries
+                continue
+            self._add(
+                mod, node, "SR006",
+                f"jit entry {wrapped.qualname}() rebuilds and returns "
+                f"its parameter {name!r} (a carry) but donates no "
+                "buffers — list it in donate_argnums/donate_argnames so "
+                "XLA reuses the carry's HBM in place",
+                function=wrapped.qualname,
+            )
 
     # -- violation plumbing --------------------------------------------
     def _add(
@@ -468,6 +527,11 @@ class Linter:
             for info in mod.functions.values():
                 if id(info) in self.jit_reachable:
                     self._scan_jit_function(mod, info)
+                else:
+                    # SR008 is about HOST code feeding synced values back
+                    # into jitted entries; jit-reachable bodies are
+                    # already covered by SR001
+                    self._scan_host_roundtrip(mod, info)
         self.violations.sort(key=lambda v: (v.path, v.line, v.rule_id))
         return self.violations
 
@@ -540,6 +604,15 @@ class Linter:
         "jax.ops.",
     )
     _STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+    # SR007: constructors whose output is inherently a multiple of the
+    # input bytes; tile/repeat only with a LITERAL factor >= the
+    # threshold (non-literal factors are skipped — precision over recall)
+    _BLOWUP_ALWAYS = {
+        "jax.numpy.broadcast_to", "jax.numpy.outer", "jax.numpy.kron",
+        "jax.numpy.meshgrid",
+    }
+    _BLOWUP_FACTOR_FNS = {"jax.numpy.tile", "jax.numpy.repeat"}
+    _BLOWUP_MIN_FACTOR = 8
 
     def _scan_jit_function(self, mod: ModuleInfo, info: FuncInfo) -> None:
         scope = info.scope
@@ -635,6 +708,28 @@ class Linter:
                         "under jit (host sync outside)",
                         function=info.qualname,
                     )
+                elif full in linter._BLOWUP_ALWAYS:
+                    short = full.replace("jax.numpy.", "jnp.")
+                    linter._add(
+                        mod, node, "SR007",
+                        f"{short}(...) materializes a broadcast in "
+                        f"jit-reachable {info.qualname}(): the output "
+                        "aval is a multiple of its inputs' bytes — keep "
+                        "the implicit-broadcast form (XLA fuses it) or "
+                        "chunk the batch",
+                        function=info.qualname,
+                    )
+                elif full in linter._BLOWUP_FACTOR_FNS:
+                    fac = _literal_int_factor(node)
+                    if fac is not None and fac >= linter._BLOWUP_MIN_FACTOR:
+                        short = full.replace("jax.numpy.", "jnp.")
+                        linter._add(
+                            mod, node, "SR007",
+                            f"{short}(...) with literal factor {fac} in "
+                            f"jit-reachable {info.qualname}(): "
+                            f"materializes {fac}x the input bytes",
+                            function=info.qualname,
+                        )
 
         def scan_stmts(stmts) -> None:
             for stmt in stmts:
@@ -723,6 +818,89 @@ class Linter:
         else:
             scan_stmts(info.node.body)
 
+    # SR008 (host-side functions only) ---------------------------------
+    def _scan_host_roundtrip(self, mod: ModuleInfo, info: FuncInfo) -> None:
+        """A value pulled to the host (np.asarray / device_get / .item())
+        and then passed as an argument to a resolvable jit root never
+        needed to leave the device. Taint is name-level within one
+        function body (no propagation through further assignments)."""
+        if isinstance(info.node, ast.Lambda):
+            return
+        scope = info.scope
+        tainted: Set[str] = set()
+        linter = self
+
+        def is_sync(node) -> bool:
+            if not isinstance(node, ast.Call):
+                return False
+            full = linter._canonical(scope, node.func)
+            if full in linter._HOST_SYNC_CALLS:
+                return True
+            return (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in linter._HOST_SYNC_METHODS
+                and not node.args
+            )
+
+        def has_sync(expr) -> bool:
+            return any(is_sync(n) for n in ast.walk(expr))
+
+        def check_call(node: ast.Call) -> None:
+            callee = linter._funcinfo_of_expr(scope, mod, node.func)
+            if callee is None or not callee.is_jit_root:
+                return
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if has_sync(arg) or (
+                    isinstance(arg, ast.Name) and arg.id in tainted
+                ):
+                    linter._add(
+                        mod, node, "SR008",
+                        "host-synchronized value fed straight back into "
+                        f"jitted {callee.qualname}() from "
+                        f"{info.qualname}(): pays a device->host sync + "
+                        "host->device transfer and defeats buffer "
+                        "donation — pass the device array directly",
+                        function=info.qualname,
+                    )
+
+        def scan(stmts) -> None:
+            for stmt in stmts:
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)
+                ):
+                    continue  # separate FuncInfo / class body
+                for _field, value in ast.iter_fields(stmt):
+                    vals = value if isinstance(value, list) else [value]
+                    for v in vals:
+                        if isinstance(v, ast.expr):
+                            for n in ast.walk(v):
+                                if isinstance(n, ast.Call):
+                                    check_call(n)
+                if isinstance(stmt, ast.Assign):
+                    sync = has_sync(stmt.value)
+                    for tgt in stmt.targets:
+                        for n in ast.walk(tgt):
+                            if isinstance(n, ast.Name):
+                                if sync:
+                                    tainted.add(n.id)
+                                else:
+                                    # reassignment from a non-sync
+                                    # value kills the taint — the name
+                                    # no longer holds the host copy
+                                    tainted.discard(n.id)
+                for block in ("body", "orelse", "finalbody"):
+                    b = getattr(stmt, block, None)
+                    if isinstance(b, list) and b and isinstance(
+                        b[0], ast.stmt
+                    ):
+                        scan(b)
+                if isinstance(stmt, ast.Try):
+                    for h in stmt.handlers:
+                        scan(h.body)
+
+        scan(info.node.body)
+
     # SR003 ------------------------------------------------------------
     def _check_dict_iter(self, mod: ModuleInfo, info: FuncInfo, it) -> None:
         if (
@@ -738,6 +916,96 @@ class Linter:
                 "construction order is deterministic across hosts",
                 function=info.qualname,
             )
+
+
+def _rebuilt_returned_params(info: FuncInfo) -> List[str]:
+    """Parameters that are reassigned in the body AND reachable from a
+    return value — the static signature of a carry (SR006). Reachability
+    follows local aliases transitively (``outs = (states, ghof)`` then
+    ``return outs`` still exposes ``states``); nested function bodies are
+    separate FuncInfos and excluded."""
+    node = info.node
+    if isinstance(node, ast.Lambda):
+        return []
+    rebuilt: Set[str] = set()
+    returned: Set[str] = set()
+    # name -> names appearing in its assigned value(s), for the closure
+    aliases: Dict[str, Set[str]] = {}
+
+    def scan(stmts) -> None:
+        for stmt in stmts:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            if isinstance(stmt, ast.Assign):
+                value_names = {
+                    n.id for n in ast.walk(stmt.value)
+                    if isinstance(n, ast.Name)
+                }
+                for tgt in stmt.targets:
+                    for n in ast.walk(tgt):
+                        if isinstance(n, ast.Name):
+                            rebuilt.add(n.id)
+                            aliases.setdefault(n.id, set()).update(
+                                value_names
+                            )
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if isinstance(stmt.target, ast.Name):
+                    rebuilt.add(stmt.target.id)
+                    if stmt.value is not None:
+                        aliases.setdefault(stmt.target.id, set()).update(
+                            n.id for n in ast.walk(stmt.value)
+                            if isinstance(n, ast.Name)
+                        )
+            elif isinstance(stmt, ast.Return) and stmt.value is not None:
+                returned.update(
+                    n.id for n in ast.walk(stmt.value)
+                    if isinstance(n, ast.Name)
+                )
+            for block in ("body", "orelse", "finalbody"):
+                b = getattr(stmt, block, None)
+                if isinstance(b, list) and b and isinstance(b[0], ast.stmt):
+                    scan(b)
+            if isinstance(stmt, ast.Try):
+                for h in stmt.handlers:
+                    scan(h.body)
+
+    scan(node.body)
+    # transitive closure of "reachable from a return value"
+    frontier = list(returned)
+    while frontier:
+        name = frontier.pop()
+        for src in aliases.get(name, ()):
+            if src not in returned:
+                returned.add(src)
+                frontier.append(src)
+    return sorted(set(info.params) & rebuilt & returned)
+
+
+def _literal_int_factor(node: ast.Call) -> Optional[int]:
+    """The literal tile/repeat factor of a jnp.tile/jnp.repeat call, or
+    None when it isn't a compile-time int/tuple-of-ints."""
+    val = node.args[1] if len(node.args) > 1 else None
+    if val is None:
+        for kw in node.keywords:
+            if kw.arg in ("reps", "repeats"):
+                val = kw.value
+    if val is None:
+        return None
+    if isinstance(val, ast.Constant) and isinstance(val.value, int):
+        return val.value
+    if isinstance(val, (ast.Tuple, ast.List)):
+        prod = 1
+        for elt in val.elts:
+            if not (
+                isinstance(elt, ast.Constant)
+                and isinstance(elt.value, int)
+            ):
+                return None
+            prod *= elt.value
+        return prod
+    return None
 
 
 def _literal_str_seq(node) -> Optional[List[str]]:

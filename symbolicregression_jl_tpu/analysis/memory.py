@@ -1,0 +1,502 @@
+"""srmem — static HBM-footprint analyzer for the search hot path.
+
+The search dies on-chip at >=64 islands with an opaque UNAVAILABLE
+error; AOT memory analysis attributes it to temp buffers of 11.7GB at
+64x256 and 45GB at 64x1000 on a 16GB v5e, dominated by
+``optimize_islands_constants``. Nothing in CI noticed when a change
+doubled peak HBM — this engine is the gate that does.
+
+Three layers, all trace-only (``jax.make_jaxpr`` / ``jax.eval_shape``
+over aval inputs; nothing executes, so it runs on CPU in CI):
+
+- **live-buffer estimator** (`live_buffer_peak`): walks a jaxpr with a
+  linear-liveness model — an equation's outputs go live, a value dies
+  after its last use, sub-jaxprs (scan/while/cond/pjit bodies) peak
+  while their caller's live set is held — and reports the peak live
+  temp bytes plus the per-equation "aval blowup" census (the SR007
+  signature: one equation whose output is many times its inputs'
+  bytes, measured with real byte counts instead of the AST heuristic).
+- **per-stage attribution** (`build_stage_programs`): the same Options
+  matrix ``compile_surface`` traces, decomposed into the production
+  stages (init / cycle / mutate / eval / simplify / optimize /
+  merge_migrate) so a regression names the stage that grew. Where the
+  backend provides it, ``jit(...).lower().compile().memory_analysis()``
+  numbers ride along (`xla_stage_analysis`) — that is the exact XLA
+  buffer-assignment accounting, and scripts/tpu_mem_analysis.py uses it
+  against the real TPU target.
+- **baseline + budget gate** (`check_memory`): per-config peaks diff
+  against the checked-in ``memory_baseline.json`` — CI fails on a >10%
+  modeled-peak regression or on any config whose modeled footprint
+  exceeds the HBM budget (default 16GB, one v5e chip). Shrinking peaks
+  never fail; they surface as refresh notes.
+
+CLI: ``python -m symbolicregression_jl_tpu.analysis --only memory
+[--hbm-budget-gb G] [--update-baseline]`` (docs/static_analysis.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .compile_surface import (
+    _BASE_KWARGS,
+    _MATRIX,
+    _NFEAT,
+    _NROWS,
+    _abstract_inputs,
+)
+
+BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "memory_baseline.json"
+)
+
+#: One v5e chip's HBM — the part the 64-island search OOMs on.
+DEFAULT_HBM_BUDGET_GB = 16.0
+
+#: Modeled-peak growth beyond this fraction of the baseline fails CI.
+REGRESSION_TOLERANCE = 0.10
+
+#: An equation is a "blowup" when its output aval exceeds this multiple
+#: of its inputs' total bytes AND this absolute size (tiny broadcasts —
+#: iotas, masks — are normal and uninteresting).
+BLOWUP_FACTOR = 8.0
+BLOWUP_MIN_BYTES = 1 << 20  # 1 MiB
+_TOP_BLOWUPS = 5
+
+
+# ---------------------------------------------------------------------------
+# live-buffer estimator
+# ---------------------------------------------------------------------------
+
+
+def aval_bytes(aval) -> int:
+    """Bytes of one abstract value (0 for tokens/opaque avals)."""
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return 0
+    n = 1
+    for d in getattr(aval, "shape", ()):
+        n *= int(d)
+    return n * int(dtype.itemsize)
+
+
+def _sub_jaxprs(params):
+    import jax.core as jcore
+
+    for v in params.values():
+        if isinstance(v, (jcore.Jaxpr, jcore.ClosedJaxpr)):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                if isinstance(item, (jcore.Jaxpr, jcore.ClosedJaxpr)):
+                    yield item
+
+
+def live_buffer_peak(jaxpr) -> dict:
+    """Linear-liveness estimate of one (Closed)Jaxpr.
+
+    Returns ``{"peak_bytes", "args_bytes", "out_bytes", "blowups"}``:
+    peak live TEMP bytes (equation outputs that have not yet died;
+    jaxpr inputs are accounted separately as args_bytes), and the
+    largest per-equation aval blowups. A sub-jaxpr's peak is charged
+    while every value live at its call site is held — the same
+    worst-case XLA's buffer assignment must accommodate when it cannot
+    overlap the regions. The model ignores fusion and rematerialization,
+    so it is an upper-ish bound whose VALUE drifts from XLA's exact
+    number but whose RATIO between two versions of the same program
+    tracks real regressions — which is all the baseline gate needs."""
+    import jax.core as jcore
+
+    blowups: List[dict] = []
+
+    def walk(jx) -> int:
+        if hasattr(jx, "jaxpr"):
+            jx = jx.jaxpr
+        last_use: Dict = {}
+        for i, eqn in enumerate(jx.eqns):
+            for v in eqn.invars:
+                if isinstance(v, jcore.Var):
+                    last_use[id(v)] = i
+        outset = {
+            id(v) for v in jx.outvars if isinstance(v, jcore.Var)
+        }
+        live_bytes: Dict[int, Tuple] = {}  # id(var) -> (var, bytes)
+        live = 0
+        peak = 0
+        for i, eqn in enumerate(jx.eqns):
+            out_b = 0
+            for v in eqn.outvars:
+                b = aval_bytes(v.aval)
+                out_b += b
+                live_bytes[id(v)] = (v, b)
+                live += b
+            in_b = sum(
+                aval_bytes(v.aval)
+                for v in eqn.invars
+                if isinstance(v, jcore.Var)
+            )
+            inner = 0
+            for sub in _sub_jaxprs(eqn.params):
+                inner = max(inner, walk(sub))
+            peak = max(peak, live + inner)
+            if (
+                in_b > 0
+                and out_b >= BLOWUP_MIN_BYTES
+                and out_b > BLOWUP_FACTOR * in_b
+            ):
+                blowups.append({
+                    "primitive": eqn.primitive.name,
+                    "out_bytes": int(out_b),
+                    "in_bytes": int(in_b),
+                    "factor": round(out_b / in_b, 1),
+                })
+            # release every value whose last use is this equation
+            # (including dead stores: outvars never read again)
+            for v in list(eqn.invars) + list(eqn.outvars):
+                vid = id(v)
+                if vid in live_bytes and vid not in outset:
+                    if last_use.get(vid, i) <= i:
+                        live -= live_bytes.pop(vid)[1]
+        return peak
+
+    peak = walk(jaxpr)
+    inner = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    args = sum(
+        aval_bytes(v.aval) for v in inner.invars + inner.constvars
+    )
+    outs = sum(aval_bytes(v.aval) for v in inner.outvars)
+    blowups.sort(key=lambda b: -b["out_bytes"])
+    return {
+        "peak_bytes": int(peak),
+        "args_bytes": int(args),
+        "out_bytes": int(outs),
+        "blowups": blowups[:_TOP_BLOWUPS],
+    }
+
+
+# ---------------------------------------------------------------------------
+# stage programs
+# ---------------------------------------------------------------------------
+
+
+def build_stage_programs(
+    options, nfeatures: int = _NFEAT, nrows: int = _NROWS
+) -> Dict[str, Tuple]:
+    """Ordered ``{stage: (fn, aval_args)}`` decomposing one production
+    iteration (plus init) into independently traceable programs. The
+    stage set mirrors the hot path: the cycle scan splits into its two
+    expensive halves (mutate = tree surgery, eval = the fused scoring
+    call over all islands' children) so blowups attribute to the half
+    that owns them. scripts/tpu_mem_analysis.py AOT-compiles exactly
+    these against the TPU target."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import evolve
+    from ..models.fitness import score_trees
+    from ..parallel.migration import merge_hofs_across_islands, migrate
+
+    I = options.npopulations
+    states, key, cm, X, y, bl, scalars, memo, keys = _abstract_inputs(
+        options, I
+    )
+    if (nfeatures, nrows) != (_NFEAT, _NROWS):
+        X = jax.ShapeDtypeStruct((nfeatures, nrows), options.dtype)
+        y = jax.ShapeDtypeStruct((nrows,), options.dtype)
+
+    def init_stage(keys, X, y, bl, scalars):
+        from ..api import _make_init_fn
+
+        return _make_init_fn(options, nfeatures, False)(
+            keys, X, y, bl, scalars
+        )
+
+    def cycle(states, cm, X, y, bl, scalars):
+        o = options.bind_scalars(scalars)
+        return evolve.s_r_cycle_islands(states, cm, X, y, None, bl, o)
+
+    def mutate(states, cm, scalars):
+        o = options.bind_scalars(scalars)
+        temp = jnp.float32(1.0)
+        return jax.vmap(
+            lambda st: evolve._propose_children(
+                st, temp, cm, nfeatures, o
+            )
+        )(states)
+
+    def simplify(states, cm, X, y, bl, scalars):
+        o = options.bind_scalars(scalars)
+        return evolve.simplify_population_islands(
+            states, cm, X, y, None, bl, o
+        )
+
+    def optimize(keys, states, X, y, bl, scalars):
+        o = options.bind_scalars(scalars)
+        return evolve.optimize_islands_constants(
+            keys, states, X, y, None, bl, o
+        )
+
+    def merge_migrate(key, states, scalars):
+        o = options.bind_scalars(scalars)
+        ghof = merge_hofs_across_islands(states.hof)
+        return migrate(key, states, ghof, o)
+
+    # the eval stage scores the flat all-islands children batch — the
+    # shape the mutate stage emits
+    props = jax.eval_shape(mutate, states, cm, scalars)
+    children_flat = jax.tree_util.tree_map(
+        lambda l: jax.ShapeDtypeStruct(
+            (l.shape[0] * l.shape[1],) + l.shape[2:], l.dtype
+        ),
+        props.children,
+    )
+
+    def eval_stage(children, X, y, bl, scalars):
+        o = options.bind_scalars(scalars)
+        return score_trees(children, X, y, None, bl, o)
+
+    return {
+        "init": (init_stage, (keys, X, y, bl, scalars)),
+        "cycle": (cycle, (states, cm, X, y, bl, scalars)),
+        "mutate": (mutate, (states, cm, scalars)),
+        "eval": (eval_stage, (children_flat, X, y, bl, scalars)),
+        "simplify": (simplify, (states, cm, X, y, bl, scalars)),
+        "optimize": (optimize, (keys, states, X, y, bl, scalars)),
+        "merge_migrate": (merge_migrate, (key, states, scalars)),
+    }
+
+
+def xla_stage_analysis(fn, args) -> dict:
+    """AOT-compile one stage for the CURRENT backend and return XLA's
+    own buffer-assignment numbers, or a structured error. Nothing
+    executes — safe against a flaky TPU tunnel window."""
+    import jax
+
+    try:
+        compiled = jax.jit(fn).lower(*args).compile()
+    except Exception as e:  # compile failure is a report, not a crash
+        return {
+            "error": f"{type(e).__name__}: {str(e)[:160]}",
+        }
+    ma = compiled.memory_analysis()
+    if ma is None:
+        return {"unavailable": True}
+    return {
+        "temp_bytes": int(ma.temp_size_in_bytes),
+        "argument_bytes": int(ma.argument_size_in_bytes),
+        "output_bytes": int(ma.output_size_in_bytes),
+        "platform": jax.default_backend(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# per-config analysis
+# ---------------------------------------------------------------------------
+
+
+def _analyze_config(
+    name: str, options, xla_memory: bool
+) -> Tuple[dict, List[str]]:
+    """One Options config: fused-iteration peak (the headline number —
+    that is the program the production host loop dispatches) plus the
+    per-stage breakdown."""
+    import jax
+
+    from ..api import _make_iteration_fn
+
+    problems: List[str] = []
+    I = options.npopulations
+    states, key, cm, X, y, bl, scalars, memo, _ = _abstract_inputs(
+        options, I
+    )
+    it_fn = _make_iteration_fn(options, False)
+    args = (states, key, cm, X, y, bl, scalars) + (
+        (memo,) if memo is not None else ()
+    )
+    est = live_buffer_peak(jax.make_jaxpr(it_fn)(*args))
+
+    entry = {
+        "peak_modeled_bytes": est["peak_bytes"],
+        "args_bytes": est["args_bytes"],
+        "out_bytes": est["out_bytes"],
+        "blowups": est["blowups"],
+        "stages": {},
+    }
+    for stage, (fn, sargs) in build_stage_programs(options).items():
+        s_est = live_buffer_peak(jax.make_jaxpr(fn)(*sargs))
+        entry["stages"][stage] = {
+            "peak_modeled_bytes": s_est["peak_bytes"],
+            "blowups": s_est["blowups"],
+        }
+    if xla_memory:
+        entry["xla"] = xla_stage_analysis(it_fn, args)
+    return entry, problems
+
+
+def diff_memory_baseline(
+    configs: Dict[str, dict],
+    baseline: dict,
+    tolerance: float = REGRESSION_TOLERANCE,
+) -> Tuple[List[str], List[str]]:
+    """(problems, notes): peaks that GREW beyond tolerance fail; peaks
+    that shrank beyond it only suggest a refresh (improvements must
+    never break CI, but a stale baseline hides the next regression)."""
+    problems: List[str] = []
+    notes: List[str] = []
+    base_configs = baseline.get("configs", {})
+
+    def check(tag: str, want: int, got: int) -> None:
+        if want <= 0:
+            return
+        ratio = got / want
+        if ratio > 1.0 + tolerance:
+            problems.append(
+                f"{tag}: modeled peak grew {want} -> {got} bytes "
+                f"(+{(ratio - 1) * 100:.0f}%, tolerance "
+                f"{tolerance * 100:.0f}%) — an HBM regression; fix it or "
+                "refresh with --update-baseline and justify in the PR"
+            )
+        elif ratio < 1.0 - tolerance:
+            notes.append(
+                f"{tag}: modeled peak shrank {want} -> {got} bytes "
+                f"({(1 - ratio) * 100:.0f}% better) — refresh the "
+                "baseline with --update-baseline to lock it in"
+            )
+
+    for name, entry in configs.items():
+        if name not in base_configs:
+            problems.append(
+                f"memory baseline has no config {name!r} — run with "
+                "--update-baseline"
+            )
+            continue
+        base = base_configs[name]
+        check(name, base.get("peak_modeled_bytes", 0),
+              entry["peak_modeled_bytes"])
+        base_stages = base.get("stages", {})
+        for stage, s_entry in entry["stages"].items():
+            if stage in base_stages:
+                check(
+                    f"{name}.{stage}",
+                    base_stages[stage].get("peak_modeled_bytes", 0),
+                    s_entry["peak_modeled_bytes"],
+                )
+            else:
+                problems.append(
+                    f"memory baseline has no stage {name}.{stage} — "
+                    "refresh with --update-baseline"
+                )
+        for stage in base_stages:
+            if stage not in entry["stages"]:
+                problems.append(
+                    f"memory baseline stage {name}.{stage} no longer "
+                    "produced — its recorded peak would silently stop "
+                    "being gated; refresh with --update-baseline"
+                )
+    for name in base_configs:
+        if name not in configs:
+            problems.append(
+                f"memory baseline config {name!r} no longer produced — "
+                "refresh with --update-baseline"
+            )
+    return problems, notes
+
+
+def check_memory(
+    update_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    configs: Optional[Tuple[Tuple[str, dict], ...]] = None,
+    hbm_budget_gb: float = DEFAULT_HBM_BUDGET_GB,
+    xla_memory: bool = False,
+    tolerance: float = REGRESSION_TOLERANCE,
+) -> dict:
+    """Run the srmem gate; returns the report dict rendered by
+    report.render_memory_text (and embedded in the CLI JSON)."""
+    import jax
+
+    from ..models.options import make_options
+    from .report import write_baseline_json
+
+    baseline_path = baseline_path or BASELINE_PATH
+    matrix = list(configs if configs is not None else _MATRIX)
+    budget_bytes = int(hbm_budget_gb * 1e9)
+    out_configs: Dict[str, dict] = {}
+    problems: List[str] = []
+    notes: List[str] = []
+    for name, extra in matrix:
+        options = make_options(**{**_BASE_KWARGS, **extra})
+        entry, probs = _analyze_config(name, options, xla_memory)
+        out_configs[name] = entry
+        problems += probs
+        # the resident footprint one dispatch needs: its arguments (the
+        # carried IslandState + dataset) plus the modeled live temps
+        footprint = entry["args_bytes"] + entry["peak_modeled_bytes"]
+        entry["footprint_bytes"] = int(footprint)
+        if footprint > budget_bytes:
+            worst = entry["blowups"][:1]
+            hint = (
+                f" (largest blowup: {worst[0]['primitive']} "
+                f"{worst[0]['out_bytes']} bytes)" if worst else ""
+            )
+            problems.append(
+                f"{name}: modeled HBM footprint {footprint} bytes "
+                f"exceeds the {hbm_budget_gb:g}GB budget "
+                f"({budget_bytes} bytes){hint}"
+            )
+
+    baseline_checked = baseline_match = False
+    if update_baseline:
+        payload = {
+            "schema_version": 1,
+            "jax_version": jax.__version__,
+            "configs": {
+                name: {
+                    "peak_modeled_bytes": e["peak_modeled_bytes"],
+                    "args_bytes": e["args_bytes"],
+                    "stages": {
+                        s: {"peak_modeled_bytes":
+                            se["peak_modeled_bytes"]}
+                        for s, se in e["stages"].items()
+                    },
+                }
+                for name, e in out_configs.items()
+            },
+        }
+        write_baseline_json(baseline_path, payload)
+    elif os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+        baseline_checked = True
+        base_problems, base_notes = diff_memory_baseline(
+            out_configs, baseline, tolerance
+        )
+        baseline_match = not base_problems
+        problems += base_problems
+        notes += base_notes
+        if baseline.get("jax_version") != jax.__version__:
+            baseline_match = False
+            problems.append(
+                "memory baseline was written under jax "
+                f"{baseline.get('jax_version')} but this is "
+                f"{jax.__version__} — refresh with --update-baseline"
+            )
+    else:
+        problems.append(
+            f"no memory baseline at {baseline_path} — create one with "
+            "--update-baseline"
+        )
+
+    return {
+        "ok": not problems,
+        "problems": problems,
+        "notes": notes,
+        "configs": out_configs,
+        "baseline_checked": baseline_checked,
+        "baseline_match": baseline_match,
+        "baseline_path": baseline_path,
+        "hbm_budget_gb": hbm_budget_gb,
+        "tolerance": tolerance,
+        "jax_version": jax.__version__,
+    }

@@ -13,7 +13,8 @@ JSON schema (schema_version 1):
       "counts": {"SR001": n, ...},    # active (non-suppressed) per rule
       "suppressed": int,              # pragma-suppressed findings
       "violations": [Violation.to_dict(), ...],
-      "surface": {...} | null         # compile-surface section, if run
+      "surface": {...} | null,        # compile-surface section, if run
+      "memory": {...} | null          # srmem section, if run
     }
 """
 
@@ -30,6 +31,7 @@ from .rules import RULES, Violation
 class AnalysisReport:
     violations: List[Violation] = dataclasses.field(default_factory=list)
     surface: Optional[dict] = None  # compile_surface.check_surface() output
+    memory: Optional[dict] = None  # memory.check_memory() output
 
     @property
     def active(self) -> List[Violation]:
@@ -40,6 +42,8 @@ class AnalysisReport:
         if self.active:
             return False
         if self.surface is not None and not self.surface.get("ok", True):
+            return False
+        if self.memory is not None and not self.memory.get("ok", True):
             return False
         return True
 
@@ -58,6 +62,7 @@ class AnalysisReport:
             "suppressed": sum(1 for v in self.violations if v.suppressed),
             "violations": [v.to_dict() for v in self.violations],
             "surface": self.surface,
+            "memory": self.memory,
         }
 
     def to_json(self) -> str:
@@ -89,7 +94,57 @@ class AnalysisReport:
             )
         if self.surface is not None:
             lines.append(render_surface_text(self.surface))
+        if self.memory is not None:
+            lines.append(render_memory_text(self.memory))
         return "\n".join(lines)
+
+
+def write_baseline_json(path: str, payload: dict) -> None:
+    """The one writer every checked-in analysis baseline goes through:
+    sorted keys, fixed 2-space indent, trailing newline — so a refresh
+    (e.g. after threading buffer donation) diffs only the values that
+    actually moved."""
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def _mb(n: int) -> str:
+    return f"{n / 1e6:.2f}MB" if n >= 100_000 else f"{n}B"
+
+
+def render_memory_text(memory: dict) -> str:
+    lines: List[str] = []
+    for problem in memory.get("problems", []):
+        lines.append(f"srmem: {problem}")
+    for note in memory.get("notes", []):
+        lines.append(f"srmem: note: {note}")
+    configs = memory.get("configs", {})
+    for name in sorted(configs):
+        entry = configs[name]
+        stages = entry.get("stages", {})
+        top = max(
+            stages.items(),
+            key=lambda kv: kv[1].get("peak_modeled_bytes", 0),
+            default=(None, None),
+        )[0]
+        lines.append(
+            f"srmem: {name}: peak {_mb(entry['peak_modeled_bytes'])} "
+            f"temps + {_mb(entry['args_bytes'])} args"
+            + (f" (dominant stage: {top})" if top else "")
+        )
+    status = "ok" if memory.get("ok", False) else "FAIL"
+    lines.append(
+        f"srmem: {status} — {len(configs)} config(s), budget "
+        f"{memory.get('hbm_budget_gb', 0):g}GB"
+        + (
+            " (baseline match)"
+            if memory.get("baseline_match") else
+            (" (baseline MISMATCH)" if memory.get("baseline_checked")
+             else " (no baseline check)")
+        )
+    )
+    return "\n".join(lines)
 
 
 def render_surface_text(surface: dict) -> str:

@@ -117,6 +117,62 @@ RULES: Dict[str, Rule] = {
                 "was meant to be a Python constant)."
             ),
         ),
+        Rule(
+            id="SR006",
+            name="missing-carry-donation",
+            summary=(
+                "jit entry whose carry-shaped argument (a parameter that "
+                "is rebuilt and returned) is not listed in "
+                "donate_argnums/donate_argnames"
+            ),
+            rationale=(
+                "A feed-outputs-back-as-inputs carry (IslandState, RNG "
+                "keys, HoF tables) that is not donated keeps TWO copies "
+                "of every carried buffer resident in HBM across the "
+                "dispatch — at 64x1000 islands that is the difference "
+                "between fitting a 16GB v5e and an opaque UNAVAILABLE "
+                "OOM. Detection is heuristic (a parameter reassigned in "
+                "the body and reachable from a return value); jit calls "
+                "forwarding **kwargs are skipped."
+            ),
+        ),
+        Rule(
+            id="SR007",
+            name="aval-bytes-blowup",
+            summary=(
+                "broadcast materialization (jnp.broadcast_to/outer/kron/"
+                "meshgrid, or tile/repeat with a literal factor >= "
+                "8) in jit-reachable code"
+            ),
+            rationale=(
+                "An equation whose output aval is many times the bytes "
+                "of its inputs is the static signature of the temp-"
+                "buffer blowups that OOM the search at scale (45GB of "
+                "temps at 64x1000 on a 16GB part, dominated by one "
+                "materialized broadcast in constant optimization). "
+                "Prefer keeping the expression in implicitly-broadcast "
+                "form (XLA fuses it) or chunking the batch; the srmem "
+                "engine (analysis/memory.py) measures the same "
+                "signature on the traced jaxpr with real byte counts."
+            ),
+        ),
+        Rule(
+            id="SR008",
+            name="host-roundtrip-into-jit",
+            summary=(
+                "host-synchronized value (np.asarray/np.array, "
+                "jax.device_get, .item()) passed straight back into a "
+                "jitted entry point"
+            ),
+            rationale=(
+                "Pulling a device value to the host and immediately "
+                "feeding it back into jitted code pays a blocking "
+                "device->host sync, a host->device transfer, AND breaks "
+                "XLA's ability to alias/donate the buffer — the value "
+                "never needed to leave the device. Keep it as a jax "
+                "Array (jit accepts device arrays directly)."
+            ),
+        ),
     ]
 }
 

@@ -172,8 +172,25 @@ class EquationSearchResult:
 import functools
 
 
-@functools.lru_cache(maxsize=32)
-def _make_iteration_fn(options: Options, has_weights: bool):
+def _donation_enabled() -> bool:
+    """Whether equation_search requests buffer donation from the jit
+    factories (SRTPU_DONATE=0 disables — used by the A/B parity tests and
+    as a debugging escape hatch). The production host loop feeds each
+    iteration's output IslandState straight back in as the next input, so
+    donating the carry lets XLA reuse its HBM in place instead of holding
+    the old and new copy live across the dispatch — at the 64x1000 north-
+    star shape that is gigabytes of steady-state headroom (see
+    docs/static_analysis.md, srmem/SR006). Direct factory callers
+    (benchmarks, tests, compile_surface) default to donate=False and keep
+    fully functional semantics: a donated call INVALIDATES its input
+    buffers on backends that implement donation (TPU, and CPU on this
+    jaxlib), so only call sites that never reuse the passed-in carry may
+    enable it."""
+    return os.environ.get("SRTPU_DONATE", "1") != "0"
+
+
+def _make_iteration_fn(options: Options, has_weights: bool,
+                       donate: bool = False):
     """One jitted function per Options GRAPH (Options hash/eq deliberately
     ignore the TRACED_SCALAR_FIELDS knobs); X/y/weights/baseline AND the
     scalar knobs are traced arguments, so multi-output searches, repeated
@@ -200,7 +217,22 @@ def _make_iteration_fn(options: Options, has_weights: bool):
     that value can differ in ULPs from what the scoring path computes
     for the same tree (different kernel/reduction order on TPU) — the
     bank must only ever hold scoring-path values or a later memo hit
-    would break the bit-identity guarantee."""
+    would break the bit-identity guarantee.
+
+    donate=True donates the IslandState carry (argument 0) to XLA
+    (input/output buffer aliasing): the returned function then DELETES
+    its input states on donation-capable backends — callers must never
+    touch the passed-in states again (equation_search's loop never does;
+    see _donation_enabled). Donation changes buffer reuse only, never
+    values: tests pin the donated search's HallOfFame bit-identical to
+    the non-donated one. The thin wrapper normalizes `donate` so the
+    2-arg and explicit-donate=False call forms share one lru_cache entry
+    (and one compile)."""
+    return _make_iteration_fn_cached(options, has_weights, bool(donate))
+
+
+@functools.lru_cache(maxsize=32)
+def _make_iteration_fn_cached(options, has_weights, donate):
 
     def one_iteration(
         states: IslandState,
@@ -266,26 +298,32 @@ def _make_iteration_fn(options: Options, has_weights: bool):
             outs = outs + (absorb_snap,)
         return outs
 
+    # the IslandState carry is argument 0 in every signature variant; the
+    # non-donating default keeps functional semantics for direct callers
+    # (benchmarks, compile_surface, tests that reuse a states pytree)
+    donate_kw = dict(donate_argnums=(0,)) if donate else {}
     if options.cache_fitness:
         if has_weights:
-            return jax.jit(one_iteration)
+            return jax.jit(one_iteration, **donate_kw)
         return jax.jit(
             lambda states, key, cm, X, y, baseline, scalars, memo:
             one_iteration(
                 states, key, cm, X, y, None, baseline, scalars, memo
-            )
+            ),
+            **donate_kw,
         )
     if has_weights:
-        return jax.jit(one_iteration)
+        return jax.jit(one_iteration, **donate_kw)
     return jax.jit(
         lambda states, key, cm, X, y, baseline, scalars: one_iteration(
             states, key, cm, X, y, None, baseline, scalars
-        )
+        ),
+        **donate_kw,
     )
 
 
-@functools.lru_cache(maxsize=32)
-def _make_phase_fns(options: Options, has_weights: bool):
+def _make_phase_fns(options: Options, has_weights: bool,
+                    donate: bool = False):
     """Jitted per-phase sub-programs of one evolution iteration, for the
     chunked-dispatch driver (options.max_cycles_per_dispatch): cycle
     chunks, simplify, constant-opt passes, and merge+migrate each compile
@@ -297,6 +335,11 @@ def _make_phase_fns(options: Options, has_weights: bool):
     the stats-window decay. (Under batching=True the minibatch key chain
     restarts per chunk — deterministic and equally distributed draws,
     but not bit-equal to the fused scan's; see the Options field doc.)"""
+    return _make_phase_fns_cached(options, has_weights, bool(donate))
+
+
+@functools.lru_cache(maxsize=32)
+def _make_phase_fns_cached(options, has_weights, donate):
 
     def _bind(scalars):
         return options.bind_scalars(scalars)
@@ -348,25 +391,36 @@ def _make_phase_fns(options: Options, has_weights: bool):
         states = migrate(k_mig, states, ghof, _bind(scalars))
         return states, ghof
 
+    # donate the IslandState carry of every phase (the driver threads one
+    # states pytree through the chain and never reuses a consumed one);
+    # X/y/weights/scalars/temperatures are reused across calls and the
+    # memo snapshot may be served again — never donated
+    def _dk(states_argnum: int) -> dict:
+        return dict(donate_argnums=(states_argnum,)) if donate else {}
+
     return {
-        "cycle": jax.jit(cycle_chunk, static_argnames=("is_last",)),
-        "simplify": jax.jit(simplify),
-        "optimize": jax.jit(optimize),
-        "optimize_mut": jax.jit(optimize_mut),
-        "merge_migrate": jax.jit(merge_migrate),
+        "cycle": jax.jit(cycle_chunk, static_argnames=("is_last",),
+                         **_dk(0)),
+        "simplify": jax.jit(simplify, **_dk(0)),
+        "optimize": jax.jit(optimize, **_dk(1)),
+        "optimize_mut": jax.jit(optimize_mut, **_dk(1)),
+        "merge_migrate": jax.jit(merge_migrate, **_dk(1)),
     }
 
 
-def _make_iteration_driver(options: Options, has_weights: bool):
+def _make_iteration_driver(options: Options, has_weights: bool,
+                           donate: bool = False):
     """The production iteration entry: returns a callable with the same
     signature/outputs as _make_iteration_fn's. With
     options.max_cycles_per_dispatch=None (default) that IS the fused
     single-jit iteration; with an int k it is a host-level driver issuing
-    phased dispatches of at most k cycles each (see _make_phase_fns)."""
+    phased dispatches of at most k cycles each (see _make_phase_fns).
+    donate=True donates the IslandState carry in either form (see
+    _make_iteration_fn doc for the caller contract)."""
     k = options.max_cycles_per_dispatch
     if k is None:
-        return _make_iteration_fn(options, has_weights)
-    fns = _make_phase_fns(options, has_weights)
+        return _make_iteration_fn(options, has_weights, donate)
+    fns = _make_phase_fns(options, has_weights, donate)
     ncycles = options.ncycles_per_iteration
     # One iteration-wide schedule, built EXACTLY as s_r_cycle_islands
     # builds it (jnp.linspace: f32 math — np.linspace computes in f64 and
@@ -412,6 +466,13 @@ def _make_iteration_driver(options: Options, has_weights: bool):
             (states.pop.trees, states.pop.losses)
             if options.cache_fitness else None
         )
+        if absorb_snap is not None and donate:
+            # the snapshot aliases leaves of `states`, which the
+            # donating optimize/merge_migrate dispatches below delete;
+            # copy so the host-side memo-bank absorb can still read it
+            absorb_snap = jax.tree_util.tree_map(
+                lambda a: jnp.array(a, copy=True), absorb_snap
+            )
         I = states.birth_counter.shape[0]
         if options.should_optimize_constants and options.optimizer_probability > 0:
             states = fns["optimize"](
@@ -437,11 +498,21 @@ def _make_iteration_driver(options: Options, has_weights: bool):
     return driver
 
 
-@functools.lru_cache(maxsize=32)
-def _make_init_fn(options: Options, nfeatures: int, has_weights: bool):
+def _make_init_fn(options: Options, nfeatures: int, has_weights: bool,
+                  donate: bool = False):
     """Like _make_iteration_fn: the trailing REQUIRED `scalars` argument
     is `options.traced_scalars()` (initial scoring reads parsimony
-    through it)."""
+    through it). donate=True donates the per-island key batch (argument
+    0, aliasable onto the returned IslandState.key) — callers must pass
+    freshly split keys they never reuse. The thin wrapper normalizes
+    `donate` so the 3-arg and explicit-donate=False call forms share
+    one lru_cache entry (and one compile)."""
+    return _make_init_fn_cached(options, nfeatures, has_weights,
+                                bool(donate))
+
+
+@functools.lru_cache(maxsize=32)
+def _make_init_fn_cached(options, nfeatures, has_weights, donate):
 
     def init(keys, X, y, weights, baseline, scalars):
         options_ = options.bind_scalars(scalars)
@@ -452,12 +523,14 @@ def _make_init_fn(options: Options, nfeatures: int, has_weights: bool):
             )
         )(keys)
 
+    donate_kw = dict(donate_argnums=(0,)) if donate else {}
     if has_weights:
-        return jax.jit(init)
+        return jax.jit(init, **donate_kw)
     return jax.jit(
         lambda keys, X, y, baseline, scalars: init(
             keys, X, y, None, baseline, scalars
-        )
+        ),
+        **donate_kw,
     )
 
 
@@ -656,7 +729,13 @@ def equation_search(
     mesh = make_mesh(options, I, row_shards=options.row_shards)
     t_start = time.time()
     early_stop = options.early_stop_fn()
-    iteration_fn = _make_iteration_driver(options, weights is not None)
+    # the host loop below never reuses a consumed IslandState, so the
+    # production jits donate the carry (steady-state HBM drops by one
+    # IslandState copy per output on donation-capable backends)
+    donate = _donation_enabled()
+    iteration_fn = _make_iteration_driver(
+        options, weights is not None, donate
+    )
     # this Options' trace-irrelevant scalar knobs, passed to every jitted
     # call (the factories' lru_caches dedup Options differing only in
     # these, so the values MUST come from here, not the closure)
@@ -744,7 +823,8 @@ def equation_search(
         def _fresh_init(key):
             k_init, key = jax.random.split(key)
             init_keys = jax.random.split(k_init, I)
-            init_fn = _make_init_fn(options, nfeatures, wj is not None)
+            init_fn = _make_init_fn(options, nfeatures, wj is not None,
+                                    donate)
             if wj is not None:
                 sts = init_fn(init_keys, Xj, yj, wj, bl, scalars)
             else:
@@ -756,6 +836,13 @@ def equation_search(
             ok_pop, ok_hof = _saved_state_compatible(state, options, I)
             if ok_pop:
                 states, ghof = state.island_states, state.global_hof
+                if donate:
+                    # iteration 1 will donate (delete) these buffers;
+                    # copy so the caller's saved_state stays usable
+                    # (resumed twice, inspected after the search)
+                    states = jax.tree_util.tree_map(
+                        lambda x: jnp.array(x, copy=True), states
+                    )
             else:
                 # the reference recreates mismatched populations with a
                 # warning (src/SymbolicRegression.jl:532-573); the saved

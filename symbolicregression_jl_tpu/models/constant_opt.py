@@ -359,7 +359,7 @@ def _bfgs_batched(
         return x_new, f, g_new, H
 
     f0, g0 = loss_grad(x0)
-    H0 = jnp.broadcast_to(I, (M, L, L))
+    H0 = jnp.broadcast_to(I, (M, L, L))  # srlint: disable=SR007 -- fori_loop carry: per-instance Hessians must be materialized once
     x, f, _, _ = jax.lax.fori_loop(0, n_iters, body, (x0, f0, g0, H0))
     return x, f
 

@@ -58,7 +58,7 @@ def _eval_single(
         k, o, f, c = node
         a = stack[jnp.maximum(sp - 1, 0)]  # top: unary operand / right operand
         b = stack[jnp.maximum(sp - 2, 0)]  # second: left operand
-        leaf = jnp.where(k == CONST, jnp.broadcast_to(c, (nrows,)), X[f])
+        leaf = jnp.where(k == CONST, jnp.broadcast_to(c, (nrows,)), X[f])  # srlint: disable=SR007 -- scalar-over-rows select arm, fused by XLA
         if unary_fns:
             una_all = jnp.stack([fn(a) for fn in unary_fns])
             una = una_all[jnp.clip(o, 0, len(unary_fns) - 1)]

@@ -130,7 +130,7 @@ def operand_schedule(kind: Array):
     ar_seq = jnp.moveaxis(jnp.where(kind == PAD, -1, arity), -1, 0)
     si_seq = jnp.broadcast_to(
         sis.reshape((L,) + (1,) * len(batch_shape)), (L,) + batch_shape
-    )
+    )  # srlint: disable=SR007 -- int32 scan xs input; scan requires a real array
     _, (lidx, ridx) = jax.lax.scan(step, init, (si_seq, ar_seq))
     return jnp.moveaxis(lidx, 0, -1), jnp.moveaxis(ridx, 0, -1)
 
@@ -242,7 +242,7 @@ def instruction_schedule(trees: TreeBatch, operators: OperatorSet):
     mv = lambda x: jnp.moveaxis(x, -1, 0)
     si_seq = jnp.broadcast_to(
         jnp.arange(L, dtype=jnp.int32)[:, None], (L, T)
-    )
+    )  # srlint: disable=SR007 -- int32 scan xs input; scan requires a real array
     inputs = (mv(kind), mv(op), mv(feat),
               mv(cval.astype(jnp.float32)), mv(arity), si_seq)
     (ssrc, sidx, scval, sp, nins), outs = jax.lax.scan(step, init, inputs)

@@ -1,0 +1,59 @@
+"""srlint fixture: SR006 jit entries that rebuild and return a carry
+without donating its buffers.
+
+Never imported — parsed by tests/test_analysis.py only. Expected: 3
+SR006 findings (the plain wrap, the bare decorator, and the aliased
+return); the donating wrappers, the non-carry function, and the
+static_argnames parameter stay clean."""
+
+import functools
+
+import jax
+
+
+def step(state, dx):
+    state = state + dx
+    return state
+
+
+fast_step = jax.jit(step)  # SR006: carry rebuilt+returned, no donation
+donated = jax.jit(step, donate_argnums=(0,))  # not flagged
+named = jax.jit(step, donate_argnames="state")  # not flagged
+
+
+@jax.jit  # SR006: bare decorator cannot donate at all
+def dec_step(carry, dx):
+    carry = carry * dx
+    return carry
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def dec_donated(carry, dx):  # not flagged
+    carry = carry * dx
+    return carry
+
+
+def aliased(state, key, dx):
+    state = state + dx
+    outs = (state, key)
+    return outs
+
+
+packed = jax.jit(aliased)  # SR006: carry reachable through the alias
+
+
+def pure(x, scale):
+    y = x * scale
+    return y
+
+
+fn = jax.jit(pure)  # not flagged: no parameter is rebuilt
+
+
+def tiled(x, block: int = 8):
+    block = max(block, 1)
+    return x, block
+
+
+# not flagged: the rebuilt-and-returned parameter is static, not a carry
+cfg = jax.jit(tiled, static_argnames=("block",))

@@ -1,0 +1,25 @@
+"""srlint fixture: SR007 broadcast materializations in jit-reachable
+code.
+
+Never imported — parsed by tests/test_analysis.py only. Expected: 3
+SR007 findings (broadcast_to, outer, tile with a literal factor >= 8);
+the small literal repeat, the non-literal tile, and the host-side
+helper stay clean."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def hot(x, y, n):
+    a = jnp.broadcast_to(x, (1024, 1024))  # SR007
+    b = jnp.outer(x, y)  # SR007
+    c = jnp.tile(x, 16)  # SR007 (literal factor >= 8)
+    d = jnp.repeat(x, 2)  # not flagged: small literal factor
+    e = jnp.tile(x, (n, 1))  # not flagged: non-literal factor
+    return a.sum() + b.sum() + c.sum() + d.sum() + e.sum()
+
+
+def host_only(x):
+    # identical call, not jit-reachable: not flagged
+    return jnp.broadcast_to(x, (1024, 1024))

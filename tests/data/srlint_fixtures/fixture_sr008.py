@@ -1,0 +1,36 @@
+"""srlint fixture: SR008 host round-trips fed straight back into jitted
+entry points.
+
+Never imported — parsed by tests/test_analysis.py only. Expected: 2
+SR008 findings in drive() (tainted name, inline round-trip); fine()
+stays clean (device value stays on device; the synced value is consumed
+on the host, never fed back) and so does retainted() (reassignment from
+a non-sync value kills the taint)."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    return x * 2
+
+
+def drive(x):
+    h = np.asarray(x)  # pulls the device value to the host...
+    y = step(h)  # SR008: ...and feeds it straight back into jit
+    z = step(np.asarray(y))  # SR008: inline round-trip
+    return y, z
+
+
+def fine(x):
+    y = step(x)  # device value straight into jit: not flagged
+    total = float(np.asarray(y).sum())  # sync consumed on host: fine
+    return total
+
+
+def retainted(x, batch):
+    v = np.asarray(x)  # taints v...
+    print(v.sum())
+    v = batch  # ...reassignment from a non-sync value kills the taint
+    return step(v)  # not flagged: v holds a device value again

@@ -1,6 +1,6 @@
-"""Static-analysis subsystem (ISSUE 3): srlint rule detection on known-bad
-fixtures, pragma suppression, reporter schema, compile-surface contracts,
-and the baseline drift gate.
+"""Static-analysis subsystem (ISSUEs 3+4): srlint rule detection on
+known-bad fixtures, pragma suppression, reporter schema, compile-surface
+contracts, the srmem HBM-footprint gate, and both baseline drift gates.
 
 The srlint fixtures under tests/data/srlint_fixtures/ are parsed, never
 imported; each file documents inline which lines must (and must NOT) be
@@ -94,6 +94,43 @@ def test_sr005_stale_static_argnames_detected():
 
 
 @pytest.mark.fast
+def test_sr006_missing_donation_detected():
+    vs = _lint_fixture("fixture_sr006.py")
+    hits = _active(vs, "SR006")
+    # plain wrap, bare decorator, aliased return
+    assert len(hits) == 3, [v.to_dict() for v in vs]
+    functions = {v.function for v in hits}
+    assert functions == {"step", "dec_step", "aliased"}
+    # donating wrappers, the pure function, and the static param stay clean
+    messages = " ".join(v.message for v in hits)
+    assert "dec_donated" not in messages
+    assert "'block'" not in messages
+
+
+@pytest.mark.fast
+def test_sr007_broadcast_materialization_detected():
+    vs = _lint_fixture("fixture_sr007.py")
+    hits = _active(vs, "SR007")
+    # broadcast_to, outer, tile with literal factor >= 8
+    assert len(hits) == 3, [v.to_dict() for v in vs]
+    assert all(v.function == "hot" for v in hits)
+    # identical call outside the jit call graph stays clean
+    assert not any(v.function == "host_only" for v in hits)
+
+
+@pytest.mark.fast
+def test_sr008_host_roundtrip_detected():
+    vs = _lint_fixture("fixture_sr008.py")
+    hits = _active(vs, "SR008")
+    # tainted-name feed-back + inline round-trip, both in drive()
+    assert len(hits) == 2, [v.to_dict() for v in vs]
+    assert all(v.function == "drive" for v in hits)
+    assert not any(v.function == "fine" for v in vs)
+    # reassignment from a non-sync value kills the taint
+    assert not any(v.function == "retainted" for v in vs)
+
+
+@pytest.mark.fast
 def test_clean_fixture_produces_zero_findings():
     vs = _lint_fixture("fixture_clean.py")
     assert vs == [], [v.to_dict() for v in vs]
@@ -127,6 +164,7 @@ def test_json_report_schema():
     assert payload["counts"] == {"SR001": 1}
     assert payload["suppressed"] == 3
     assert payload["surface"] is None
+    assert payload["memory"] is None
     for v in payload["violations"]:
         assert set(v) == {
             "rule", "name", "path", "line", "col", "function", "message",
@@ -168,11 +206,12 @@ def test_package_tree_is_srlint_clean():
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.fast
+@pytest.mark.slow
 def test_compile_surface_single_config(tmp_path):
     """One small config end-to-end under JAX_PLATFORMS=cpu (conftest):
     aval stability, IslandState contract, no callbacks/f64, census
-    written and re-read as a baseline."""
+    written and re-read as a baseline. Slow: ~6s of tracing (tier-1
+    timing hygiene, ISSUE 4)."""
     from symbolicregression_jl_tpu.analysis.compile_surface import (
         check_surface,
     )
@@ -241,6 +280,167 @@ def test_checked_in_baseline_exists_and_well_formed():
 
 
 # ---------------------------------------------------------------------------
+# srmem (memory engine)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_live_buffer_peak_models_liveness_and_blowups():
+    """The estimator sees a materialized broadcast as both peak bytes and
+    an SR007-signature blowup; a pointwise chain of the same shapes does
+    not blow up."""
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.analysis.memory import live_buffer_peak
+
+    def blowy(x):  # (1024,) f32 -> (512, 1024) f32: 2MB from 4KB
+        big = jnp.broadcast_to(x, (512, 1024)) * 2.0
+        return big.sum()
+
+    est = live_buffer_peak(
+        jax.make_jaxpr(blowy)(jnp.zeros((1024,), jnp.float32))
+    )
+    assert est["peak_bytes"] >= 512 * 1024 * 4
+    assert est["args_bytes"] == 1024 * 4
+    assert est["blowups"], est
+    assert est["blowups"][0]["factor"] >= 8
+
+    def pointwise(x):
+        return ((x * 2.0) + 1.0).sum()
+
+    est2 = live_buffer_peak(
+        jax.make_jaxpr(pointwise)(jnp.zeros((1024,), jnp.float32))
+    )
+    assert est2["blowups"] == []
+    assert est2["peak_bytes"] < est["peak_bytes"]
+
+
+@pytest.mark.slow
+def test_memory_single_config_baseline_roundtrip(tmp_path):
+    """One config end-to-end: stages attributed, baseline written, and a
+    second run diffs clean against it (the srmem analog of the
+    compile-surface round-trip above). Slow: two full single-config
+    analyses, ~14s of tracing."""
+    from symbolicregression_jl_tpu.analysis.memory import check_memory
+
+    path = str(tmp_path / "memory_baseline.json")
+    r = check_memory(
+        update_baseline=True, baseline_path=path, configs=(("base", {}),),
+    )
+    entry = r["configs"]["base"]
+    assert entry["peak_modeled_bytes"] > 0
+    assert set(entry["stages"]) == {
+        "init", "cycle", "mutate", "eval", "simplify", "optimize",
+        "merge_migrate",
+    }
+    assert entry["footprint_bytes"] == (
+        entry["args_bytes"] + entry["peak_modeled_bytes"]
+    )
+    r2 = check_memory(baseline_path=path, configs=(("base", {}),))
+    assert r2["ok"], r2["problems"]
+    assert r2["baseline_checked"] and r2["baseline_match"]
+
+
+@pytest.mark.fast
+def test_memory_diff_catches_injected_regression():
+    """Acceptance: a >10% modeled-peak growth fails, a shrink only notes,
+    and config-set drift fails in both directions."""
+    from symbolicregression_jl_tpu.analysis.memory import (
+        diff_memory_baseline,
+    )
+
+    baseline = {
+        "configs": {
+            "base": {
+                "peak_modeled_bytes": 1000,
+                "stages": {"optimize": {"peak_modeled_bytes": 800}},
+            },
+        }
+    }
+
+    def configs(peak, stage_peak):
+        return {
+            "base": {
+                "peak_modeled_bytes": peak,
+                "stages": {"optimize": {"peak_modeled_bytes": stage_peak}},
+            }
+        }
+
+    probs, notes = diff_memory_baseline(configs(1050, 820), baseline)
+    assert probs == [] and notes == []
+    probs, notes = diff_memory_baseline(configs(1200, 800), baseline)
+    assert len(probs) == 1 and "+20%" in probs[0]
+    # per-stage attribution regresses independently of the headline peak
+    probs, notes = diff_memory_baseline(configs(1000, 1600), baseline)
+    assert len(probs) == 1 and "base.optimize" in probs[0]
+    # improvements never fail; they suggest a refresh
+    probs, notes = diff_memory_baseline(configs(500, 400), baseline)
+    assert probs == [] and len(notes) == 2
+    probs, _ = diff_memory_baseline(
+        {"other": {"peak_modeled_bytes": 1, "stages": {}}}, baseline
+    )
+    assert len(probs) == 2  # unknown config + config no longer produced
+    # stage-set drift fails in both directions too: a baseline stage
+    # that is no longer produced must not silently stop being gated
+    probs, _ = diff_memory_baseline(
+        {"base": {"peak_modeled_bytes": 1000, "stages": {}}}, baseline
+    )
+    assert len(probs) == 1 and "base.optimize no longer produced" in probs[0]
+
+
+@pytest.mark.slow
+def test_memory_budget_gate_fails_oversize_config(tmp_path):
+    """Acceptance: a config whose modeled footprint exceeds the HBM
+    budget fails even when it matches the baseline perfectly. Slow:
+    two full single-config analyses (tier-1 timing hygiene)."""
+    from symbolicregression_jl_tpu.analysis.memory import check_memory
+
+    path = str(tmp_path / "memory_baseline.json")
+    check_memory(
+        update_baseline=True, baseline_path=path, configs=(("base", {}),),
+    )
+    r = check_memory(
+        baseline_path=path, configs=(("base", {}),),
+        hbm_budget_gb=1e-6,
+    )
+    assert not r["ok"]
+    assert any("exceeds the 1e-06GB budget" in p for p in r["problems"])
+
+
+@pytest.mark.fast
+def test_checked_in_memory_baseline_exists_and_well_formed():
+    from symbolicregression_jl_tpu.analysis.memory import BASELINE_PATH
+
+    with open(BASELINE_PATH) as f:
+        payload = json.load(f)
+    assert payload["schema_version"] == 1
+    assert set(payload["configs"]) == {
+        "base", "cache", "islands4", "pop32",
+    }
+    for entry in payload["configs"].values():
+        assert entry["peak_modeled_bytes"] > 0
+        assert entry["stages"]
+
+
+@pytest.mark.fast
+def test_baseline_writer_stable_format(tmp_path):
+    """Both checked-in baselines go through one writer: sorted keys,
+    2-space indent, trailing newline — so refreshes diff minimally."""
+    from symbolicregression_jl_tpu.analysis.report import (
+        write_baseline_json,
+    )
+
+    path = str(tmp_path / "b.json")
+    write_baseline_json(path, {"b": {"z": 1, "a": 2}, "a": 0})
+    text = open(path).read()
+    assert text.endswith("\n")
+    assert text.index('"a"') < text.index('"b"') < text.index('"z"')
+    write_baseline_json(path, {"a": 0, "b": {"a": 2, "z": 1}})
+    assert open(path).read() == text
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 
@@ -283,9 +483,9 @@ def test_cli_in_process_exit_codes(tmp_path, monkeypatch):
 
 @pytest.mark.slow
 def test_cli_full_run_green_at_head():
-    """The full gate — srlint + compile surface vs the checked-in
-    baseline — exits 0 on the repo at HEAD (the ISSUE 3 acceptance
-    criterion). Slow: traces the whole Options matrix (~1 min)."""
+    """The full gate — srlint + compile surface + srmem vs the checked-in
+    baselines — exits 0 on the repo at HEAD (the ISSUE 3/4 acceptance
+    criterion). Slow: traces the whole Options matrix twice (~2 min)."""
     proc = subprocess.run(
         [sys.executable, "-m", "symbolicregression_jl_tpu.analysis",
          "--format", "json"],
@@ -296,6 +496,26 @@ def test_cli_full_run_green_at_head():
     payload = json.loads(proc.stdout)
     assert payload["ok"] is True
     assert payload["surface"]["baseline_match"] is True
+    assert payload["memory"]["baseline_match"] is True
+
+
+@pytest.mark.slow
+def test_cli_memory_only_nonzero_on_tiny_budget():
+    """Acceptance: `--only memory` exits nonzero when a config exceeds
+    the HBM budget. Slow: traces the full Options matrix."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "symbolicregression_jl_tpu.analysis",
+         "--only", "memory", "--format", "json",
+         "--hbm-budget-gb", "1e-6"],
+        capture_output=True, text=True, cwd=REPO, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 1, proc.stdout[-2000:] + proc.stderr[-2000:]
+    payload = json.loads(proc.stdout)
+    assert payload["memory"]["ok"] is False
+    assert any(
+        "budget" in p for p in payload["memory"]["problems"]
+    )
 
 
 @pytest.mark.slow

@@ -57,6 +57,7 @@ def test_recovery_and_predict(rng):
     )
 
 
+@pytest.mark.slow
 def test_multi_output(rng):
     X, y0 = make_data(rng)
     y = np.stack([y0, X[1] * 2.0])
@@ -68,6 +69,7 @@ def test_multi_output(rng):
         res.predict(X, output=out)
 
 
+@pytest.mark.slow
 def test_resume_state(rng):
     X, y = make_data(rng)
     res1 = sr.equation_search(
@@ -82,6 +84,7 @@ def test_resume_state(rng):
     assert res2.state is None  # only returned when asked
 
 
+@pytest.mark.slow
 def test_early_stop_and_callback(rng):
     X, y = make_data(rng)
     seen = []
@@ -93,6 +96,7 @@ def test_early_stop_and_callback(rng):
     assert len(seen) == 1  # stopped after the first iteration
 
 
+@pytest.mark.slow
 def test_weighted_search(rng):
     X, y = make_data(rng)
     w = np.ones_like(y)
@@ -100,6 +104,7 @@ def test_weighted_search(rng):
     assert len(res.frontier()) > 0
 
 
+@pytest.mark.slow
 def test_checkpoint_csv(rng, tmp_path):
     X, y = make_data(rng)
     path = str(tmp_path / "hof.csv")
@@ -114,6 +119,7 @@ def test_checkpoint_csv(rng, tmp_path):
     ]
 
 
+@pytest.mark.slow
 def test_deterministic_same_seed(rng):
     X, y = make_data(rng)
     r1 = sr.equation_search(X, y, niterations=2, seed=5, **TINY)
@@ -129,6 +135,7 @@ def test_deterministic_same_seed(rng):
     ] or r3.best().loss != r1.best().loss
 
 
+@pytest.mark.slow
 def test_timeout_stops_early(rng):
     """timeout_in_seconds ends the search after the current iteration
     (analog of reference test/test_stop_on_clock.jl:9-14)."""
@@ -172,6 +179,7 @@ def test_preflight_rejects_nonfinite(rng):
         sr.equation_search(Xbad, y, niterations=1, **TINY)
 
 
+@pytest.mark.slow
 def test_resume_mismatched_options_recreates(rng):
     """A saved_state whose npop no longer matches Options is recreated with
     a warning, keeping the saved hall of fame (analog of reference
@@ -194,6 +202,7 @@ def test_resume_mismatched_options_recreates(rng):
     assert min(c.loss for c in res2.frontier()) <= hof_best + 1e-6
 
 
+@pytest.mark.slow
 def test_warm_start_from_csv(rng, tmp_path):
     """warm_start_file seeds the search from a hall-of-fame CSV (analog of
     load_saved_hall_of_fame, reference src/SearchUtils.jl:275-301)."""
@@ -279,6 +288,7 @@ def test_reference_parallelism_kwargs(rng):
         )
 
 
+@pytest.mark.slow
 def test_independent_island_batches(rng):
     """Reference-exact per-island minibatch draws
     (src/LossFunctions.jl:95-115) as an Options knob."""
@@ -291,6 +301,7 @@ def test_independent_island_batches(rng):
     assert np.isfinite(res.best_loss().loss)
 
 
+@pytest.mark.slow
 def test_integer_input_data_is_cast(rng):
     """Integer-typed X/y are accepted and cast to the working float dtype
     (deviation from reference test_integer_evaluation.jl, which preserves
@@ -305,6 +316,7 @@ def test_integer_input_data_is_cast(rng):
     assert pred.dtype == np.float32
 
 
+@pytest.mark.slow
 def test_checkpoint_bkup_fallback(rng, tmp_path):
     """A torn or missing main checkpoint falls back to the .bkup
     double-write (the reference's survive-mid-write-kill mechanism,
@@ -353,6 +365,7 @@ def test_deprecated_kwargs_remap():
         make_options(binary_operators=["+"], batchSize=1, batch_size=2)
 
 
+@pytest.mark.slow
 def test_readme_quickstart_executes(monkeypatch, capsys):
     """The README quickstart code blocks execute as written (analog of the
     reference running its README example, test/full.jl:19-21). The search
@@ -397,6 +410,7 @@ def test_readme_quickstart_executes(monkeypatch, capsys):
     assert "Hall of Fame" in out  # print(result) rendered the table
 
 
+@pytest.mark.slow
 def test_search_state_disk_roundtrip(rng, tmp_path):
     """Full search state survives a disk round-trip and resumes exactly
     (beyond the reference, whose exact-resume state lives only in the
@@ -467,6 +481,7 @@ def test_reference_option_kwargs_parity():
         make_options(binary_operators=["+"], bin_constraints=[(3, 1)])
 
 
+@pytest.mark.slow
 def test_save_to_file_false_suppresses_csv(tmp_path):
     """save_to_file=False keeps output_file configured but writes nothing
     (reference src/Options.jl:285)."""
@@ -489,3 +504,38 @@ def test_recorder_env_default(monkeypatch):
     assert make_options(binary_operators=["+"], recorder=False).recorder is False
     monkeypatch.delenv("PYSR_RECORDER")
     assert make_options(binary_operators=["+"]).recorder is False
+
+
+@pytest.mark.slow
+def test_donated_carry_search_bit_identical_3_seeds(rng, monkeypatch):
+    """Buffer donation (SRTPU_DONATE, default on) changes HBM reuse only,
+    never values: over 3 seeds the donated search's HallOfFame — losses,
+    complexities, and rendered equations — is bit-identical to the
+    non-donated one (the ISSUE 4 acceptance criterion; srmem/SR006
+    motivate WHY the production path donates)."""
+    X, y = make_data(rng)
+
+    def frontier_bits(res):
+        return [
+            (c.complexity, float(c.loss), c.equation)
+            for c in res.frontier()
+        ]
+
+    for seed in (0, 1, 2):
+        monkeypatch.setenv("SRTPU_DONATE", "0")
+        r_off = sr.equation_search(X, y, niterations=2, seed=seed, **TINY)
+        monkeypatch.setenv("SRTPU_DONATE", "1")
+        r_on = sr.equation_search(X, y, niterations=2, seed=seed, **TINY)
+        assert frontier_bits(r_on) == frontier_bits(r_off), seed
+
+    # the chunked-dispatch driver donates through its phase jits too —
+    # with and without the fitness cache (cache+chunked is the combo
+    # where the absorb snapshot aliases the donated carry and must be
+    # copied before the optimize/merge dispatches delete it)
+    for extra in ({}, {"cache_fitness": True}):
+        chunked = dict(TINY, max_cycles_per_dispatch=15, **extra)
+        monkeypatch.setenv("SRTPU_DONATE", "0")
+        c_off = sr.equation_search(X, y, niterations=2, seed=0, **chunked)
+        monkeypatch.setenv("SRTPU_DONATE", "1")
+        c_on = sr.equation_search(X, y, niterations=2, seed=0, **chunked)
+        assert frontier_bits(c_on) == frontier_bits(c_off), extra

@@ -6,6 +6,7 @@ compile-heavy group in its own module keeps both modules under the
 threshold)."""
 
 import numpy as np
+import pytest
 
 import symbolicregression_jl_tpu as sr
 from symbolicregression_jl_tpu.models.options import make_options
@@ -14,6 +15,7 @@ from test_api import TINY, make_data
 
 
 
+@pytest.mark.slow
 def test_global_stop_across_outputs(rng):
     """Global stop semantics (reference src/SymbolicRegression.jl:899-909):
     max_evals/'q'/timeout end the WHOLE multi-output search the moment
@@ -46,6 +48,7 @@ def test_global_stop_across_outputs(rng):
 
 
 
+@pytest.mark.slow
 def test_loss_threshold_needs_all_outputs(rng):
     """One satisfied output must NOT stop the search while another output
     is unsatisfied (reference src/SearchUtils.jl:117-128 returns false on
@@ -66,6 +69,7 @@ def test_loss_threshold_needs_all_outputs(rng):
 
 
 
+@pytest.mark.slow
 def test_scalar_knob_sweep_reuses_compilation(rng):
     """TRACED_SCALAR_FIELDS knobs (parsimony/alpha/migration fractions...)
     enter the jitted iteration as traced arguments: Options differing only

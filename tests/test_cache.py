@@ -306,6 +306,7 @@ def test_bank_registry_shares_by_fingerprint(rng):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_seeded_search_cached_vs_uncached_identical(rng):
     """cache_fitness=True on a seeded search: bit-identical hall of fame,
     nonzero reported cache hit rate, per-iteration unique-ratio rows."""
