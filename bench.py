@@ -195,11 +195,23 @@ ANCHOR_REPS = 5  # the anchor swung 1.8x between rounds when timed once;
 # >=5 runs with the spread recorded makes vs_baseline attributable
 
 
+def _cpu_core_count():
+    """Cores actually available to this process (affinity-aware) — the
+    honest multiplier behind any 'multithreaded' anchor claim."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except AttributeError:  # pragma: no cover
+        return os.cpu_count()
+
+
 def _native_cpu_anchor(jax, options, n_trees, verbose):
-    """Multithreaded native-C++ score throughput (eval + MSE reduction) on
-    the same workload — the honest stand-in for the reference's
-    compiled-Julia CPU `score_func` path. Returns (median trees-rows/sec,
-    per-run rates) or (None, [])."""
+    """Native-C++ score throughput (eval + MSE reduction) on the same
+    workload — the honest stand-in for the reference's compiled-Julia CPU
+    `score_func` path. Threaded across however many cores the process
+    actually has (the printed line says how many: a 1-core container is
+    NOT a multithreaded anchor, and labeling it as one overstated the
+    anchor in BENCH_r05). Returns (median trees-rows/sec, per-run rates)
+    or (None, [])."""
     from symbolicregression_jl_tpu import native
 
     if not native.native_available():
@@ -218,8 +230,10 @@ def _native_cpu_anchor(jax, options, n_trees, verbose):
         rates.append(n_trees * N_ROWS / (time.perf_counter() - t0))
     rate = float(np.median(rates))
     if verbose:
+        n_cores = _cpu_core_count()
         print(
-            f"# native CPU anchor (multithreaded C++ score): {n_trees} "
+            f"# native CPU anchor (C++ score, {n_cores} core"
+            f"{'s' if n_cores != 1 else ''}): {n_trees} "
             f"trees x {N_ROWS} rows, {len(rates)} runs -> median "
             f"{rate:.3e} trees-rows/s "
             f"(spread {min(rates):.3e}..{max(rates):.3e})",
@@ -907,10 +921,43 @@ def main(verbose=True):
         else:
             cpu_rate = value
 
-    try:
-        n_cores = len(os.sched_getaffinity(0))
-    except AttributeError:  # pragma: no cover
-        n_cores = os.cpu_count()
+    n_cores = _cpu_core_count()
+    # the anchor label carries the measured core count: a 1-core
+    # container's native anchor is single-threaded, and calling it
+    # "multithreaded" overstated the baseline (BENCH_r05)
+    if anchor == "native-C++-MT-CPU":
+        anchor = f"native-C++-CPU-{n_cores}core"
+
+    # bucketed-vs-flat jnp interpreter throughput (ISSUE 5): the
+    # length-bucketed eval dispatch (Options.eval_bucket_ladder) against
+    # the flat interpreter on the SAME workload and device — measured on
+    # the CPU backend, the interpreter's production home (on TPU the
+    # large-batch scoring path runs the Pallas kernel, which ignores the
+    # ladder). The flat reference reuses the rate already measured above
+    # (main run on CPU platform, the xla-cpu anchor otherwise).
+    bucketed_rate, bucketed_ratio = None, None
+    interp_flat_rate = value if platform == "cpu" else xla_cpu_rate
+    if interp_flat_rate is not None:
+        try:
+            b_options = make_options(
+                binary_operators=["+", "-", "*", "/"],
+                unary_operators=["cos", "exp"],
+                maxsize=MAXSIZE,
+                loss="L2DistLoss",
+                eval_backend="jnp",
+                eval_bucket_ladder=(0.25, 0.5, 0.75, 1.0),
+            )
+            b_dev = main_dev if platform == "cpu" else jax.devices("cpu")[0]
+            b_inner = 20 if platform == "cpu" else 1
+            bucketed_rate, _, _ = _time_backend(
+                jax, jnp, b_options, b_dev, min(n_trees, 8192), b_inner,
+                "bucketed interp (cpu)", verbose,
+            )
+            bucketed_ratio = bucketed_rate / interp_flat_rate
+        except Exception as e:  # pragma: no cover
+            if verbose:
+                print(f"# bucketed interp measurement failed: {e}",
+                      file=sys.stderr)
 
     # achieved fraction of the kernel's VPU-issue roofline (see
     # benchmark/roofline.py for the model; CPU runs have no such bound)
@@ -975,6 +1022,14 @@ def main(verbose=True):
         ),
         "anchor_xla_cpu": (
             round(xla_cpu_rate, 1) if xla_cpu_rate is not None else None
+        ),
+        # jnp interpreter with Options.eval_bucket_ladder vs flat, same
+        # workload, CPU backend (docs/eval_pipeline.md)
+        "interp_bucketed": (
+            round(bucketed_rate, 1) if bucketed_rate is not None else None
+        ),
+        "interp_bucketed_vs_flat": (
+            round(bucketed_ratio, 3) if bucketed_ratio is not None else None
         ),
         "first_call_s": round(compile_s, 1),
         "roofline_fraction": roofline_fraction,

@@ -6,7 +6,7 @@ kernel throughput into solved equations. The reference's recovery bar is
 the analog: exact-form recovery within budget
 (/root/reference/test/test_mixed.jl:129-141).
 
-At this scale the per-cycle scoring batches clear `_PALLAS_MIN_BATCH`, so
+At this scale the per-cycle scoring batches clear `_PALLAS_MIN_WORK`, so
 on TPU every candidate evaluation runs through the Pallas kernel and
 constant optimization through the fused loss/grad kernels. On a 1-core
 CPU one iteration of this shape takes >40 min (BASELINE.md) — this

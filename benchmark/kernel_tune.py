@@ -12,6 +12,13 @@ Usage: python benchmark/kernel_tune.py [n_inner] [--tail N] [--rows-sweep]
 --tail N runs only the last N grid entries (quick probes of newly added
 variants without re-sweeping the full grid).
 
+--bucket-sweep instead measures the jnp interpreter's length-bucketed
+eval dispatch (models/fitness.py eval_loss_trees_bucketed) across a
+ladder grid on the bench workload, flat ladder () first as the
+reference — the A/B that picks Options.eval_bucket_ladder defaults.
+Runs the interpreter path regardless of device (the Pallas kernel
+ignores the ladder).
+
 --rows-sweep instead measures the default variant across dataset row
 counts {128, 256, 512, 1024, 2048}: rows live on (r_sub, 128) vreg
 tiles, so row counts below 1024 under-fill the 8 sublanes — 256 rows
@@ -62,6 +69,8 @@ def main():
         args = args[:i] + args[i + 2:]
     rows_sweep = "--rows-sweep" in args
     args = [a for a in args if a != "--rows-sweep"]
+    bucket_sweep = "--bucket-sweep" in args
+    args = [a for a in args if a != "--bucket-sweep"]
     rows_max = 2048
     if "--rows-max" in args:
         i = args.index("--rows-max")
@@ -91,6 +100,67 @@ def main():
         return time_pallas_variant(
             jax, jnp, trees, X, ops, overhead, n_inner, **kw
         )
+
+    if bucket_sweep:
+        # ladder A/B on the jnp interpreter path: flat reference first,
+        # then coarser-to-finer positional ladders. Timing methodology
+        # matches the kernel grid (n_inner evals inside one jit with the
+        # constant-perturbation trick, dispatch overhead subtracted).
+        import time as _time
+
+        from symbolicregression_jl_tpu.models.fitness import (
+            eval_loss_trees,
+        )
+
+        loss_fn = options.elementwise_loss
+        y = jnp.asarray(_feynman_data()[1])
+        ladders = [
+            (),
+            (1.0,),
+            (0.5, 1.0),
+            (0.25, 0.5, 1.0),
+            (0.25, 0.5, 0.75, 1.0),
+            (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0),
+        ]
+        for ladder in ladders:
+            def body(i, acc, _ladder=ladder):
+                t = trees._replace(cval=trees.cval + acc * 1e-12)
+                loss = eval_loss_trees(
+                    t, X, y, None, ops, loss_fn, backend="jnp",
+                    bucket_ladder=_ladder,
+                )
+                good = jnp.where(jnp.isfinite(loss), loss, 0.0)
+                return acc + jnp.clip(jnp.mean(good), 0.0, 1.0)
+
+            fn = jax.jit(
+                lambda _body=body: jax.lax.fori_loop(
+                    0, n_inner, _body, jnp.float32(0.0)
+                )
+            )
+            t_c0 = _time.perf_counter()
+            assert np.isfinite(float(fn()))
+            compile_s = _time.perf_counter() - t_c0
+            ts = []
+            for _ in range(3):
+                t0 = _time.perf_counter()
+                float(fn())
+                ts.append(_time.perf_counter() - t0)
+            per_iter = max(
+                (float(np.median(ts)) - overhead) / n_inner, 1e-9
+            )
+            rate = N_TREES * X.shape[1] / per_iter
+            print(json.dumps({
+                "sweep": "buckets", "ladder": list(ladder),
+                "trees_rows_per_s": rate, "per_iter_s": per_iter,
+                "compile_s": compile_s,
+                "platform": jax.devices()[0].platform,
+            }), flush=True)
+            print(
+                f"# ladder={ladder or '(flat)'}  {rate:.3e} t-r/s  "
+                f"{per_iter*1e3:7.2f} ms/iter  (compile {compile_s:.0f}s)",
+                file=sys.stderr, flush=True,
+            )
+        return
 
     if rows_sweep:
         # lane-utilization diagnostic: rows under 1024 under-fill the

@@ -163,6 +163,87 @@ def bench_population_scoring():
     ]
 
 
+def bench_bucketed_eval():
+    """Length-bucketed vs flat jnp interpreter evaluation (ISSUE 5): an
+    8192-tree population with a skewed length distribution (80% short /
+    15% mid / 5% long — the shape GP populations actually have) scored
+    flat and through the eval_bucket_ladder dispatch. Reports both
+    trees-rows/s rates, their ratio (the acceptance target is >=1.5x on
+    CPU), and the bit-identity of the two loss vectors. eval_backend is
+    pinned to 'jnp' so the case measures the interpreter on every
+    platform (the Pallas kernel path ignores the ladder — it already
+    prices trees by length)."""
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.models.fitness import eval_loss_trees
+    from symbolicregression_jl_tpu.models.mutate_device import (
+        gen_random_tree_fixed_size,
+    )
+    from symbolicregression_jl_tpu.models.options import make_options
+
+    options = make_options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp"],
+        maxsize=20,
+    )
+    ops = options.operators
+    loss_fn = options.elementwise_loss
+    n_trees, n_rows = 8192, 1000
+    rng = np.random.default_rng(0)
+    u = rng.random(n_trees)
+    sizes = np.where(
+        u < 0.80, rng.integers(3, 7, n_trees),
+        np.where(u < 0.95, rng.integers(7, 13, n_trees),
+                 rng.integers(13, 21, n_trees)),
+    ).astype(np.int32)
+    trees = jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(
+            k, s, 5, ops, options.max_len
+        )
+    )(jax.random.split(jax.random.PRNGKey(0), n_trees), jnp.asarray(sizes))
+    X = jax.random.normal(jax.random.PRNGKey(2), (5, n_rows), jnp.float32)
+    y = 2.0 * jnp.cos(X[4]) + X[1] ** 2 - 2.0
+    ladder = (0.25, 0.5, 0.75, 1.0)
+
+    flat_fn = jax.jit(
+        lambda t: eval_loss_trees(t, X, y, None, ops, loss_fn,
+                                  backend="jnp")
+    )
+    buck_fn = jax.jit(
+        lambda t: eval_loss_trees(t, X, y, None, ops, loss_fn,
+                                  backend="jnp", bucket_ladder=ladder)
+    )
+    l_flat = np.asarray(flat_fn(trees))
+    l_buck = np.asarray(buck_fn(trees))
+    identical = bool(np.array_equal(l_flat, l_buck))
+    dt_flat = _median_time(lambda: jax.block_until_ready(flat_fn(trees)))
+    dt_buck = _median_time(lambda: jax.block_until_ready(buck_fn(trees)))
+    work = n_trees * n_rows
+    return [
+        {
+            "suite": "bucketed_eval",
+            "case": "flat",
+            "median_s": dt_flat,
+            "trees_rows_per_s": work / dt_flat,
+        },
+        {
+            "suite": "bucketed_eval",
+            "case": f"ladder{'-'.join(str(f) for f in ladder)}",
+            "median_s": dt_buck,
+            "trees_rows_per_s": work / dt_buck,
+        },
+        {
+            "suite": "bucketed_eval",
+            "case": "summary",
+            "bit_identical": identical,
+            "bucketed_vs_flat": dt_flat / dt_buck,
+            "mean_tree_len": float(np.asarray(trees.length).mean()),
+            "max_len_slots": options.max_len,
+        },
+    ]
+
+
 def bench_search_iteration():
     """Full-search throughput: one jitted evolution iteration (s_r_cycle +
     simplify + constant-opt + HoF merge + migration) over all islands —
@@ -227,7 +308,8 @@ def bench_search_iteration():
 def bench_search_iteration_northstar():
     """BASELINE.json's north-star search shape (npopulations=64,
     npop=1000): at this scale the in-loop scoring batches clear
-    _PALLAS_MIN_BATCH, so on TPU the evolution cycles themselves run
+    _PALLAS_MIN_WORK (the trees x rows volume gate), so on TPU the
+    evolution cycles themselves run
     through the Pallas eval kernel and constant optimization through the
     fused loss/grad kernels (optimizer_backend='auto'). Heavy — runs on
     non-CPU platforms or with SRTPU_SUITE_BIG=1.
@@ -560,6 +642,7 @@ _CASES = [
     (bench_eval_fixed_tree, 600),
     (bench_single_eval_48_nodes, 600),
     (bench_population_scoring, 600),
+    (bench_bucketed_eval, 900),
     (bench_search_iteration, 1200),
     (bench_fitness_cache, 1200),
     (bench_precision_ratio, 1200),

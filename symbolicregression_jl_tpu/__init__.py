@@ -39,6 +39,7 @@ from .ops.interpreter import (
     eval_diff_tree,
     eval_grad_constants,
     eval_grad_variables,
+    eval_loss_trees_fused,
     eval_tree,
     eval_trees,
 )
@@ -112,6 +113,7 @@ __all__ = [
     "parse_expression",
     "eval_tree",
     "eval_trees",
+    "eval_loss_trees_fused",
     "eval_diff_tree",
     "eval_grad_constants",
     "eval_grad_variables",

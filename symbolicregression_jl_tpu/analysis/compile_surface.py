@@ -60,6 +60,10 @@ _MATRIX: Tuple[Tuple[str, dict], ...] = (
     ("cache", dict(cache_fitness=True, cache_device_slots=8)),
     ("islands4", dict(npopulations=4)),
     ("pop32", dict(npop=32)),
+    # length-bucketed eval graphs (docs/eval_pipeline.md): the ladder
+    # replaces the flat lockstep scan with per-bucket bounded loops —
+    # a distinct compiled surface whose aval contract must still hold
+    ("bucketed", dict(eval_bucket_ladder=(0.5, 1.0))),
 )
 
 #: config name for the phased (chunked-dispatch) closure set

@@ -207,6 +207,15 @@ def _make_iteration_fn(options: Options, has_weights: bool,
     With options.recorder the returned function yields a third output:
     the per-cycle MutationEvents for the lineage recorder.
 
+    Evaluation-graph shape: options.eval_bucket_ladder /
+    options.eval_rows_per_tile select the length-bucketed / row-tiled
+    jnp scoring graphs for every eval inside the iteration (cycle scan,
+    simplify rescore, warm-start scoring) — they are part of the Options
+    graph key, so flat and bucketed searches compile as distinct
+    programs (docs/eval_pipeline.md has the dispatch decision tree and
+    the per-path exactness guarantees; the bucketed graph is
+    bit-identical to the flat one, asserted in tests).
+
     With options.cache_fitness the function takes ONE more trailing
     argument — the cache.DeviceMemo snapshot of the host memo bank
     (traced: a refreshed snapshot per iteration costs zero recompiles) —
@@ -334,7 +343,13 @@ def _make_phase_fns(options: Options, has_weights: bool,
     iteration-wide annealing schedule and only the final chunk applies
     the stats-window decay. (Under batching=True the minibatch key chain
     restarts per chunk — deterministic and equally distributed draws,
-    but not bit-equal to the fused scan's; see the Options field doc.)"""
+    but not bit-equal to the fused scan's; see the Options field doc.)
+
+    The phase closures read the same Options as the fused form, so the
+    bucketed/row-tiled evaluation graphs (eval_bucket_ladder /
+    eval_rows_per_tile) thread through both drivers identically — the
+    chunked-vs-fused and bucketed-vs-flat bit-identity guarantees
+    compose."""
     return _make_phase_fns_cached(options, has_weights, bool(donate))
 
 

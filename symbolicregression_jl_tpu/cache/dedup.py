@@ -8,10 +8,14 @@ subtrees). The reference tolerates this — per-tree Julia evals are cheap —
 but here every duplicate burns a slot in the batched eval launch. This
 module removes them *inside* the jitted cycle with static shapes:
 
-    hash -> stable lexicographic sort -> exact-equality segmenting ->
-    compact unique representatives to the front -> device-memo lookup on
-    the representatives -> evaluate the remainder -> scatter every
-    segment's loss back to all duplicates.
+    hash -> stable lexicographic (length, hash) sort -> exact-equality
+    segmenting -> compact unique representatives to the front ->
+    device-memo lookup on the representatives -> evaluate the remainder
+    -> scatter every segment's loss back to all duplicates.
+
+The sort is length-major (see _lex_order): the representative buffer
+comes out grouped by program length, so the length-bucketed evaluator
+(models/fitness.py) runs on it without a second sort.
 
 Shape discipline: XLA needs static shapes, so the compact buffer keeps the
 full batch size N; slots past the unique count U (and memo-hit slots) hold
@@ -74,12 +78,20 @@ def empty_device_memo(slots: int, dtype=jnp.float32) -> DeviceMemo:
     )
 
 
-def _lex_order(h1: Array, h2: Array) -> Array:
-    """Stable argsort by (h1, h2) lexicographic — equal 64-bit keys (hence
-    all copies of one program) end up adjacent, ties broken by original
-    index so the permutation is deterministic."""
+def _lex_order(length: Array, h1: Array, h2: Array) -> Array:
+    """Stable argsort by (length, h1, h2) lexicographic — equal 64-bit
+    keys (hence all copies of one program) end up adjacent, ties broken
+    by original index so the permutation is deterministic.
+
+    `length` is the OUTERMOST key on purpose: identical programs have
+    identical lengths, so segmenting is unaffected, but the compacted
+    representative buffer comes out grouped by program length — the exact
+    ordering the length-bucketed evaluator wants (models/fitness.py
+    eval_loss_trees_bucketed presorted=True). One sort serves both the
+    dedup and the bucketing."""
     order = jnp.argsort(h2, stable=True)
-    return order[jnp.argsort(h1[order], stable=True)]
+    order = order[jnp.argsort(h1[order], stable=True)]
+    return order[jnp.argsort(length[order], stable=True)]
 
 
 def dedup_eval_losses(
@@ -101,7 +113,7 @@ def dedup_eval_losses(
 
     N = trees.length.shape[0]
     h1, h2 = tree_hash_device(trees)
-    order = _lex_order(h1, h2)
+    order = _lex_order(trees.length, h1, h2)
 
     # exact-equality segmenting over the canonical program bytes
     kindm, opm, featm, cwords, length = canonical_fields_device(trees)

@@ -92,18 +92,21 @@ def dataset_fingerprint(X, y, weights, options) -> str:
     # npop, or CPU vs TPU process) never share a bank.
     backend = options.eval_backend
     if backend == "auto":
-        from ..models.fitness import _PALLAS_MIN_BATCH
-        from ..ops.pallas_eval import pallas_available
+        from ..models.fitness import resolve_eval_backend_pallas
 
         rescore_batch = options.npopulations * options.npop
-        backend = "pallas" if (
-            pallas_available()
-            and options.precision in ("float32", "bfloat16")
-            and rescore_batch >= _PALLAS_MIN_BATCH
+        backend = "pallas" if resolve_eval_backend_pallas(
+            "auto", options.dtype, rescore_batch,
+            int(np.asarray(y).shape[-1]),
         ) else "jnp"
+    # eval_rows_per_tile changes the jnp reduction order (tile-wise
+    # partial sums — fitness._make_eval_loss_fn) so it is part of the
+    # context; eval_bucket_ladder is deliberately ABSENT — bucketing is
+    # bit-identical to the flat path, so banks are shared across ladders.
     h.update(
         f"{backend}:{options.kernel_program}:"
-        f"{options.kernel_leaf_skip}:{options.row_shards}".encode()
+        f"{options.kernel_leaf_skip}:{options.row_shards}:"
+        f"{options.eval_rows_per_tile}".encode()
     )
     return h.hexdigest()
 

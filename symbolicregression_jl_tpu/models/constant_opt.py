@@ -240,7 +240,7 @@ def _use_fused_kernels(options: Options, n_instances: int, X: Array) -> bool:
     (TPU-only, no custom loss_function, BFGS; layout overflows raise
     from the kernel)."""
     from ..ops.pallas_eval import _SLOT_UNROLL, _round_up, pallas_available
-    from .fitness import _PALLAS_MIN_BATCH
+    from .fitness import _pallas_work_gate
 
     backend = options.optimizer_backend
     if backend == "jnp":
@@ -267,7 +267,10 @@ def _use_fused_kernels(options: Options, n_instances: int, X: Array) -> bool:
         fits
         and pallas_available()
         and X.dtype == jnp.float32
-        and n_instances >= _PALLAS_MIN_BATCH
+        # instances x rows work volume, like the eval kernel's gate: the
+        # grad kernel tiles rows onto the same (8, 128) vregs, so a
+        # many-instances/tiny-rows launch would mostly pad row lanes
+        and _pallas_work_gate(n_instances, X.shape[1])
     )
 
 

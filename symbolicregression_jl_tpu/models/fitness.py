@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.interpreter import eval_trees
+from ..ops.interpreter import eval_loss_trees_fused, eval_trees
 from ..ops.losses import aggregate_loss
 from ..ops.operators import OperatorSet
 from .complexity import compute_complexity
@@ -26,6 +26,21 @@ Array = jax.Array
 
 
 _PALLAS_MIN_BATCH = 512
+
+# Minimum trees x rows work volume for the Pallas kernel ('auto' routing).
+# The kernel lays rows out on (8, 128) float32 vregs — one full row tile
+# is 1024 lanes — so the gate is calibrated as _PALLAS_MIN_BATCH trees at
+# exactly one full tile of rows: a 512-tree batch at >=1024 rows routes to
+# the kernel as before, while a large-batch/tiny-rows call (e.g. 8192
+# trees x 50 minibatch rows, where every grid step pads 974 of 1024
+# lanes) now stays on the jnp interpreter, which wastes nothing on rows.
+_PALLAS_MIN_WORK = _PALLAS_MIN_BATCH * 1024
+
+
+def _pallas_work_gate(n_trees: int, n_rows: int) -> bool:
+    """True when an (n_trees x n_rows) eval is big enough that the Pallas
+    kernel's tile padding is amortized (see _PALLAS_MIN_WORK)."""
+    return n_trees * n_rows >= _PALLAS_MIN_WORK
 
 # Kernel program shape used when kernel_program="auto": the best measured
 # variant on hardware (benchmark/kernel_tune.py A/B history in BASELINE.md).
@@ -54,8 +69,6 @@ def dispatch_eval(
     optimization) must force backend='jnp' or call eval_trees directly;
     'auto' never changes semantics or breaks grads only because the guards
     below route those cases to the jnp path."""
-    from ..ops.pallas_eval import pallas_available
-
     if backend == "pallas" and X.dtype not in (jnp.float32, jnp.bfloat16):
         # never silently downcast: the kernel computes in f32 (bf16 is
         # storage-only), so an explicit pallas request for f64/f16 data
@@ -65,12 +78,7 @@ def dispatch_eval(
             f"{X.dtype} (float64 has no native TPU path — use "
             "eval_backend='jnp'; see BASELINE.md 'float64')"
         )
-    if backend == "pallas" or (
-        backend == "auto"
-        and pallas_available()
-        and X.dtype in (jnp.float32, jnp.bfloat16)
-        and int(np.prod(trees.length.shape)) >= _PALLAS_MIN_BATCH
-    ):
+    if _routes_to_pallas(trees, X, backend):
         from ..ops.pallas_eval import eval_trees_pallas
 
         compute_dtype = (
@@ -92,6 +100,115 @@ def dispatch_eval(
     return eval_trees(trees, X, operators)
 
 
+def resolve_eval_backend_pallas(
+    backend: str, dtype, n_trees: int, n_rows: int
+) -> bool:
+    """THE kernel routing decision, in shape terms: True when evaluation
+    runs the Pallas kernel. Single source of truth — dispatch_eval, the
+    loss-path builder (_make_eval_loss_fn, via _routes_to_pallas), and
+    the memo bank's fingerprint resolution (cache/memo.py, which must
+    predict the backend the rescore will use or a served loss could be
+    ULP-wrong) all call this one predicate. All inputs are trace-time
+    constants, so the decision is host-static."""
+    from ..ops.pallas_eval import pallas_available
+
+    import jax.numpy as _jnp
+
+    return backend == "pallas" or (
+        backend == "auto"
+        and pallas_available()
+        and dtype in (_jnp.float32, _jnp.bfloat16)
+        and _pallas_work_gate(n_trees, n_rows)
+    )
+
+
+def _routes_to_pallas(trees: TreeBatch, X: Array, backend: str) -> bool:
+    """resolve_eval_backend_pallas on an actual (trees, X) call shape."""
+    return resolve_eval_backend_pallas(
+        backend, X.dtype, int(np.prod(trees.length.shape)), X.shape[1]
+    )
+
+
+def _bucket_bounds(n: int, ladder: Tuple[float, ...]) -> Tuple[int, ...]:
+    """Static positional boundaries [0, n1, ..., n] of a length-sorted
+    batch of n trees under a cumulative-fraction ladder. Duplicate
+    boundaries (empty buckets at small n) are kept — callers skip
+    zero-width buckets."""
+    bounds = [0]
+    for frac in ladder:
+        bounds.append(min(n, max(bounds[-1], int(round(frac * n)))))
+    bounds[-1] = n  # the ladder's last rung is validated to be 1.0
+    return tuple(bounds)
+
+
+def eval_loss_trees_bucketed(
+    trees: TreeBatch,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    operators: OperatorSet,
+    loss_fn: Callable,
+    ladder: Tuple[float, ...],
+    rows_per_tile: int = 0,
+    presorted: bool = False,
+) -> Array:
+    """Length-bucketed jnp evaluation: per-tree aggregated loss,
+    bit-identical to the flat interpreter path (with rows_per_tile=0).
+
+    GP populations are dominated by short programs (early iterations run
+    under a small curmaxsize; mutation shrinks as often as it grows), but
+    the lockstep interpreter scans all max_len slots for every tree. This
+    driver argsorts the flat batch by program length, splits the sorted
+    order at the ladder's host-static positional boundaries (cumulative
+    batch fractions — `_bucket_bounds`), and evaluates each bucket with
+    the slot loop truncated to THAT bucket's longest program (a traced
+    bound: `jnp.max` over the bucket, so an all-short bucket stops at its
+    actual need rather than a fixed rung). Losses scatter back to the
+    original order. Exact by construction: every truncated slot is PAD,
+    and PAD steps are identities in the interpreter (`_slot_step`), so
+    per-tree results are invariant to bucket assignment — which is also
+    why composing with the dedup sort below is safe.
+
+    presorted=True skips the argsort: the caller guarantees the batch is
+    already grouped so that ordering by position approximates ordering by
+    length (the dedup pipeline's length-major sort — cache/dedup.py — so
+    dedup and bucketing share ONE sort; its filler slots are length-1
+    programs that never raise a bucket's bound). Correctness does NOT
+    depend on the ordering, only the realized speedup does."""
+    batch_shape = trees.length.shape
+    flat = jax.tree_util.tree_map(
+        lambda x: x.reshape((-1,) + x.shape[len(batch_shape):]), trees
+    )
+    N = flat.length.shape[0]
+    if presorted:
+        order = None
+        ordered = flat
+    else:
+        order = jnp.argsort(flat.length, stable=True)
+        ordered = jax.tree_util.tree_map(lambda x: x[order], flat)
+    bounds = _bucket_bounds(N, ladder)
+    losses = []
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        if lo == hi:
+            continue
+        bucket = ordered[lo:hi]
+        n_steps = jnp.max(bucket.length)
+        losses.append(
+            eval_loss_trees_fused(
+                bucket, X, y, weights, operators, loss_fn,
+                rows_per_tile=rows_per_tile, n_steps=n_steps,
+            )
+        )
+    if not losses:  # N == 0: every bucket zero-width, like the flat path
+        return jnp.zeros(batch_shape, X.dtype)
+    loss_sorted = losses[0] if len(losses) == 1 else jnp.concatenate(losses)
+    if order is None:
+        loss = loss_sorted
+    else:
+        loss = jnp.zeros((N,), loss_sorted.dtype).at[order].set(loss_sorted)
+    return loss.reshape(batch_shape)
+
+
 def _make_eval_loss_fn(
     X: Array,
     y: Array,
@@ -101,15 +218,39 @@ def _make_eval_loss_fn(
     backend: str,
     program: str,
     leaf_skip: "str | bool",
+    bucket_ladder: Tuple[float, ...] = (),
+    rows_per_tile: int = 0,
+    length_sorted: bool = False,
 ) -> Callable:
     """TreeBatch -> per-tree aggregated loss (Inf on NaN/Inf evals,
     reference src/LossFunctions.jl:36-39). The ONE definition of the
     scoring composition: both the plain and the deduped/memoized paths
     call this exact closure, which is what makes the cache subsystem's
     bit-identity guarantee a structural property instead of a
-    keep-two-copies-in-sync obligation."""
+    keep-two-copies-in-sync obligation.
+
+    Dispatch decision tree (docs/eval_pipeline.md): batches that route to
+    the Pallas kernel keep the flat composition (the kernel already
+    prices trees by length — ops/pallas_eval.py design note 3b); jnp
+    batches take the length-bucketed graph when `bucket_ladder` is
+    non-empty (bit-identical), else the row-tiled fused reduction when
+    `rows_per_tile` > 0 (opt-in, NOT bit-identical), else the flat
+    composition unchanged. length_sorted=True is the dedup pipeline's
+    shared-sort hint (see eval_loss_trees_bucketed)."""
 
     def eval_fn(trees: TreeBatch) -> Array:
+        if not _routes_to_pallas(trees, X, backend):
+            if bucket_ladder:
+                return eval_loss_trees_bucketed(
+                    trees, X, y, weights, operators, loss_fn,
+                    bucket_ladder, rows_per_tile=rows_per_tile,
+                    presorted=length_sorted,
+                )
+            if rows_per_tile > 0:
+                return eval_loss_trees_fused(
+                    trees, X, y, weights, operators, loss_fn,
+                    rows_per_tile=rows_per_tile,
+                )
         y_pred, ok = dispatch_eval(trees, X, operators, backend, program,
                                    leaf_skip)
         elem = loss_fn(y_pred, y)
@@ -130,17 +271,23 @@ def eval_loss_trees(
     backend: str = "auto",
     program: str = "auto",
     leaf_skip: "str | bool" = "auto",
+    bucket_ladder: Tuple[float, ...] = (),
+    rows_per_tile: int = 0,
 ) -> Array:
     """Per-tree aggregated loss over all rows (or the row_idx minibatch).
 
     Trees whose evaluation hit NaN/Inf get Inf loss
-    (reference src/LossFunctions.jl:36-39)."""
+    (reference src/LossFunctions.jl:36-39). bucket_ladder / rows_per_tile
+    select the length-bucketed / row-tiled jnp graphs — see
+    _make_eval_loss_fn for the dispatch decision tree and exactness
+    guarantees per path."""
     if row_idx is not None:
         X = X[:, row_idx]
         y = y[row_idx]
         weights = None if weights is None else weights[row_idx]
     return _make_eval_loss_fn(
-        X, y, weights, operators, loss_fn, backend, program, leaf_skip
+        X, y, weights, operators, loss_fn, backend, program, leaf_skip,
+        bucket_ladder, rows_per_tile,
     )(trees)
 
 
@@ -155,6 +302,8 @@ def eval_loss_trees_deduped(
     backend: str = "auto",
     program: str = "auto",
     leaf_skip: "str | bool" = "auto",
+    bucket_ladder: Tuple[float, ...] = (),
+    rows_per_tile: int = 0,
     memo=None,
 ):
     """eval_loss_trees through the cache subsystem: intra-batch dedup of
@@ -163,7 +312,14 @@ def eval_loss_trees_deduped(
 
     The memo holds FULL-data losses, so it is consulted only when
     row_idx is None — minibatch draws always evaluate (cache/memo.py
-    keying rules)."""
+    keying rules).
+
+    Bucketing composes with the dedup through ONE sort: dedup's
+    length-major (length, hash) ordering leaves its compacted
+    representative buffer grouped by length, so the closure is built with
+    length_sorted=True and the bucketed path skips its own argsort
+    (per-tree losses are invariant to bucket assignment, so the dedup's
+    bit-identity contract — eval_fn(buffer) slot by slot — still holds)."""
     from ..cache.dedup import dedup_eval_losses
 
     if row_idx is not None:
@@ -177,7 +333,8 @@ def eval_loss_trees_deduped(
         lambda x: x.reshape((-1,) + x.shape[len(batch_shape):]), trees
     )
     eval_fn = _make_eval_loss_fn(
-        X, y, weights, operators, loss_fn, backend, program, leaf_skip
+        X, y, weights, operators, loss_fn, backend, program, leaf_skip,
+        bucket_ladder, rows_per_tile, length_sorted=True,
     )
     loss, stats = dedup_eval_losses(flat, eval_fn, memo)
     return loss.reshape(batch_shape), stats
@@ -213,6 +370,8 @@ def score_trees_cached(
         row_idx, backend=options.eval_backend,
         program=options.kernel_program,
         leaf_skip=options.kernel_leaf_skip,
+        bucket_ladder=options.eval_bucket_ladder,
+        rows_per_tile=options.eval_rows_per_tile,
         memo=memo,
     )
     complexity = compute_complexity(trees, options)
@@ -277,6 +436,8 @@ def score_trees(
             row_idx, backend=options.eval_backend,
             program=options.kernel_program,
             leaf_skip=options.kernel_leaf_skip,
+            bucket_ladder=options.eval_bucket_ladder,
+            rows_per_tile=options.eval_rows_per_tile,
         )
     complexity = compute_complexity(trees, options)
     score = loss_to_score(loss, baseline, complexity, options)

@@ -251,6 +251,27 @@ class Options:
     # also skips the transcendental candidates. Applies to the postfix
     # program only (the instr programs have no leaf slots).
     kernel_leaf_skip: "str | bool" = "auto"
+    # Length-bucketed jnp interpreter evaluation (docs/eval_pipeline.md).
+    # Non-empty: a host-static ladder of cumulative batch fractions,
+    # ascending and ending at 1.0 (e.g. (0.25, 0.5, 1.0)). Each scoring
+    # batch is argsorted by program length, split at the ladder's
+    # positional boundaries, and every bucket's slot loop truncates to
+    # that bucket's longest program — exact (bit-identical to the flat
+    # path: truncated slots are PAD no-ops) and faster whenever the
+    # population skews short (early curmaxsize warm-up, post-simplify
+    # populations). Applies only where the jnp interpreter runs (CPU,
+    # small batches, f64/f16); batches routed to the Pallas kernel keep
+    # the flat composition — the kernel already prices trees by length.
+    # () (default) = flat evaluation, identical graphs to pre-ladder
+    # builds.
+    eval_bucket_ladder: Tuple[float, ...] = ()
+    # Row-tiled streaming loss for the jnp interpreter path: > 0 streams
+    # dataset rows through fixed-width tiles of this many rows inside the
+    # fused per-tree reduction, bounding eval-stage memory at
+    # O(batch x rows_per_tile) instead of O(batch x nrows). NOT
+    # bit-identical to the flat reduction (tile-wise partial sums reduce
+    # in a different order) — opt-in for large datasets, default off.
+    eval_rows_per_tile: int = 0
     # Constant-optimization eval path: "auto" routes BFGS through the
     # fused Pallas loss/grad kernels (ops/pallas_grad.py) at population
     # scale on TPU; "jnp" pins the vmapped-interpreter path; "pallas"
@@ -346,6 +367,27 @@ class Options:
             raise ValueError(
                 "optimizer_backend must be one of auto/jnp/pallas"
             )
+        if not isinstance(self.eval_bucket_ladder, tuple):
+            object.__setattr__(
+                self, "eval_bucket_ladder",
+                tuple(float(f) for f in self.eval_bucket_ladder),
+            )
+        ladder = self.eval_bucket_ladder
+        if ladder:
+            if any(
+                not 0.0 < float(f) <= 1.0 for f in ladder
+            ) or list(ladder) != sorted(ladder):
+                raise ValueError(
+                    "eval_bucket_ladder must be ascending cumulative "
+                    f"batch fractions in (0, 1], got {ladder!r}"
+                )
+            if float(ladder[-1]) != 1.0:
+                raise ValueError(
+                    "eval_bucket_ladder must end at 1.0 (the last bucket "
+                    f"covers the whole batch), got {ladder!r}"
+                )
+        if self.eval_rows_per_tile < 0:
+            raise ValueError("eval_rows_per_tile must be >= 0")
         if self.row_shards < 1:
             raise ValueError("row_shards must be >= 1")
         if (
@@ -443,6 +485,8 @@ class Options:
             self.independent_island_batches,
             self.n_parallel_tournaments, self.eval_backend,
             self.kernel_program, self.kernel_leaf_skip, self.precision,
+            # bucketed / row-tiled eval graphs are compiled in
+            self.eval_bucket_ladder, self.eval_rows_per_tile,
             self.constraints, self.nested_constraints,
             self.complexity_of_operators, self.complexity_of_constants,
             self.complexity_of_variables, self.mutation_weights.as_tuple(),

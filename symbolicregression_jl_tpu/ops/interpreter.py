@@ -36,6 +36,61 @@ from .operators import OperatorSet
 Array = jax.Array
 
 
+def _slot_step(carry, node, X: Array, operators: OperatorSet, arity_table):
+    """One stack-machine step over all rows: carry (stack (depth, nrows),
+    sp, bad (nrows,)), node (kind, op, feat, cval) scalars.
+
+    The ONE definition of the per-slot math: the full-L scan
+    (`_eval_single`), the bounded fori_loop evaluator (`_eval_rows` — the
+    bucketed/fused loss paths), and their vmapped forms all execute this
+    exact op sequence, which is what makes the bucketed evaluation
+    bit-identical to the flat path a structural property instead of a
+    keep-two-interpreters-in-sync obligation. A PAD step is an identity
+    on the whole carry — truncating the slot loop anywhere past a
+    program's `length` cannot change its result."""
+    stack, sp, bad = carry
+    k, o, f, c = node
+    nrows = X.shape[1]
+    unary_fns = operators.unary_fns
+    binary_fns = operators.binary_fns
+    a = stack[jnp.maximum(sp - 1, 0)]  # top: unary operand / right operand
+    b = stack[jnp.maximum(sp - 2, 0)]  # second: left operand
+    leaf = jnp.where(k == CONST, jnp.broadcast_to(c, (nrows,)), X[f])  # srlint: disable=SR007 -- scalar-over-rows select arm, fused by XLA
+    if unary_fns:
+        una_all = jnp.stack([fn(a) for fn in unary_fns])
+        una = una_all[jnp.clip(o, 0, len(unary_fns) - 1)]
+    else:
+        una = jnp.zeros_like(a)
+    if binary_fns:
+        bin_all = jnp.stack([fn(b, a) for fn in binary_fns])
+        binv = bin_all[jnp.clip(o, 0, len(binary_fns) - 1)]
+    else:
+        binv = jnp.zeros_like(a)
+    v = jnp.where(k <= VAR, leaf, jnp.where(k == UNA, una, binv))
+    # some operator impls upcast half precisions internally (special
+    # functions route through f32); pin the working dtype so the stack
+    # update below type-checks for bf16/f16 inputs
+    v = v.astype(stack.dtype)
+    arity = arity_table[k]
+    new_sp = jnp.where(k == PAD, sp, sp - arity + 1)
+    write = jnp.maximum(new_sp - 1, 0)
+    v_final = jnp.where(k == PAD, stack[write], v)
+    new_stack = jax.lax.dynamic_update_index_in_dim(stack, v_final, write, 0)
+    # elementwise NaN/Inf poison per row; reduced once at the end
+    # (cheaper than a per-step all-rows reduction, same semantics as the
+    # reference's early exit: any non-finite intermediate -> incomplete)
+    new_bad = bad | ((k != PAD) & ~jnp.isfinite(v))
+    return new_stack, new_sp, new_bad
+
+
+def _stack_init(L: int, nrows: int, dtype):
+    return (
+        jnp.zeros((L // 2 + 2, nrows), dtype),
+        jnp.int32(0),
+        jnp.zeros((nrows,), jnp.bool_),
+    )
+
+
 def _eval_single(
     kind: Array,
     op: Array,
@@ -47,53 +102,47 @@ def _eval_single(
 ) -> Tuple[Array, Array]:
     """Evaluate one tree over X (nfeatures, nrows) -> (y (nrows,), ok bool)."""
     L = kind.shape[0]
-    nrows = X.shape[1]
-    depth = L // 2 + 2
     arity_table = jnp.asarray(ARITY)
-    unary_fns = operators.unary_fns
-    binary_fns = operators.binary_fns
 
     def step(carry, node):
-        stack, sp, bad = carry  # stack: (depth, nrows); bad: (nrows,) bool
-        k, o, f, c = node
-        a = stack[jnp.maximum(sp - 1, 0)]  # top: unary operand / right operand
-        b = stack[jnp.maximum(sp - 2, 0)]  # second: left operand
-        leaf = jnp.where(k == CONST, jnp.broadcast_to(c, (nrows,)), X[f])  # srlint: disable=SR007 -- scalar-over-rows select arm, fused by XLA
-        if unary_fns:
-            una_all = jnp.stack([fn(a) for fn in unary_fns])
-            una = una_all[jnp.clip(o, 0, len(unary_fns) - 1)]
-        else:
-            una = jnp.zeros_like(a)
-        if binary_fns:
-            bin_all = jnp.stack([fn(b, a) for fn in binary_fns])
-            binv = bin_all[jnp.clip(o, 0, len(binary_fns) - 1)]
-        else:
-            binv = jnp.zeros_like(a)
-        v = jnp.where(k <= VAR, leaf, jnp.where(k == UNA, una, binv))
-        # some operator impls upcast half precisions internally (special
-        # functions route through f32); pin the working dtype so the stack
-        # update below type-checks for bf16/f16 inputs
-        v = v.astype(stack.dtype)
-        arity = arity_table[k]
-        new_sp = jnp.where(k == PAD, sp, sp - arity + 1)
-        write = jnp.maximum(new_sp - 1, 0)
-        v_final = jnp.where(k == PAD, stack[write], v)
-        new_stack = jax.lax.dynamic_update_index_in_dim(stack, v_final, write, 0)
-        # elementwise NaN/Inf poison per row; reduced once at the end
-        # (cheaper than a per-step all-rows reduction, same semantics as the
-        # reference's early exit: any non-finite intermediate -> incomplete)
-        new_bad = bad | ((k != PAD) & ~jnp.isfinite(v))
-        return (new_stack, new_sp, new_bad), None
+        return _slot_step(carry, node, X, operators, arity_table), None
 
-    init = (
-        jnp.zeros((depth, nrows), X.dtype),
-        jnp.int32(0),
-        jnp.zeros((nrows,), jnp.bool_),
+    (stack, sp, bad), _ = jax.lax.scan(
+        step, _stack_init(L, X.shape[1], X.dtype), (kind, op, feat, cval)
     )
-    (stack, sp, bad), _ = jax.lax.scan(step, init, (kind, op, feat, cval))
     y = stack[0]
     ok = ~jnp.any(bad) & (length > 0)
     return y, ok
+
+
+def _eval_rows(
+    kind: Array,
+    op: Array,
+    feat: Array,
+    cval: Array,
+    X: Array,
+    operators: OperatorSet,
+    n_steps,
+) -> Tuple[Array, Array]:
+    """One tree over X with the slot loop truncated to `n_steps` (a static
+    int or a traced int32 scalar) -> (y (nrows,), bad (nrows,)).
+
+    Exact for every tree whose `length <= n_steps`: slots past the program
+    end are PAD, and a PAD `_slot_step` is an identity on the carry. A
+    traced bound lowers `fori_loop` to `while_loop` (not reverse-mode
+    differentiable — scoring only; constant optimization grads go through
+    the `_eval_single` scan). Returns the raw per-row poison flags so
+    callers that tile or mask rows can reduce them correctly."""
+    arity_table = jnp.asarray(ARITY)
+
+    def body(i, carry):
+        node = (kind[i], op[i], feat[i], cval[i])
+        return _slot_step(carry, node, X, operators, arity_table)
+
+    stack, sp, bad = jax.lax.fori_loop(
+        0, n_steps, body, _stack_init(kind.shape[0], X.shape[1], X.dtype)
+    )
+    return stack[0], bad
 
 
 def filler_trees(
@@ -147,6 +196,126 @@ def eval_tree(
     return _eval_single(
         tree.kind, tree.op, tree.feat, tree.cval, tree.length, X, operators
     )
+
+
+def _eval_loss_single(
+    kind: Array,
+    op: Array,
+    feat: Array,
+    cval: Array,
+    length: Array,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    operators: OperatorSet,
+    loss_fn,
+    n_steps,
+    rows_per_tile: int,
+) -> Array:
+    """One tree -> aggregated loss scalar (Inf on NaN/Inf evals), never
+    materializing the prediction row vector past the reduction.
+
+    rows_per_tile == 0 (exact mode): evaluate all rows at once and apply
+    literally the flat scoring composition — loss_fn, aggregate_loss,
+    inf-on-incomplete — so the result is bit-identical to the unfused
+    path. rows_per_tile > 0: stream the rows through a lax.scan of
+    fixed-width tiles, accumulating per-tree sufficient statistics
+    (weighted loss sum, weight sum, poison flag); the tile-wise partial
+    sums reduce in a different order than the flat row reduction, so this
+    mode is NOT bit-identical (documented opt-in for large datasets —
+    peak memory per tree drops from O(nrows) to O(rows_per_tile))."""
+    from .losses import aggregate_loss
+
+    nrows = X.shape[1]
+    if rows_per_tile <= 0 or rows_per_tile >= nrows:
+        y_pred, bad = _eval_rows(kind, op, feat, cval, X, operators, n_steps)
+        ok = ~jnp.any(bad) & (length > 0)
+        elem = loss_fn(y_pred, y)
+        loss = aggregate_loss(elem, weights)
+        return jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
+
+    tile = int(rows_per_tile)
+    n_tiles = -(-nrows // tile)
+    pad = n_tiles * tile - nrows
+    # edge-pad the rows (in-domain values keep the padded lanes from
+    # manufacturing spurious non-finites; the mask below excludes them
+    # from every reduction regardless)
+    Xp = jnp.pad(X, ((0, 0), (0, pad)), mode="edge")
+    yp = jnp.pad(y, (0, pad), mode="edge")
+    mask = jnp.arange(n_tiles * tile, dtype=jnp.int32) < nrows
+    wp = None if weights is None else jnp.pad(weights, (0, pad))
+    xs = (
+        jnp.moveaxis(Xp.reshape(X.shape[0], n_tiles, tile), 1, 0),
+        yp.reshape(n_tiles, tile),
+        mask.reshape(n_tiles, tile),
+        (jnp.zeros((n_tiles, 0), X.dtype) if wp is None
+         else wp.reshape(n_tiles, tile)),
+    )
+
+    def tile_step(carry, xt):
+        num, den, bad_any = carry
+        Xt, yt, mt, wt = xt
+        y_pred, bad = _eval_rows(kind, op, feat, cval, Xt, operators,
+                                 n_steps)
+        elem = loss_fn(y_pred, yt)
+        w_eff = mt.astype(elem.dtype) if weights is None else jnp.where(
+            mt, wt, jnp.zeros((), elem.dtype)
+        )
+        num = num + jnp.sum(elem * w_eff)
+        den = den + jnp.sum(w_eff)
+        bad_any = bad_any | jnp.any(bad & mt)
+        return (num, den, bad_any), None
+
+    init = (
+        jnp.zeros((), X.dtype), jnp.zeros((), X.dtype),
+        jnp.zeros((), jnp.bool_),
+    )
+    (num, den, bad_any), _ = jax.lax.scan(tile_step, init, xs)
+    loss = num / den
+    ok = ~bad_any & (length > 0)
+    return jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
+
+
+def eval_loss_trees_fused(
+    trees: TreeBatch,
+    X: Array,
+    y: Array,
+    weights: Optional[Array],
+    operators: OperatorSet,
+    loss_fn,
+    rows_per_tile: int = 0,
+    n_steps=None,
+) -> Array:
+    """Fused evaluate+reduce: per-tree aggregated loss (Inf on NaN/Inf
+    evals) with NO (batch, nrows) prediction intermediate — the
+    elementwise loss reduces to a scalar inside the vmapped evaluator.
+
+    trees batch shape (...,); X (nfeat, nrows); y (nrows,); returns loss
+    (...,). With rows_per_tile=0 (default) the result is bit-identical to
+    the unfused composition ``aggregate_loss(loss_fn(eval_trees(...)))``
+    with the same inf-on-incomplete fold (asserted in tests);
+    rows_per_tile>0 streams rows through fixed-width tiles and is NOT
+    bit-identical (different reduction order — see _eval_loss_single).
+
+    n_steps truncates the slot loop (static int or traced int32): exact
+    whenever every tree in the batch has length <= n_steps, because
+    truncated slots are PAD identities. None means all max_len slots —
+    the drop-in flat replacement. The length-bucketed driver
+    (models/fitness.py) passes each bucket's dynamic length bound."""
+    batch_shape = trees.length.shape
+    if n_steps is None:
+        n_steps = trees.max_len
+    flat = jax.tree_util.tree_map(
+        lambda x: x.reshape((-1,) + x.shape[len(batch_shape):]), trees
+    )
+    f = jax.vmap(
+        lambda k, o, ft, c, n: _eval_loss_single(
+            k, o, ft, c, n, X, y, weights, operators, loss_fn, n_steps,
+            rows_per_tile,
+        )
+    )
+    loss = f(flat.kind, flat.op, flat.feat, flat.cval, flat.length)
+    return loss.reshape(batch_shape)
 
 
 def eval_grad_constants(

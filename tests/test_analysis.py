@@ -270,7 +270,7 @@ def test_checked_in_baseline_exists_and_well_formed():
         payload = json.load(f)
     assert payload["schema_version"] == 1
     assert set(payload["configs"]) == {
-        "base", "cache", "islands4", "pop32", "chunked",
+        "base", "cache", "islands4", "pop32", "bucketed", "chunked",
     }
     for entry in payload["configs"].values():
         assert entry["total_primitives"] == sum(
@@ -416,7 +416,7 @@ def test_checked_in_memory_baseline_exists_and_well_formed():
         payload = json.load(f)
     assert payload["schema_version"] == 1
     assert set(payload["configs"]) == {
-        "base", "cache", "islands4", "pop32",
+        "base", "cache", "islands4", "pop32", "bucketed",
     }
     for entry in payload["configs"].values():
         assert entry["peak_modeled_bytes"] > 0

@@ -302,7 +302,10 @@ def test_use_fused_kernels_routing(monkeypatch):
     import symbolicregression_jl_tpu.models.constant_opt as co
     import symbolicregression_jl_tpu.ops.pallas_eval as pe
 
-    X = jnp.ones((1, 10), jnp.float32)
+    # 1024 rows: one full (8, 128) row tile, so the instances x rows
+    # work-volume gate (fitness._pallas_work_gate) reduces to the
+    # instance count vs the old batch threshold
+    X = jnp.ones((1, 1024), jnp.float32)
     opt = make_options(optimizer_backend="auto")
     # off-TPU: never
     assert not co._use_fused_kernels(opt, 10_000, X)
@@ -311,12 +314,17 @@ def test_use_fused_kernels_routing(monkeypatch):
     assert co._use_fused_kernels(opt, 10_000, X)
     # too small a batch
     assert not co._use_fused_kernels(opt, 8, X)
+    # many instances but tiny rows: insufficient work volume — the grad
+    # kernel would mostly pad the row tile
+    assert not co._use_fused_kernels(
+        opt, 10_000, jnp.ones((1, 10), jnp.float32)
+    )
     # non-f32 data (bf16 here; f64 is unconstructable without x64 enabled)
     assert not co._use_fused_kernels(
-        opt, 10_000, jnp.ones((1, 10), jnp.bfloat16)
+        opt, 10_000, jnp.ones((1, 1024), jnp.bfloat16)
     )
     # layout overflow (wide feature space) falls back quietly on auto
-    X_wide = jnp.ones((2040, 10), jnp.float32)
+    X_wide = jnp.ones((2040, 1024), jnp.float32)
     assert not co._use_fused_kernels(opt, 10_000, X_wide)
     # non-BFGS never routes on auto
     opt_nm = make_options(

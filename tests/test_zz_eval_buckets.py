@@ -1,0 +1,309 @@
+"""Length-bucketed + fused/row-tiled evaluation (ISSUE 5).
+
+Exactness contract under test: the bucketed jnp dispatch
+(models/fitness.py eval_loss_trees_bucketed) and the untiled fused
+reduction (ops/interpreter.py eval_loss_trees_fused) are BIT-IDENTICAL
+to the flat interpreter path; the row-tiled mode is close-but-not-exact
+by design (tile-wise partial sums). docs/eval_pipeline.md documents the
+guarantees per path.
+
+File intentionally sorts LAST in tests/: the tier-1 runner is a
+timeout-bounded dot count, so new fast tests must not displace the
+early-alphabet files (ROADMAP tier-1 note); search-heavy cases here are
+additionally under the `slow` marker.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import symbolicregression_jl_tpu as sr
+from symbolicregression_jl_tpu.models.fitness import (
+    _bucket_bounds,
+    _pallas_work_gate,
+    eval_loss_trees,
+    eval_loss_trees_bucketed,
+    score_trees,
+    score_trees_cached,
+)
+from symbolicregression_jl_tpu.models.mutate_device import (
+    gen_random_tree_fixed_size,
+)
+from symbolicregression_jl_tpu.models.options import make_options
+from symbolicregression_jl_tpu.ops.interpreter import eval_loss_trees_fused
+
+LADDER = (0.25, 0.5, 1.0)
+
+
+def _options(**kw):
+    kw.setdefault("binary_operators", ["+", "-", "*", "/"])
+    kw.setdefault("unary_operators", ["cos", "exp"])
+    kw.setdefault("maxsize", 12)
+    return make_options(**kw)
+
+
+def _workload(options, n_trees, n_rows, seed, sizes=None, nfeat=2):
+    rng = np.random.default_rng(seed)
+    if sizes is None:
+        sizes = rng.integers(1, options.maxsize + 1, n_trees)
+    trees = jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(
+            k, s, nfeat, options.operators, options.max_len
+        )
+    )(
+        jax.random.split(jax.random.PRNGKey(seed), n_trees),
+        jnp.asarray(np.asarray(sizes, np.int32)),
+    )
+    X = jnp.asarray(rng.standard_normal((nfeat, n_rows)), jnp.float32)
+    y = 2.0 * jnp.cos(X[-1]) + X[0] ** 2 - 0.5
+    return trees, X, y
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_bucketed_bit_identical_to_flat(seed):
+    options = _options()
+    trees, X, y = _workload(options, 256, 64, seed)
+    ops, loss_fn = options.operators, options.elementwise_loss
+    flat = eval_loss_trees(trees, X, y, None, ops, loss_fn, backend="jnp")
+    buck = eval_loss_trees(
+        trees, X, y, None, ops, loss_fn, backend="jnp",
+        bucket_ladder=LADDER,
+    )
+    assert np.array_equal(np.asarray(flat), np.asarray(buck))
+
+
+def test_bucketed_bit_identical_weighted_and_bf16():
+    options = _options()
+    trees, X, y = _workload(options, 128, 48, 3)
+    ops, loss_fn = options.operators, options.elementwise_loss
+    w = jnp.asarray(np.random.default_rng(3).random(48), jnp.float32)
+    flat = eval_loss_trees(trees, X, y, w, ops, loss_fn, backend="jnp")
+    buck = eval_loss_trees(
+        trees, X, y, w, ops, loss_fn, backend="jnp", bucket_ladder=LADDER
+    )
+    assert np.array_equal(np.asarray(flat), np.asarray(buck))
+    # bf16 storage: same exactness claim at the TPU-native half precision
+    tb = trees._replace(cval=trees.cval.astype(jnp.bfloat16))
+    Xb, yb = X.astype(jnp.bfloat16), y.astype(jnp.bfloat16)
+    flat_b = eval_loss_trees(tb, Xb, yb, None, ops, loss_fn, backend="jnp")
+    buck_b = eval_loss_trees(
+        tb, Xb, yb, None, ops, loss_fn, backend="jnp", bucket_ladder=LADDER
+    )
+    assert np.array_equal(
+        np.asarray(flat_b, np.float32), np.asarray(buck_b, np.float32)
+    )
+
+
+def test_bucket_boundary_lengths_exact():
+    """Trees whose lengths tie exactly across a positional bucket edge:
+    the sort may place equal-length trees on both sides of a boundary,
+    and per-tree results must not depend on which side they land."""
+    options = _options(maxsize=12)
+    n = 64
+    bounds = _bucket_bounds(n, LADDER)
+    sizes = np.ones(n, np.int32) * 3
+    # a run of identical mid-size trees straddling the first boundary,
+    # and max-length trees at the very end
+    lo = max(bounds[1] - 4, 0)
+    sizes[lo:bounds[1] + 4] = 7
+    sizes[-4:] = options.maxsize
+    trees, X, y = _workload(options, n, 32, 5, sizes=sizes)
+    ops, loss_fn = options.operators, options.elementwise_loss
+    flat = eval_loss_trees(trees, X, y, None, ops, loss_fn, backend="jnp")
+    buck = eval_loss_trees(
+        trees, X, y, None, ops, loss_fn, backend="jnp",
+        bucket_ladder=LADDER,
+    )
+    assert np.array_equal(np.asarray(flat), np.asarray(buck))
+    # degenerate ladders: a single full-batch rung (adaptive max-length
+    # truncation) and a ladder finer than the batch (empty buckets)
+    for ladder in [(1.0,), tuple((i + 1) / 16 for i in range(16))]:
+        buck2 = eval_loss_trees(
+            trees, X, y, None, ops, loss_fn, backend="jnp",
+            bucket_ladder=ladder,
+        )
+        assert np.array_equal(np.asarray(flat), np.asarray(buck2))
+
+
+def test_bucket_bounds_static():
+    assert _bucket_bounds(100, (0.25, 0.5, 1.0)) == (0, 25, 50, 100)
+    assert _bucket_bounds(2, (0.25, 0.5, 1.0)) == (0, 0, 1, 2)
+    assert _bucket_bounds(0, (1.0,)) == (0, 0)
+
+
+def test_fused_matches_flat_composition():
+    from symbolicregression_jl_tpu.ops.interpreter import eval_trees
+    from symbolicregression_jl_tpu.ops.losses import aggregate_loss
+
+    options = _options()
+    trees, X, y = _workload(options, 96, 40, 7)
+    ops, loss_fn = options.operators, options.elementwise_loss
+    for w in (None, jnp.asarray(
+            np.random.default_rng(7).random(40), jnp.float32)):
+        y_pred, ok = eval_trees(trees, X, ops)
+        loss = aggregate_loss(loss_fn(y_pred, y), w)
+        flat = jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
+        fused = eval_loss_trees_fused(trees, X, y, w, ops, loss_fn)
+        assert np.array_equal(np.asarray(flat), np.asarray(fused))
+
+
+def test_row_tiled_close_and_same_inf_pattern():
+    options = _options()
+    trees, X, y = _workload(options, 96, 50, 9)
+    ops, loss_fn = options.operators, options.elementwise_loss
+    flat = np.asarray(
+        eval_loss_trees(trees, X, y, None, ops, loss_fn, backend="jnp")
+    )
+    for w in (None, jnp.asarray(
+            np.random.default_rng(9).random(50) + 0.1, jnp.float32)):
+        ref = np.asarray(
+            eval_loss_trees(trees, X, y, w, ops, loss_fn, backend="jnp")
+        )
+        # 13 does not divide 50: exercises the masked pad tile
+        tiled = np.asarray(
+            eval_loss_trees_fused(
+                trees, X, y, w, ops, loss_fn, rows_per_tile=13
+            )
+        )
+        assert np.array_equal(np.isfinite(ref), np.isfinite(tiled))
+        fin = np.isfinite(ref)
+        np.testing.assert_allclose(ref[fin], tiled[fin], rtol=1e-5)
+    # a whole-batch tile is the exact path: bit-identical
+    whole = np.asarray(
+        eval_loss_trees_fused(
+            trees, X, y, None, ops, loss_fn, rows_per_tile=50
+        )
+    )
+    assert np.array_equal(flat, whole)
+
+
+def test_bucketed_composes_with_row_tiling():
+    options = _options()
+    trees, X, y = _workload(options, 64, 30, 11)
+    ops, loss_fn = options.operators, options.elementwise_loss
+    ref = np.asarray(
+        eval_loss_trees(trees, X, y, None, ops, loss_fn, backend="jnp")
+    )
+    both = np.asarray(
+        eval_loss_trees(
+            trees, X, y, None, ops, loss_fn, backend="jnp",
+            bucket_ladder=LADDER, rows_per_tile=8,
+        )
+    )
+    fin = np.isfinite(ref)
+    assert np.array_equal(fin, np.isfinite(both))
+    np.testing.assert_allclose(ref[fin], both[fin], rtol=1e-5)
+
+
+def test_bucketed_under_island_vmap():
+    """The per-island vmapped scoring path (independent island batches)
+    batches the bucketed graph's while_loops; results must still match
+    the flat path lane for lane."""
+    options = _options()
+    I, B = 3, 32
+    trees, X, y = _workload(options, I * B, 24, 13)
+    itrees = jax.tree_util.tree_map(
+        lambda a: a.reshape((I, B) + a.shape[1:]), trees
+    )
+    ops, loss_fn = options.operators, options.elementwise_loss
+    flat = jax.vmap(
+        lambda t: eval_loss_trees(t, X, y, None, ops, loss_fn,
+                                  backend="jnp")
+    )(itrees)
+    buck = jax.vmap(
+        lambda t: eval_loss_trees(t, X, y, None, ops, loss_fn,
+                                  backend="jnp", bucket_ladder=LADDER)
+    )(itrees)
+    assert np.array_equal(np.asarray(flat), np.asarray(buck))
+
+
+def test_cached_scoring_bit_identical_with_ladder():
+    """Dedup + ladder share one length-major sort (cache/dedup.py): the
+    cached scorer's losses must equal the uncached flat scorer's even
+    with duplicates and memo-style fillers in the eval buffer."""
+    options_flat = _options()
+    options_b = _options(eval_bucket_ladder=LADDER)
+    trees, X, y = _workload(options_flat, 128, 32, 17)
+    dup = jax.tree_util.tree_map(lambda a: a.at[40:80].set(a[0:40]), trees)
+    bl = jnp.float32(float(jnp.var(y)))
+    s_f, l_f = score_trees(dup, X, y, None, bl, options_flat)
+    s_c, l_c, stats = score_trees_cached(dup, X, y, None, bl, options_b)
+    assert np.array_equal(np.asarray(l_f), np.asarray(l_c))
+    assert np.array_equal(np.asarray(s_f), np.asarray(s_c))
+    assert int(stats.unique) < int(stats.total)
+
+
+def test_pallas_work_gate_volume():
+    # calibration point: 512 trees at one full (8, 128) row tile
+    assert _pallas_work_gate(512, 1024)
+    assert _pallas_work_gate(64, 100_000)
+    # large-batch/tiny-rows: kernel would mostly pad the row tile
+    assert not _pallas_work_gate(8192, 8)
+    assert not _pallas_work_gate(511, 1024)
+
+
+def test_option_validation():
+    with pytest.raises(ValueError, match="ascending"):
+        _options(eval_bucket_ladder=(0.5, 0.25, 1.0))
+    with pytest.raises(ValueError, match="ascending"):
+        _options(eval_bucket_ladder=(0.5, 1.5))
+    with pytest.raises(ValueError, match="end at 1.0"):
+        _options(eval_bucket_ladder=(0.25, 0.5))
+    with pytest.raises(ValueError, match="eval_rows_per_tile"):
+        _options(eval_rows_per_tile=-1)
+    # list form normalizes to a (hashable) tuple
+    o = _options(eval_bucket_ladder=[0.5, 1.0])
+    assert o.eval_bucket_ladder == (0.5, 1.0)
+    hash(o)
+
+
+def test_graph_key_includes_eval_knobs():
+    a = _options()
+    b = _options(eval_bucket_ladder=LADDER)
+    c = _options(eval_rows_per_tile=64)
+    assert a != b and a != c and hash(a) != hash(b)
+
+
+def test_presorted_matches_sorted_path():
+    """presorted=True must be a pure performance hint: identical values
+    on any ordering (per-tree results are bucket-assignment-invariant)."""
+    options = _options()
+    trees, X, y = _workload(options, 96, 24, 19)
+    ops, loss_fn = options.operators, options.elementwise_loss
+    a = eval_loss_trees_bucketed(
+        trees, X, y, None, ops, loss_fn, LADDER, presorted=False
+    )
+    b = eval_loss_trees_bucketed(
+        trees, X, y, None, ops, loss_fn, LADDER, presorted=True
+    )
+    assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
+def test_search_hof_identical_bucketed_vs_flat():
+    """Full equation_search trajectories: the bucketed ladder must leave
+    the hall of fame bit-identical under the fused driver, the chunked
+    driver, and the cached scorer (their bit-identity guarantees
+    compose)."""
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((3, 96)).astype(np.float32)
+    y = 2.0 * np.cos(X[2]) + X[0] ** 2 - 0.5
+    kw = dict(
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        npopulations=3, npop=24, ncycles_per_iteration=16, maxsize=10,
+        seed=11, verbosity=0, progress=False, niterations=2,
+    )
+    front = lambda r: [
+        (c.complexity, float(c.loss), float(c.score), c.equation)
+        for c in r.frontier()
+    ]
+    ref = front(sr.equation_search(X, y, **kw))
+    assert ref
+    for extra in (
+        dict(eval_bucket_ladder=(0.5, 1.0)),
+        dict(eval_bucket_ladder=(0.5, 1.0), max_cycles_per_dispatch=5),
+        dict(eval_bucket_ladder=(0.5, 1.0), cache_fitness=True),
+    ):
+        sr.clear_memo_banks()
+        assert front(sr.equation_search(X, y, **kw, **extra)) == ref
