@@ -98,7 +98,7 @@ def _dispatch_overhead_s(jax, jnp, device):
 
 
 def _time_backend(jax, jnp, options, device, n_trees, n_inner, label,
-                  verbose):
+                  verbose, spans=None):
     """Score n_trees random trees against the Feynman-I.6.2a dataset on
     `device`; return (trees-rows/sec, compile seconds, tree lengths).
 
@@ -106,8 +106,16 @@ def _time_backend(jax, jnp, options, device, n_trees, n_inner, label,
     perturbed per iteration so no computation can be reused) and the fixed
     dispatch overhead — measured separately — is subtracted; a single
     dispatch through a tunneled TPU transport costs ~70 ms, which would
-    swamp the kernel."""
+    swamp the kernel.
+
+    spans: a telemetry.spans.SpanRecorder — the timed rep loop is
+    recorded as an `eval`-stage span whose attrs carry the workload
+    shape, the measured overhead, and the derived overhead-subtracted
+    trees_rows_per_s (the number roofline_fraction is computed from)."""
     from symbolicregression_jl_tpu.models.fitness import score_trees
+
+    if spans is None:
+        from symbolicregression_jl_tpu.telemetry.spans import NULL as spans
 
     n_feat = 1
     X_h, y_h = _feynman_data()
@@ -135,12 +143,20 @@ def _time_backend(jax, jnp, options, device, n_trees, n_inner, label,
         compile_s = time.perf_counter() - t_c
         assert np.isfinite(total)
 
-        times = []
-        for _ in range(REPS):
-            t0 = time.perf_counter()
-            float(fn())  # scalar fetch forces a full sync
-            times.append(time.perf_counter() - t0)
-        per_iter = max((float(np.median(times)) - overhead) / n_inner, 1e-9)
+        with spans.span(
+            "eval", trees=n_trees, rows=N_ROWS, inner_iters=n_inner,
+            reps=REPS, label=label,
+        ) as sp:
+            times = []
+            for _ in range(REPS):
+                t0 = time.perf_counter()
+                float(fn())  # scalar fetch forces a full sync
+                times.append(time.perf_counter() - t0)
+            per_iter = max(
+                (float(np.median(times)) - overhead) / n_inner, 1e-9
+            )
+            sp.attrs["dispatch_overhead_s"] = overhead
+            sp.attrs["trees_rows_per_s"] = n_trees * N_ROWS / per_iter
 
     lengths = np.asarray(jax.device_get(trees.length), dtype=np.float64)
     rate = n_trees * N_ROWS / per_iter
@@ -828,6 +844,26 @@ def _last_tpu_block():
     return out
 
 
+def _roofline_skip_reason(platform, pallas_routed, error=None):
+    """Why roofline_fraction is null, as a machine-checkable string
+    (distinct reasons, never a silent null): 'cpu-only' — a CPU run has
+    no VPU-issue roofline bound; 'interpreter-path' — the device run's
+    scoring stayed on the jnp interpreter (work-volume gate or
+    eval_backend), so the kernel roofline does not describe it;
+    'import-failure' — the roofline model itself could not be imported;
+    'error: <Type>' — the model imported but the computation failed.
+    Returns None exactly when the fraction should have a value."""
+    if platform == "cpu":
+        return "cpu-only"
+    if not pallas_routed:
+        return "interpreter-path"
+    if error is not None:
+        if isinstance(error, ImportError):
+            return "import-failure"
+        return f"error: {type(error).__name__}"
+    return None
+
+
 def main(verbose=True):
     devices = _devices_or_cpu_fallback(verbose)
 
@@ -847,6 +883,46 @@ def main(verbose=True):
     platform = main_dev.platform
     n_trees = N_POPULATIONS * NPOP
 
+    # Per-run telemetry event log (telemetry/ subsystem): the
+    # machine-readable record of this bench run — the tunnel-acquisition
+    # verdict and the eval-stage span roofline_fraction is computed
+    # from. Observability must never sink the benchmark: any failure
+    # here degrades to sink=None.
+    sink, spans = None, None
+    try:
+        import tempfile
+
+        from symbolicregression_jl_tpu.telemetry.events import (
+            open_event_log,
+        )
+        from symbolicregression_jl_tpu.telemetry.spans import SpanRecorder
+
+        tdir = os.environ.get(
+            "SRTPU_BENCH_TELEMETRY_DIR"
+        ) or tempfile.mkdtemp(prefix="srtpu_bench_telemetry_")
+        sink = open_event_log(tdir)
+        sink.emit(
+            "run_start",
+            config_fingerprint=(
+                f"bench-{N_POPULATIONS}x{NPOP}-rows{N_ROWS}"
+                f"-maxsize{MAXSIZE}"
+            ),
+            backend=platform,
+            devices=[str(d) for d in devices],
+            nout=1,
+            x_shape=[1, N_ROWS],
+        )
+        sink.emit(
+            "tunnel_state",
+            state=ACQUISITION["tunnel_state"],
+            attempts=ACQUISITION["attempts"],
+        )
+        spans = SpanRecorder(sink)
+    except Exception as e:  # pragma: no cover - defensive
+        sink, spans = None, None
+        if verbose:
+            print(f"# telemetry unavailable: {e}", file=sys.stderr)
+
     if platform != "cpu":
         # persistent compilation cache: TPU executables serialize safely
         # (the known segfault is CPU-only), so a repeat bench run loads its
@@ -864,7 +940,7 @@ def main(verbose=True):
 
     value, compile_s, workload_lengths = _time_backend(
         jax, jnp, options, main_dev, min(n_trees, CHUNK), 20,
-        f"main ({platform})", verbose,
+        f"main ({platform})", verbose, spans=spans,
     )
 
     parity = ""
@@ -960,9 +1036,29 @@ def main(verbose=True):
                       file=sys.stderr)
 
     # achieved fraction of the kernel's VPU-issue roofline (see
-    # benchmark/roofline.py for the model; CPU runs have no such bound)
+    # benchmark/roofline.py for the model; CPU runs have no such bound).
+    # Computed from the telemetry eval-stage span's measured throughput;
+    # when the fraction is null, roofline_skip_reason says WHY (distinct
+    # reasons — a null with no reason is a bug, not a benign skip).
     roofline_fraction = None
+    pallas_routed = False
     if platform != "cpu":
+        try:
+            from symbolicregression_jl_tpu.models.fitness import (
+                resolve_eval_backend_pallas,
+            )
+
+            # THE kernel routing decision the timed run's score_trees
+            # calls actually made (single source of truth in fitness.py:
+            # backend knob x kernel availability x dtype x work volume)
+            pallas_routed = resolve_eval_backend_pallas(
+                options.eval_backend, options.dtype,
+                min(n_trees, CHUNK), N_ROWS,
+            )
+        except Exception:  # pragma: no cover
+            pallas_routed = False
+    roofline_error = None
+    if platform != "cpu" and pallas_routed:
         try:
             sys.path.insert(
                 0,
@@ -997,10 +1093,27 @@ def main(verbose=True):
                 np.repeat(executed, tu)[: len(workload_lengths)].mean()
             )
             rl = kernel_roofline(options.operators, avg)
-            roofline_fraction = round(value / rl["bound"], 4)
+            # the telemetry eval-stage span carries the measured
+            # throughput (identical to `value`: _time_backend records
+            # the overhead-subtracted rate as a span attribute)
+            span_rate = value
+            if spans is not None:
+                ev_span = next(
+                    (s for s in spans.spans if s.name == "eval"), None
+                )
+                if ev_span is not None:
+                    span_rate = ev_span.attrs.get(
+                        "trees_rows_per_s", value
+                    )
+            roofline_fraction = round(span_rate / rl["bound"], 4)
         except Exception as e:  # pragma: no cover
+            roofline_error = e
             if verbose:
                 print(f"# roofline unavailable: {e}", file=sys.stderr)
+    roofline_skip_reason = (
+        None if roofline_fraction is not None
+        else _roofline_skip_reason(platform, pallas_routed, roofline_error)
+    )
     out = {
         "metric": (
             "population fitness-eval throughput, Feynman-I.6.2a "
@@ -1033,9 +1146,13 @@ def main(verbose=True):
         ),
         "first_call_s": round(compile_s, 1),
         "roofline_fraction": roofline_fraction,
+        "roofline_skip_reason": roofline_skip_reason,
+        "telemetry_event_log": sink.path if sink is not None else None,
     }
     if platform == "cpu":
         out["last_tpu"] = _last_tpu_block()
+    if sink is not None:
+        sink.close()
     print(json.dumps(out))
 
 

@@ -244,6 +244,67 @@ def bench_bucketed_eval():
     ]
 
 
+def bench_telemetry():
+    """Unified search telemetry (ISSUE 7): a short search with
+    Options.telemetry writes a JSONL event log. Asserts the log parses
+    as strict JSON, validates against the checked-in schema
+    (telemetry/event_schema_v1.json), and contains all seven stage spans
+    — and reports the per-stage wall time columns, the per-iteration
+    observability the fused engine never had."""
+    import tempfile
+
+    import symbolicregression_jl_tpu as sr
+    from symbolicregression_jl_tpu.telemetry import (
+        STAGES,
+        validate_events_file,
+    )
+
+    d = tempfile.mkdtemp(prefix="srtpu_suite_telemetry_")
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((3, 128)).astype(np.float32)
+    y = 2.0 * np.cos(X[2]) + X[0] ** 2 - 0.5
+    t0 = time.perf_counter()
+    r = sr.equation_search(
+        X, y,
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        npopulations=4, npop=24, ncycles_per_iteration=30, maxsize=12,
+        niterations=2, seed=0, verbosity=0, progress=False,
+        telemetry=True, telemetry_dir=d,
+    )
+    wall_s = time.perf_counter() - t0
+    paths = sorted(
+        os.path.join(d, f) for f in os.listdir(d) if f.endswith(".jsonl")
+    )
+    report = validate_events_file(paths[0])
+    stage_s = {s: 0.0 for s in STAGES}
+    n_metrics = 0
+    with open(paths[0]) as f:
+        for line in f:
+            e = json.loads(line)
+            if e["type"] == "span" and e["name"] in stage_s:
+                stage_s[e["name"]] += e["duration_s"]
+            elif e["type"] == "metrics":
+                n_metrics += 1
+    row = {
+        "suite": "telemetry",
+        "case": "stage_times",
+        "schema_ok": report["ok"],
+        "events": report["events"],
+        "spans_complete": all(stage_s[s] > 0.0 for s in STAGES),
+        "metrics_events": n_metrics,
+        "search_wall_s": wall_s,
+        "hof_size": len(r.frontier()),
+        "event_log": paths[0],
+    }
+    # one stage-time column per stage, the per-stage attribution rows
+    # downstream dashboards join on (mutate/eval are one-shot probe
+    # dispatches, the in-loop phases are summed over iterations)
+    row.update({f"stage_{s}_s": round(stage_s[s], 4) for s in STAGES})
+    if report["problems"]:
+        row["schema_problems"] = report["problems"][:3]
+    return [row]
+
+
 def bench_search_iteration():
     """Full-search throughput: one jitted evolution iteration (s_r_cycle +
     simplify + constant-opt + HoF merge + migration) over all islands —
@@ -587,6 +648,7 @@ def bench_static_analysis():
     surface = payload.get("surface") or {}
     memory = payload.get("memory") or {}
     docs = payload.get("docs") or {}
+    tele = payload.get("telemetry_schema") or {}
     mem_configs = memory.get("configs", {})
     return [
         {
@@ -626,6 +688,12 @@ def bench_static_analysis():
         },
         {
             "suite": "static_analysis",
+            "case": "telemetry_schema",
+            "ok": tele.get("ok", False),
+            "events": tele.get("events", 0),
+        },
+        {
+            "suite": "static_analysis",
             "case": "summary",
             "ok": payload.get("ok", False),
             "rc": proc.returncode,
@@ -643,6 +711,7 @@ _CASES = [
     (bench_single_eval_48_nodes, 600),
     (bench_population_scoring, 600),
     (bench_bucketed_eval, 900),
+    (bench_telemetry, 900),
     (bench_search_iteration, 1200),
     (bench_fitness_cache, 1200),
     (bench_precision_ratio, 1200),

@@ -29,6 +29,44 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+def check_telemetry_schema() -> dict:
+    """telemetry-schema gate: the golden event-log fixture must validate
+    against the checked-in JSON schema
+    (symbolicregression_jl_tpu/telemetry/event_schema_v1.json) and carry
+    all seven stage spans — so the event writer, the schema, and the
+    stage vocabulary cannot drift apart without CI noticing. The fixture
+    is a real (truncated) run captured by tests/test_ab_telemetry.py's
+    generator; refresh it by re-running a telemetry search and copying
+    the log (docs/observability.md 'Golden fixture')."""
+    import json as _json
+
+    from symbolicregression_jl_tpu.telemetry import (
+        STAGES,
+        validate_events_file,
+    )
+
+    golden = os.path.join(
+        REPO, "tests", "data", "telemetry", "golden_events.jsonl"
+    )
+    report = validate_events_file(golden)
+    problems = list(report["problems"])
+    if report["ok"]:
+        seen = set()
+        with open(golden) as f:
+            for line in f:
+                e = _json.loads(line)
+                if e.get("type") == "span":
+                    seen.add(e.get("name"))
+        missing = [s for s in STAGES if s not in seen]
+        if missing:
+            problems.append(f"golden fixture missing stage spans {missing}")
+    return {
+        "ok": not problems,
+        "events": report["events"],
+        "detail": problems[0] if problems else "",
+    }
+
+
 def check_docs() -> dict:
     """gen_api_reference.py --check in a subprocess (it imports the whole
     package and renders docstrings; isolation keeps this process's jax
@@ -65,6 +103,10 @@ def main(argv=None) -> int:
         "--skip-docs", action="store_true",
         help="skip the docs/api_reference.md drift check",
     )
+    ap.add_argument(
+        "--skip-telemetry-schema", action="store_true",
+        help="skip the telemetry golden-fixture schema check",
+    )
     ns = ap.parse_args(argv)
 
     pin_platform()
@@ -77,11 +119,20 @@ def main(argv=None) -> int:
         xla_memory=ns.xla_memory,
     )
     docs = None if ns.skip_docs else check_docs()
-    ok = report.ok and (docs is None or docs["api_reference_current"])
+    telemetry = (
+        None if (ns.skip_telemetry_schema or ns.only is not None)
+        else check_telemetry_schema()
+    )
+    ok = (
+        report.ok
+        and (docs is None or docs["api_reference_current"])
+        and (telemetry is None or telemetry["ok"])
+    )
 
     if ns.format == "json":
         payload = report.to_dict()
         payload["docs"] = docs
+        payload["telemetry_schema"] = telemetry
         payload["ok"] = ok
         print(json.dumps(payload, indent=2, sort_keys=True))
     else:
@@ -92,6 +143,12 @@ def main(argv=None) -> int:
                 else f"OUT OF DATE ({docs['detail']})"
             )
             print(f"docs/api_reference.md: {state}")
+        if telemetry is not None:
+            state = (
+                f"valid ({telemetry['events']} events)" if telemetry["ok"]
+                else f"INVALID ({telemetry['detail']})"
+            )
+            print(f"telemetry golden fixture: {state}")
     return 0 if ok else 1
 
 

@@ -79,6 +79,15 @@ from .models.population import (
 # Evaluation memo bank (opt-in via Options.cache_fitness).
 from .cache import FitnessMemoBank, clear_memo_banks, tree_hash_host
 
+# Unified search telemetry (opt-in via Options.telemetry).
+from .telemetry import (
+    EventLog,
+    MetricsRegistry,
+    SpanRecorder,
+    open_event_log,
+    validate_events_file,
+)
+
 __version__ = "0.1.0"
 
 # Populated lazily to avoid importing heavy modules at package import:
@@ -148,4 +157,9 @@ __all__ = [
     "FitnessMemoBank",
     "clear_memo_banks",
     "tree_hash_host",
+    "EventLog",
+    "MetricsRegistry",
+    "SpanRecorder",
+    "open_event_log",
+    "validate_events_file",
 ]

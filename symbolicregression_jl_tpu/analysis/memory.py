@@ -257,7 +257,7 @@ def build_stage_programs(
         o = options.bind_scalars(scalars)
         return score_trees(children, X, y, None, bl, o)
 
-    return {
+    stages = {
         "init": (init_stage, (keys, X, y, bl, scalars)),
         "cycle": (cycle, (states, cm, X, y, bl, scalars)),
         "mutate": (mutate, (states, cm, scalars)),
@@ -266,6 +266,13 @@ def build_stage_programs(
         "optimize": (optimize, (keys, states, X, y, bl, scalars)),
         "merge_migrate": (merge_migrate, (key, states, scalars)),
     }
+    # one stage vocabulary across the repo: srmem attribution, telemetry
+    # spans, and XLA-profile annotations all join on these names — a
+    # rename here without telemetry.spans.STAGES breaks that join
+    from ..telemetry.spans import STAGES
+
+    assert tuple(stages) == STAGES, (tuple(stages), STAGES)
+    return stages
 
 
 def xla_stage_analysis(fn, args) -> dict:

@@ -424,17 +424,33 @@ def _make_phase_fns_cached(options, has_weights, donate):
 
 
 def _make_iteration_driver(options: Options, has_weights: bool,
-                           donate: bool = False):
+                           donate: bool = False, spans=None):
     """The production iteration entry: returns a callable with the same
     signature/outputs as _make_iteration_fn's. With
     options.max_cycles_per_dispatch=None (default) that IS the fused
     single-jit iteration; with an int k it is a host-level driver issuing
     phased dispatches of at most k cycles each (see _make_phase_fns).
     donate=True donates the IslandState carry in either form (see
-    _make_iteration_fn doc for the caller contract)."""
+    _make_iteration_fn doc for the caller contract).
+
+    spans: a telemetry.spans.SpanRecorder (or None). When set, the
+    driver always takes the PHASED path — with max_cycles_per_dispatch
+    unset the whole cycle scan runs as ONE chunk, which receives the
+    full fused-form temperature schedule and derives the identical
+    minibatch key chain, so the math is the fused iteration's exactly
+    (the chunked-vs-fused bit-identity tests pin this) — and each phase
+    dispatch is wrapped in a fenced span (cycle / simplify / optimize /
+    merge_migrate; the explicit block_until_ready per phase is what
+    attributes device time to the right stage, at the cost of
+    serializing the phase dispatches)."""
     k = options.max_cycles_per_dispatch
-    if k is None:
+    if k is None and spans is None:
         return _make_iteration_fn(options, has_weights, donate)
+    if spans is None:
+        # chunked dispatch without telemetry: no-op instrumentation
+        # (no fences, no timing — the pre-telemetry chunked driver)
+        from .telemetry.spans import NULL as spans
+    k = k or options.ncycles_per_iteration
     fns = _make_phase_fns(options, has_weights, donate)
     ncycles = options.ncycles_per_iteration
     # One iteration-wide schedule, built EXACTLY as s_r_cycle_islands
@@ -461,20 +477,25 @@ def _make_iteration_driver(options: Options, has_weights: bool,
 
         k_mig, k_opt, k_opt_mut = jax.random.split(key, 3)
         events_chunks = []
-        for chunk, is_last in _chunks:
-            out = fns["cycle"](
+        with spans.span("cycle", chunks=len(_chunks),
+                        ncycles=ncycles) as sp:
+            for chunk, is_last in _chunks:
+                out = fns["cycle"](
+                    states, curmaxsize, X, y, weights, baseline, scalars,
+                    chunk, is_last=is_last,
+                )
+                if options.recorder:
+                    states, ev = out
+                    events_chunks.append(ev)
+                else:
+                    states = out
+            sp.fence = states
+        with spans.span("simplify") as sp:
+            states = fns["simplify"](
                 states, curmaxsize, X, y, weights, baseline, scalars,
-                chunk, is_last=is_last,
+                memo=memo,
             )
-            if options.recorder:
-                states, ev = out
-                events_chunks.append(ev)
-            else:
-                states = out
-        states = fns["simplify"](
-            states, curmaxsize, X, y, weights, baseline, scalars,
-            memo=memo,
-        )
+            sp.fence = states
         # post-simplify, pre-optimize: scoring-path values only (same
         # capture point as the fused one_iteration's absorb snapshot)
         absorb_snap = (
@@ -489,17 +510,26 @@ def _make_iteration_driver(options: Options, has_weights: bool,
                 lambda a: jnp.array(a, copy=True), absorb_snap
             )
         I = states.birth_counter.shape[0]
-        if options.should_optimize_constants and options.optimizer_probability > 0:
-            states = fns["optimize"](
-                jax.random.split(k_opt, I), states, X, y, weights,
-                baseline, scalars,
-            )
-        if expected_optimize_count(options) > 0:
-            states = fns["optimize_mut"](
-                jax.random.split(k_opt_mut, I), states, X, y, weights,
-                baseline, scalars,
-            )
-        states, ghof = fns["merge_migrate"](k_mig, states, scalars)
+        with spans.span("optimize") as sp:
+            passes = 0
+            if (options.should_optimize_constants
+                    and options.optimizer_probability > 0):
+                states = fns["optimize"](
+                    jax.random.split(k_opt, I), states, X, y, weights,
+                    baseline, scalars,
+                )
+                passes += 1
+            if expected_optimize_count(options) > 0:
+                states = fns["optimize_mut"](
+                    jax.random.split(k_opt_mut, I), states, X, y,
+                    weights, baseline, scalars,
+                )
+                passes += 1
+            sp.fence = states
+            sp.attrs["passes"] = passes
+        with spans.span("merge_migrate") as sp:
+            states, ghof = fns["merge_migrate"](k_mig, states, scalars)
+            sp.fence = (states, ghof)
         outs = (states, ghof)
         if options.recorder:
             events = jax.tree_util.tree_map(
@@ -748,8 +778,54 @@ def equation_search(
     # production jits donate the carry (steady-state HBM drops by one
     # IslandState copy per output on donation-capable backends)
     donate = _donation_enabled()
+
+    # ---- unified telemetry (options.telemetry; docs/observability.md):
+    # JSONL event log + per-stage spans + metrics registry, entirely
+    # host-side orchestration. Single-controller only, like the recorder
+    # and the quit watcher: the phased span driver and the probe/metrics
+    # dispatches change the program sequence host 0 issues, and on
+    # multi-host SPMD every host must issue the identical sequence or
+    # the collective-issuing loops desync. ----
+    telemetry_on = (
+        options.telemetry
+        and is_primary_host()
+        and jax.process_count() == 1
+    )
+    sink = None
+    spans_rec = None
+    search_metrics = None
+    if telemetry_on:
+        import hashlib
+
+        from . import __version__ as _pkg_version
+        from .telemetry.events import open_event_log
+        from .telemetry.metrics import SearchMetrics
+        from .telemetry.spans import SpanRecorder
+        from .utils.recorder import repr_options
+
+        fingerprint = hashlib.sha256(
+            (
+                repr_options(options)
+                + f"|X{X.shape}|y{ys.shape}|niter{niterations}"
+            ).encode()
+        ).hexdigest()[:16]
+        sink = open_event_log(options.telemetry_dir)
+        sink.emit(
+            "run_start",
+            config_fingerprint=fingerprint,
+            backend=jax.default_backend(),
+            devices=[str(d) for d in jax.devices()],
+            niterations=niterations,
+            nout=int(ys.shape[0]),
+            x_shape=[int(s) for s in X.shape],
+            package_version=_pkg_version,
+            options=repr_options(options),
+        )
+        spans_rec = SpanRecorder(sink)
+        search_metrics = SearchMetrics(options, sink)
+
     iteration_fn = _make_iteration_driver(
-        options, weights is not None, donate
+        options, weights is not None, donate, spans=spans_rec
     )
     # this Options' trace-irrelevant scalar knobs, passed to every jitted
     # call (the factories' lru_caches dedup Options differing only in
@@ -765,15 +841,18 @@ def equation_search(
     record_here = (
         options.recorder and is_primary_host() and jax.process_count() == 1
     )
-    recorder = Recorder(options, variable_names) if record_here else None
+    recorder = (
+        Recorder(options, variable_names, sink=sink) if record_here
+        else None
+    )
     total_its = niterations * max(ys.shape[0], 1)
-    progress = SearchProgress(total_its, options)
+    progress = SearchProgress(total_its, options, sink=sink)
     bar = (
         ProgressBar(total_its, width=options.terminal_width)
         if options.terminal_width
         else ProgressBar(total_its)
     )
-    monitor = ResourceMonitor()
+    monitor = ResourceMonitor(sink=sink, verbosity=options.verbosity)
     # 'q'-to-quit is single-controller only: on multi-host SPMD a break taken
     # on host 0 alone would desync the collective-issuing host loops.
     quit_watcher = QuitWatcher(
@@ -792,6 +871,7 @@ def equation_search(
     live_hofs = []         # current merged hall of fame per output
     out_keys = []          # per-output PRNG stream
     start_iters = []
+    bl_host = []           # host-side baseline loss per output (metrics)
 
     # ---- evaluation memo bank (options.cache_fitness) ----
     use_cache = options.cache_fitness
@@ -835,12 +915,19 @@ def equation_search(
         master_key = jax.random.PRNGKey(options.seed + 7919 * j)
         bl = jnp.asarray(ds.baseline_loss, options.dtype)
 
-        def _fresh_init(key):
+        def _fresh_init(key, _j=j):
             k_init, key = jax.random.split(key)
             init_keys = jax.random.split(k_init, I)
             init_fn = _make_init_fn(options, nfeatures, wj is not None,
                                     donate)
-            if wj is not None:
+            if spans_rec is not None:
+                with spans_rec.span("init", output=_j) as sp:
+                    if wj is not None:
+                        sts = init_fn(init_keys, Xj, yj, wj, bl, scalars)
+                    else:
+                        sts = init_fn(init_keys, Xj, yj, bl, scalars)
+                    sp.fence = sts
+            elif wj is not None:
                 sts = init_fn(init_keys, Xj, yj, wj, bl, scalars)
             else:
                 sts = init_fn(init_keys, Xj, yj, bl, scalars)
@@ -904,6 +991,7 @@ def equation_search(
         live_hofs.append(ghof)
         out_keys.append(master_key)
         start_iters.append(start_iter)
+        bl_host.append(float(ds.baseline_loss))
 
     # ---- joint iteration loop: one iteration per output per round
     # (the reference's kappa round-robin over (out, pop) pairs,
@@ -929,8 +1017,21 @@ def equation_search(
             states = live_states[j]
             its[j] = start_iters[j] + step
             it = its[j]
-            cm = jnp.int32(_curmaxsize(options, it, max(niterations, 1)))
+            cm_host = _curmaxsize(options, it, max(niterations, 1))
+            cm = jnp.int32(cm_host)
             out_keys[j], k_it = jax.random.split(out_keys[j])
+            if spans_rec is not None:
+                spans_rec.set_context(output=j, iteration=it)
+                if step == 0 and j == 0:
+                    # one-shot measured spans for the two in-scan stages
+                    # (mutate / eval): their own jitted programs, run
+                    # once — see telemetry.spans.probe_mutate_eval
+                    from .telemetry.spans import probe_mutate_eval
+
+                    probe_mutate_eval(
+                        spans_rec, options, states, Xj, yj, wj, bl,
+                        scalars,
+                    )
             t_dev = time.time()
             if use_cache:
                 # refreshed device snapshot of the memo bank (traced
@@ -948,24 +1049,44 @@ def equation_search(
                 memo_args = (memo,)
             else:
                 memo_args = ()
-            if wj is not None:
-                out = iteration_fn(
-                    states, k_it, cm, Xj, yj, wj, bl, scalars, *memo_args
-                )
-            else:
-                out = iteration_fn(
-                    states, k_it, cm, Xj, yj, bl, scalars, *memo_args
-                )
-            if use_cache:
-                absorb_snap = out[-1]
-                out = out[:-1]
-            else:
-                absorb_snap = None
-            if options.recorder:
-                states, ghof, events = out
-            else:
-                (states, ghof), events = out, None
-            jax.block_until_ready(ghof.losses)
+            try:
+                if wj is not None:
+                    out = iteration_fn(
+                        states, k_it, cm, Xj, yj, wj, bl, scalars,
+                        *memo_args
+                    )
+                else:
+                    out = iteration_fn(
+                        states, k_it, cm, Xj, yj, bl, scalars, *memo_args
+                    )
+                if use_cache:
+                    absorb_snap = out[-1]
+                    out = out[:-1]
+                else:
+                    absorb_snap = None
+                if options.recorder:
+                    states, ghof, events = out
+                else:
+                    (states, ghof), events = out, None
+                jax.block_until_ready(ghof.losses)
+            except Exception as e:
+                # the machine-readable fault trail the resume-not-restart
+                # story needs (ROADMAP item 4): a mid-run UNAVAILABLE /
+                # tunnel fault is recorded with its iteration before the
+                # exception propagates (line-buffered log: the event is
+                # on disk even if the process dies with us)
+                if sink is not None:
+                    sink.emit(
+                        "dispatch_fault",
+                        where="iteration",
+                        error_type=type(e).__name__,
+                        error=str(e)[:500],
+                        output=j,
+                        iteration=it,
+                        fatal=True,
+                    )
+                    sink.close()
+                raise
             t_host = time.time()
             live_states[j] = states
             live_hofs[j] = ghof
@@ -1017,6 +1138,24 @@ def equation_search(
                 cache_iter_rows.append(cache_row)
             progress.note_iteration(I)
             global_it += 1
+            if (
+                search_metrics is not None
+                and (it - start_iters[j]) % options.telemetry_every == 0
+            ):
+                # one fused device reduction + host-held values -> one
+                # `metrics` event (telemetry.metrics.SearchMetrics)
+                ncyc = options.ncycles_per_iteration
+                search_metrics.observe_iteration(
+                    states, ghof, output=j, iteration=it,
+                    baseline=bl_host[j],
+                    temperature=(
+                        0.5 if options.annealing and ncyc > 1 else 1.0
+                    ),
+                    curmaxsize=cm_host,
+                    cache_row=cache_row,
+                    cycles_per_second=progress.cycles_per_second,
+                    device_s=t_host - t_dev,
+                )
             cands = hof_to_candidates(ghof, options, variable_names)
             latest_cands[j] = cands
             if recorder is not None:
@@ -1041,22 +1180,30 @@ def equation_search(
                 if multi:
                     path = _multi_output_path(path, j)
                 save_hof_csv(cands, path)
-            if options.verbosity > 0 and is_primary_host():
+                if sink is not None:
+                    sink.emit(
+                        "checkpoint", path=path, output=j, iteration=it
+                    )
+            want_console = options.verbosity > 0 and is_primary_host()
+            if want_console or sink is not None:
                 best_loss = min((c.loss for c in cands), default=float("inf"))
                 evals = float(jnp.sum(states.num_evals))
                 prefix = f"[output {j}] " if multi else ""
-                print(
-                    prefix
-                    + progress.status_line(
-                        global_it - 1, best_loss, evals,
-                        # this search's own work: exclude a resumed
-                        # saved_state's carried counters, matching
-                        # result.cache_stats["totals"]
-                        cache_counts=tuple(cache_prev[j] - cache_base[j])
-                        if use_cache else None,
-                    )
+                # one status, every channel: `progress` event on the
+                # sink (even at verbosity 0 — quiet consoles must not
+                # silence the machine-readable trail), console line only
+                # when verbose
+                progress.report(
+                    global_it - 1, best_loss, evals,
+                    # this search's own work: exclude a resumed
+                    # saved_state's carried counters, matching
+                    # result.cache_stats["totals"]
+                    cache_counts=tuple(cache_prev[j] - cache_base[j])
+                    if use_cache else None,
+                    prefix=prefix, console=want_console,
+                    output=j, search_iteration=it,
                 )
-                if options.progress:
+                if want_console and options.progress:
                     bar.update(global_it, pareto_table(cands))
             if on_iteration is not None:
                 on_iteration(j, it, cands)
@@ -1107,8 +1254,9 @@ def equation_search(
             )
         )
 
+    search_time_s = time.time() - t_start
     if recorder is not None:
-        recorder.record_final(total_evals, time.time() - t_start)
+        recorder.record_final(total_evals, search_time_s)
         recorder.save()
 
     cache_stats = None
@@ -1137,12 +1285,41 @@ def equation_search(
             "banks": [b.stats if b is not None else None for b in banks],
         }
 
+    if sink is not None:
+        if return_state:
+            # in-memory serialization point (the caller may persist it
+            # with utils.checkpoint.save_search_state, which emits its
+            # own on-disk saved_state event)
+            sink.emit(
+                "saved_state", outputs=nout, path=None, in_memory=True,
+                iteration=max((s.iteration for s in out_states),
+                              default=0),
+            )
+        sink.emit(
+            "run_end",
+            num_evals=total_evals,
+            search_time_s=search_time_s,
+            hof=[
+                [
+                    {
+                        "complexity": c.complexity,
+                        "loss": c.loss,
+                        "score": c.score,
+                        "equation": c.equation,
+                    }
+                    for c in cands
+                ]
+                for cands in results
+            ],
+        )
+        sink.close()
+
     return EquationSearchResult(
         candidates=results,
         options=options,
         variable_names=variable_names,
         state=out_states if return_state else None,
         num_evals=total_evals,
-        search_time_s=time.time() - t_start,
+        search_time_s=search_time_s,
         cache_stats=cache_stats,
     )

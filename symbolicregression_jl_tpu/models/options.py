@@ -215,6 +215,23 @@ class Options:
     define_helper_functions: bool = True
     recorder: bool = False
     recorder_file: str = "pysr_recorder.json"
+    # --- unified search telemetry (telemetry/ subsystem) ---
+    # Opt-in per-stage span timers + metrics registry + JSONL event log
+    # (docs/observability.md). Host-side orchestration only: no primitive
+    # is added to any jitted search program and the hall of fame is
+    # bit-identical with telemetry on or off. With telemetry enabled the
+    # iteration dispatches through the phased driver (one phase program
+    # per stage instead of one fused program) so each stage can be
+    # fenced and timed — numerically identical, slightly more compile
+    # and dispatch overhead. Orchestration-only knobs: absent from
+    # _graph_key.
+    telemetry: bool = False
+    # Directory for the per-run events-<run>.jsonl file (created if
+    # needed); None = current working directory.
+    telemetry_dir: Optional[str] = None
+    # Emit a metrics snapshot every k-th iteration (spans and lifecycle
+    # events are always emitted); 1 = every iteration.
+    telemetry_every: int = 1
     # --- evaluation memo bank (cache/ subsystem) ---
     # Opt-in fitness caching, two tiers: intra-batch dedup of every fused
     # eval batch (duplicate programs evaluated once, losses scattered
@@ -399,6 +416,8 @@ class Options:
             raise ValueError("tournament_selection_n must be <= npop")
         if self.cache_capacity < 1:
             raise ValueError("cache_capacity must be >= 1")
+        if self.telemetry_every < 1:
+            raise ValueError("telemetry_every must be >= 1")
         if self.cache_device_slots < 0:
             raise ValueError("cache_device_slots must be >= 0")
         # build and cache derived structures

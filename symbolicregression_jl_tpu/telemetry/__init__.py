@@ -1,0 +1,37 @@
+"""Unified search telemetry: per-stage spans, a typed metrics registry,
+and an append-only JSONL event log.
+
+Opt-in via ``Options.telemetry`` (+ ``telemetry_dir`` /
+``telemetry_every``) and threaded through both search drivers in
+``api.py``. Everything here is host-side orchestration — no primitive is
+added to any jitted search program, the compile-surface baseline stays
+byte-identical, and a telemetry-on search returns a bit-identical
+hall of fame (asserted in tests). See docs/observability.md for the span
+model, the metric catalog, and the JSONL schema.
+"""
+
+from .events import (
+    SCHEMA_VERSION,
+    EventLog,
+    open_event_log,
+    validate_event,
+    validate_events_file,
+)
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, SearchMetrics
+from .spans import STAGES, Span, SpanRecorder
+
+__all__ = [
+    "STAGES",
+    "SCHEMA_VERSION",
+    "Counter",
+    "EventLog",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "SearchMetrics",
+    "Span",
+    "SpanRecorder",
+    "open_event_log",
+    "validate_event",
+    "validate_events_file",
+]

@@ -1,0 +1,283 @@
+"""Append-only JSONL event log — the machine-readable trail of one run.
+
+One file per run, one strict-JSON object per line, schema-versioned
+(``event_schema_v1.json`` next to this module is the checked-in
+contract; ``scripts/lint.py`` validates a golden fixture against it so
+the writer and the schema cannot drift apart silently).
+
+Design constraints, in order:
+
+* **crash-safe**: the file is opened line-buffered and every event is
+  one ``write()`` of one ``\\n``-terminated line — a SIGKILL mid-run
+  loses at most the event being written, never the file (this is the
+  trail the resume-not-restart story of ROADMAP item 4 needs after a
+  mid-run UNAVAILABLE fault or a tunnel drop);
+* **strict JSON**: ``json.dumps(allow_nan=False)`` after a sanitizer
+  that converts numpy scalars/arrays to Python and non-finite floats to
+  null — every line parses with any JSON reader, unlike the recorder's
+  bare-``Infinity`` output;
+* **never fatal**: a failed write disables the log with one stderr
+  warning; observability must not kill the search it observes.
+
+Event vocabulary (see the schema file / docs/observability.md):
+``run_start``, ``span``, ``metrics``, ``progress``, ``dispatch_fault``,
+``tunnel_state``, ``saved_state``, ``checkpoint``, ``resource_warning``,
+``recorder_saved``, ``probe_error``, ``run_end``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+SCHEMA_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "event_schema_v1.json"
+)
+
+
+def _sanitize(obj):
+    """Recursively convert to strict-JSON-serializable Python values:
+    numpy scalars/arrays -> Python, tuples -> lists, non-finite floats ->
+    None, dict keys -> str."""
+    import numpy as np
+
+    if obj is None or isinstance(obj, (bool, str)):
+        return obj
+    if isinstance(obj, (int, np.integer)):
+        return int(obj)
+    if isinstance(obj, (float, np.floating)):
+        f = float(obj)
+        return f if math.isfinite(f) else None
+    if isinstance(obj, np.ndarray):
+        if obj.dtype == object:
+            # tolist() of an object array hands the wrapped Python
+            # objects straight back — stringify instead of recursing
+            return [str(v) for v in obj.ravel().tolist()]
+        return _sanitize(obj.tolist())
+    if isinstance(obj, dict):
+        return {str(k): _sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize(v) for v in obj]
+    # jax arrays and anything else NUMERIC-array-like; arbitrary objects
+    # (np.asarray wraps them as 0-d object arrays, whose tolist() would
+    # return the object itself and recurse forever) fall through to str
+    try:
+        arr = np.asarray(obj)
+        if arr.dtype != object:
+            return _sanitize(arr.tolist())
+    except Exception:
+        pass
+    return str(obj)
+
+
+class EventLog:
+    """Writer for one run's event log. Also the event *sink* the rest of
+    the telemetry subsystem (spans, metrics, progress, recorder) emits
+    through — ``emit(type, **fields)`` is the whole interface."""
+
+    def __init__(self, path: str, run_id: Optional[str] = None):
+        self.path = path
+        self.run_id = run_id or _default_run_id()
+        # line-buffered text: one flush per event line (crash-safe)
+        self._f = open(path, "w", buffering=1)
+        self._dead = False
+
+    def emit(self, type: str, **fields) -> Optional[dict]:
+        """Append one event; returns the emitted dict (None if the log
+        is disabled after a write failure)."""
+        if self._dead:
+            return None
+        event = {
+            "v": SCHEMA_VERSION,
+            "t": time.time(),
+            "run": self.run_id,
+            "type": type,
+        }
+        try:
+            # sanitize INSIDE the guard: a hostile field value must
+            # disable the log, never raise into the observed search
+            event.update(_sanitize(fields))
+            self._f.write(json.dumps(event, allow_nan=False) + "\n")
+        except (OSError, ValueError, RecursionError, TypeError) as e:
+            self._dead = True
+            print(
+                f"telemetry: event log disabled ({type}: {e})",
+                file=sys.stderr,
+            )
+            return None
+        return event
+
+    def close(self) -> None:
+        if not self._dead:
+            try:
+                self._f.close()
+            except OSError:  # pragma: no cover
+                pass
+        self._dead = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+_RUN_SEQ = iter(range(1, 1 << 31))
+
+
+def _default_run_id() -> str:
+    # second-resolution timestamp + pid + an in-process sequence number:
+    # two sub-second runs in one process (a parameter sweep) must never
+    # collide on the log path and truncate each other's trail
+    return (
+        time.strftime("%Y%m%dT%H%M%S")
+        + f"-{os.getpid():x}-{next(_RUN_SEQ)}"
+    )
+
+
+def open_event_log(
+    telemetry_dir: Optional[str], run_id: Optional[str] = None
+) -> EventLog:
+    """Create ``<telemetry_dir>/events-<run_id>.jsonl`` (directory
+    created if needed; default directory: cwd)."""
+    d = telemetry_dir or "."
+    os.makedirs(d, exist_ok=True)
+    rid = run_id or _default_run_id()
+    return EventLog(os.path.join(d, f"events-{rid}.jsonl"), run_id=rid)
+
+
+# ---------------------------------------------------------------------------
+# schema validation
+# ---------------------------------------------------------------------------
+
+_JSON_TYPES = {
+    "object": dict,
+    "array": list,
+    "string": str,
+    "boolean": bool,
+    "null": type(None),
+}
+
+
+def load_schema() -> dict:
+    with open(SCHEMA_PATH) as f:
+        return json.load(f)
+
+
+def _type_ok(value, tname: str) -> bool:
+    if tname == "number":
+        return isinstance(value, (int, float)) and not isinstance(
+            value, bool
+        )
+    if tname == "integer":
+        return isinstance(value, int) and not isinstance(value, bool)
+    return isinstance(value, _JSON_TYPES[tname])
+
+
+def _check_subschema(value, sub: dict, path: str, problems: List[str]):
+    """Minimal JSON-Schema interpreter covering the keywords the
+    checked-in schema uses: type (name or list), const, enum, required,
+    properties, items. Unknown keywords are ignored (forward-compatible
+    with validating the same file under a full validator)."""
+    if "const" in sub and value != sub["const"]:
+        problems.append(f"{path}: expected {sub['const']!r}, got {value!r}")
+        return
+    if "enum" in sub and value not in sub["enum"]:
+        problems.append(f"{path}: {value!r} not one of {sub['enum']}")
+        return
+    t = sub.get("type")
+    if t is not None:
+        names = t if isinstance(t, list) else [t]
+        if not any(_type_ok(value, n) for n in names):
+            problems.append(
+                f"{path}: expected {'|'.join(names)}, got "
+                f"{type(value).__name__}"
+            )
+            return
+    if isinstance(value, dict):
+        for req in sub.get("required", ()):
+            if req not in value:
+                problems.append(f"{path}: missing required field {req!r}")
+        for k, psub in sub.get("properties", {}).items():
+            if k in value:
+                _check_subschema(value[k], psub, f"{path}.{k}", problems)
+    if isinstance(value, list) and "items" in sub:
+        for i, item in enumerate(value):
+            _check_subschema(item, sub["items"], f"{path}[{i}]", problems)
+
+
+def validate_event(event: dict, schema: Optional[dict] = None) -> List[str]:
+    """Problems (empty = valid) for one event object: the schema's common
+    envelope plus the per-type definition selected by ``event.type``."""
+    schema = schema or load_schema()
+    problems: List[str] = []
+    if not isinstance(event, dict):
+        return [f"event is {type(event).__name__}, not object"]
+    _check_subschema(event, schema, "$", problems)
+    etype = event.get("type")
+    defs = schema.get("definitions", {})
+    if isinstance(etype, str):
+        sub = defs.get(etype)
+        if sub is None:
+            problems.append(f"$.type: unknown event type {etype!r}")
+        else:
+            _check_subschema(event, sub, f"$({etype})", problems)
+    return problems
+
+
+def _strict_loads(line: str):
+    """json.loads that REJECTS the NaN/Infinity extensions (the log
+    promises strict JSON; accepting them here would hide a writer bug)."""
+
+    def _bad(tok):
+        raise ValueError(f"non-strict JSON token {tok!r}")
+
+    return json.loads(line, parse_constant=_bad)
+
+
+def validate_events_file(path: str, max_problems: int = 20) -> dict:
+    """Validate one JSONL event log end to end.
+
+    Returns ``{"ok", "events", "problems"}``: every line must parse as
+    strict JSON and validate against the schema; the first event must be
+    ``run_start`` (consumers key run metadata off it)."""
+    schema = load_schema()
+    problems: List[str] = []
+    n = 0
+    first_type = None
+    try:
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                n += 1
+                try:
+                    event = _strict_loads(line)
+                except ValueError as e:
+                    problems.append(f"line {lineno}: not strict JSON ({e})")
+                    continue
+                if first_type is None:
+                    first_type = event.get("type") if isinstance(
+                        event, dict
+                    ) else None
+                for p in validate_event(event, schema):
+                    problems.append(f"line {lineno}: {p}")
+                if len(problems) >= max_problems:
+                    problems.append("... (truncated)")
+                    break
+    except OSError as e:
+        problems.append(f"unreadable: {e}")
+    if n == 0:
+        problems.append("empty event log")
+    elif first_type != "run_start":
+        problems.append(
+            f"first event is {first_type!r}, expected 'run_start'"
+        )
+    return {"ok": not problems, "events": n, "problems": problems}

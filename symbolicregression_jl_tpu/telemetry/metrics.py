@@ -1,0 +1,373 @@
+"""Typed metrics registry + the per-iteration search collector.
+
+The registry is deliberately tiny — three instrument kinds with the
+semantics everyone expects from them:
+
+* :class:`Counter` — monotone non-decreasing total (``inc``);
+* :class:`Gauge` — last-write-wins scalar (``set``);
+* :class:`Histogram` — fixed integer-edge buckets fed either one
+  observation at a time (``observe``) or from a device-computed count
+  vector (``add_counts`` — how the population length distribution
+  arrives without a per-member host loop).
+
+:class:`SearchMetrics` is the search-specific feeder: once per
+``telemetry_every`` iterations it runs ONE fused jitted device reduction
+over the island states (per-island best/mean loss, population length
+bincount) — a single extra dispatch off the hot path, zero primitives
+added to the search programs — and combines it with values the host
+already holds (memo-bank counters, annealing temperature, hall-of-fame
+Pareto size and a dominated-hypervolume proxy, device HBM stats). The
+snapshot is emitted to the event sink as one ``metrics`` event per
+iteration (docs/observability.md lists the full catalog).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class Counter:
+    """Monotone total. ``inc`` with a negative amount is a bug upstream
+    and raises rather than silently un-counting."""
+
+    name: str
+    help: str = ""
+    value: float = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name}: negative inc {amount}")
+        self.value += amount
+
+
+@dataclasses.dataclass
+class Gauge:
+    """Last-write-wins scalar; None means 'not yet observed'."""
+
+    name: str
+    help: str = ""
+    value: Optional[float] = None
+
+    def set(self, value) -> None:
+        self.value = None if value is None else float(value)
+
+
+@dataclasses.dataclass
+class Histogram:
+    """Fixed-bucket histogram. ``edges`` are inclusive upper bounds of
+    each bucket; an implicit overflow bucket catches the rest."""
+
+    name: str
+    edges: List[float]
+    help: str = ""
+    counts: List[int] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        if list(self.edges) != sorted(self.edges):
+            raise ValueError(f"histogram {self.name}: edges not ascending")
+        if not self.counts:
+            self.counts = [0] * (len(self.edges) + 1)
+
+    def observe(self, value: float) -> None:
+        for i, edge in enumerate(self.edges):
+            if value <= edge:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def add_counts(self, counts) -> None:
+        """Merge a per-bucket count vector (len(edges) or len(edges)+1
+        entries; a missing overflow bucket means zero overflow)."""
+        counts = [int(c) for c in counts]
+        if len(counts) == len(self.edges):
+            counts = counts + [0]
+        if len(counts) != len(self.counts):
+            raise ValueError(
+                f"histogram {self.name}: got {len(counts)} buckets, "
+                f"want {len(self.counts)}"
+            )
+        self.counts = [a + b for a, b in zip(self.counts, counts)]
+
+    @property
+    def total(self) -> int:
+        return sum(self.counts)
+
+
+class MetricsRegistry:
+    """Name-keyed instrument store. Re-requesting a name returns the
+    existing instrument (so feeders never lose accumulated state);
+    requesting an existing name as a different kind raises."""
+
+    def __init__(self):
+        self._instruments: Dict[str, Any] = {}
+
+    def _get(self, cls, name: str, **kwargs):
+        inst = self._instruments.get(name)
+        if inst is None:
+            inst = cls(name=name, **kwargs)
+            self._instruments[name] = inst
+            return inst
+        if not isinstance(inst, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(inst).__name__}, not {cls.__name__}"
+            )
+        return inst
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help=help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help=help)
+
+    def histogram(self, name: str, edges, help: str = "") -> Histogram:
+        return self._get(Histogram, name, edges=list(edges), help=help)
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """JSON-ready state of every instrument (non-finite floats become
+        None — the event log writes strict JSON)."""
+
+        def _clean(v):
+            if v is None:
+                return None
+            v = float(v)
+            return v if math.isfinite(v) else None
+
+        out: Dict[str, Dict[str, Any]] = {
+            "counters": {}, "gauges": {}, "histograms": {},
+        }
+        for name, inst in sorted(self._instruments.items()):
+            if isinstance(inst, Counter):
+                out["counters"][name] = _clean(inst.value)
+            elif isinstance(inst, Gauge):
+                out["gauges"][name] = _clean(inst.value)
+            else:
+                out["histograms"][name] = {
+                    "edges": [float(e) for e in inst.edges],
+                    "counts": [int(c) for c in inst.counts],
+                }
+        return out
+
+
+# ---------------------------------------------------------------------------
+# search-specific collector
+# ---------------------------------------------------------------------------
+
+
+def _hypervolume_proxy(hof_losses, hof_exists, baseline: float) -> float:
+    """Dominated-hypervolume proxy of the hall-of-fame frontier in [0, 1]:
+    the mean over complexity slots 1..S of the baseline-normalized loss
+    improvement ``max(0, 1 - best_loss_at_or_below(c) / baseline)`` —
+    i.e. the area (in normalized-loss x complexity-fraction units) the
+    frontier dominates w.r.t. the reference point (maxsize, baseline
+    loss). Cheap, monotone under frontier improvement, and comparable
+    across iterations of one run (NOT across datasets)."""
+    import numpy as np
+
+    losses = np.asarray(hof_losses, np.float64)
+    exists = np.asarray(hof_exists, bool)
+    if baseline is None or not np.isfinite(baseline) or baseline <= 0:
+        return 0.0
+    best = np.where(exists & np.isfinite(losses), losses, np.inf)
+    runmin = np.minimum.accumulate(best)
+    gain = np.where(
+        np.isfinite(runmin), np.clip(1.0 - runmin / baseline, 0.0, 1.0), 0.0
+    )
+    return float(gain.mean())
+
+
+class SearchMetrics:
+    """Feeds a :class:`MetricsRegistry` once per observed iteration and
+    emits the snapshot to the event sink. One instance per search run."""
+
+    #: population length histogram bucket width (slots)
+    LENGTH_BUCKET = 4
+
+    def __init__(self, options, sink=None):
+        self.options = options
+        self.sink = sink
+        self.registry = MetricsRegistry()
+        self._reduce = None  # jitted on first use (needs array shapes)
+
+    def _reduction_fn(self):
+        if self._reduce is not None:
+            return self._reduce
+        import jax
+        import jax.numpy as jnp
+
+        max_len = self.options.max_len
+
+        def reduce_states(losses, lengths, hof_losses, hof_exists,
+                          num_evals):
+            # (I, npop) losses / lengths; (S,) hof. ONE fused program,
+            # outputs a few KB — a single dispatch + fetch per snapshot
+            # (the hof arrays pass through so the host-side hypervolume
+            # proxy reads the same fetch instead of syncing again; on a
+            # tunneled TPU each extra round trip is ~70 ms).
+            finite = jnp.isfinite(losses)
+            big = jnp.asarray(jnp.finfo(jnp.float32).max, losses.dtype)
+            best = jnp.min(jnp.where(finite, losses, big), axis=1)
+            n_fin = jnp.sum(finite, axis=1)
+            mean = jnp.sum(
+                jnp.where(finite, losses, 0.0), axis=1
+            ) / jnp.maximum(n_fin, 1)
+            len_counts = jnp.bincount(
+                lengths.astype(jnp.int32).ravel(), length=max_len + 1
+            )
+            mean_len = jnp.mean(lengths.astype(jnp.float32))
+            hof_size = jnp.sum(hof_exists.astype(jnp.int32))
+            return {
+                "island_best_loss": best,
+                "island_mean_loss": mean,
+                "island_finite_frac": n_fin / losses.shape[1],
+                "length_counts": len_counts,
+                "mean_length": mean_len,
+                "hof_size": hof_size,
+                "hof_losses": hof_losses,
+                "hof_exists": hof_exists,
+                "num_evals": jnp.sum(num_evals),
+            }
+
+        self._reduce = jax.jit(reduce_states)
+        return self._reduce
+
+    def observe_iteration(
+        self,
+        states,
+        ghof,
+        *,
+        output: int,
+        iteration: int,
+        baseline: Optional[float] = None,
+        temperature: Optional[float] = None,
+        curmaxsize: Optional[int] = None,
+        cache_row: Optional[dict] = None,
+        cycles_per_second: Optional[float] = None,
+        device_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """One iteration's metric snapshot: ONE fused device reduction
+        (single dispatch + single fetch) + host-side values -> registry
+        -> one ``metrics`` event. Returns the emitted snapshot dict."""
+        import jax
+        import numpy as np
+
+        vals = jax.device_get(
+            self._reduction_fn()(
+                states.pop.losses, states.pop.trees.length,
+                ghof.losses, ghof.exists, states.num_evals,
+            )
+        )
+        reg = self.registry
+        reg.counter(
+            "iterations_total", "host-loop iterations observed"
+        ).inc()
+        reg.gauge("best_loss", "global best population loss").set(
+            float(np.min(vals["island_best_loss"]))
+        )
+        reg.gauge("mean_loss", "mean finite population loss").set(
+            float(np.mean(vals["island_mean_loss"]))
+        )
+        reg.gauge(
+            "population_finite_frac",
+            "fraction of members with finite loss",
+        ).set(float(np.mean(vals["island_finite_frac"])))
+        reg.gauge("mean_tree_length", "mean program length (slots)").set(
+            float(vals["mean_length"])
+        )
+        reg.gauge("hof_size", "occupied hall-of-fame complexity slots").set(
+            int(vals["hof_size"])
+        )
+        reg.gauge(
+            "hof_hypervolume_proxy",
+            "dominated-hypervolume proxy of the HoF frontier [0,1]",
+        ).set(_hypervolume_proxy(
+            vals["hof_losses"], vals["hof_exists"], baseline
+        ))
+        reg.gauge("num_evals_total", "cumulative equation evaluations").set(
+            float(vals["num_evals"])
+        )
+        if temperature is not None:
+            reg.gauge(
+                "annealing_temperature",
+                "mean annealing temperature of this iteration's schedule",
+            ).set(temperature)
+        if curmaxsize is not None:
+            reg.gauge(
+                "curmaxsize", "warm-up complexity cap this iteration"
+            ).set(curmaxsize)
+        if cycles_per_second is not None:
+            reg.gauge(
+                "cycles_per_second", "progress-window cycles/second"
+            ).set(cycles_per_second)
+        if device_s is not None:
+            reg.gauge(
+                "iteration_device_s", "last iteration's dispatch wall time"
+            ).set(device_s)
+        if cache_row is not None:
+            reg.gauge(
+                "memo_hit_rate", "memo-bank hit fraction of scored trees"
+            ).set(cache_row.get("memo_hit_rate"))
+            reg.gauge(
+                "dedup_unique_ratio", "unique fraction of scored trees"
+            ).set(cache_row.get("unique_ratio"))
+            reg.gauge(
+                "eval_batch_fill",
+                "fraction of eval-batch slots that needed evaluation",
+            ).set(cache_row.get("eval_batch_fill"))
+        hist = reg.histogram(
+            "population_length",
+            list(range(
+                self.LENGTH_BUCKET, self.options.max_len + 1,
+                self.LENGTH_BUCKET,
+            )),
+            "program length distribution (slots)",
+        )
+        counts = np.asarray(vals["length_counts"])
+        bucketed = [
+            int(counts[max(0, e - self.LENGTH_BUCKET + 1):e + 1].sum())
+            for e in [int(b) for b in hist.edges]
+        ]
+        bucketed.append(int(counts.sum()) - sum(bucketed))
+        hist.counts = [0] * len(hist.counts)  # gauge-like: this iteration
+        hist.add_counts(bucketed)
+
+        # device HBM, where the backend reports it (CPU usually doesn't)
+        try:
+            from ..utils.profiling import device_memory_stats
+
+            stats = device_memory_stats()
+            in_use = [
+                s.get("bytes_in_use") for s in stats.values()
+                if isinstance(s, dict) and s.get("bytes_in_use") is not None
+            ]
+            if in_use:
+                reg.gauge(
+                    "hbm_bytes_in_use", "max live device bytes"
+                ).set(max(in_use))
+        except Exception:  # pragma: no cover - defensive
+            pass
+
+        snap = reg.snapshot()
+        if self.sink is not None:
+            self.sink.emit(
+                "metrics",
+                output=output,
+                iteration=iteration,
+                snapshot=snap,
+                per_island={
+                    "best_loss": [
+                        float(v) for v in np.asarray(
+                            vals["island_best_loss"], np.float64
+                        )
+                    ],
+                    "mean_loss": [
+                        float(v) for v in np.asarray(
+                            vals["island_mean_loss"], np.float64
+                        )
+                    ],
+                },
+            )
+        return snap

@@ -1,0 +1,239 @@
+"""Host-side span timers for the search stages.
+
+The search's wall time lives in a handful of device dispatches; knowing
+*which stage* owns it is the difference between guessing and fixing
+(TensorGP, arxiv 2103.07512: tensorized-GP perf work is dominated by
+attributing padded/lockstep time). A span is one timed host-side region
+with explicit ``jax.block_until_ready`` fencing — without the fence an
+async dispatch returns immediately and the time would be charged to
+whichever later call happens to synchronize.
+
+Stage vocabulary: :data:`STAGES` — the same seven names
+``analysis/memory.py::build_stage_programs`` decomposes the iteration
+into, so span timings, srmem per-stage HBM attribution, and XLA-profile
+regions all join on one key. Every span also nests inside a
+``profiling.annotate`` region, so when a ``profiling.trace`` capture is
+active the spans appear on the XLA/Perfetto timeline under
+``srtpu/<name>``.
+
+Two stages (``mutate`` / ``eval``) live *inside* the fused cycle scan and
+cannot be fenced from the host per-iteration; :func:`probe_mutate_eval`
+times them as standalone one-shot programs (the exact decomposition
+srmem's stage programs use), recorded with ``probe: true`` so consumers
+can tell a measured sub-dispatch from an in-loop phase.
+
+Everything here is host-side orchestration: no primitive is added to any
+jitted search program (the compile-surface baseline stays byte-identical
+with telemetry on).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+#: The per-iteration stage vocabulary, shared with
+#: ``analysis.memory.build_stage_programs`` (asserted there) and the
+#: ``srtpu/<stage>`` profiler annotations.
+STAGES = (
+    "init",
+    "cycle",
+    "mutate",
+    "eval",
+    "simplify",
+    "optimize",
+    "merge_migrate",
+)
+
+
+@dataclasses.dataclass
+class Span:
+    """One completed timed region."""
+
+    name: str
+    t_start: float  # unix seconds (event-log joinable)
+    duration_s: float
+    attrs: Dict[str, Any]
+
+
+class _SpanBox:
+    """Mutable handle yielded by ``SpanRecorder.span``: set ``fence`` to a
+    jax pytree to block on before the clock stops; add result-dependent
+    attributes via ``attrs``."""
+
+    __slots__ = ("fence", "attrs")
+
+    def __init__(self):
+        self.fence = None
+        self.attrs: Dict[str, Any] = {}
+
+
+class SpanRecorder:
+    """Collects spans and forwards each to an event sink (events.EventLog)
+    the moment it closes.
+
+    ``set_context`` attaches ambient attributes (output/iteration) to
+    every span recorded until the next call — the host loop updates it
+    once per iteration instead of threading ids through the driver."""
+
+    #: retained-span cap: every span is forwarded to the sink the moment
+    #: it closes, so in-memory retention is a convenience for direct
+    #: consumers (bench reads its one eval span; tests inspect a few) —
+    #: a 10k-iteration search must not accumulate unbounded host memory
+    MAX_RETAINED = 4096
+
+    def __init__(self, sink=None, max_retained: Optional[int] = None):
+        self.sink = sink
+        self.max_retained = (
+            self.MAX_RETAINED if max_retained is None else max_retained
+        )
+        self.spans: List[Span] = []
+        self._ctx: Dict[str, Any] = {}
+
+    def set_context(self, **ctx) -> None:
+        """Merge ambient span attributes; a value of None removes the key."""
+        for k, v in ctx.items():
+            if v is None:
+                self._ctx.pop(k, None)
+            else:
+                self._ctx[k] = v
+
+    @contextlib.contextmanager
+    def span(self, name: str, fence=None, **attrs):
+        """Time the enclosed block as stage `name`.
+
+        ``fence`` (or ``box.fence`` set inside the block) is passed to
+        ``jax.block_until_ready`` before the clock stops, so queued device
+        work is charged to THIS span. The wait happens inside the
+        ``profiling.annotate`` region — on an XLA trace the annotation
+        covers dispatch + device completion, same extent as the span."""
+        import jax
+
+        from ..utils.profiling import annotate
+
+        box = _SpanBox()
+        err: Optional[BaseException] = None
+        with annotate(f"srtpu/{name}"):
+            t_wall = time.time()
+            t0 = time.perf_counter()
+            try:
+                yield box
+            except BaseException as e:
+                err = e
+                raise
+            finally:
+                try:
+                    if err is None:
+                        for val in (fence, box.fence):
+                            if val is not None:
+                                jax.block_until_ready(val)
+                finally:
+                    duration = time.perf_counter() - t0
+                    a = {**self._ctx, **attrs, **box.attrs}
+                    if err is not None:
+                        a["error"] = type(err).__name__
+                    self._record(Span(name, t_wall, duration, a))
+
+    def _record(self, sp: Span) -> None:
+        self.spans.append(sp)
+        if len(self.spans) > self.max_retained:
+            del self.spans[0]  # oldest out; the sink has the full trail
+        if self.sink is not None:
+            self.sink.emit(
+                "span",
+                name=sp.name,
+                t_start=sp.t_start,
+                duration_s=sp.duration_s,
+                attrs=sp.attrs,
+            )
+
+    def total_s(self, name: str) -> float:
+        """Summed duration of every span named `name`."""
+        return sum(s.duration_s for s in self.spans if s.name == name)
+
+
+class NullSpanRecorder(SpanRecorder):
+    """No-op recorder: ``span`` yields a box and records nothing — the
+    phased iteration driver uses it when telemetry is off so the chunked
+    dispatch path carries zero instrumentation (no fence, no timing)."""
+
+    def __init__(self):
+        super().__init__(sink=None)
+
+    @contextlib.contextmanager
+    def span(self, name: str, fence=None, **attrs):
+        yield _SpanBox()
+
+
+NULL = NullSpanRecorder()
+
+
+def probe_mutate_eval(
+    recorder: SpanRecorder, options, states, X, y, weights, baseline,
+    scalars,
+) -> None:
+    """One-shot measured spans for the two in-scan stages.
+
+    Runs the standalone ``mutate`` (tree surgery over all islands) and
+    ``eval`` (fused flat scoring of the children batch) programs —
+    the same decomposition ``analysis.memory.build_stage_programs``
+    traces — once on real data, fenced, after a warmup call so the span
+    measures the steady-state dispatch, not compilation. Each probe
+    program is its own jit: nothing is added to the production search
+    programs. Called once per run by the host loop (probe cost ~= one
+    evolution cycle); any failure is reported to the sink as a
+    ``probe_error`` event, never raised into the search."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import evolve
+    from ..models.fitness import score_trees
+
+    sink = recorder.sink
+    try:
+        nfeatures = int(X.shape[0])
+        cm = jnp.int32(options.maxsize)
+
+        def mutate_fn(sts, cm, sc):
+            o = options.bind_scalars(sc)
+            return jax.vmap(
+                lambda st: evolve._propose_children(
+                    st, jnp.float32(1.0), cm, nfeatures, o
+                )
+            )(sts)
+
+        mutate_jit = jax.jit(mutate_fn)
+        props = jax.block_until_ready(mutate_jit(states, cm, scalars))
+        with recorder.span("mutate", probe=True) as sp:
+            props = mutate_jit(states, cm, scalars)
+            sp.fence = props.children
+
+        children = jax.tree_util.tree_map(
+            lambda x: x.reshape((-1,) + x.shape[2:]), props.children
+        )
+        n_trees = int(children.length.shape[0])
+        n_rows = int(X.shape[1])
+
+        def eval_fn(ch, X, y, w, bl, sc):
+            o = options.bind_scalars(sc)
+            return score_trees(ch, X, y, w, bl, o)
+
+        eval_jit = jax.jit(eval_fn)
+        out = jax.block_until_ready(
+            eval_jit(children, X, y, weights, baseline, scalars)
+        )
+        # trees/rows ride along so consumers (bench roofline, suite
+        # stage-time rows) can derive trees-rows/s from the duration
+        with recorder.span(
+            "eval", probe=True, trees=n_trees, rows=n_rows
+        ) as sp:
+            out = eval_jit(children, X, y, weights, baseline, scalars)
+            sp.fence = out
+    except Exception as e:  # pragma: no cover - defensive
+        if sink is not None:
+            sink.emit(
+                "probe_error",
+                error=f"{type(e).__name__}: {str(e)[:200]}",
+            )

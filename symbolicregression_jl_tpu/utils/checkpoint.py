@@ -42,10 +42,14 @@ def _to_host(x) -> np.ndarray:
     return np.asarray(x)
 
 
-def save_search_state(path: str, state: List["SearchState"]) -> str:
+def save_search_state(path: str, state: List["SearchState"],
+                      sink=None) -> str:
     """Write the list of per-output SearchStates (from
     `equation_search(..., return_state=True).state`) to `path`. Uses the
-    same double-write discipline as the CSV checkpoint (file + .bkup)."""
+    same double-write discipline as the CSV checkpoint (file + .bkup).
+    `sink` (a telemetry EventLog) records the serialization point as a
+    ``saved_state`` event — the resume-not-restart trail of ROADMAP
+    item 4 keys off these."""
     if state is None:
         raise ValueError(
             "state is None — run equation_search with return_state=True"
@@ -65,6 +69,13 @@ def save_search_state(path: str, state: List["SearchState"]) -> str:
     for p in (path, path + ".bkup"):
         with open(p, "wb") as f:
             f.write(payload)
+    if sink is not None:
+        sink.emit(
+            "saved_state",
+            path=path,
+            outputs=len(host),
+            iteration=max((d["iteration"] for d in host), default=0),
+        )
     return path
 
 

@@ -34,10 +34,19 @@ def debug(verbosity: int, *args, **kwargs) -> None:
 
 class ResourceMonitor:
     """Host-occupation estimator (ResourceMonitor analog,
-    reference src/SearchUtils.jl:143-213)."""
+    reference src/SearchUtils.jl:143-213).
 
-    def __init__(self, warn_fraction: float = 0.2, max_samples: int = 100):
+    The warning routes through the telemetry event sink when one is
+    attached (a machine-readable ``resource_warning`` event, emitted even
+    in quiet mode — the trail must survive silenced consoles) and prints
+    to stderr only when the run is not quiet (verbosity > 0 and not
+    SYMBOLIC_REGRESSION_TEST)."""
+
+    def __init__(self, warn_fraction: float = 0.2, max_samples: int = 100,
+                 sink=None, verbosity: int = 1):
         self.warn_fraction = warn_fraction
+        self.sink = sink
+        self.verbosity = verbosity
         self.device_s = 0.0
         self.host_s = 0.0
         self._samples: Deque[Tuple[float, float]] = deque(maxlen=max_samples)
@@ -55,20 +64,27 @@ class ResourceMonitor:
 
     def maybe_warn(self) -> None:
         if (
-            not self._warned
-            and len(self._samples) >= 5
-            and self.host_occupation > self.warn_fraction
-            and not _quiet()
+            self._warned
+            or len(self._samples) < 5
+            or self.host_occupation <= self.warn_fraction
         ):
-            self._warned = True
-            print(
-                f"Warning: the host spends {100 * self.host_occupation:.1f}% "
-                "of wall time on orchestration (decoding/printing/"
-                "checkpointing) while the device is idle. Consider "
-                "verbosity=0, progress=False, or a larger "
-                "ncycles_per_iteration.",
-                file=sys.stderr,
+            return
+        self._warned = True
+        message = (
+            f"the host spends {100 * self.host_occupation:.1f}% "
+            "of wall time on orchestration (decoding/printing/"
+            "checkpointing) while the device is idle. Consider "
+            "verbosity=0, progress=False, or a larger "
+            "ncycles_per_iteration."
+        )
+        if self.sink is not None:
+            self.sink.emit(
+                "resource_warning",
+                host_occupation=self.host_occupation,
+                message=message,
             )
+        if self.verbosity > 0 and not _quiet():
+            print("Warning: " + message, file=sys.stderr)
 
 
 class SearchProgress:
@@ -81,9 +97,10 @@ class SearchProgress:
 
     WINDOW_S = 50.0
 
-    def __init__(self, total_iterations: int, options) -> None:
+    def __init__(self, total_iterations: int, options, sink=None) -> None:
         self.total = max(total_iterations, 1)
         self.options = options
+        self.sink = sink
         self.t0 = time.time()
         self._samples: Deque[Tuple[float, float]] = deque()
         self._equations = 0.0
@@ -128,6 +145,45 @@ class SearchProgress:
                     f"(dedup {100.0 * (scored - unique) / scored:.0f}%, "
                     f"memo {100.0 * hits / scored:.0f}%)."
                 )
+        return line
+
+    def report(self, iteration: int, best_loss: float, num_evals: float,
+               cache_counts: Optional[Tuple[int, int, int]] = None,
+               prefix: str = "", console: bool = True,
+               output: Optional[int] = None,
+               search_iteration: Optional[int] = None) -> str:
+        """One iteration's status, through every attached channel: a
+        ``progress`` event on the telemetry sink (always, when one is
+        set — quiet consoles must not silence the machine-readable
+        trail) and the classic status line on stdout (``console=True``
+        and not quiet). Returns the rendered line."""
+        import math
+
+        if self.sink is not None:
+            cache = None
+            if cache_counts is not None:
+                scored, unique, hits = (int(v) for v in cache_counts)
+                cache = {"scored": scored, "unique": unique,
+                         "memo_hits": hits}
+            self.sink.emit(
+                "progress",
+                iteration=search_iteration,
+                output=output,
+                best_loss=(
+                    float(best_loss)
+                    if best_loss is not None and math.isfinite(best_loss)
+                    else None
+                ),
+                num_evals=float(num_evals),
+                cycles_per_second=self.cycles_per_second,
+                elapsed_s=time.time() - self.t0,
+                cache=cache,
+            )
+        line = prefix + self.status_line(
+            iteration, best_loss, num_evals, cache_counts=cache_counts
+        )
+        if console and not _quiet():
+            print(line)
         return line
 
 

@@ -59,9 +59,14 @@ class Recorder:
     mirroring the reference's allow_inf serialization)."""
 
     def __init__(self, options: Options,
-                 variable_names: Optional[Sequence[str]] = None):
+                 variable_names: Optional[Sequence[str]] = None,
+                 sink=None):
         self.options = options
         self.variable_names = variable_names
+        # telemetry event sink (telemetry/events.py): save() announces the
+        # written artifact there, so one JSONL trail names every output
+        # channel of a run
+        self.sink = sink
         self.record: RecordType = {
             "options": repr_options(options),
             "start_time": time.time(),
@@ -281,6 +286,10 @@ class Recorder:
             # non-strict JSON the reference writes with allow_inf
             # (src/SymbolicRegression.jl:923-927).
             json.dump(self.record, f)
+        if self.sink is not None:
+            self.sink.emit(
+                "recorder_saved", path=path, keys=len(self.record)
+            )
         return path
 
 

@@ -1,0 +1,520 @@
+"""Unified search telemetry (ISSUE 7): spans, metrics registry, JSONL
+event log, and the satellites that ride along (bench roofline skip
+reasons, quiet-mode ResourceMonitor, recorder/cache_stats schema).
+
+File name sorts EARLY (test_ab_*) and everything outside the `slow`
+marker is CPU-only host-side unit work (<10s total): the tier-1 budget
+(memory: tier1-timing-budget) pays for dots, not searches. The
+full-search round trips — bit-identical HoF with telemetry on/off, the
+seven-span event log from a real run — live under `slow`.
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.telemetry import (
+    STAGES,
+    EventLog,
+    MetricsRegistry,
+    SpanRecorder,
+    validate_event,
+    validate_events_file,
+)
+from symbolicregression_jl_tpu.telemetry.spans import NULL as NULL_SPANS
+
+GOLDEN = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "data", "telemetry",
+    "golden_events.jsonl",
+)
+
+
+class FakeSink:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, type, **fields):
+        self.events.append({"type": type, **fields})
+        return self.events[-1]
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_recorder_records_and_emits():
+    sink = FakeSink()
+    rec = SpanRecorder(sink)
+    rec.set_context(output=0, iteration=3)
+    with rec.span("cycle", chunks=2) as sp:
+        sp.fence = np.ones(4)  # block_until_ready passthrough
+        sp.attrs["extra"] = 1
+    assert len(rec.spans) == 1
+    sp = rec.spans[0]
+    assert sp.name == "cycle" and sp.duration_s >= 0.0
+    assert sp.attrs == {"output": 0, "iteration": 3, "chunks": 2,
+                        "extra": 1}
+    (ev,) = sink.events
+    assert ev["type"] == "span" and ev["name"] == "cycle"
+    assert ev["attrs"]["iteration"] == 3
+    # context update replaces; None removes
+    rec.set_context(iteration=4, output=None)
+    with rec.span("simplify"):
+        pass
+    assert rec.spans[-1].attrs == {"iteration": 4}
+    assert rec.total_s("cycle") == sp.duration_s
+
+
+def test_span_retention_capped_and_run_ids_unique():
+    rec = SpanRecorder(max_retained=3)
+    for i in range(5):
+        with rec.span(f"s{i}"):
+            pass
+    assert [s.name for s in rec.spans] == ["s2", "s3", "s4"]
+    from symbolicregression_jl_tpu.telemetry.events import _default_run_id
+
+    # sub-second back-to-back runs must not collide on the log path
+    assert _default_run_id() != _default_run_id()
+
+
+def test_span_exception_recorded_and_reraised():
+    rec = SpanRecorder()
+    with pytest.raises(RuntimeError):
+        with rec.span("optimize"):
+            raise RuntimeError("boom")
+    assert rec.spans[-1].attrs["error"] == "RuntimeError"
+
+
+def test_null_span_recorder_is_inert():
+    with NULL_SPANS.span("cycle") as sp:
+        sp.fence = np.ones(2)
+    assert NULL_SPANS.spans == []
+
+
+def test_stage_vocabulary_is_the_srmem_one():
+    # the names build_stage_programs decomposes the iteration into
+    # (asserted against STAGES inside analysis.memory at build time)
+    assert STAGES == (
+        "init", "cycle", "mutate", "eval", "simplify", "optimize",
+        "merge_migrate",
+    )
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_registry_semantics():
+    reg = MetricsRegistry()
+    c = reg.counter("iters", "help")
+    c.inc()
+    c.inc(2)
+    assert reg.counter("iters").value == 3  # same instrument back
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(TypeError):
+        reg.gauge("iters")  # kind mismatch
+    g = reg.gauge("best_loss")
+    g.set(np.float32(0.5))
+    h = reg.histogram("length", [4, 8, 12])
+    h.observe(3)
+    h.observe(9)
+    h.observe(99)  # overflow bucket
+    h.add_counts([1, 0, 0])
+    assert h.counts == [2, 0, 1, 1] and h.total == 4
+    with pytest.raises(ValueError):
+        reg.histogram("bad", [8, 4])
+    snap = reg.snapshot()
+    assert snap["counters"]["iters"] == 3.0
+    assert snap["gauges"]["best_loss"] == 0.5
+    assert snap["histograms"]["length"]["counts"] == [2, 0, 1, 1]
+    # non-finite gauge values become None (strict-JSON event log)
+    g.set(float("inf"))
+    assert reg.snapshot()["gauges"]["best_loss"] is None
+
+
+def test_hypervolume_proxy_bounds():
+    from symbolicregression_jl_tpu.telemetry.metrics import (
+        _hypervolume_proxy,
+    )
+
+    losses = np.array([np.inf, 0.5, 0.1, np.inf])
+    exists = np.array([False, True, True, False])
+    hv = _hypervolume_proxy(losses, exists, baseline=1.0)
+    # slots: [0, 0.5, 0.9, 0.9] / 4
+    assert math.isclose(hv, (0.0 + 0.5 + 0.9 + 0.9) / 4)
+    assert _hypervolume_proxy(losses, exists, baseline=0.0) == 0.0
+    assert _hypervolume_proxy(losses, np.zeros(4, bool), 1.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+
+def test_event_log_line_buffered_strict_json(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(path, run_id="r1")
+    log.emit(
+        "run_start", config_fingerprint="abc", backend="cpu",
+        devices=["TFRT_CPU_0"], nout=1,
+    )
+    log.emit(
+        "span", name="eval", t_start=1.0, duration_s=0.5,
+        attrs={"bad": float("nan"), "arr": np.arange(3),
+               "f": np.float32(2.0)},
+    )
+    # crash-safety: both lines are on disk BEFORE close (line-buffered)
+    with open(path) as f:
+        lines = f.read().splitlines()
+    assert len(lines) == 2
+    ev = json.loads(lines[1])
+    assert ev["v"] == 1 and ev["run"] == "r1"
+    assert ev["attrs"]["bad"] is None  # NaN sanitized, strict JSON
+    assert ev["attrs"]["arr"] == [0, 1, 2]
+    assert ev["attrs"]["f"] == 2.0
+    log.close()
+    report = validate_events_file(path)
+    assert report["ok"], report["problems"]
+    assert report["events"] == 2
+
+
+def test_event_log_never_fatal_on_hostile_fields(tmp_path):
+    # arbitrary objects (np.asarray would wrap them as 0-d object
+    # arrays) stringify instead of recursing; emit survives anything
+    import pathlib
+
+    from symbolicregression_jl_tpu.telemetry.events import _sanitize
+
+    class Weird:
+        def __repr__(self):
+            return "<weird>"
+
+    assert _sanitize(Weird()) == "<weird>"
+    assert _sanitize(pathlib.Path("/tmp/x")) in ("/tmp/x", "\\tmp\\x")
+    assert _sanitize(np.array([Weird()], dtype=object)) == ["<weird>"]
+    log = EventLog(str(tmp_path / "e.jsonl"), run_id="r")
+    ev = log.emit("probe_error", error="x", ctx=Weird())
+    assert ev is not None and ev["ctx"] == "<weird>"
+    log.close()
+    assert validate_events_file(str(tmp_path / "e.jsonl"))["events"] == 1
+
+
+def test_validate_catches_schema_violations(tmp_path):
+    # per-type requirements: a span without its name/duration fails
+    bad = {"v": 1, "t": 0.0, "run": "r", "type": "span"}
+    problems = validate_event(bad)
+    assert any("name" in p for p in problems)
+    assert any("duration_s" in p for p in problems)
+    # wrong envelope version
+    assert validate_event({"v": 2, "t": 0.0, "run": "r",
+                           "type": "run_end"})
+    # unknown type
+    assert validate_event({"v": 1, "t": 0.0, "run": "r", "type": "nope"})
+    # file-level: first event must be run_start; bare Infinity rejected
+    p = tmp_path / "bad.jsonl"
+    p.write_text(
+        '{"v": 1, "t": 0.0, "run": "r", "type": "run_end", '
+        '"num_evals": Infinity, "search_time_s": 1.0}\n'
+    )
+    report = validate_events_file(str(p))
+    assert not report["ok"]
+    assert any("strict JSON" in x for x in report["problems"])
+
+
+def test_golden_fixture_validates_with_all_stage_spans():
+    # the same invariant scripts/lint.py's telemetry-schema gate enforces
+    report = validate_events_file(GOLDEN)
+    assert report["ok"], report["problems"]
+    names = set()
+    with open(GOLDEN) as f:
+        for line in f:
+            e = json.loads(line)
+            if e["type"] == "span":
+                names.add(e["name"])
+    assert set(STAGES) <= names
+
+
+def test_lint_telemetry_schema_gate():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "srtpu_lint", os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "lint.py",
+        )
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = mod.check_telemetry_schema()
+    assert out["ok"], out["detail"]
+    assert out["events"] > 0
+
+
+# ---------------------------------------------------------------------------
+# satellites: bench roofline skip reason
+# ---------------------------------------------------------------------------
+
+
+def test_roofline_skip_reason_selection():
+    import importlib
+
+    bench = importlib.import_module("bench")
+    fn = bench._roofline_skip_reason
+    assert fn("cpu", False) == "cpu-only"
+    # CPU wins even if routing would have picked the kernel elsewhere
+    assert fn("cpu", True) == "cpu-only"
+    assert fn("tpu", False) == "interpreter-path"
+    assert fn("tpu", True, ImportError("no roofline")) == "import-failure"
+    # ModuleNotFoundError is an ImportError: same reason
+    assert fn("tpu", True, ModuleNotFoundError("x")) == "import-failure"
+    assert fn("tpu", True, ZeroDivisionError()) == "error: ZeroDivisionError"
+    assert fn("tpu", True, None) is None  # fraction should exist
+
+
+# ---------------------------------------------------------------------------
+# satellites: ResourceMonitor quiet mode + sink
+# ---------------------------------------------------------------------------
+
+
+def _tripped_monitor(**kw):
+    from symbolicregression_jl_tpu.utils.progress import ResourceMonitor
+
+    m = ResourceMonitor(warn_fraction=0.2, **kw)
+    for _ in range(5):
+        m.note(device_s=0.1, host_s=0.9)
+    return m
+
+
+def test_resource_monitor_emits_event_and_respects_quiet(
+    monkeypatch, capsys
+):
+    # quiet console (verbosity=0): the event still lands on the sink,
+    # nothing is printed
+    monkeypatch.setenv("SYMBOLIC_REGRESSION_TEST", "")
+    sink = FakeSink()
+    m = _tripped_monitor(sink=sink, verbosity=0)
+    m.maybe_warn()
+    (ev,) = sink.events
+    assert ev["type"] == "resource_warning"
+    assert ev["host_occupation"] == pytest.approx(0.9)
+    assert capsys.readouterr().err == ""
+    # verbose console: printed once, never twice
+    m2 = _tripped_monitor(sink=None, verbosity=1)
+    m2.maybe_warn()
+    m2.maybe_warn()
+    assert capsys.readouterr().err.count("Warning") == 1
+    # SYMBOLIC_REGRESSION_TEST=true silences the console but not the sink
+    monkeypatch.setenv("SYMBOLIC_REGRESSION_TEST", "true")
+    sink3 = FakeSink()
+    m3 = _tripped_monitor(sink=sink3, verbosity=1)
+    m3.maybe_warn()
+    assert len(sink3.events) == 1
+    assert capsys.readouterr().err == ""
+
+
+def test_progress_report_emits_event_without_console(capsys):
+    from symbolicregression_jl_tpu.models.options import make_options
+    from symbolicregression_jl_tpu.utils.progress import SearchProgress
+
+    sink = FakeSink()
+    progress = SearchProgress(4, make_options(verbosity=0), sink=sink)
+    progress.report(
+        0, float("inf"), 100.0, cache_counts=(10, 8, 2),
+        console=False, output=0, search_iteration=0,
+    )
+    (ev,) = sink.events
+    assert ev["type"] == "progress"
+    assert ev["best_loss"] is None  # inf -> null (strict JSON)
+    assert ev["num_evals"] == 100.0
+    assert ev["cache"] == {"scored": 10, "unique": 8, "memo_hits": 2}
+    assert capsys.readouterr().out == ""
+
+
+# ---------------------------------------------------------------------------
+# satellites: recorder out{j}_cache payload + sink; checkpoint event
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_cache_payload_schema_and_save_event(tmp_path):
+    from symbolicregression_jl_tpu.models.options import make_options
+    from symbolicregression_jl_tpu.utils.recorder import Recorder
+
+    sink = FakeSink()
+    opts = make_options(cache_fitness=True, verbosity=0)
+    rec = Recorder(opts, sink=sink)
+    fields = ("scored", "unique", "memo_hits", "evaluated",
+              "unique_ratio", "memo_hit_rate", "eval_batch_fill")
+    for it in range(3):
+        rec.record_cache(1, it, {
+            "output": 1, "iteration": it, "scored": 100 * (it + 1),
+            "unique": 60, "memo_hits": 10 * it, "evaluated": 60 - 10 * it,
+            "unique_ratio": 0.6, "memo_hit_rate": 0.1 * it,
+            "eval_batch_fill": 0.5,
+        })
+    cache = rec.record["out2_cache"]
+    assert sorted(cache) == ["iteration1", "iteration2", "iteration3"]
+    for entry in cache.values():
+        assert all(k in entry for k in fields)
+        assert "output" not in entry and "iteration" not in entry
+    path = rec.save(str(tmp_path / "rec.json"))
+    (ev,) = sink.events
+    assert ev["type"] == "recorder_saved" and ev["path"] == path
+
+
+def test_save_search_state_emits_saved_state_event(tmp_path):
+    from symbolicregression_jl_tpu.api import SearchState
+    from symbolicregression_jl_tpu.utils.checkpoint import (
+        load_search_state,
+        save_search_state,
+    )
+
+    sink = FakeSink()
+    state = SearchState(
+        island_states={"a": np.ones(3, np.float32)},
+        global_hof={"b": np.zeros(2, np.float32)},
+        iteration=4,
+    )
+    path = str(tmp_path / "run.ckpt")
+    save_search_state(path, [state], sink=sink)
+    (ev,) = sink.events
+    assert ev["type"] == "saved_state"
+    assert ev["path"] == path and ev["outputs"] == 1
+    assert ev["iteration"] == 4
+    assert load_search_state(path)[0].iteration == 4
+
+
+# ---------------------------------------------------------------------------
+# options knobs
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_options_are_orchestration_only():
+    from symbolicregression_jl_tpu.models.options import make_options
+
+    base = make_options()
+    tele = make_options(
+        telemetry=True, telemetry_dir="/tmp/x", telemetry_every=3
+    )
+    # same compiled graph: hash/eq ignore the telemetry knobs, so the
+    # jit factories' lru_caches hit across them
+    assert base == tele and hash(base) == hash(tele)
+    with pytest.raises(ValueError):
+        make_options(telemetry_every=0)
+
+
+# ---------------------------------------------------------------------------
+# full-search round trips (slow: real compiles)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_full_search_telemetry_round_trip(tmp_path):
+    import symbolicregression_jl_tpu as sr
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((2, 64)).astype(np.float32)
+    y = 2.0 * np.cos(X[1]) + X[0] ** 2
+    kw = dict(
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        niterations=2, npopulations=3, npop=16, ncycles_per_iteration=8,
+        maxsize=10, seed=5, verbosity=0, progress=False,
+    )
+    r_off = sr.equation_search(X, y, **kw)
+    r_on = sr.equation_search(
+        X, y, telemetry=True, telemetry_dir=str(tmp_path),
+        telemetry_every=1, **kw,
+    )
+
+    def frontier(r):
+        return [
+            (c.complexity, float(c.loss), float(c.score), c.equation)
+            for c in r.frontier()
+        ]
+
+    # ISSUE 7 acceptance: telemetry must not change the search
+    assert frontier(r_off) == frontier(r_on)
+
+    (path,) = [
+        os.path.join(tmp_path, f) for f in os.listdir(tmp_path)
+        if f.endswith(".jsonl")
+    ]
+    report = validate_events_file(path)
+    assert report["ok"], report["problems"]
+    events = [json.loads(line) for line in open(path)]
+    assert events[0]["type"] == "run_start"
+    assert events[-1]["type"] == "run_end"
+    span_names = [e["name"] for e in events if e["type"] == "span"]
+    assert set(STAGES) <= set(span_names)  # all seven stages
+    # per-iteration phases appear once per iteration; probes once per run
+    assert span_names.count("simplify") == 2
+    assert span_names.count("mutate") == 1
+    metrics = [e for e in events if e["type"] == "metrics"]
+    assert [m["iteration"] for m in metrics] == [0, 1]
+    for m in metrics:
+        snap = m["snapshot"]
+        assert snap["gauges"]["best_loss"] is not None
+        assert snap["gauges"]["hof_size"] >= 1
+        assert 0.0 <= snap["gauges"]["hof_hypervolume_proxy"] <= 1.0
+        assert sum(
+            snap["histograms"]["population_length"]["counts"]
+        ) == 3 * 16  # islands x npop
+        assert len(m["per_island"]["best_loss"]) == 3
+    assert [e for e in events if e["type"] == "progress"]
+
+
+@pytest.mark.slow
+def test_cache_stats_schema_and_recorder_cache_round_trip(tmp_path):
+    """ISSUE 7 satellite: result.cache_stats schema + monotone counters
+    and the Recorder's out{j}_cache payloads from a REAL search (the
+    cache suite case was the only thing asserting these)."""
+    import symbolicregression_jl_tpu as sr
+
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((2, 64)).astype(np.float32)
+    y = X[0] * X[1] - 0.5
+    sr.clear_memo_banks()
+    r = sr.equation_search(
+        X, y,
+        binary_operators=["+", "-", "*"],
+        niterations=3, npopulations=2, npop=16, ncycles_per_iteration=8,
+        maxsize=10, seed=2, verbosity=0, progress=False,
+        cache_fitness=True, recorder=True,
+        recorder_file=str(tmp_path / "rec.json"),
+    )
+    stats = r.cache_stats
+    assert set(stats) == {"totals", "per_iteration", "banks"}
+    totals = stats["totals"]
+    for k in ("scored", "unique", "memo_hits", "evaluated", "hit_rate",
+              "unique_ratio"):
+        assert k in totals
+    rows = stats["per_iteration"]
+    assert len(rows) == 3
+    cum = np.zeros(3, np.int64)
+    for i, row in enumerate(rows):
+        assert row["iteration"] == i and row["output"] == 0
+        delta = np.array(
+            [row["scored"], row["unique"], row["memo_hits"]], np.int64
+        )
+        # per-iteration deltas of cumulative device counters: never
+        # negative, so the cumulative series is monotone non-decreasing
+        assert (delta >= 0).all()
+        assert row["evaluated"] == row["unique"] - row["memo_hits"]
+        cum += delta
+    assert totals["scored"] == int(cum[0])
+    assert totals["unique"] == int(cum[1])
+    assert totals["memo_hits"] == int(cum[2])
+    # recorder carries the same rows under out1_cache
+    rec = json.load(open(tmp_path / "rec.json"))
+    cache = rec["out1_cache"]
+    assert sorted(cache) == ["iteration1", "iteration2", "iteration3"]
+    for i, row in enumerate(rows):
+        entry = cache[f"iteration{i + 1}"]
+        assert entry["scored"] == row["scored"]
+        assert entry["memo_hits"] == row["memo_hits"]
+    sr.clear_memo_banks()
