@@ -1,0 +1,332 @@
+#!/usr/bin/env python
+"""Real-search multi-chip capture: the production `equation_search`
+sharded over an (islands, rows) mesh vs the same search on one device.
+
+Replaces the 3-step `dryrun_multichip` as the repo's multi-device
+evidence (MULTICHIP_r01-r05 recorded only that a tiny sharded step ran):
+this runs the actual public search end to end — init, cycle scan,
+simplify, HoF merge, migration, candidate extraction — under the
+compiled sharding contract of api.py's jit factories, and reports
+
+- trees-rows/s of the sharded and the single-device run (compile
+  excluded: a warm-up search pays it first; the jit factories'
+  lru_caches keep the compiled programs across `equation_search` calls);
+- ``speedup_vs_single`` (single wall / sharded wall) and
+  ``scaling_efficiency`` (speedup / devices used — 1.0 = perfectly
+  linear island scaling, the arxiv 2501.17168 regime);
+- the bit-identity verdict (row_shards=1 only: islands-only sharding
+  leaves per-island math unchanged — docs/multichip.md) and the
+  sharded-carry verdict (every IslandState leaf island-sharded after
+  the run).
+
+Run standalone (one JSON row per line, benchmark/suite.py row format):
+
+    python benchmark/multichip.py --force-host 8            # CPU harness
+    python benchmark/multichip.py --northstar               # 64 islands
+    python benchmark/multichip.py --out MULTICHIP_LATEST.json
+
+``--force-host N`` forces N virtual CPU devices and pins the CPU
+platform BEFORE jax initializes (this image's sitecustomize would
+otherwise route backend init at the axon TPU tunnel) — so callers
+(bench.py, suite.py) run this file as a subprocess. Without the flag it
+uses whatever devices the session has (the real-chip path when the
+tunnel is up). bench.py embeds these rows in its JSON next to
+``multichip_skip_reason``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+#: Eval-dominated default shape: big row count and population so the
+#: per-iteration device work dwarfs host orchestration — the regime where
+#: island scaling is measurable (and the suite `multichip` case's
+#: acceptance shape: npopulations=8, row_shards=1).
+DEFAULTS = dict(
+    islands=8, npop=128, rows=2048, ncycles=8, maxsize=12,
+    niterations=2, seed=0,
+)
+
+#: The north-star island count (BASELINE.json npopulations=64) at a
+#: CPU-tractable npop; ``--northstar`` on a real pod raises npop too.
+NORTHSTAR = dict(
+    islands=64, npop=64, rows=1024, ncycles=4, maxsize=12,
+    niterations=1, seed=0,
+)
+
+
+def _search_kwargs(cfg: dict) -> dict:
+    return dict(
+        binary_operators=["+", "-", "*"],
+        unary_operators=["cos"],
+        npopulations=cfg["islands"],
+        npop=cfg["npop"],
+        ncycles_per_iteration=cfg["ncycles"],
+        maxsize=cfg["maxsize"],
+        should_optimize_constants=False,
+        verbosity=0, progress=False, runtests=False,
+    )
+
+
+def _data(cfg: dict):
+    import numpy as np
+
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((3, cfg["rows"])).astype(np.float32)
+    y = (2.0 * np.cos(X[2]) + X[0] * X[0] - 0.5).astype(np.float32)
+    return X, y
+
+
+def _frontier(res):
+    return [
+        (c.complexity, c.equation, float(c.loss)) for c in res.frontier()
+    ]
+
+
+def _carries_sharded(state, island_axis: str):
+    """True iff every leaf of the carried IslandState reports island-axis
+    NamedSharding (the no-replicated-carries acceptance check)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    for _, leaf in jax.tree_util.tree_flatten_with_path(
+        state.island_states
+    )[0]:
+        sh = getattr(leaf, "sharding", None)
+        spec = tuple(getattr(sh, "spec", ()) or ())
+        if not (
+            isinstance(sh, NamedSharding)
+            and spec
+            and spec[0] == island_axis
+        ):
+            return False
+    return True
+
+
+def run_capture(cfg: dict, emit=None) -> list:
+    """Run the sharded-vs-single capture on this process's devices;
+    returns (and optionally streams via ``emit``) suite-format rows."""
+    import jax
+
+    import symbolicregression_jl_tpu as sr
+    from symbolicregression_jl_tpu import api
+    from symbolicregression_jl_tpu.models.options import make_options
+    from symbolicregression_jl_tpu.parallel.mesh import (
+        describe_mesh,
+        make_mesh,
+    )
+
+    rows: list = []
+
+    def _row(rec):
+        rows.append(rec)
+        if emit is not None:
+            emit(rec)
+        return rec
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    kwargs = _search_kwargs(cfg)
+    if n_dev <= 1:
+        _row({
+            "suite": "multichip",
+            "skipped": "single-device",
+            "n_devices": n_dev,
+        })
+        return rows
+    opts_probe = make_options(**{
+        k: v for k, v in kwargs.items() if k != "runtests"
+    })
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        mesh = make_mesh(opts_probe, cfg["islands"], row_shards=1)
+    if mesh is None or int(mesh.devices.size) <= 1:
+        # make_mesh degraded all the way to one device (e.g. a prime
+        # island count on a 2-chip host): a "sharded" run would be a
+        # single-device run wearing a mesh — skip, and say why
+        _row({
+            "suite": "multichip",
+            "skipped": "shape-indivisible",
+            "n_devices": n_dev,
+            "islands": cfg["islands"],
+        })
+        return rows
+    mesh_info = describe_mesh(mesh)
+    X, y = _data(cfg)
+
+    def timed_search(single: bool, return_state: bool = False):
+        orig = api.make_mesh
+        if single:
+            api.make_mesh = lambda *a, **k: None
+        try:
+            # warm-up pays every compile; the factories' lru_caches hand
+            # the timed call the same compiled programs
+            sr.equation_search(
+                X, y, niterations=1, seed=cfg["seed"], **kwargs
+            )
+            t0 = time.perf_counter()
+            res = sr.equation_search(
+                X, y, niterations=cfg["niterations"], seed=cfg["seed"],
+                return_state=return_state, **kwargs,
+            )
+            wall = time.perf_counter() - t0
+        finally:
+            api.make_mesh = orig
+        return res, wall
+
+    res_m, wall_m = timed_search(single=False, return_state=True)
+    rate_m = res_m.num_evals * cfg["rows"] / wall_m
+    _row({
+        "suite": "multichip",
+        "case": "sharded",
+        "n_devices": mesh_info["n_devices"],
+        "mesh_shape": mesh_info["mesh_shape"],
+        "idle_devices": mesh_info["idle_devices"],
+        "device_kind": mesh_info["device_kind"],
+        "wall_s": wall_m,
+        "num_evals": res_m.num_evals,
+        "trees_rows_per_s": rate_m,
+    })
+
+    res_s, wall_s = timed_search(single=True)
+    rate_s = res_s.num_evals * cfg["rows"] / wall_s
+    _row({
+        "suite": "multichip",
+        "case": "single_device",
+        "wall_s": wall_s,
+        "num_evals": res_s.num_evals,
+        "trees_rows_per_s": rate_s,
+    })
+
+    speedup = wall_s / wall_m if wall_m > 0 else 0.0
+    _row({
+        "suite": "multichip",
+        "case": "summary",
+        "config": {k: cfg[k] for k in (
+            "islands", "npop", "rows", "ncycles", "niterations", "seed"
+        )},
+        "n_devices": mesh_info["n_devices"],
+        "mesh_shape": mesh_info["mesh_shape"],
+        "device_kind": mesh_info["device_kind"],
+        # islands-only sharding leaves per-island math unchanged, so the
+        # frontier must match the single-device run bit for bit
+        "hof_bit_identical": _frontier(res_m) == _frontier(res_s),
+        "carries_sharded": _carries_sharded(
+            res_m.state[0], opts_probe.island_axis
+        ),
+        "speedup_vs_single": speedup,
+        "scaling_efficiency": speedup / max(mesh_info["n_devices"], 1),
+        "host_cpu_count": os.cpu_count(),
+    })
+    return rows
+
+
+def write_latest(path: str, rows: list, platform: str) -> None:
+    """The one writer of MULTICHIP_*.json capture artifacts (both the
+    --out flag here and bench.py's on-chip branch go through it, so the
+    record shape cannot drift between producers)."""
+    with open(path, "w") as f:
+        json.dump(
+            {"captured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+             "platform": platform,
+             "rows": rows},
+            f, indent=2,
+        )
+        f.write("\n")
+
+
+def run_subprocess(extra_args=(), timeout=900, force_host=8):
+    """Run this capture in a FRESH subprocess (the virtual-device force
+    must precede backend init, and callers — bench.py, suite.py — own
+    their own backend) and parse its JSON rows off stdout.
+
+    Returns ``(rows, error)``: error is None when rows were captured,
+    else a short "rc=N: <stderr tail>" string. Single shared
+    implementation so the two call sites cannot drift."""
+    import subprocess
+
+    cmd = [
+        sys.executable, os.path.abspath(__file__),
+        "--force-host", str(force_host), *extra_args,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+    except subprocess.TimeoutExpired:
+        return [], f"timed out after {timeout}s"
+    rows = []
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    if not rows:
+        tail = (proc.stderr or "").strip().splitlines()[-2:]
+        return [], f"rc={proc.returncode}: " + " / ".join(tail)[:200]
+    return rows, None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--force-host", type=int, default=0, metavar="N",
+        help="force N virtual CPU devices (set BEFORE jax init; run "
+        "this file as a subprocess when the parent already owns a "
+        "backend)",
+    )
+    ap.add_argument("--northstar", action="store_true",
+                    help="the 64-island north-star config")
+    for k, v in DEFAULTS.items():
+        ap.add_argument(f"--{k}", type=int, default=None)
+    ap.add_argument("--out", default=None,
+                    help="also write {rows: [...]} JSON to this path")
+    ns = ap.parse_args()
+
+    if ns.force_host:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={ns.force_host}"
+            ).strip()
+    import jax
+
+    if ns.force_host:
+        # pure-CPU capture must never touch the axon tunnel's one slot
+        jax.config.update("jax_platforms", "cpu")
+
+    cfg = dict(NORTHSTAR if ns.northstar else DEFAULTS)
+    for k in DEFAULTS:
+        v = getattr(ns, k)
+        if v is not None:
+            cfg[k] = v
+
+    rows = run_capture(
+        cfg, emit=lambda rec: print(json.dumps(rec), flush=True)
+    )
+    if ns.out:
+        write_latest(ns.out, rows, jax.default_backend())
+    summary = next(
+        (r for r in rows if r.get("case") == "summary"), None
+    )
+    if summary is None:
+        return 0  # a skip is a successful verdict, not a failure
+    return 0 if summary["hof_bit_identical"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

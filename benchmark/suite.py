@@ -305,6 +305,24 @@ def bench_telemetry():
     return [row]
 
 
+def bench_multichip():
+    """Multi-chip island sharding (ISSUE 9): the REAL production
+    `equation_search` sharded over an 8-virtual-device (islands, rows)
+    mesh vs the same search on one device — benchmark/multichip.py in
+    its own subprocess (the capture forces 8 host CPU devices, which
+    must happen before ITS backend initializes, not ours). Reports
+    trees-rows/s both ways, speedup vs the 1-device wall clock, the
+    hall-of-fame bit-identity verdict, and the sharded-carry verdict
+    (every IslandState leaf island-sharded after the run)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from multichip import run_subprocess
+
+    rows, error = run_subprocess(timeout=900)
+    if error is not None:
+        return [{"suite": "multichip", "error": f"capture {error}"}]
+    return rows
+
+
 def bench_search_iteration():
     """Full-search throughput: one jitted evolution iteration (s_r_cycle +
     simplify + constant-opt + HoF merge + migration) over all islands —
@@ -711,6 +729,7 @@ _CASES = [
     (bench_single_eval_48_nodes, 600),
     (bench_population_scoring, 600),
     (bench_bucketed_eval, 900),
+    (bench_multichip, 1200),
     (bench_telemetry, 900),
     (bench_search_iteration, 1200),
     (bench_fitness_cache, 1200),

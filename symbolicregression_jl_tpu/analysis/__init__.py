@@ -47,9 +47,13 @@ def pin_platform() -> None:
     parses and traces — platform-independent work — and this image's
     sitecustomize would otherwise route backend init at the experimental
     TPU tunnel and hang on its single slot; same guard as
-    tests/conftest.py). SRTPU_ANALYSIS_PLATFORM overrides; empty string
-    leaves the default resolution alone. Shared by the two CLI entry
-    points (analysis.__main__ and scripts/lint.py)."""
+    tests/conftest.py). On the CPU pin, additionally force 8 virtual
+    host devices (the tests/conftest.py harness) so the compile-surface
+    `sharded` config always has a mesh to partition against — on one
+    real device the collective census could never run and the sharded
+    gate would silently skip. SRTPU_ANALYSIS_PLATFORM overrides; empty
+    string leaves the default resolution alone. Shared by the two CLI
+    entry points (analysis.__main__ and scripts/lint.py)."""
     import os
 
     platform = os.environ.get("SRTPU_ANALYSIS_PLATFORM", "cpu")
@@ -57,6 +61,12 @@ def pin_platform() -> None:
         import jax
 
         jax.config.update("jax_platforms", platform)
+        if platform == "cpu":
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
 
 
 def add_engine_args(parser) -> None:

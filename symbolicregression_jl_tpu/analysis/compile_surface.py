@@ -69,6 +69,26 @@ _MATRIX: Tuple[Tuple[str, dict], ...] = (
 #: config name for the phased (chunked-dispatch) closure set
 _CHUNKED = ("chunked", dict(max_cycles_per_dispatch=1))
 
+#: the island-sharded production surface (docs/multichip.md): the fused
+#: iteration jit carrying explicit NamedSharding in/out specs over an
+#: (islands, rows) mesh. 8 islands so an 8-virtual-device CPU harness
+#: (tests/conftest.py, analysis pin_platform) shards 1 island/device.
+#: Checked like every other config PLUS a collective census: the
+#: partitioned program's all-gather/all-reduce counts are part of the
+#: checked-in baseline, so a change that silently multiplies cross-chip
+#: traffic (or partitions the migration gather away entirely) fails CI.
+_SHARDED = ("sharded", dict(npopulations=8))
+
+#: HLO instruction names counted by the collective census (async
+#: -start/-done pairs count once, via the -start spelling).
+_COLLECTIVE_OPS = (
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
 _NFEAT, _NROWS = 3, 32
 
 
@@ -130,6 +150,19 @@ def _walk_avals(jaxpr):
                         yield from walk(s)
 
     return walk(jaxpr)
+
+
+def collective_census(hlo_text: str) -> Dict[str, int]:
+    """Count cross-device collective instructions in optimized HLO text.
+    Matches instruction applications (`" all-gather("` etc.); async
+    collectives are counted by their `-start` halves so a sync->async
+    lowering change does not read as a doubling."""
+    counts: Dict[str, int] = {}
+    for op in _COLLECTIVE_OPS:
+        n = hlo_text.count(f" {op}(") + hlo_text.count(f" {op}-start(")
+        if n:
+            counts[op] = n
+    return counts
 
 
 def forbidden_primitives(counts: Dict[str, int]) -> List[str]:
@@ -213,8 +246,17 @@ def _abstract_inputs(options, I: int):
     return states, key, cm, X, y, bl, scalars, memo, keys
 
 
-def _check_iteration_config(name: str, options) -> Tuple[dict, List[str]]:
-    """Fused single-jit iteration: aval stability + contract + census."""
+def _check_iteration_config(
+    name: str, options, mesh=None
+) -> Tuple[dict, List[str]]:
+    """Fused single-jit iteration: aval stability + contract + census.
+
+    mesh: additionally AOT-compiles the sharded program over it and
+    records (a) the collective census of the partitioned HLO (part of
+    the baseline diff) and (b) the output-sharding CONTRACT — every
+    carried IslandState leaf must come back island-sharded and the
+    merged HoF replicated; a partitioner change that silently
+    replicates the carry fails here, not in production."""
     import jax
 
     from ..api import _make_iteration_fn
@@ -224,7 +266,7 @@ def _check_iteration_config(name: str, options) -> Tuple[dict, List[str]]:
     states, key, cm, X, y, bl, scalars, memo, _ = _abstract_inputs(
         options, I
     )
-    it_fn = _make_iteration_fn(options, False)
+    it_fn = _make_iteration_fn(options, False, mesh=mesh)
     args = (states, key, cm, X, y, bl, scalars) + (
         (memo,) if memo is not None else ()
     )
@@ -256,7 +298,61 @@ def _check_iteration_config(name: str, options) -> Tuple[dict, List[str]]:
         "stable_avals": not any("aval drift" in p or "structure" in p
                                 for p in problems),
     }
+    if mesh is not None:
+        compiled = it_fn.lower(*args).compile()
+        entry["n_devices"] = int(mesh.devices.size)
+        entry["collectives"] = collective_census(compiled.as_text())
+        if not entry["collectives"]:
+            problems.append(
+                f"{name}: the partitioned iteration compiled to ZERO "
+                "cross-device collectives — the islands axis was "
+                "partitioned away (migration/HoF-merge no longer "
+                "communicate)"
+            )
+        problems += _sharding_contract_problems(
+            name, options, compiled, states
+        )
     return entry, problems
+
+
+def _sharding_contract_problems(
+    name: str, options, compiled, states_aval
+) -> List[str]:
+    """Assert the compiled output shardings: IslandState leaves pinned to
+    the island axis, merged HoF fully replicated."""
+    problems: List[str] = []
+    try:
+        out_sh = compiled.output_shardings
+    except Exception as e:  # pragma: no cover - jax API variance
+        return [f"{name}: could not read compiled output shardings: {e}"]
+    import jax
+
+    st_sh, ghof_sh = out_sh[0], out_sh[1]
+    n_sh = len(jax.tree_util.tree_leaves(st_sh))
+    n_aval = len(jax.tree_util.tree_leaves(states_aval))
+    if n_sh != n_aval:
+        problems.append(
+            f"{name}: compiled output-sharding tree has {n_sh} leaves "
+            f"but the IslandState aval has {n_aval} — the contract "
+            "check no longer covers the carry"
+        )
+    for path, sh in jax.tree_util.tree_flatten_with_path(st_sh)[0]:
+        spec = tuple(getattr(sh, "spec", ()) or ())
+        if not spec or spec[0] != options.island_axis:
+            problems.append(
+                f"{name}: carried IslandState leaf"
+                f"{jax.tree_util.keystr(path)} comes back with sharding "
+                f"{sh} instead of island-axis sharding — a replicated "
+                "carry serializes every later iteration on one device"
+            )
+    for path, sh in jax.tree_util.tree_flatten_with_path(ghof_sh)[0]:
+        if not sh.is_fully_replicated:
+            problems.append(
+                f"{name}: merged HoF leaf{jax.tree_util.keystr(path)} "
+                f"is not replicated ({sh}) — host-side candidate "
+                "extraction would gather per-iteration"
+            )
+    return problems
 
 
 def _check_phase_config(name: str, options) -> Tuple[dict, List[str]]:
@@ -275,8 +371,10 @@ def _check_phase_config(name: str, options) -> Tuple[dict, List[str]]:
     k = options.max_cycles_per_dispatch
     temps = jax.ShapeDtypeStruct((k,), jnp.float32)
     phase_args = {
+        # is_last positional: the phase jits take it via static_argnums
+        # (kwargs are rejected once a jit carries explicit in_shardings)
         "cycle": lambda f: f(
-            states, cm, X, y, None, bl, scalars, temps, is_last=True
+            states, cm, X, y, None, bl, scalars, temps, True
         ),
         "simplify": lambda f: f(
             states, cm, X, y, None, bl, scalars, memo=memo
@@ -327,6 +425,22 @@ def _check_phase_config(name: str, options) -> Tuple[dict, List[str]]:
     return entry, problems
 
 
+def _sharded_check_mesh(options):
+    """The (islands, rows) mesh the sharded surface config compiles
+    against: up to 8 local devices, islands only (row_shards=1 — the
+    bit-identity configuration). None when this host has one device."""
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return None
+    return make_mesh(
+        options, options.npopulations, devices=devices[:8], row_shards=1
+    )
+
+
 def diff_baseline(
     configs: Dict[str, dict], baseline: dict
 ) -> List[str]:
@@ -334,7 +448,12 @@ def diff_baseline(
     (refresh with --update-baseline when intentional)."""
     problems: List[str] = []
     base_configs = baseline.get("configs", {})
+    skipped = {
+        name for name, entry in configs.items() if "skipped" in entry
+    }
     for name, entry in configs.items():
+        if name in skipped:
+            continue  # e.g. sharded on a single-device host
         if name not in base_configs:
             problems.append(
                 f"baseline has no config {name!r} — run with "
@@ -351,8 +470,22 @@ def diff_baseline(
                     f"baseline {w} -> now {g} (intentional? refresh with "
                     "--update-baseline)"
                 )
+        # collective census (sharded configs): any drift in cross-device
+        # traffic shape is a compile-surface change, gated like the
+        # primitive counts
+        want_c = base_configs[name].get("collectives")
+        got_c = entry.get("collectives")
+        if want_c is not None or got_c is not None:
+            for op in sorted(set(want_c or {}) | set(got_c or {})):
+                w, g = (want_c or {}).get(op, 0), (got_c or {}).get(op, 0)
+                if w != g:
+                    problems.append(
+                        f"{name}: collective census drift for {op!r}: "
+                        f"baseline {w} -> now {g} (intentional? refresh "
+                        "with --update-baseline)"
+                    )
     for name in base_configs:
-        if name not in configs:
+        if name not in configs and name not in skipped:
             problems.append(
                 f"baseline config {name!r} no longer produced — refresh "
                 "with --update-baseline"
@@ -387,19 +520,44 @@ def check_surface(
         entry, probs = _check_phase_config(name, options)
         out_configs[name] = entry
         problems += probs
+    if configs is None:
+        name, extra = _SHARDED
+        options = make_options(**{**_BASE_KWARGS, **extra})
+        mesh = _sharded_check_mesh(options)
+        if mesh is None:
+            # diffed as "skipped", never as a missing config: a
+            # single-device host cannot partition anything
+            out_configs[name] = {
+                "skipped": f"{len(jax.devices())} device(s) — the "
+                "sharded surface needs >= 2"
+            }
+        else:
+            entry, probs = _check_iteration_config(name, options, mesh)
+            out_configs[name] = entry
+            problems += probs
 
     baseline_checked = baseline_match = False
     if update_baseline:
         from .report import write_baseline_json
 
+        from .report import build_baseline_configs
+
         payload = {
             "schema_version": 1,
             "jax_version": jax.__version__,
-            "configs": {
-                name: {"primitives": entry["primitives"],
-                       "total_primitives": entry["total_primitives"]}
-                for name, entry in out_configs.items()
-            },
+            # skipped configs (sharded on a single-device host) keep
+            # their prior checked-in entry instead of being deleted —
+            # see report.build_baseline_configs
+            "configs": build_baseline_configs(
+                baseline_path, out_configs,
+                lambda entry: {
+                    "primitives": entry["primitives"],
+                    "total_primitives": entry["total_primitives"],
+                    **({"collectives": entry["collectives"],
+                        "n_devices": entry["n_devices"]}
+                       if "collectives" in entry else {}),
+                },
+            ),
         }
         write_baseline_json(baseline_path, payload)
     elif os.path.exists(baseline_path):

@@ -304,11 +304,15 @@ def xla_stage_analysis(fn, args) -> dict:
 
 
 def _analyze_config(
-    name: str, options, xla_memory: bool
+    name: str, options, xla_memory: bool, mesh=None
 ) -> Tuple[dict, List[str]]:
     """One Options config: fused-iteration peak (the headline number —
     that is the program the production host loop dispatches) plus the
-    per-stage breakdown."""
+    per-stage breakdown. mesh traces the island-sharded production jit
+    (explicit in/out shardings; the `sharded` config) — the modeled
+    bytes are GLOBAL (the liveness walk sees logical avals), so its gate
+    catches whole-program regressions while the per-device footprint is
+    that number over the island shards."""
     import jax
 
     from ..api import _make_iteration_fn
@@ -318,7 +322,7 @@ def _analyze_config(
     states, key, cm, X, y, bl, scalars, memo, _ = _abstract_inputs(
         options, I
     )
-    it_fn = _make_iteration_fn(options, False)
+    it_fn = _make_iteration_fn(options, False, mesh=mesh)
     args = (states, key, cm, X, y, bl, scalars) + (
         (memo,) if memo is not None else ()
     )
@@ -353,6 +357,9 @@ def diff_memory_baseline(
     problems: List[str] = []
     notes: List[str] = []
     base_configs = baseline.get("configs", {})
+    skipped = {
+        name for name, entry in configs.items() if "skipped" in entry
+    }
 
     def check(tag: str, want: int, got: int) -> None:
         if want <= 0:
@@ -373,6 +380,8 @@ def diff_memory_baseline(
             )
 
     for name, entry in configs.items():
+        if name in skipped:
+            continue  # e.g. sharded on a single-device host
         if name not in base_configs:
             problems.append(
                 f"memory baseline has no config {name!r} — run with "
@@ -403,7 +412,7 @@ def diff_memory_baseline(
                     "being gated; refresh with --update-baseline"
                 )
     for name in base_configs:
-        if name not in configs:
+        if name not in configs and name not in skipped:
             problems.append(
                 f"memory baseline config {name!r} no longer produced — "
                 "refresh with --update-baseline"
@@ -432,9 +441,24 @@ def check_memory(
     out_configs: Dict[str, dict] = {}
     problems: List[str] = []
     notes: List[str] = []
+    if configs is None:
+        # the island-sharded production surface rides the same gate
+        # (docs/multichip.md); skipped — never missing — on one device
+        from .compile_surface import _SHARDED, _sharded_check_mesh
+
+        matrix = matrix + [_SHARDED]
     for name, extra in matrix:
         options = make_options(**{**_BASE_KWARGS, **extra})
-        entry, probs = _analyze_config(name, options, xla_memory)
+        mesh = None
+        if configs is None and name == _SHARDED[0]:
+            mesh = _sharded_check_mesh(options)
+            if mesh is None:
+                out_configs[name] = {
+                    "skipped": f"{len(jax.devices())} device(s) — the "
+                    "sharded surface needs >= 2"
+                }
+                continue
+        entry, probs = _analyze_config(name, options, xla_memory, mesh)
         out_configs[name] = entry
         problems += probs
         # the resident footprint one dispatch needs: its arguments (the
@@ -455,11 +479,16 @@ def check_memory(
 
     baseline_checked = baseline_match = False
     if update_baseline:
+        from .report import build_baseline_configs
+
         payload = {
             "schema_version": 1,
             "jax_version": jax.__version__,
-            "configs": {
-                name: {
+            # skipped configs (sharded on one device) keep their prior
+            # checked-in entry — see report.build_baseline_configs
+            "configs": build_baseline_configs(
+                baseline_path, out_configs,
+                lambda e: {
                     "peak_modeled_bytes": e["peak_modeled_bytes"],
                     "args_bytes": e["args_bytes"],
                     "stages": {
@@ -467,9 +496,8 @@ def check_memory(
                             se["peak_modeled_bytes"]}
                         for s, se in e["stages"].items()
                     },
-                }
-                for name, e in out_configs.items()
-            },
+                },
+            ),
         }
         write_baseline_json(baseline_path, payload)
     elif os.path.exists(baseline_path):

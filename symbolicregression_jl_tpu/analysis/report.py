@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 from typing import Dict, List, Optional
 
 from .rules import RULES, Violation
@@ -109,6 +110,33 @@ def write_baseline_json(path: str, payload: dict) -> None:
         f.write("\n")
 
 
+def build_baseline_configs(
+    baseline_path: str, out_configs: dict, build_entry
+) -> dict:
+    """The one refresh rule both baseline engines share: build each
+    config's baseline record with ``build_entry(entry)``, EXCEPT skipped
+    configs (e.g. the sharded surface on a single-device host) — those
+    are never written from the current (empty) run, but an entry the
+    checked-in baseline already has is PRESERVED, so refreshing on a
+    host that cannot produce a config never deletes its gate for the
+    hosts that can."""
+    prior: dict = {}
+    if os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                prior = json.load(f).get("configs", {})
+        except (OSError, json.JSONDecodeError):
+            prior = {}
+    new_configs: dict = {}
+    for name, entry in out_configs.items():
+        if "skipped" in entry:
+            if name in prior:
+                new_configs[name] = prior[name]
+            continue
+        new_configs[name] = build_entry(entry)
+    return new_configs
+
+
 def _mb(n: int) -> str:
     return f"{n / 1e6:.2f}MB" if n >= 100_000 else f"{n}B"
 
@@ -122,6 +150,9 @@ def render_memory_text(memory: dict) -> str:
     configs = memory.get("configs", {})
     for name in sorted(configs):
         entry = configs[name]
+        if "skipped" in entry:  # e.g. sharded on a single-device host
+            lines.append(f"srmem: {name}: skipped ({entry['skipped']})")
+            continue
         stages = entry.get("stages", {})
         top = max(
             stages.items(),

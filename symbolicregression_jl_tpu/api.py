@@ -50,7 +50,13 @@ from .models.population import (
 from .models.trees import TreeBatch
 from .ops.interpreter import eval_tree
 from .parallel.distributed import initialize_multihost, is_primary_host
-from .parallel.mesh import make_mesh, shard_dataset, shard_island_states
+from .parallel.mesh import (
+    describe_mesh,
+    make_mesh,
+    search_shardings,
+    shard_dataset,
+    shard_island_states,
+)
 from .parallel.migration import merge_hofs_across_islands, migrate
 from .utils.output import Candidate, hof_to_candidates, pareto_table, save_hof_csv
 from .utils.preflight import preflight_checks
@@ -189,8 +195,38 @@ def _donation_enabled() -> bool:
     return os.environ.get("SRTPU_DONATE", "1") != "0"
 
 
+def _iteration_shard_kw(options: Options, mesh, has_weights: bool):
+    """jit ``in_shardings``/``out_shardings`` for the fused-iteration
+    signature — the compiled sharding CONTRACT of the production search
+    (docs/multichip.md). Inputs: IslandState carry and the memo snapshot
+    island-sharded/replicated, X/y/weights row-sharded, everything scalar
+    replicated. Outputs: the carried IslandState PINNED island-sharded
+    (a replicated carry would silently serialize every later iteration
+    on one device), the merged HallOfFame replicated (host-side
+    candidate extraction and migrate()'s HoF sampling both want every
+    device holding it whole), recorder events island-sharded on dim 1
+    (the cycle scan stacks its axis in front). None mesh -> {} (plain
+    jit; the single-device graphs stay byte-identical)."""
+    if mesh is None:
+        return {}
+    sh = search_shardings(mesh, options)
+    isl, repl = sh["island"], sh["replicated"]
+    in_sh = [isl, repl, repl, sh["x"], sh["rows"]]
+    if has_weights:
+        in_sh.append(sh["rows"])
+    in_sh += [repl, repl]
+    if options.cache_fitness:
+        in_sh.append(repl)
+    out_sh = [isl, repl]
+    if options.recorder:
+        out_sh.append(sh["events"])
+    if options.cache_fitness:
+        out_sh.append(isl)
+    return dict(in_shardings=tuple(in_sh), out_shardings=tuple(out_sh))
+
+
 def _make_iteration_fn(options: Options, has_weights: bool,
-                       donate: bool = False):
+                       donate: bool = False, mesh=None):
     """One jitted function per Options GRAPH (Options hash/eq deliberately
     ignore the TRACED_SCALAR_FIELDS knobs); X/y/weights/baseline AND the
     scalar knobs are traced arguments, so multi-output searches, repeated
@@ -236,12 +272,24 @@ def _make_iteration_fn(options: Options, has_weights: bool,
     values: tests pin the donated search's HallOfFame bit-identical to
     the non-donated one. The thin wrapper normalizes `donate` so the
     2-arg and explicit-donate=False call forms share one lru_cache entry
-    (and one compile)."""
-    return _make_iteration_fn_cached(options, has_weights, bool(donate))
+    (and one compile).
+
+    mesh: a jax.sharding.Mesh (hashable — part of the cache key) makes
+    island-axis sharding a COMPILED CONTRACT of the returned function
+    via explicit in_shardings/out_shardings (_iteration_shard_kw): the
+    donated sharded carry comes back island-sharded every iteration
+    (donation aliases like-sharded buffers shard-for-shard), migration's
+    topn pool build lowers to one all-gather + local masked scatter, and
+    the merged HoF comes back replicated (no per-iteration device->host
+    gather of island state — host consumers read reduced or replicated
+    leaves only). mesh=None (the default, and every direct factory
+    caller) is the unchanged single-device program."""
+    return _make_iteration_fn_cached(options, has_weights, bool(donate),
+                                     mesh)
 
 
 @functools.lru_cache(maxsize=32)
-def _make_iteration_fn_cached(options, has_weights, donate):
+def _make_iteration_fn_cached(options, has_weights, donate, mesh=None):
 
     def one_iteration(
         states: IslandState,
@@ -298,8 +346,8 @@ def _make_iteration_fn_cached(options, has_weights, donate):
                 okeys2, states, X, y, weights, baseline, options_,
                 probability=p_sel, count_optimize_telemetry=True,
             )
-        ghof = merge_hofs_across_islands(states.hof)
-        states = migrate(k_mig, states, ghof, options_)
+        ghof = merge_hofs_across_islands(states.hof, mesh=mesh)
+        states = migrate(k_mig, states, ghof, options_, mesh=mesh)
         outs = (states, ghof)
         if options.recorder:
             outs = outs + (events,)
@@ -311,6 +359,7 @@ def _make_iteration_fn_cached(options, has_weights, donate):
     # non-donating default keeps functional semantics for direct callers
     # (benchmarks, compile_surface, tests that reuse a states pytree)
     donate_kw = dict(donate_argnums=(0,)) if donate else {}
+    donate_kw.update(_iteration_shard_kw(options, mesh, has_weights))
     if options.cache_fitness:
         if has_weights:
             return jax.jit(one_iteration, **donate_kw)
@@ -332,7 +381,7 @@ def _make_iteration_fn_cached(options, has_weights, donate):
 
 
 def _make_phase_fns(options: Options, has_weights: bool,
-                    donate: bool = False):
+                    donate: bool = False, mesh=None):
     """Jitted per-phase sub-programs of one evolution iteration, for the
     chunked-dispatch driver (options.max_cycles_per_dispatch): cycle
     chunks, simplify, constant-opt passes, and merge+migrate each compile
@@ -349,12 +398,18 @@ def _make_phase_fns(options: Options, has_weights: bool,
     bucketed/row-tiled evaluation graphs (eval_bucket_ladder /
     eval_rows_per_tile) thread through both drivers identically — the
     chunked-vs-fused and bucketed-vs-flat bit-identity guarantees
-    compose."""
-    return _make_phase_fns_cached(options, has_weights, bool(donate))
+    compose.
+
+    mesh: every phase carries the same explicit in/out sharding contract
+    as the fused iteration (_make_iteration_fn) — in particular each
+    phase's IslandState output is pinned island-sharded, so the chunked
+    driver's carry round-trips the mesh between dispatches without a
+    silent full replication at any phase boundary."""
+    return _make_phase_fns_cached(options, has_weights, bool(donate), mesh)
 
 
 @functools.lru_cache(maxsize=32)
-def _make_phase_fns_cached(options, has_weights, donate):
+def _make_phase_fns_cached(options, has_weights, donate, mesh=None):
 
     def _bind(scalars):
         return options.bind_scalars(scalars)
@@ -402,8 +457,8 @@ def _make_phase_fns_cached(options, has_weights, donate):
         )
 
     def merge_migrate(k_mig, states, scalars):
-        ghof = merge_hofs_across_islands(states.hof)
-        states = migrate(k_mig, states, ghof, _bind(scalars))
+        ghof = merge_hofs_across_islands(states.hof, mesh=mesh)
+        states = migrate(k_mig, states, ghof, _bind(scalars), mesh=mesh)
         return states, ghof
 
     # donate the IslandState carry of every phase (the driver threads one
@@ -413,18 +468,64 @@ def _make_phase_fns_cached(options, has_weights, donate):
     def _dk(states_argnum: int) -> dict:
         return dict(donate_argnums=(states_argnum,)) if donate else {}
 
+    # per-phase sharding contract (mesh=None -> plain jit): the states
+    # carry and per-island key batches island-sharded in AND out, data
+    # row-sharded, scalars/keys/memo replicated; the chunked driver then
+    # never leaves the mesh between phase dispatches
+    if mesh is None:
+        _sk = lambda in_sh, out_sh: {}
+    else:
+        _shv = search_shardings(mesh, options)
+
+        def _sk(in_sh, out_sh):
+            return dict(
+                in_shardings=tuple(_shv[k] for k in in_sh),
+                out_shardings=(
+                    tuple(_shv[k] for k in out_sh)
+                    if isinstance(out_sh, tuple) else _shv[out_sh]
+                ),
+            )
+
+    _data = ("x", "rows", "rows")  # X, y, weights (None weights: no-op)
+    _cycle_out = (
+        ("island", "events") if options.recorder else "island"
+    )
     return {
-        "cycle": jax.jit(cycle_chunk, static_argnames=("is_last",),
-                         **_dk(0)),
-        "simplify": jax.jit(simplify, **_dk(0)),
-        "optimize": jax.jit(optimize, **_dk(1)),
-        "optimize_mut": jax.jit(optimize_mut, **_dk(1)),
-        "merge_migrate": jax.jit(merge_migrate, **_dk(1)),
+        # is_last is static by POSITION: a jit carrying explicit
+        # in_shardings rejects every kwarg, static ones included — the
+        # driver passes it positionally
+        "cycle": jax.jit(
+            cycle_chunk, static_argnums=(8,), **_dk(0),
+            **_sk(("island", "replicated") + _data
+                  + ("replicated", "replicated", "replicated"),
+                  _cycle_out),
+        ),
+        "simplify": jax.jit(
+            simplify, **_dk(0),
+            **_sk(("island", "replicated") + _data
+                  + ("replicated", "replicated", "replicated"),
+                  "island"),
+        ),
+        "optimize": jax.jit(
+            optimize, **_dk(1),
+            **_sk(("island", "island") + _data
+                  + ("replicated", "replicated"), "island"),
+        ),
+        "optimize_mut": jax.jit(
+            optimize_mut, **_dk(1),
+            **_sk(("island", "island") + _data
+                  + ("replicated", "replicated"), "island"),
+        ),
+        "merge_migrate": jax.jit(
+            merge_migrate, **_dk(1),
+            **_sk(("replicated", "island", "replicated"),
+                  ("island", "replicated")),
+        ),
     }
 
 
 def _make_iteration_driver(options: Options, has_weights: bool,
-                           donate: bool = False, spans=None):
+                           donate: bool = False, spans=None, mesh=None):
     """The production iteration entry: returns a callable with the same
     signature/outputs as _make_iteration_fn's. With
     options.max_cycles_per_dispatch=None (default) that IS the fused
@@ -445,13 +546,13 @@ def _make_iteration_driver(options: Options, has_weights: bool,
     serializing the phase dispatches)."""
     k = options.max_cycles_per_dispatch
     if k is None and spans is None:
-        return _make_iteration_fn(options, has_weights, donate)
+        return _make_iteration_fn(options, has_weights, donate, mesh)
     if spans is None:
         # chunked dispatch without telemetry: no-op instrumentation
         # (no fences, no timing — the pre-telemetry chunked driver)
         from .telemetry.spans import NULL as spans
     k = k or options.ncycles_per_iteration
-    fns = _make_phase_fns(options, has_weights, donate)
+    fns = _make_phase_fns(options, has_weights, donate, mesh)
     ncycles = options.ncycles_per_iteration
     # One iteration-wide schedule, built EXACTLY as s_r_cycle_islands
     # builds it (jnp.linspace: f32 math — np.linspace computes in f64 and
@@ -482,7 +583,7 @@ def _make_iteration_driver(options: Options, has_weights: bool,
             for chunk, is_last in _chunks:
                 out = fns["cycle"](
                     states, curmaxsize, X, y, weights, baseline, scalars,
-                    chunk, is_last=is_last,
+                    chunk, is_last,
                 )
                 if options.recorder:
                     states, ev = out
@@ -491,9 +592,11 @@ def _make_iteration_driver(options: Options, has_weights: bool,
                     states = out
             sp.fence = states
         with spans.span("simplify") as sp:
+            # memo passed positionally: a jit carrying explicit
+            # in_shardings requires every sharded argument positional
             states = fns["simplify"](
                 states, curmaxsize, X, y, weights, baseline, scalars,
-                memo=memo,
+                memo,
             )
             sp.fence = states
         # post-simplify, pre-optimize: scoring-path values only (same
@@ -544,20 +647,24 @@ def _make_iteration_driver(options: Options, has_weights: bool,
 
 
 def _make_init_fn(options: Options, nfeatures: int, has_weights: bool,
-                  donate: bool = False):
+                  donate: bool = False, mesh=None):
     """Like _make_iteration_fn: the trailing REQUIRED `scalars` argument
     is `options.traced_scalars()` (initial scoring reads parsimony
     through it). donate=True donates the per-island key batch (argument
     0, aliasable onto the returned IslandState.key) — callers must pass
-    freshly split keys they never reuse. The thin wrapper normalizes
-    `donate` so the 3-arg and explicit-donate=False call forms share
-    one lru_cache entry (and one compile)."""
+    freshly split keys they never reuse. mesh makes the returned
+    IslandState island-sharded BY CONSTRUCTION (keys in and every state
+    leaf out pinned to the island axis): the search starts on the mesh
+    instead of initializing replicated and paying a reshard. The thin
+    wrapper normalizes `donate` so the 3-arg and explicit-donate=False
+    call forms share one lru_cache entry (and one compile)."""
     return _make_init_fn_cached(options, nfeatures, has_weights,
-                                bool(donate))
+                                bool(donate), mesh)
 
 
 @functools.lru_cache(maxsize=32)
-def _make_init_fn_cached(options, nfeatures, has_weights, donate):
+def _make_init_fn_cached(options, nfeatures, has_weights, donate,
+                         mesh=None):
 
     def init(keys, X, y, weights, baseline, scalars):
         options_ = options.bind_scalars(scalars)
@@ -569,6 +676,15 @@ def _make_init_fn_cached(options, nfeatures, has_weights, donate):
         )(keys)
 
     donate_kw = dict(donate_argnums=(0,)) if donate else {}
+    if mesh is not None:
+        sh = search_shardings(mesh, options)
+        in_sh = [sh["island"], sh["x"], sh["rows"]]
+        if has_weights:
+            in_sh.append(sh["rows"])
+        in_sh += [sh["replicated"], sh["replicated"]]
+        donate_kw.update(
+            in_shardings=tuple(in_sh), out_shardings=sh["island"]
+        )
     if has_weights:
         return jax.jit(init, **donate_kw)
     return jax.jit(
@@ -820,12 +936,16 @@ def equation_search(
             x_shape=[int(s) for s in X.shape],
             package_version=_pkg_version,
             options=repr_options(options),
+            # the mesh actually driving this run (None mesh_shape =
+            # single-device): a degraded mesh choice (idle devices) is
+            # part of the machine-readable record, not just a warning
+            **describe_mesh(mesh),
         )
         spans_rec = SpanRecorder(sink)
         search_metrics = SearchMetrics(options, sink)
 
     iteration_fn = _make_iteration_driver(
-        options, weights is not None, donate, spans=spans_rec
+        options, weights is not None, donate, spans=spans_rec, mesh=mesh
     )
     # this Options' trace-irrelevant scalar knobs, passed to every jitted
     # call (the factories' lru_caches dedup Options differing only in
@@ -919,7 +1039,7 @@ def equation_search(
             k_init, key = jax.random.split(key)
             init_keys = jax.random.split(k_init, I)
             init_fn = _make_init_fn(options, nfeatures, wj is not None,
-                                    donate)
+                                    donate, mesh)
             if spans_rec is not None:
                 with spans_rec.span("init", output=_j) as sp:
                     if wj is not None:

@@ -17,7 +17,8 @@ parallel/distributed.py.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import warnings
+from typing import Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -36,7 +37,11 @@ def make_mesh(
 
     Uses the largest device count d <= len(devices) such that d divides
     n_islands * row_shards layouts cleanly; returns None for a single
-    device (plain jit, no sharding needed)."""
+    device (plain jit, no sharding needed). When the division forces
+    devices to sit idle (e.g. 8 devices, 6 islands -> a 6x1 mesh), the
+    choice is loud: a warning names the mesh and the idle devices, so a
+    quietly-degraded production run is visible in the log (and in the
+    telemetry ``run_start`` event via :func:`describe_mesh`)."""
     devices = devices if devices is not None else jax.devices()
     n_dev = len(devices)
     if n_dev <= 1:
@@ -46,8 +51,56 @@ def make_mesh(
     while island_shards > 1 and n_islands % island_shards != 0:
         island_shards -= 1
     use = island_shards * row_shards
+    if use < n_dev:
+        # name the knob actually responsible: a row_shards that does not
+        # divide the device count wastes the remainder even when the
+        # island count tiles perfectly
+        if n_dev % row_shards != 0:
+            remedy = (
+                f"Pick row_shards dividing {n_dev} (and npopulations "
+                f"divisible by the islands axis) to use every device."
+            )
+        else:
+            remedy = (
+                f"Pick npopulations divisible by {n_dev // row_shards} "
+                "(or adjust row_shards) to use every device."
+            )
+        warnings.warn(
+            f"make_mesh: npopulations={n_islands} with row_shards="
+            f"{row_shards} does not tile {n_dev} devices — using a "
+            f"({island_shards}, {row_shards}) ({options.island_axis}, "
+            f"{options.row_axis}) mesh on {use} device(s) and leaving "
+            f"{n_dev - use} idle ({', '.join(str(d) for d in devices[use:])}). "
+            + remedy,
+            stacklevel=2,
+        )
     dev_array = np.array(devices[:use]).reshape(island_shards, row_shards)
     return Mesh(dev_array, (options.island_axis, options.row_axis))
+
+
+def describe_mesh(mesh: Optional[Mesh], devices=None) -> Dict:
+    """Machine-readable mesh facts for telemetry/bench records:
+    ``mesh_shape`` ({axis: size}, None when unsharded), ``n_devices``
+    (devices the mesh actually uses; 1 when unsharded), ``idle_devices``
+    (available-but-unused device count), ``device_kind``."""
+    devices = devices if devices is not None else jax.devices()
+    if mesh is None:
+        return {
+            "mesh_shape": None,
+            "n_devices": 1,
+            "idle_devices": max(0, len(devices) - 1),
+            "device_kind": devices[0].device_kind if devices else None,
+        }
+    use = int(mesh.devices.size)
+    return {
+        "mesh_shape": {
+            str(name): int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)
+        },
+        "n_devices": use,
+        "idle_devices": max(0, len(devices) - use),
+        "device_kind": mesh.devices.ravel()[0].device_kind,
+    }
 
 
 def island_sharding(mesh: Optional[Mesh], options: Options):
@@ -65,6 +118,41 @@ def data_sharding(mesh: Optional[Mesh], options: Options, rows_dim: int = 1):
     spec = [None, None]
     spec[rows_dim] = options.row_axis
     return NamedSharding(mesh, P(*spec))
+
+
+def replicated_sharding(mesh: Optional[Mesh]):
+    """Fully-replicated NamedSharding over the mesh (scalars, PRNG keys,
+    the merged hall of fame — everything every device must hold whole)."""
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, P())
+
+
+def search_shardings(mesh: Optional[Mesh], options: Options):
+    """The sharding vocabulary of one search iteration, as a dict the
+    api.py jit factories thread into ``in_shardings``/``out_shardings``
+    (the compiled contract of the production drivers —
+    docs/multichip.md):
+
+    - ``island``: leading-axis island parallelism — every IslandState
+      leaf, the per-island PRNG key batches, and the memo-absorb
+      snapshot;
+    - ``replicated``: scalars, iteration keys, the merged HallOfFame;
+    - ``x`` / ``rows``: dataset sharding over the rows axis (features
+      replicated);
+    - ``events``: recorder MutationEvents — cycle-scan outputs stack the
+      scan axis in front, so the island axis is dim 1.
+
+    None mesh -> None (plain jit, no sharding arguments)."""
+    if mesh is None:
+        return None
+    return {
+        "island": NamedSharding(mesh, P(options.island_axis)),
+        "replicated": NamedSharding(mesh, P()),
+        "x": NamedSharding(mesh, P(None, options.row_axis)),
+        "rows": NamedSharding(mesh, P(options.row_axis)),
+        "events": NamedSharding(mesh, P(None, options.island_axis)),
+    }
 
 
 def put_global(x, sharding):

@@ -14,10 +14,11 @@ locally.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.evolve import IslandState
 from ..models.options import Options
@@ -56,10 +57,18 @@ def migrate(
     states: IslandState,
     global_hof: HallOfFame,
     options: Options,
+    mesh: Optional[Mesh] = None,
 ) -> IslandState:
     """Replace random slots of every island with pool / hall-of-fame members
     (reference src/Migration.jl:15-35; fractions
-    fraction_replaced=3.6e-4, fraction_replaced_hof=0.035 per member)."""
+    fraction_replaced=3.6e-4, fraction_replaced_hof=0.035 per member).
+
+    mesh: when the caller's jit is sharded over the island axis, the topn
+    pool is pinned fully replicated here — the pool build then lowers to
+    ONE all-gather of the (I*topn,) winner slices over the mesh, and the
+    masked scatter-replace below stays device-local (GSPMD left free
+    would otherwise gather whole populations for the `pool_field[choice]`
+    cross-island indexing). None keeps the unsharded program unchanged."""
     if not options.migration:
         return states
     I = states.pop.scores.shape[0]
@@ -67,6 +76,12 @@ def migrate(
     topn = min(options.topn, npop)
 
     pool_trees, pool_scores, pool_losses = _topn_pool(states, topn)
+    if mesh is not None:
+        repl = NamedSharding(mesh, P())
+        constrain = lambda x: jax.lax.with_sharding_constraint(x, repl)
+        pool_trees = jax.tree_util.tree_map(constrain, pool_trees)
+        pool_scores = constrain(pool_scores)
+        pool_losses = constrain(pool_losses)
     pool_size = I * topn
 
     k1, k2, k3, k4 = jax.random.split(key, 4)
@@ -126,10 +141,18 @@ def migrate(
     )
 
 
-def merge_hofs_across_islands(hofs: HallOfFame) -> HallOfFame:
+def merge_hofs_across_islands(
+    hofs: HallOfFame, mesh: Optional[Mesh] = None
+) -> HallOfFame:
     """Per-slot argmin-loss across the islands axis. Under a sharded jit the
     argmin lowers to a cross-island reduction over ICI (the analog of the
-    head-node HoF merge, reference src/SymbolicRegression.jl:722-744)."""
+    head-node HoF merge, reference src/SymbolicRegression.jl:722-744).
+
+    mesh: pins the merged result fully replicated — every device holds
+    the whole global hall of fame, so the migrate() HoF sampling that
+    consumes it stays device-local and the host-side candidate
+    extraction reads a replicated array instead of triggering a
+    per-iteration cross-device gather."""
     masked = jnp.where(hofs.exists, hofs.losses, jnp.inf)  # (I, S)
     best_i = jnp.argmin(masked, axis=0)  # (S,)
     S = best_i.shape[0]
@@ -139,9 +162,15 @@ def merge_hofs_across_islands(hofs: HallOfFame) -> HallOfFame:
             x, best_i.reshape((1, S) + (1,) * (x.ndim - 2)), axis=0
         )[0]
 
-    return HallOfFame(
+    merged = HallOfFame(
         trees=jax.tree_util.tree_map(pick, hofs.trees),
         scores=pick(hofs.scores),
         losses=pick(hofs.losses),
         exists=jnp.any(hofs.exists, axis=0),
     )
+    if mesh is not None:
+        repl = NamedSharding(mesh, P())
+        merged = jax.tree_util.tree_map(
+            lambda x: jax.lax.with_sharding_constraint(x, repl), merged
+        )
+    return merged

@@ -261,6 +261,54 @@ def test_baseline_diff_catches_injected_primitive(tmp_path):
 
 
 @pytest.mark.fast
+def test_baseline_diff_collective_census_and_skip():
+    """The sharded config's collective census diffs like the primitive
+    counts, and a skipped config (single-device host) is exempt in both
+    directions instead of reading as missing/unknown."""
+    from symbolicregression_jl_tpu.analysis.compile_surface import (
+        diff_baseline,
+    )
+
+    baseline = {
+        "configs": {
+            "sharded": {
+                "primitives": {"add": 3},
+                "collectives": {"all-gather": 16, "all-reduce": 14},
+            },
+        }
+    }
+    clean = {"sharded": {"primitives": {"add": 3},
+                         "collectives": {"all-gather": 16,
+                                         "all-reduce": 14}}}
+    assert diff_baseline(clean, baseline) == []
+    drifted = {"sharded": {"primitives": {"add": 3},
+                           "collectives": {"all-gather": 17,
+                                           "all-reduce": 14}}}
+    probs = diff_baseline(drifted, baseline)
+    assert len(probs) == 1 and "all-gather" in probs[0]
+    vanished = {"sharded": {"primitives": {"add": 3}, "collectives": {}}}
+    probs = diff_baseline(vanished, baseline)
+    assert len(probs) == 2  # both collective counts dropped to 0
+    skipped = {"sharded": {"skipped": "1 device(s)"}}
+    assert diff_baseline(skipped, baseline) == []
+
+
+@pytest.mark.fast
+def test_collective_census_counts_hlo_ops():
+    from symbolicregression_jl_tpu.analysis.compile_surface import (
+        collective_census,
+    )
+
+    hlo = (
+        "%ag = f32[8,4]{1,0} all-gather(f32[1,4]{1,0} %p), dims={0}\n"
+        "%ar = f32[] all-reduce(f32[] %x), to_apply=%sum\n"
+        "%ag2.s = f32[8]{0} all-gather-start(f32[1]{0} %q)\n"
+        "%ag2.d = f32[8]{0} all-gather-done(f32[8]{0} %ag2.s)\n"
+    )
+    assert collective_census(hlo) == {"all-gather": 2, "all-reduce": 1}
+
+
+@pytest.mark.fast
 def test_checked_in_baseline_exists_and_well_formed():
     from symbolicregression_jl_tpu.analysis.compile_surface import (
         BASELINE_PATH,
@@ -271,12 +319,20 @@ def test_checked_in_baseline_exists_and_well_formed():
     assert payload["schema_version"] == 1
     assert set(payload["configs"]) == {
         "base", "cache", "islands4", "pop32", "bucketed", "chunked",
+        "sharded",
     }
     for entry in payload["configs"].values():
         assert entry["total_primitives"] == sum(
             entry["primitives"].values()
         )
         assert not any("callback" in p for p in entry["primitives"])
+    # the sharded config additionally pins the collective census — the
+    # cross-device traffic shape of the partitioned iteration
+    sharded = payload["configs"]["sharded"]
+    assert sharded["n_devices"] >= 2
+    assert sharded["collectives"] and all(
+        n > 0 for n in sharded["collectives"].values()
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -416,7 +472,7 @@ def test_checked_in_memory_baseline_exists_and_well_formed():
         payload = json.load(f)
     assert payload["schema_version"] == 1
     assert set(payload["configs"]) == {
-        "base", "cache", "islands4", "pop32", "bucketed",
+        "base", "cache", "islands4", "pop32", "bucketed", "sharded",
     }
     for entry in payload["configs"].values():
         assert entry["peak_modeled_bytes"] > 0
