@@ -865,6 +865,7 @@ def _roofline_skip_reason(platform, pallas_routed, error=None):
 
 
 def main(verbose=True):
+    t_main_start = time.time()
     devices = _devices_or_cpu_fallback(verbose)
 
     import jax
@@ -1114,6 +1115,19 @@ def main(verbose=True):
         None if roofline_fraction is not None
         else _roofline_skip_reason(platform, pallas_routed, roofline_error)
     )
+    # the event log carries the roofline verdict too (fraction OR the
+    # machine-checkable skip reason — never a silent null): the run
+    # doctor (telemetry.analyze) and TRAJECTORY.json read it from here
+    # whenever the eval-stage span exists, so a probe re-exec or a
+    # downstream consumer that only has the log still sees WHY the
+    # fraction is absent
+    if sink is not None:
+        sink.emit(
+            "roofline",
+            fraction=roofline_fraction,
+            skip_reason=roofline_skip_reason,
+            trees_rows_per_s=value,
+        )
 
     # ---- multi-chip real-search capture (benchmark/multichip.py):
     # the production equation_search sharded over an island mesh vs the
@@ -1198,6 +1212,27 @@ def main(verbose=True):
             if verbose:
                 print(f"# host multichip capture failed: {e}",
                       file=sys.stderr)
+    # ---- round-over-round trajectory (scripts/bench_trajectory.py):
+    # the checked-in BENCH_r*/MULTICHIP_* series + regression flags ride
+    # along in the artifact, so a drop is visible the moment this JSON
+    # lands (a report, never a gate — and never allowed to sink the
+    # bench). ----
+    trajectory = None
+    try:
+        _scripts = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"
+        )
+        if _scripts not in sys.path:
+            sys.path.insert(0, _scripts)
+        from bench_trajectory import bench_summary, build_trajectory
+
+        trajectory = bench_summary(
+            build_trajectory(os.path.dirname(os.path.abspath(__file__)))
+        )
+    except Exception as e:  # pragma: no cover - defensive
+        if verbose:
+            print(f"# trajectory unavailable: {e}", file=sys.stderr)
+
     out = {
         "metric": (
             "population fitness-eval throughput, Feynman-I.6.2a "
@@ -1235,11 +1270,22 @@ def main(verbose=True):
         # the skip reason names why no ON-PLATFORM capture exists
         "multichip": multichip_rows,
         "multichip_skip_reason": multichip_skip_reason,
+        # round-over-round series + regression flags (bench_trajectory)
+        "trajectory": trajectory,
         "telemetry_event_log": sink.path if sink is not None else None,
     }
     if platform == "cpu":
         out["last_tpu"] = _last_tpu_block()
     if sink is not None:
+        # close the trail properly: consumers (telemetry.analyze, the
+        # watcher's --telemetry-dir classifier) treat a log without
+        # run_end as still-in-flight/killed — a finished bench must
+        # read as completed
+        sink.emit(
+            "run_end",
+            num_evals=float(min(n_trees, CHUNK)),
+            search_time_s=time.time() - t_main_start,
+        )
         sink.close()
     print(json.dumps(out))
 

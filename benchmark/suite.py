@@ -244,6 +244,21 @@ def bench_bucketed_eval():
     ]
 
 
+def _suite_telemetry_dir(prefix):
+    """Per-case telemetry directory. When the watcher exported
+    SRTPU_BENCH_TELEMETRY_DIR (tpu_watcher.py --telemetry-dir) the logs
+    land THERE, so its event-log classifier sees this case's
+    run_start/dispatch_fault/saved_state/run_end trail instead of
+    falling back to stdout scraping; otherwise a private tmpdir."""
+    import tempfile
+
+    d = os.environ.get("SRTPU_BENCH_TELEMETRY_DIR")
+    if d:
+        os.makedirs(d, exist_ok=True)
+        return d
+    return tempfile.mkdtemp(prefix=prefix)
+
+
 def bench_telemetry():
     """Unified search telemetry (ISSUE 7): a short search with
     Options.telemetry writes a JSONL event log. Asserts the log parses
@@ -251,15 +266,13 @@ def bench_telemetry():
     (telemetry/event_schema_v1.json), and contains all seven stage spans
     — and reports the per-stage wall time columns, the per-iteration
     observability the fused engine never had."""
-    import tempfile
-
     import symbolicregression_jl_tpu as sr
     from symbolicregression_jl_tpu.telemetry import (
         STAGES,
         validate_events_file,
     )
 
-    d = tempfile.mkdtemp(prefix="srtpu_suite_telemetry_")
+    d = _suite_telemetry_dir("srtpu_suite_telemetry_")
     rng = np.random.default_rng(0)
     X = rng.standard_normal((3, 128)).astype(np.float32)
     y = 2.0 * np.cos(X[2]) + X[0] ** 2 - 0.5
@@ -272,13 +285,16 @@ def bench_telemetry():
         telemetry=True, telemetry_dir=d,
     )
     wall_s = time.perf_counter() - t0
+    # newest log: a shared watcher telemetry dir may hold earlier runs
     paths = sorted(
-        os.path.join(d, f) for f in os.listdir(d) if f.endswith(".jsonl")
+        (os.path.join(d, f) for f in os.listdir(d)
+         if f.endswith(".jsonl")),
+        key=os.path.getmtime,
     )
-    report = validate_events_file(paths[0])
+    report = validate_events_file(paths[-1])
     stage_s = {s: 0.0 for s in STAGES}
     n_metrics = 0
-    with open(paths[0]) as f:
+    with open(paths[-1]) as f:
         for line in f:
             e = json.loads(line)
             if e["type"] == "span" and e["name"] in stage_s:
@@ -294,7 +310,7 @@ def bench_telemetry():
         "metrics_events": n_metrics,
         "search_wall_s": wall_s,
         "hof_size": len(r.frontier()),
-        "event_log": paths[0],
+        "event_log": paths[-1],
     }
     # one stage-time column per stage, the per-stage attribution rows
     # downstream dashboards join on (mutate/eval are one-shot probe
@@ -303,6 +319,58 @@ def bench_telemetry():
     if report["problems"]:
         row["schema_problems"] = report["problems"][:3]
     return [row]
+
+
+def bench_run_doctor():
+    """Run doctor end to end (ISSUE 10): a tiny search with telemetry on
+    must yield an event log the doctor reads as HEALTHY — all seven
+    stage spans present, per-island diversity in (0, 1], the exact
+    hypervolume and per-mutation acceptance populated. This is the
+    closed loop: the search writes the trail, the analyzer interprets
+    it, and CI asserts the interpretation."""
+    import symbolicregression_jl_tpu as sr
+    from symbolicregression_jl_tpu.telemetry.analyze import (
+        analyze_run,
+        resolve_log,
+    )
+
+    d = _suite_telemetry_dir("srtpu_suite_doctor_")
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((3, 128)).astype(np.float32)
+    y = 2.0 * np.cos(X[2]) + X[0] ** 2 - 0.5
+    t0 = time.perf_counter()
+    sr.equation_search(
+        X, y,
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        npopulations=4, npop=24, ncycles_per_iteration=30, maxsize=12,
+        niterations=2, seed=0, verbosity=0, progress=False,
+        telemetry=True, telemetry_dir=d,
+    )
+    wall_s = time.perf_counter() - t0
+    report = analyze_run(resolve_log(d))
+    div = report.get("diversity") or {}
+    div_ok = bool(div) and 0.0 < div["last"] <= 1.0
+    return [{
+        "suite": "run_doctor",
+        "case": "healthy_search",
+        "ok": (
+            report["verdict"] == "healthy"
+            and report["spans_complete"]
+            and div_ok
+        ),
+        "verdict": report["verdict"],
+        "spans_complete": report["spans_complete"],
+        "diversity_last": div.get("last"),
+        "diversity_ok": div_ok,
+        "hypervolume_last": (report.get("hypervolume") or {}).get("last"),
+        "best_loss_last": (report.get("best_loss") or {}).get("last"),
+        "mutation_accept_rate": (
+            report.get("mutation_accept_rate") or {}
+        ).get("last"),
+        "metric_snapshots": report.get("metric_snapshots"),
+        "search_wall_s": wall_s,
+        "event_log": report.get("path"),
+    }]
 
 
 def bench_multichip():
@@ -731,6 +799,7 @@ _CASES = [
     (bench_bucketed_eval, 900),
     (bench_multichip, 1200),
     (bench_telemetry, 900),
+    (bench_run_doctor, 900),
     (bench_search_iteration, 1200),
     (bench_fitness_cache, 1200),
     (bench_precision_ratio, 1200),

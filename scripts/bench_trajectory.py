@@ -1,0 +1,455 @@
+#!/usr/bin/env python
+"""Bench-trajectory aggregator: the per-round artifacts -> one
+machine-readable TRAJECTORY.json + human TRAJECTORY.md, with per-metric
+regression detection.
+
+Every round leaves ``BENCH_rNN.json`` (the headline bench capture) and
+``MULTICHIP_rNN.json`` / ``MULTICHIP_LATEST.json`` (dryrun, then
+real-search sharding evidence) at the repo root — but until now nothing
+joined them, so "is throughput trending up? did roofline_fraction ever
+move? did multichip scaling regress?" meant opening five files by hand
+(ROADMAP #3 explicitly flags the untracked roofline_fraction trend; the
+ROADMAP's own bench-trajectory paragraph was being maintained by hand).
+
+This script builds, per metric, a round-indexed series and flags
+regressions: a round whose value dropped more than ``--threshold``
+(default 10%) below the best earlier value captured on the SAME
+platform (a CPU-fallback round is not a regression against an on-chip
+round — the platform column keeps the comparison apples-to-apples).
+Everything is a REPORT, not a gate: scripts/lint.py prints it
+non-fatally and bench.py embeds a summary in its JSON, so a regression
+is visible the moment the artifact lands without ever blocking a
+capture.
+
+Tolerant by design: BENCH_r04-style records whose ``parsed`` block is
+empty fall back to scanning the step's stdout tail for the headline
+JSON line; missing files and dryrun-era MULTICHIP records (no
+scaling_efficiency yet) contribute null points, never errors.
+
+Usage:
+    python scripts/bench_trajectory.py [--repo DIR] [--threshold 0.1]
+        [--no-write] [--print]
+
+Writes TRAJECTORY.json + TRAJECTORY.md at the repo root by default.
+Exit is always 0 unless the repo holds no rounds at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: higher-is-better metrics tracked round-over-round. value = headline
+#: trees-rows/s; the rest are ratios in [0, ~]. Lower-is-better columns
+#: (first_call_s) are recorded in the rounds but not regression-gated —
+#: compile time is dominated by cache state, not code.
+METRICS = (
+    "throughput",
+    "vs_baseline",
+    "roofline_fraction",
+    "interp_bucketed_vs_flat",
+    "multichip_scaling_efficiency",
+    "multichip_speedup",
+)
+
+DEFAULT_THRESHOLD = 0.10
+
+
+def _headline_from_tail(tail: str):
+    """BENCH_r04 regression-proofing: when the round record's ``parsed``
+    is empty, the headline JSON line (the one carrying vs_baseline) is
+    usually still in the captured stdout tail."""
+    tail = tail or ""
+    for line in tail.splitlines():
+        line = line.strip()
+        if line.startswith("{") and '"vs_baseline"' in line:
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict) and "vs_baseline" in obj:
+                return obj
+    # r04-style damage: the tail is one truncated mega-line with the
+    # headline object EMBEDDED mid-string — raw_decode from each
+    # '{"metric"' anchor still recovers it
+    dec = json.JSONDecoder()
+    for m in re.finditer(r'\{"metric"', tail):
+        try:
+            obj, _ = dec.raw_decode(tail, m.start())
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "vs_baseline" in obj:
+            return obj
+    # last resort (the actual r04 file): only the `last_tpu` embed's
+    # trailing on-chip headline pair survived the truncation. Those two
+    # fields are, by construction (bench._last_tpu_block), the last
+    # ON-CHIP bench values — platform tpu, not the fallback CPU run.
+    pairs = re.findall(
+        r'"value":\s*([0-9.eE+-]+),\s*"vs_baseline":\s*([0-9.eE+-]+)',
+        tail,
+    )
+    if pairs:
+        v, b = pairs[-1]
+        try:
+            return {
+                "value": float(v), "vs_baseline": float(b),
+                "platform": "tpu", "recovered_from": "last_tpu_tail",
+            }
+        except ValueError:
+            pass
+    return None
+
+
+def _round_no(path: str):
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def round_label(r) -> str:
+    """'r04' for integer rounds, the literal tag otherwise ('latest',
+    None) — every formatter must go through this: a regression entry can
+    legitimately carry round='latest' (the MULTICHIP_LATEST point)."""
+    return f"r{r:02d}" if isinstance(r, int) else str(r)
+
+
+def _num(v):
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
+        else None
+
+
+def _multichip_summary(rows):
+    """The summary row of a benchmark/multichip.py capture (list of
+    suite rows), or None."""
+    if not isinstance(rows, list):
+        return None
+    return next(
+        (r for r in rows
+         if isinstance(r, dict) and r.get("case") == "summary"),
+        None,
+    )
+
+
+def load_bench_round(path: str):
+    """One BENCH_rNN.json -> a trajectory point (never raises)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"source": os.path.basename(path),
+                "error": f"{type(e).__name__}: {e}"}
+    parsed = data.get("parsed") or {}
+    if not parsed.get("vs_baseline"):
+        parsed = _headline_from_tail(data.get("tail")) or parsed
+    point = {
+        "round": _round_no(path) or data.get("n"),
+        "source": os.path.basename(path),
+        "platform": parsed.get("platform"),
+        "tunnel_state": parsed.get("tunnel_state"),
+        "throughput": _num(parsed.get("value")),
+        "vs_baseline": _num(parsed.get("vs_baseline")),
+        "roofline_fraction": _num(parsed.get("roofline_fraction")),
+        "roofline_skip_reason": parsed.get("roofline_skip_reason"),
+        "interp_bucketed_vs_flat": _num(
+            parsed.get("interp_bucketed_vs_flat")
+        ),
+        "first_call_s": _num(parsed.get("first_call_s")),
+    }
+    mc = _multichip_summary(parsed.get("multichip"))
+    if mc is not None:
+        point["multichip_scaling_efficiency"] = _num(
+            mc.get("scaling_efficiency")
+        )
+        point["multichip_speedup"] = _num(mc.get("speedup_vs_single"))
+    return point
+
+
+def load_multichip_record(path: str):
+    """One MULTICHIP_*.json -> a trajectory point. Handles both the
+    dryrun era ({n_devices, ok, rc, skipped, tail}) and the real-search
+    capture format ({platform, rows: [...]})."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"source": os.path.basename(path),
+                "error": f"{type(e).__name__}: {e}"}
+    name = os.path.basename(path)
+    point = {
+        "round": _round_no(path) if _round_no(path) is not None
+        else "latest",
+        "source": name,
+    }
+    if "rows" in data:  # real-search capture (benchmark/multichip.py)
+        point["platform"] = data.get("platform")
+        mc = _multichip_summary(data.get("rows"))
+        if mc is not None:
+            point["multichip_scaling_efficiency"] = _num(
+                mc.get("scaling_efficiency")
+            )
+            point["multichip_speedup"] = _num(mc.get("speedup_vs_single"))
+            point["hof_bit_identical"] = mc.get("hof_bit_identical")
+            point["n_devices"] = mc.get("n_devices")
+    else:  # dryrun era
+        point["dryrun_ok"] = bool(data.get("ok"))
+        point["n_devices"] = data.get("n_devices")
+    return point
+
+
+def detect_regressions(points, metrics=METRICS,
+                       threshold: float = DEFAULT_THRESHOLD):
+    """Per metric: flag every point whose value sits more than
+    `threshold` below the best EARLIER value on the same platform.
+    Null points neither regress nor set the bar."""
+    out = []
+    for metric in metrics:
+        best_by_platform = {}
+        for p in points:
+            v = _num(p.get(metric))
+            plat = p.get("platform")
+            if v is None:
+                continue
+            best = best_by_platform.get(plat)
+            if best is not None and v < best["value"] * (1 - threshold):
+                out.append({
+                    "metric": metric,
+                    "round": p.get("round"),
+                    "platform": plat,
+                    "value": v,
+                    "best_prev": best["value"],
+                    "best_prev_round": best["round"],
+                    "drop_frac": round(1 - v / best["value"], 4),
+                })
+            if best is None or v > best["value"]:
+                best_by_platform[plat] = {
+                    "value": v, "round": p.get("round"),
+                }
+    return out
+
+
+def build_trajectory(repo: str = REPO,
+                     threshold: float = DEFAULT_THRESHOLD):
+    """Aggregate every checked-in round artifact under `repo` into the
+    TRAJECTORY payload."""
+    bench_paths = sorted(
+        glob.glob(os.path.join(repo, "BENCH_r[0-9]*.json")),
+        key=lambda p: _round_no(p) or 0,
+    )
+    mc_paths = sorted(
+        glob.glob(os.path.join(repo, "MULTICHIP_r[0-9]*.json")),
+        key=lambda p: _round_no(p) or 0,
+    )
+    latest = os.path.join(repo, "MULTICHIP_LATEST.json")
+    rounds = [load_bench_round(p) for p in bench_paths]
+    multichip = [load_multichip_record(p) for p in mc_paths]
+    if os.path.exists(latest):
+        multichip.append(load_multichip_record(latest))
+
+    # merge multichip scaling onto the same-round bench point ONLY when
+    # the platforms agree (regression detection groups by platform — a
+    # TPU multichip capture must not inherit a CPU-fallback bench row's
+    # label, or it would set/compare the wrong platform's bar);
+    # unmerged carriers become their own series points, in round order,
+    # with "latest" trailing
+    by_round = {p.get("round"): p for p in rounds}
+    series_points = list(rounds)
+    for p in multichip:
+        tgt = by_round.get(p.get("round"))
+        plat_ok = tgt is not None and (
+            p.get("platform") is None
+            or tgt.get("platform") is None
+            or p.get("platform") == tgt.get("platform")
+        )
+        if plat_ok:
+            for k in ("multichip_scaling_efficiency", "multichip_speedup",
+                      "hof_bit_identical"):
+                if k in p and k not in tgt:
+                    tgt[k] = p[k]
+        elif any(k in p for k in ("multichip_scaling_efficiency",
+                                  "multichip_speedup")):
+            series_points.append(p)
+    series_points.sort(
+        key=lambda p: (0, p["round"]) if isinstance(p.get("round"), int)
+        else (1, 0)
+    )
+
+    series = {
+        m: [
+            {"round": p.get("round"), "platform": p.get("platform"),
+             "value": _num(p.get(m))}
+            for p in series_points
+        ]
+        for m in METRICS
+    }
+    regressions = detect_regressions(series_points, threshold=threshold)
+    summary = {}
+    for m in METRICS:
+        vals = [
+            (p.get("round"), _num(p.get(m))) for p in series_points
+            if _num(p.get(m)) is not None
+        ]
+        if vals:
+            summary[m] = {
+                "points": len(vals),
+                "first": vals[0][1],
+                "last": vals[-1][1],
+                "best": max(v for _, v in vals),
+                "best_round": max(vals, key=lambda rv: rv[1])[0],
+            }
+    return {
+        "generated_by": "scripts/bench_trajectory.py",
+        "threshold": threshold,
+        "rounds": rounds,
+        "multichip": multichip,
+        "series": series,
+        "summary": summary,
+        "regressions": regressions,
+    }
+
+
+def render_markdown(traj) -> str:
+    """TRAJECTORY.md: one table over rounds, the regression list, and
+    the per-metric summary."""
+    lines = [
+        "# Bench trajectory",
+        "",
+        "*Generated by `scripts/bench_trajectory.py` — do not edit; "
+        "regenerate after a new BENCH/MULTICHIP capture lands "
+        "(`python scripts/bench_trajectory.py`). Regression flags "
+        "compare each round against the best earlier round on the same "
+        f"platform (threshold {traj['threshold']:.0%}); they are a "
+        "report, not a gate.*",
+        "",
+        "| round | platform | tunnel | trees-rows/s | vs_baseline | "
+        "roofline | bucketed/flat | mc scaling | mc speedup |",
+        "|---|---|---|---|---|---|---|---|---|",
+    ]
+
+    def cell(v, spec=".3g"):
+        if isinstance(v, bool):
+            return str(v).lower()
+        if isinstance(v, (int, float)):
+            return format(v, spec)
+        return v if isinstance(v, str) else "—"
+
+    for p in traj["rounds"]:
+        roof = p.get("roofline_fraction")
+        roof_cell = (
+            cell(roof) if roof is not None
+            else (p.get("roofline_skip_reason") or "—")
+        )
+        lines.append(
+            f"| {round_label(p.get('round'))} | {cell(p.get('platform'))} "
+            f"| {cell(p.get('tunnel_state'))} "
+            f"| {cell(p.get('throughput'), '.3e')} "
+            f"| {cell(p.get('vs_baseline'))} "
+            f"| {roof_cell} "
+            f"| {cell(p.get('interp_bucketed_vs_flat'))} "
+            f"| {cell(p.get('multichip_scaling_efficiency'))} "
+            f"| {cell(p.get('multichip_speedup'))} |"
+        )
+    mc_latest = [p for p in traj["multichip"] if p.get("round") == "latest"]
+    for p in mc_latest:
+        lines.append(
+            f"| latest | {cell(p.get('platform'))} | — | — | — | — | — "
+            f"| {cell(p.get('multichip_scaling_efficiency'))} "
+            f"| {cell(p.get('multichip_speedup'))} |"
+        )
+    lines.append("")
+    if traj["regressions"]:
+        lines.append("## Regressions (vs best earlier same-platform round)")
+        lines.append("")
+        for r in traj["regressions"]:
+            lines.append(
+                f"- **{r['metric']}** {round_label(r['round'])} "
+                f"[{r['platform']}]: {r['value']:.4g} is "
+                f"{r['drop_frac']:.0%} below "
+                f"{round_label(r['best_prev_round'])}'s "
+                f"{r['best_prev']:.4g}"
+            )
+    else:
+        lines.append("No regressions at the current threshold.")
+    lines.append("")
+    lines.append("## Per-metric summary")
+    lines.append("")
+    lines.append("| metric | points | first | last | best | best round |")
+    lines.append("|---|---|---|---|---|---|")
+    for m, s in traj["summary"].items():
+        lines.append(
+            f"| {m} | {s['points']} | {cell(s['first'])} "
+            f"| {cell(s['last'])} | {cell(s['best'])} "
+            f"| {s['best_round']} |"
+        )
+    lines.append("")
+    lines.append(
+        "Multichip rounds r01–r05 predate the real-search capture "
+        "(dryrun only — no scaling series); `MULTICHIP_LATEST.json` "
+        "carries the current sharded-vs-single measurement."
+    )
+    return "\n".join(lines) + "\n"
+
+
+def bench_summary(traj) -> dict:
+    """The compact block bench.py embeds in its one-line JSON: enough to
+    see the trend and any flag without re-reading five files."""
+    return {
+        "rounds": len(traj["rounds"]),
+        "throughput": [
+            p["value"] for p in traj["series"]["throughput"]
+        ],
+        "roofline_fraction": [
+            p["value"] for p in traj["series"]["roofline_fraction"]
+        ],
+        "multichip_scaling_efficiency": [
+            p["value"]
+            for p in traj["series"]["multichip_scaling_efficiency"]
+        ],
+        "regressions": traj["regressions"],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("--repo", default=REPO)
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD)
+    ap.add_argument(
+        "--no-write", action="store_true",
+        help="build and report only; do not touch TRAJECTORY.*",
+    )
+    ap.add_argument("--print", dest="do_print", action="store_true",
+                    help="print the JSON payload to stdout")
+    ns = ap.parse_args(argv)
+
+    traj = build_trajectory(ns.repo, threshold=ns.threshold)
+    if not traj["rounds"] and not traj["multichip"]:
+        print("no BENCH_r*/MULTICHIP_* artifacts found", file=sys.stderr)
+        return 1
+    if not ns.no_write:
+        with open(os.path.join(ns.repo, "TRAJECTORY.json"), "w") as f:
+            json.dump(traj, f, indent=1, sort_keys=True)
+            f.write("\n")
+        with open(os.path.join(ns.repo, "TRAJECTORY.md"), "w") as f:
+            f.write(render_markdown(traj))
+        print(
+            f"wrote TRAJECTORY.json + TRAJECTORY.md "
+            f"({len(traj['rounds'])} bench rounds, "
+            f"{len(traj['regressions'])} regression flags)",
+            file=sys.stderr,
+        )
+    if ns.do_print:
+        print(json.dumps(traj, indent=1, sort_keys=True))
+    for r in traj["regressions"]:
+        print(
+            f"# regression: {r['metric']} {round_label(r['round'])} "
+            f"{r['drop_frac']:.0%} below best", file=sys.stderr,
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

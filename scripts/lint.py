@@ -50,21 +50,66 @@ def check_telemetry_schema() -> dict:
     )
     report = validate_events_file(golden)
     problems = list(report["problems"])
+    doctor_verdict = None
     if report["ok"]:
         seen = set()
+        dynamics = False
         with open(golden) as f:
             for line in f:
                 e = _json.loads(line)
                 if e.get("type") == "span":
                     seen.add(e.get("name"))
+                elif e.get("type") == "metrics":
+                    g = (e.get("snapshot") or {}).get("gauges") or {}
+                    dynamics = dynamics or (
+                        "population_diversity" in g
+                        and "hof_hypervolume" in g
+                        and "pareto" in e
+                        and "mutations" in e
+                    )
         missing = [s for s in STAGES if s not in seen]
         if missing:
             problems.append(f"golden fixture missing stage spans {missing}")
+        if not dynamics:
+            problems.append(
+                "golden fixture has no dynamics-metrics event "
+                "(diversity/hypervolume/pareto/mutations)"
+            )
+        # the run doctor must produce a verdict on the golden fixture
+        # (`analyze --self-check` equivalent): the doctor, the writer,
+        # and the schema move together or CI notices. The fixture was
+        # schema-validated just above — skip the second pass.
+        from symbolicregression_jl_tpu.telemetry.analyze import self_check
+
+        doctor = self_check(golden, skip_validation=True)
+        doctor_verdict = doctor["verdict"]
+        if not doctor["ok"]:
+            problems.append(f"run doctor self-check: {doctor['detail']}")
     return {
         "ok": not problems,
         "events": report["events"],
+        "doctor_verdict": doctor_verdict,
         "detail": problems[0] if problems else "",
     }
+
+
+def trajectory_report() -> dict:
+    """NON-FATAL bench-trajectory report (scripts/bench_trajectory.py):
+    the round-over-round series + regression flags, printed alongside
+    the gates so a throughput/roofline/scaling drop is visible on every
+    lint run — but never failing it (capture conditions, not code,
+    usually move these numbers)."""
+    try:
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        from bench_trajectory import build_trajectory
+
+        traj = build_trajectory(REPO)
+        return {
+            "rounds": len(traj["rounds"]),
+            "regressions": traj["regressions"],
+        }
+    except Exception as e:  # pragma: no cover - defensive
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
 def check_docs() -> dict:
@@ -123,6 +168,8 @@ def main(argv=None) -> int:
         None if (ns.skip_telemetry_schema or ns.only is not None)
         else check_telemetry_schema()
     )
+    # non-fatal: the bench trajectory is a report, never a gate
+    trajectory = None if ns.only is not None else trajectory_report()
     ok = (
         report.ok
         and (docs is None or docs["api_reference_current"])
@@ -133,6 +180,7 @@ def main(argv=None) -> int:
         payload = report.to_dict()
         payload["docs"] = docs
         payload["telemetry_schema"] = telemetry
+        payload["trajectory"] = trajectory
         payload["ok"] = ok
         print(json.dumps(payload, indent=2, sort_keys=True))
     else:
@@ -145,10 +193,25 @@ def main(argv=None) -> int:
             print(f"docs/api_reference.md: {state}")
         if telemetry is not None:
             state = (
-                f"valid ({telemetry['events']} events)" if telemetry["ok"]
+                f"valid ({telemetry['events']} events, doctor verdict "
+                f"{telemetry.get('doctor_verdict')})" if telemetry["ok"]
                 else f"INVALID ({telemetry['detail']})"
             )
             print(f"telemetry golden fixture: {state}")
+        if trajectory is not None and "error" not in trajectory:
+            n_reg = len(trajectory["regressions"])
+            print(
+                f"bench trajectory (non-fatal): {trajectory['rounds']} "
+                f"rounds, {n_reg} regression flag(s)"
+            )
+            for r in trajectory["regressions"]:
+                # round may be an int or the 'latest' tag
+                rnd = r["round"]
+                lab = f"r{rnd:02d}" if isinstance(rnd, int) else str(rnd)
+                print(
+                    f"  - {r['metric']} {lab} [{r['platform']}]: "
+                    f"{r['drop_frac']:.0%} below best earlier round"
+                )
     return 0 if ok else 1
 
 

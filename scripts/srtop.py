@@ -1,0 +1,318 @@
+#!/usr/bin/env python
+"""srtop — live terminal dashboard over a search's telemetry event log.
+
+`top` for a symbolic-regression run: point it at an event log (or a
+telemetry directory — it follows the newest ``events-*.jsonl``) and it
+renders, refreshing in place:
+
+* run header — run id, backend, mesh/device state, last-event age (the
+  liveness signal: a growing age on an ``incomplete`` run is the "dead
+  vs mid-run fault" distinction ROADMAP #4 cares about);
+* per-stage wall-time split (the span breakdown, summed live);
+* best/mean loss per island + a sparkline of the global best-loss
+  trajectory, population diversity, exact hypervolume;
+* mutation acceptance and memo-bank hit rates;
+* the fault/tunnel/saved-state tail.
+
+Deliberately curses-free: plain ANSI rewind-and-redraw on TTYs (the
+same trick utils/progress.ProgressBar uses), plain append when piped —
+so it works over ssh, inside tmux, and in CI logs. Reading is
+incremental (byte offset + partial-line buffer), so tailing a large log
+costs only the new bytes, and a HALF-WRITTEN last line is simply held
+until its newline arrives — safe against a log being written this
+moment, or truncated by a kill.
+
+Usage:
+    python scripts/srtop.py RUN_DIR_OR_LOG [--interval 2] [--once]
+
+``--once`` renders a single frame and exits (also the test hook).
+Exit: 0 on 'q'/Ctrl-C or --once; the dashboard never modifies the log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+# stdlib-only on purpose: tailing a log must never pay (or hang on)
+# the package/jax import — resolve() below mirrors analyze.resolve_log
+
+SPARK = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 40) -> str:
+    """Unicode sparkline of the last `width` finite values (log-scaled
+    when the spread warrants it — loss trajectories span decades)."""
+    vals = [
+        float(v) for v in values
+        if isinstance(v, (int, float)) and math.isfinite(v)
+    ][-width:]
+    if not vals:
+        return ""
+    if min(vals) > 0 and max(vals) / min(vals) > 50:
+        vals = [math.log10(v) for v in vals]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(
+        SPARK[int((v - lo) / span * (len(SPARK) - 1))] for v in vals
+    )
+
+
+class LogTail:
+    """Incremental reader of one JSONL event log. ``poll()`` returns the
+    complete NEW events since the last call; a partial trailing line
+    (mid-write) stays buffered until its newline lands; a truncated
+    file (log rotated / rewritten shorter) resets the tail."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+        self.buf = ""
+
+    def poll(self):
+        events = []
+        try:
+            size = os.path.getsize(self.path)
+            if size < self.offset:
+                self.offset, self.buf = 0, ""  # rewritten: start over
+            with open(self.path) as f:
+                f.seek(self.offset)
+                chunk = f.read()
+                self.offset = f.tell()
+        except OSError:
+            return events
+        self.buf += chunk
+        while "\n" in self.buf:
+            line, self.buf = self.buf.split("\n", 1)
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue  # corrupt line: skip, keep tailing
+            if isinstance(e, dict):
+                events.append(e)
+        return events
+
+
+class Dashboard:
+    """Accumulates events and renders frames."""
+
+    def __init__(self):
+        self.start = {}
+        self.stages = {}
+        self.metrics_tail = []   # last N metrics events
+        self.best_series = []
+        self.progress_last = None
+        self.faults = []
+        self.tunnel = None
+        self.saved = None
+        self.ended = None
+        self.t_last = None
+        self.n_events = 0
+        self.MAX_TAIL = 512
+
+    def feed(self, events) -> None:
+        for e in events:
+            self.n_events += 1
+            t = e.get("t")
+            if isinstance(t, (int, float)):
+                self.t_last = max(self.t_last or t, t)
+            typ = e.get("type")
+            if typ == "run_start":
+                self.start = e
+            elif typ == "span":
+                row = self.stages.setdefault(
+                    e.get("name"), {"total_s": 0.0, "count": 0}
+                )
+                d = e.get("duration_s")
+                if isinstance(d, (int, float)) and math.isfinite(d):
+                    row["total_s"] += d
+                    row["count"] += 1
+            elif typ == "metrics":
+                self.metrics_tail.append(e)
+                del self.metrics_tail[:-4]
+                g = (e.get("snapshot") or {}).get("gauges") or {}
+                self.best_series.append(g.get("best_loss"))
+                del self.best_series[:-self.MAX_TAIL]
+            elif typ == "progress":
+                self.progress_last = e
+            elif typ == "dispatch_fault":
+                self.faults.append(e)
+            elif typ == "tunnel_state":
+                self.tunnel = e.get("state")
+            elif typ == "saved_state":
+                self.saved = e
+            elif typ == "run_end":
+                self.ended = e
+
+    def render(self, now=None) -> str:
+        now = now or time.time()
+        L = []
+
+        def fmt(v, spec=".4g"):
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                return format(v, spec)
+            return "-"
+
+        s = self.start
+        mesh = s.get("mesh_shape")
+        hdr = (
+            f"srtop — run {s.get('run', '?')} [{s.get('backend', '?')}] "
+            f"devices={s.get('n_devices', len(s.get('devices', []) or []) or '?')}"
+        )
+        if mesh:
+            hdr += f" mesh={mesh}"
+        L.append(hdr)
+        age = (now - self.t_last) if self.t_last else None
+        if self.ended is not None:
+            state = (
+                f"ENDED — {fmt(self.ended.get('num_evals'), '.3g')} evals "
+                f"in {fmt(self.ended.get('search_time_s'), '.1f')}s"
+            )
+        elif self.faults:
+            f = self.faults[-1]
+            state = (
+                f"FAULTED at iteration {f.get('iteration')} "
+                f"({f.get('error_type')}) — "
+                + ("resumable: saved_state on disk" if self.saved
+                   else "no saved_state")
+            )
+        else:
+            state = "RUNNING"
+        L.append(
+            f"state: {state}   last event {fmt(age, '.1f')}s ago   "
+            f"events: {self.n_events}"
+            + (f"   tunnel: {self.tunnel}" if self.tunnel else "")
+        )
+
+        m = self.metrics_tail[-1] if self.metrics_tail else None
+        if m is not None:
+            g = (m.get("snapshot") or {}).get("gauges") or {}
+            L.append(
+                f"iter {m.get('iteration')}: best {fmt(g.get('best_loss'))}"
+                f"  mean {fmt(g.get('mean_loss'))}"
+                f"  diversity {fmt(g.get('population_diversity'), '.3f')}"
+                f"  hypervolume {fmt(g.get('hof_hypervolume'), '.4f')}"
+                f"  hof {fmt(g.get('hof_size'), '.0f')}"
+            )
+            rates = []
+            if g.get("mutation_accept_rate") is not None:
+                rates.append(
+                    f"mut-accept {fmt(g.get('mutation_accept_rate'), '.3f')}"
+                )
+            if g.get("memo_hit_rate") is not None:
+                rates.append(
+                    f"memo-hit {fmt(g.get('memo_hit_rate'), '.3f')}"
+                )
+            if g.get("cycles_per_second") is not None:
+                rates.append(
+                    f"cycles/s {fmt(g.get('cycles_per_second'), '.3g')}"
+                )
+            if g.get("num_evals_total") is not None:
+                rates.append(
+                    f"evals {fmt(g.get('num_evals_total'), '.3g')}"
+                )
+            if rates:
+                L.append("  ".join(rates))
+            spark = sparkline(self.best_series)
+            if spark:
+                L.append(f"best loss: {spark}")
+            pi = m.get("per_island") or {}
+            best_i = pi.get("best_loss") or []
+            mean_i = pi.get("mean_loss") or []
+            div_i = pi.get("diversity") or []
+            if best_i:
+                show = min(len(best_i), 8)
+                L.append("island     " + " ".join(
+                    f"{i:>8d}" for i in range(show)
+                ) + (" ..." if len(best_i) > show else ""))
+                L.append("  best     " + " ".join(
+                    f"{fmt(v, '.3g'):>8}" for v in best_i[:show]
+                ))
+                if mean_i:
+                    L.append("  mean     " + " ".join(
+                        f"{fmt(v, '.3g'):>8}" for v in mean_i[:show]
+                    ))
+                if div_i:
+                    L.append("  diversity" + " ".join(
+                        f"{fmt(v, '.2f'):>8}" for v in div_i[:show]
+                    ))
+
+        if self.stages:
+            total = sum(v["total_s"] for v in self.stages.values()) or 1.0
+            parts = []
+            for name, v in sorted(
+                self.stages.items(), key=lambda kv: -kv[1]["total_s"]
+            ):
+                parts.append(
+                    f"{name} {v['total_s']:.1f}s "
+                    f"({100 * v['total_s'] / total:.0f}%)"
+                )
+            L.append("stages: " + "  ".join(parts))
+        return "\n".join(L)
+
+
+def resolve(path: str):
+    """The log file to tail right now, or None while nothing exists yet
+    (a dir with no events-*.jsonl, or a log path that has not been
+    created / was cleaned up — both render the waiting frame rather
+    than an empty 'run ?' dashboard that never fills)."""
+    if os.path.isdir(path):
+        cands = [
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.startswith("events-") and f.endswith(".jsonl")
+        ]
+        return max(cands, key=os.path.getmtime) if cands else None
+    return path if os.path.exists(path) else None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument(
+        "log", help="event log path, or a telemetry dir (follows the "
+        "newest events-*.jsonl, switching when a newer run appears)",
+    )
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit (no follow loop)",
+    )
+    ns = ap.parse_args(argv)
+
+    tail = None
+    dash = Dashboard()
+    last_lines = 0
+    try:
+        while True:
+            path = resolve(ns.log)
+            if path is not None:
+                if tail is None or tail.path != path:
+                    tail, dash = LogTail(path), Dashboard()
+                dash.feed(tail.poll())
+                frame = dash.render()
+            else:
+                frame = (
+                    f"srtop — waiting for "
+                    f"{'events-*.jsonl in ' if os.path.isdir(ns.log) else ''}"
+                    f"{ns.log} (not there yet)"
+                )
+            if last_lines and sys.stdout.isatty():
+                sys.stdout.write(f"\x1b[{last_lines}F\x1b[0J")
+            sys.stdout.write(frame + "\n")
+            sys.stdout.flush()
+            last_lines = frame.count("\n") + 1
+            if ns.once:
+                return 0
+            time.sleep(ns.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

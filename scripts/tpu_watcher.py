@@ -38,12 +38,24 @@ recent (<24 h) BENCH_TPU_LATEST.json are not re-run. A complete or stale
 capture file disables resume automatically (a new round must re-capture,
 not silently exit on last round's file); --fresh forces that manually.
 
+With ``--telemetry-dir DIR`` the watcher threads the directory into
+every step (``SRTPU_BENCH_TELEMETRY_DIR``) and classifies each step
+from the telemetry event logs written during it instead of scraping
+stdout: the ``run_start`` backend replaces the platform-field scrape,
+``tunnel_state`` events carry the acquisition verdict, and a
+``dispatch_fault`` with a ``saved_state`` event in the same trail is
+classified **resumable**, not dead (ROADMAP #4 groundwork — a faulted
+64x1000 run with a snapshot on disk should be resumed, never
+restarted). Steps without telemetry fall back to the stdout scrape.
+
 Usage:  python scripts/tpu_watcher.py [--poll SECONDS] [--fresh]
+            [--telemetry-dir DIR]
 """
 
 from __future__ import annotations
 
 import datetime
+import glob as _glob
 import json
 import os
 import signal
@@ -54,6 +66,9 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 RESULT_PATH = os.path.join(REPO, "BENCH_TPU_LATEST.json")
 SENTINEL = "/tmp/srtpu_watcher_capturing"
+
+# set by main() from --telemetry-dir; empty = stdout-scrape behavior
+TELEMETRY_DIR = None
 
 # Round-5 order (VERDICT r4 #1/#2/#3): after the ONE short canary, the
 # scale-fault bisect runs FIRST — the 64x1000 northstar iteration has
@@ -155,8 +170,79 @@ def parse_json_lines(text):
     return out
 
 
+def read_telemetry_verdict(telemetry_dir, since_ts=0.0):
+    """Aggregate the telemetry event logs (events-*.jsonl) written under
+    `telemetry_dir` since `since_ts` into one machine-readable verdict —
+    the event-log replacement for scraping a step's stdout:
+
+      {"logs", "backends", "tunnel_state", "faults", "saved_states",
+       "complete", "classification"}
+
+    classification: 'completed' (run_end, no fault), 'resumable'
+    (dispatch_fault WITH a saved_state event in the same trail — resume,
+    don't restart: ROADMAP #4), 'dead' (fault, nothing to resume from),
+    'in-flight' (neither fault nor run_end — still running or killed).
+    Returns None when the dir is unset/absent or holds no new logs
+    (callers fall back to the stdout scrape); never raises on content —
+    truncated lines in a crashed run's log are skipped."""
+    if not telemetry_dir or not os.path.isdir(telemetry_dir):
+        return None
+    logs = [
+        p for p in _glob.glob(
+            os.path.join(telemetry_dir, "events-*.jsonl")
+        )
+        if os.path.getmtime(p) >= since_ts
+    ]
+    if not logs:
+        return None
+    out = {
+        "logs": len(logs), "backends": [], "tunnel_state": None,
+        "faults": 0, "saved_states": 0, "complete": False,
+    }
+    backends = set()
+    for path in sorted(logs, key=os.path.getmtime):
+        try:
+            with open(path) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                continue  # truncated mid-write line: expected, skip
+            typ = e.get("type")
+            if typ == "run_start" and e.get("backend"):
+                backends.add(e["backend"])
+            elif typ == "tunnel_state":
+                out["tunnel_state"] = e.get("state")
+            elif typ == "dispatch_fault":
+                out["faults"] += 1
+            elif typ == "saved_state":
+                out["saved_states"] += 1
+            elif typ == "run_end":
+                out["complete"] = True
+    out["backends"] = sorted(backends)
+    if out["faults"]:
+        out["classification"] = (
+            "resumable" if out["saved_states"] else "dead"
+        )
+    elif out["complete"]:
+        out["classification"] = "completed"
+    else:
+        out["classification"] = "in-flight"
+    return out
+
+
 def run_step(name, argv, timeout, extra_env):
     env = dict(os.environ)
+    if TELEMETRY_DIR:
+        # every step's telemetry lands in one place; the verdict reader
+        # below picks up only the logs this step wrote (mtime >= t0)
+        env["SRTPU_BENCH_TELEMETRY_DIR"] = TELEMETRY_DIR
     if extra_env:
         env.update(extra_env)
     t0 = time.time()
@@ -191,15 +277,24 @@ def run_step(name, argv, timeout, extra_env):
         "stdout_tail": "\n".join((out or "").splitlines()[-12:]),
         "stderr_tail": "\n".join((err or "").splitlines()[-8:]),
     }
+    tv = read_telemetry_verdict(TELEMETRY_DIR, since_ts=t0)
+    if tv is not None:
+        rec["telemetry"] = tv
     return rec
 
 
 def step_on_chip(name, rec):
-    """Did this step's output actually come from the TPU? (bench/suite
-    report a platform field — feynman_scale stamps it per case line, so
-    a partially-finished suite still attributes its finished cases; the
+    """Did this step's output actually come from the TPU? Preferred
+    evidence: the telemetry trail's run_start backend (present whenever
+    the step ran with --telemetry-dir — the event log, not a stdout
+    scrape, is the record). Fallbacks: bench/suite report a platform
+    field — feynman_scale stamps it per case line, so a
+    partially-finished suite still attributes its finished cases; the
     pytest tier passes only when not skipped; text-only steps count by
-    exit code.)"""
+    exit code."""
+    tv = rec.get("telemetry")
+    if tv and tv.get("backends"):
+        return "tpu" in tv["backends"]
     if name in ("bench", "suite", "feynman_scale", "scale_bisect",
                 "rows_sweep"):
         plats = {j.get("platform") for j in rec["json"] if "platform" in j}
@@ -330,9 +425,13 @@ def compute_resume_state(results):
 
 
 def main():
+    global TELEMETRY_DIR
     poll = 120
     if "--poll" in sys.argv:
         poll = int(sys.argv[sys.argv.index("--poll") + 1])
+    if "--telemetry-dir" in sys.argv:
+        TELEMETRY_DIR = sys.argv[sys.argv.index("--telemetry-dir") + 1]
+        os.makedirs(TELEMETRY_DIR, exist_ok=True)
 
     results = {}
     first_captured_at = None
@@ -405,6 +504,17 @@ def main():
                     f"step {name}: rc={rec['rc']} {rec['seconds']}s "
                     f"on_chip={on_chip} ok={ok}"
                 )
+                tv = rec.get("telemetry")
+                if tv is not None:
+                    # fault-with-saved_state is RESUMABLE, not dead: the
+                    # run left a snapshot to resume from (ROADMAP #4)
+                    log(
+                        f"step {name} telemetry: "
+                        f"{tv['classification']} "
+                        f"(faults={tv['faults']}, "
+                        f"saved_states={tv['saved_states']}, "
+                        f"tunnel={tv['tunnel_state']})"
+                    )
                 if ok or attempts[name] >= MAX_ATTEMPTS:
                     # done — or persistently failing: record what there
                     # is (flagged partial) and stop burning chip time

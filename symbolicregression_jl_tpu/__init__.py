@@ -79,14 +79,29 @@ from .models.population import (
 # Evaluation memo bank (opt-in via Options.cache_fitness).
 from .cache import FitnessMemoBank, clear_memo_banks, tree_hash_host
 
-# Unified search telemetry (opt-in via Options.telemetry).
+# Unified search telemetry (opt-in via Options.telemetry) + the offline
+# run doctor over its event logs. analyze_run/compare_runs resolve
+# lazily (PEP 562, below) so the documented CLI
+# `python -m symbolicregression_jl_tpu.telemetry.analyze` never
+# double-imports the module it is about to execute.
 from .telemetry import (
     EventLog,
     MetricsRegistry,
     SpanRecorder,
+    hypervolume_2d,
     open_event_log,
     validate_events_file,
 )
+
+
+def __getattr__(name):
+    if name in ("analyze_run", "compare_runs"):
+        from . import telemetry
+
+        return getattr(telemetry, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
 
 __version__ = "0.1.0"
 
@@ -160,6 +175,9 @@ __all__ = [
     "EventLog",
     "MetricsRegistry",
     "SpanRecorder",
+    "analyze_run",
+    "compare_runs",
+    "hypervolume_2d",
     "open_event_log",
     "validate_events_file",
 ]

@@ -95,6 +95,29 @@ MUTATION_NAMES = (
 )
 
 
+def mutation_counts_table(mut_counts) -> dict:
+    """``IslandState.mut_counts`` (optionally with leading island/batch
+    axes, which are summed away) as ``{kind_name: {"proposed", "accepted",
+    "accept_rate"}}`` — the host-side view the telemetry ``metrics`` event
+    and the run doctor publish. Counters are cumulative over the run
+    (per-iteration rates come from differencing two snapshots).
+    ``accept_rate`` is None until the kind has been proposed at least
+    once."""
+    import numpy as np
+
+    counts = np.asarray(mut_counts, np.int64)
+    counts = counts.reshape((-1,) + counts.shape[-2:]).sum(axis=0)
+    out = {}
+    for i, name in enumerate(MUTATION_NAMES):
+        proposed, accepted = int(counts[i, 0]), int(counts[i, 1])
+        out[name] = {
+            "proposed": proposed,
+            "accepted": accepted,
+            "accept_rate": accepted / proposed if proposed else None,
+        }
+    return out
+
+
 class IslandState(NamedTuple):
     """Everything one island owns. vmap/shard_map over a leading axis of
     these gives multi-island search."""

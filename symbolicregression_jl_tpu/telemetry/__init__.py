@@ -17,12 +17,37 @@ from .events import (
     validate_event,
     validate_events_file,
 )
-from .metrics import Counter, Gauge, Histogram, MetricsRegistry, SearchMetrics
+from .metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    SearchMetrics,
+    hypervolume_2d,
+)
 from .spans import STAGES, Span, SpanRecorder
+
+# The run doctor (analyze.py) is exported LAZILY (PEP 562): importing it
+# during package init would put the module in sys.modules before runpy
+# executes the documented CLI `python -m ...telemetry.analyze`, tripping
+# the double-import RuntimeWarning on every invocation.
+_ANALYZE_EXPORTS = ("VERDICTS", "analyze_run", "compare_runs")
+
+
+def __getattr__(name):
+    if name in _ANALYZE_EXPORTS:
+        from . import analyze
+
+        return getattr(analyze, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
+
 
 __all__ = [
     "STAGES",
     "SCHEMA_VERSION",
+    "VERDICTS",
     "Counter",
     "EventLog",
     "Gauge",
@@ -31,6 +56,9 @@ __all__ = [
     "SearchMetrics",
     "Span",
     "SpanRecorder",
+    "analyze_run",
+    "compare_runs",
+    "hypervolume_2d",
     "open_event_log",
     "validate_event",
     "validate_events_file",

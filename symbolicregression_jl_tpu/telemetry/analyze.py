@@ -1,0 +1,636 @@
+"""Offline run doctor: turn one (or two) telemetry event logs into an
+operational verdict.
+
+PR 6 made every run leave a crash-safe JSONL trail; this module is the
+layer that *interprets* it — the answer to "is this 64x1000 run stalled,
+diverging, or healthy?" without eyeballing raw JSONL (ROADMAP #4: the
+watcher must distinguish a mid-run fault from a dead run; GP-dynamics
+literature — TensorGP arxiv 2103.07512, Kozax arxiv 2502.03047 — names
+diversity collapse and operator-acceptance drift as the leading
+indicators of wasted tensorized-GP compute, which is exactly what the
+``metrics`` events now carry).
+
+Entry points:
+
+* :func:`load_events` — tolerant loader: a mid-write run's truncated
+  last line (or a corrupted line) is skipped and counted, never fatal —
+  the doctor and ``scripts/srtop.py`` both read *live* logs;
+* :func:`analyze_run` — one log -> a structured report with a verdict
+  from :data:`VERDICTS` plus the evidence (best-loss trajectory,
+  diversity, per-mutation acceptance drift, exact hypervolume, stage
+  wall-time breakdown, fault/tunnel timeline, saved-state points);
+* :func:`compare_runs` — A/B of two logs on the shared summary metrics
+  (wall time, evals/s, final best loss, hypervolume, stage split);
+* :func:`self_check` — the lint-gate form: schema-validate a log AND
+  assert the doctor produces a verdict on it;
+* CLI — ``python -m symbolicregression_jl_tpu.telemetry.analyze LOG
+  [LOG2] [--format json|text]`` (two logs -> comparison). Exit 0 iff
+  the verdict is ``healthy`` (or the comparison/self-check succeeded),
+  so CI can gate on it directly.
+
+Verdict vocabulary (:data:`VERDICTS`, documented in
+docs/observability.md):
+
+* ``healthy`` — no fault, run completed (or still progressing), best
+  loss improving or diversity above the floor;
+* ``stalled`` — best-loss plateau (relative improvement below
+  ``stall_tol`` across the trailing ``stall_window`` metric snapshots)
+  AND population diversity at/below ``diversity_floor`` — the
+  diversity-collapse signature: more iterations are unlikely to help;
+* ``diverging`` — the population's finite fraction collapsed or the
+  best loss lost finiteness (NaN/Inf flood);
+* ``faulted`` — a ``dispatch_fault`` was recorded; the report's
+  ``resumable`` flag says whether a ``saved_state`` event exists to
+  resume from (fault-with-recent-saved-state is "resumable", not
+  "dead" — ROADMAP #4);
+* ``incomplete`` — no ``run_end`` and no fault: the run is either
+  still in flight or was killed; pair with the log's mtime/last event
+  age to tell which (srtop shows it live);
+* ``empty`` — no parseable events.
+
+Everything here is host-side file reading — no jax import.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+#: the complete verdict vocabulary, in precedence order (first match
+#: wins): faulted > diverging > stalled > incomplete > healthy; empty
+#: when there is nothing to judge.
+VERDICTS = (
+    "faulted", "diverging", "stalled", "incomplete", "healthy", "empty",
+)
+
+#: defaults for the stall detector (overridable per call / via CLI)
+STALL_WINDOW = 5      # trailing metric snapshots the plateau must span
+STALL_TOL = 1e-3      # relative best-loss improvement below this = flat
+DIVERSITY_FLOOR = 0.2  # unique-tree fraction at/below this = collapsed
+
+
+def load_events(
+    path: str, max_skipped: Optional[int] = None
+) -> Tuple[List[dict], int]:
+    """Parse one JSONL event log, skipping (and counting) unparsable
+    lines. A truncated final line — the normal state of a log being
+    written, or of a run killed mid-write — is a skip, not an error.
+    Returns ``(events, skipped_lines)``."""
+    events: List[dict] = []
+    skipped = 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                skipped += 1
+                if max_skipped is not None and skipped > max_skipped:
+                    break
+                continue
+            if isinstance(e, dict):
+                events.append(e)
+            else:
+                skipped += 1
+    return events, skipped
+
+
+def _finite(v) -> Optional[float]:
+    if isinstance(v, (int, float)) and not isinstance(v, bool) and \
+            math.isfinite(v):
+        return float(v)
+    return None
+
+
+def _gauge(e: dict, name: str) -> Optional[float]:
+    return _finite(
+        ((e.get("snapshot") or {}).get("gauges") or {}).get(name)
+    )
+
+
+def _series(metrics: List[dict], name: str) -> List[Optional[float]]:
+    return [_gauge(m, name) for m in metrics]
+
+
+def _summary(values: List[Optional[float]]) -> Optional[dict]:
+    vals = [v for v in values if v is not None]
+    if not vals:
+        return None
+    return {
+        "first": vals[0],
+        "last": vals[-1],
+        "min": min(vals),
+        "max": max(vals),
+    }
+
+
+def _stall(
+    best: List[Optional[float]], window: int, tol: float
+) -> Optional[bool]:
+    """True when the best-loss series is flat over its trailing `window`
+    observations (None = not enough evidence to call it either way).
+
+    A plateau AT zero loss is NOT a stall: the search converged onto the
+    exact equation — there is nothing left to improve, and calling a
+    successful run 'stalled' would fail the CLI's exit-0-iff-healthy
+    contract on precisely the best outcome."""
+    vals = [v for v in best if v is not None]
+    if len(vals) < window + 1:
+        return None
+    head, tail = vals[-(window + 1)], vals[-1]
+    if head <= 0:
+        return False  # converged to (or below) zero: solved, not stuck
+    return (head - tail) / abs(head) < tol
+
+
+def analyze_run(
+    source: Union[str, List[dict]],
+    *,
+    stall_window: int = STALL_WINDOW,
+    stall_tol: float = STALL_TOL,
+    diversity_floor: float = DIVERSITY_FLOOR,
+) -> Dict[str, Any]:
+    """One event log (path or pre-loaded event list) -> structured
+    verdict + evidence. Never raises on content (only on an unreadable
+    path): a malformed or partial log yields a report saying so."""
+    if isinstance(source, str):
+        events, skipped = load_events(source)
+        path = source
+    else:
+        events, skipped, path = list(source), 0, None
+
+    report: Dict[str, Any] = {
+        "path": path,
+        "events": len(events),
+        "skipped_lines": skipped,
+        "verdict": "empty",
+        "reasons": [],
+        "complete": False,
+        "resumable": False,
+    }
+    if not events:
+        report["reasons"].append("no parseable events")
+        return report
+
+    by_type: Dict[str, List[dict]] = {}
+    for e in events:
+        by_type.setdefault(str(e.get("type")), []).append(e)
+
+    start = (by_type.get("run_start") or [{}])[0]
+    report["run"] = {
+        k: start.get(k)
+        for k in (
+            "run", "config_fingerprint", "backend", "niterations",
+            "nout", "mesh_shape", "n_devices", "device_kind",
+        )
+        if start.get(k) is not None
+    }
+    ends = by_type.get("run_end") or []
+    report["complete"] = bool(ends)
+    if ends:
+        report["wall_s"] = _finite(ends[-1].get("search_time_s"))
+        report["num_evals"] = _finite(ends[-1].get("num_evals"))
+    ts = [e["t"] for e in events if _finite(e.get("t")) is not None]
+    if ts:
+        report["t_first"], report["t_last"] = min(ts), max(ts)
+        report.setdefault("wall_s", max(ts) - min(ts))
+
+    # ---- stage wall-time breakdown + span completeness ----
+    from .spans import STAGES
+
+    stages: Dict[str, dict] = {}
+    for sp in by_type.get("span", []):
+        name = sp.get("name")
+        d = _finite(sp.get("duration_s"))
+        if not isinstance(name, str) or d is None:
+            continue
+        row = stages.setdefault(name, {"total_s": 0.0, "count": 0})
+        row["total_s"] += d
+        row["count"] += 1
+    report["stages"] = {
+        k: {"total_s": round(v["total_s"], 6), "count": v["count"]}
+        for k, v in sorted(stages.items())
+    }
+    report["spans_complete"] = all(s in stages for s in STAGES)
+
+    # ---- fault / tunnel timeline (ROADMAP #4: the machine-readable
+    # trail distinguishing a mid-run fault from a dead run) ----
+    faults = [
+        {
+            k: f.get(k)
+            for k in ("t", "where", "error_type", "error", "fatal",
+                      "output", "iteration")
+            if k in f
+        }
+        for f in by_type.get("dispatch_fault", [])
+    ]
+    report["faults"] = faults
+    tunnel = by_type.get("tunnel_state", [])
+    if tunnel:
+        report["tunnel_state"] = tunnel[-1].get("state")
+    saved = by_type.get("saved_state", [])
+    report["saved_states"] = len(saved)
+    if saved:
+        report["last_saved_state"] = {
+            k: saved[-1].get(k)
+            for k in ("t", "path", "iteration", "in_memory")
+            if k in saved[-1]
+        }
+    roofs = by_type.get("roofline", [])
+    if roofs:
+        report["roofline"] = {
+            "fraction": _finite(roofs[-1].get("fraction")),
+            "skip_reason": roofs[-1].get("skip_reason"),
+        }
+
+    # ---- search-dynamics series from the metrics events ----
+    # multi-output runs interleave one metrics event per output per
+    # iteration: every trajectory judgment below is made PER OUTPUT
+    # (a zigzag across outputs would fake plateaus and divergence)
+    metrics = by_type.get("metrics", [])
+    report["metric_snapshots"] = len(metrics)
+    by_out: Dict[int, List[dict]] = {}
+    for m in metrics:
+        j = m.get("output")
+        by_out.setdefault(j if isinstance(j, int) else 0, []).append(m)
+    outputs = sorted(by_out)
+    report["best_loss"] = _summary(_series(metrics, "best_loss"))
+    report["diversity"] = _summary(
+        _series(metrics, "population_diversity")
+    )
+    report["hypervolume"] = _summary(_series(metrics, "hof_hypervolume"))
+    report["mutation_accept_rate"] = _summary(
+        _series(metrics, "mutation_accept_rate")
+    )
+    if metrics and isinstance(metrics[-1].get("mutations"), dict):
+        report["mutations"] = metrics[-1]["mutations"]
+    if metrics and isinstance(metrics[-1].get("pareto"), dict):
+        report["pareto"] = metrics[-1]["pareto"]
+
+    per_out: Dict[int, Dict[str, Any]] = {}
+    for j in outputs:
+        ms = by_out[j]
+        best_j = _series(ms, "best_loss")
+        div_j = _series(ms, "population_diversity")
+        frac_j = _series(ms, "population_finite_frac")
+        gauges_j = ((ms[-1].get("snapshot") or {}).get("gauges") or {})
+        s = _summary(best_j) or {}
+        per_out[j] = {
+            "flat": _stall(best_j, stall_window, stall_tol),
+            "last_diversity": next(
+                (v for v in reversed(div_j) if v is not None), None
+            ),
+            "last_finite_frac": next(
+                (v for v in reversed(frac_j) if v is not None), None
+            ),
+            "latest_best_null": (
+                "best_loss" in gauges_j and best_j[-1] is None
+            ),
+            "last_best": next(
+                (v for v in reversed(best_j) if v is not None), None
+            ),
+            "improvement": (
+                (s["first"] - s["last"]) / abs(s["first"])
+                if s.get("first") else 0.0
+            ) if s else None,
+        }
+    if report["best_loss"] is not None and per_out:
+        # the conservative cross-output figure: the least-improved output
+        report["best_loss"]["improvement"] = min(
+            (p["improvement"] for p in per_out.values()
+             if p["improvement"] is not None),
+            default=0.0,
+        )
+    if len(outputs) > 1:
+        report["per_output"] = {
+            j: {
+                "best_loss": p["last_best"],
+                "diversity": p["last_diversity"],
+                "improvement": p["improvement"],
+            }
+            for j, p in per_out.items()
+        }
+
+    # ---- verdict (precedence: faulted > diverging > stalled >
+    # incomplete > healthy) ----
+    reasons = report["reasons"]
+    verdict = "healthy"
+
+    # cross-output aggregation: ANY output diverging is a diverging
+    # run; a stall needs EVERY output plateaued with EVERY output's
+    # diversity at/below the floor (one healthy output = the run can
+    # still move, matching the reference's all-outputs stop semantics)
+    vals = list(per_out.values())
+    flat = bool(vals) and all(p["flat"] is True for p in vals)
+    divs = [p["last_diversity"] for p in vals
+            if p["last_diversity"] is not None]
+    last_div = max(divs) if divs else None
+    fracs = [p["last_finite_frac"] for p in vals
+             if p["last_finite_frac"] is not None]
+    last_frac = min(fracs) if fracs else None
+    # NaN-flood detection keys on each output's LATEST snapshot: its
+    # gauges must carry best_loss (the writer always emits it; null =
+    # non-finite) — a log from a writer that never emitted the gauge
+    # stays unjudged
+    latest_best_null = any(p["latest_best_null"] for p in vals)
+
+    if faults:
+        verdict = "faulted"
+        f = faults[-1]
+        reasons.append(
+            f"dispatch_fault at iteration {f.get('iteration')}: "
+            f"{f.get('error_type')}"
+        )
+        report["resumable"] = bool(saved)
+        reasons.append(
+            "resumable: saved_state available to resume from"
+            if saved else
+            "not resumable: no saved_state event in this log"
+        )
+    elif latest_best_null:
+        verdict = "diverging"
+        reasons.append("best loss lost finiteness (NaN/Inf flood)")
+    elif last_frac is not None and last_frac < 0.1:
+        verdict = "diverging"
+        reasons.append(
+            f"finite-loss fraction collapsed to {last_frac:.3f}"
+        )
+    elif flat and (last_div is not None and last_div <= diversity_floor):
+        verdict = "stalled"
+        reasons.append(
+            f"best-loss plateau over the last {stall_window} snapshots "
+            f"(< {stall_tol:g} relative improvement) with diversity "
+            f"{last_div:.3f} <= floor {diversity_floor:g}"
+        )
+    elif not report["complete"]:
+        verdict = "incomplete"
+        reasons.append(
+            "no run_end event: run still in flight or killed "
+            "(check the log's last-event age)"
+        )
+        report["resumable"] = bool(saved)
+    else:
+        if vals and all(
+            p["last_best"] is not None and p["last_best"] <= 0
+            for p in vals
+        ):
+            reasons.append("best loss at zero — converged")
+        if flat:
+            reasons.append(
+                "best-loss plateau, but diversity above the floor — "
+                "search can still move"
+            )
+        if not report["spans_complete"]:
+            missing = [s for s in STAGES if s not in stages]
+            reasons.append(f"missing stage spans: {missing}")
+        if not reasons:
+            reasons.append("completed, loss improving, no faults")
+    report["verdict"] = verdict
+    return report
+
+
+def compare_runs(
+    a: Union[str, List[dict]], b: Union[str, List[dict]], **kw
+) -> Dict[str, Any]:
+    """A/B two runs on the shared summary metrics. ``delta`` rows are
+    ``b - a`` (ratios where a rate is the natural unit); each side's
+    full report rides along under ``a``/``b``."""
+    ra, rb = analyze_run(a, **kw), analyze_run(b, **kw)
+
+    def _last(r, key):
+        s = r.get(key)
+        return s["last"] if isinstance(s, dict) else None
+
+    def _evals_per_s(r):
+        ev, w = r.get("num_evals"), r.get("wall_s")
+        return ev / w if ev and w else None
+
+    rows = {}
+    for name, fa, fb in (
+        ("wall_s", ra.get("wall_s"), rb.get("wall_s")),
+        ("num_evals", ra.get("num_evals"), rb.get("num_evals")),
+        ("evals_per_s", _evals_per_s(ra), _evals_per_s(rb)),
+        ("best_loss", _last(ra, "best_loss"), _last(rb, "best_loss")),
+        ("hypervolume", _last(ra, "hypervolume"),
+         _last(rb, "hypervolume")),
+        ("diversity", _last(ra, "diversity"), _last(rb, "diversity")),
+        ("mutation_accept_rate", _last(ra, "mutation_accept_rate"),
+         _last(rb, "mutation_accept_rate")),
+    ):
+        rows[name] = {
+            "a": fa,
+            "b": fb,
+            "delta": (fb - fa) if (fa is not None and fb is not None)
+            else None,
+            "ratio": (fb / fa) if (fa and fb is not None) else None,
+        }
+    stage_rows = {}
+    for name in sorted(
+        set(ra.get("stages", {})) | set(rb.get("stages", {}))
+    ):
+        sa = ra.get("stages", {}).get(name, {}).get("total_s")
+        sb = rb.get("stages", {}).get(name, {}).get("total_s")
+        stage_rows[name] = {
+            "a_s": sa, "b_s": sb,
+            "ratio": (sb / sa) if (sa and sb is not None) else None,
+        }
+    return {
+        "verdicts": {"a": ra["verdict"], "b": rb["verdict"]},
+        "metrics": rows,
+        "stages": stage_rows,
+        "a": ra,
+        "b": rb,
+    }
+
+
+def self_check(path: str, skip_validation: bool = False) -> Dict[str, Any]:
+    """The lint-gate form (``--self-check``): the log must schema-validate
+    (``events.validate_events_file``) AND the doctor must produce a
+    verdict from :data:`VERDICTS` on it without raising. Returns
+    ``{"ok", "verdict", "detail"}``. ``skip_validation=True`` is for
+    callers that already validated the same file (scripts/lint.py does,
+    immediately before) — one schema pass, not two."""
+    out: Dict[str, Any] = {"ok": False, "verdict": None, "detail": ""}
+    if not skip_validation:
+        from .events import validate_events_file
+
+        val = validate_events_file(path)
+        if not val["ok"]:
+            out["detail"] = f"schema: {val['problems'][0]}"
+            return out
+    try:
+        report = analyze_run(path)
+    except Exception as e:  # pragma: no cover - the point of the check
+        out["detail"] = f"analyze_run raised {type(e).__name__}: {e}"
+        return out
+    out["verdict"] = report["verdict"]
+    if report["verdict"] not in VERDICTS:
+        out["detail"] = f"unknown verdict {report['verdict']!r}"
+        return out
+    out["ok"] = True
+    out["detail"] = f"verdict {report['verdict']} on {report['events']} events"
+    return out
+
+
+def resolve_log(path: str) -> str:
+    """A directory argument resolves to its newest ``events-*.jsonl``
+    (the run most recently written to); a file passes through."""
+    if os.path.isdir(path):
+        cands = [
+            os.path.join(path, f) for f in os.listdir(path)
+            if f.startswith("events-") and f.endswith(".jsonl")
+        ]
+        if not cands:
+            raise FileNotFoundError(f"no events-*.jsonl under {path!r}")
+        return max(cands, key=os.path.getmtime)
+    return path
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    """Human-oriented rendering of one analyze_run report."""
+    lines = []
+    run = report.get("run", {})
+    lines.append(
+        f"run {run.get('run', '?')} [{run.get('backend', '?')}] "
+        f"-> verdict: {report['verdict'].upper()}"
+    )
+    for r in report.get("reasons", []):
+        lines.append(f"  - {r}")
+    bl = report.get("best_loss")
+    if bl:
+        lines.append(
+            f"best loss: {bl['first']:.6g} -> {bl['last']:.6g} "
+            f"(improvement {bl.get('improvement', 0.0) * 100:.1f}%) over "
+            f"{report.get('metric_snapshots', 0)} snapshots"
+        )
+    dv = report.get("diversity")
+    if dv:
+        lines.append(f"diversity: {dv['first']:.3f} -> {dv['last']:.3f}")
+    hv = report.get("hypervolume")
+    if hv:
+        lines.append(
+            f"hypervolume: {hv['first']:.4f} -> {hv['last']:.4f}"
+        )
+    mr = report.get("mutation_accept_rate")
+    if mr:
+        lines.append(
+            f"mutation accept rate: {mr['first']:.3f} -> {mr['last']:.3f}"
+        )
+    stages = report.get("stages", {})
+    if stages:
+        total = sum(v["total_s"] for v in stages.values()) or 1.0
+        lines.append("stage wall time:")
+        for name, v in sorted(
+            stages.items(), key=lambda kv: -kv[1]["total_s"]
+        ):
+            lines.append(
+                f"  {name:>14}: {v['total_s']:9.3f}s "
+                f"({100 * v['total_s'] / total:5.1f}%) x{v['count']}"
+            )
+    if report.get("faults"):
+        lines.append(f"faults: {len(report['faults'])} "
+                     f"(resumable: {report.get('resumable')})")
+        for f in report["faults"][-3:]:
+            lines.append(
+                f"  iteration {f.get('iteration')}: "
+                f"{f.get('error_type')} at {f.get('where')}"
+            )
+    if "tunnel_state" in report:
+        lines.append(f"tunnel: {report['tunnel_state']}")
+    if report.get("wall_s") is not None:
+        lines.append(f"wall: {report['wall_s']:.1f}s, events: "
+                     f"{report['events']} "
+                     f"(+{report['skipped_lines']} unparseable)")
+    return "\n".join(lines)
+
+
+def render_comparison_text(cmp: Dict[str, Any]) -> str:
+    lines = [
+        f"A: {cmp['verdicts']['a']}  vs  B: {cmp['verdicts']['b']}",
+        f"{'metric':>22} {'A':>12} {'B':>12} {'B/A':>8}",
+    ]
+
+    def _fmt(v):
+        return f"{v:.6g}" if isinstance(v, float) else str(v)
+
+    for name, row in cmp["metrics"].items():
+        ratio = row["ratio"]
+        lines.append(
+            f"{name:>22} {_fmt(row['a']):>12} {_fmt(row['b']):>12} "
+            f"{(f'{ratio:.3f}' if ratio is not None else '-'):>8}"
+        )
+    for name, row in cmp["stages"].items():
+        ratio = row["ratio"]
+        lines.append(
+            f"{'stage ' + name:>22} {_fmt(row['a_s']):>12} "
+            f"{_fmt(row['b_s']):>12} "
+            f"{(f'{ratio:.3f}' if ratio is not None else '-'):>8}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m symbolicregression_jl_tpu.telemetry.analyze",
+        description=(
+            "Run doctor over telemetry event logs: one log -> verdict "
+            "(exit 0 iff healthy), two logs -> A/B comparison."
+        ),
+    )
+    ap.add_argument(
+        "logs", nargs="+",
+        help="1-2 event logs (a directory resolves to its newest "
+        "events-*.jsonl)",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument(
+        "--self-check", action="store_true",
+        help="schema-validate the log and assert the doctor yields a "
+        "verdict (the scripts/lint.py golden-fixture gate)",
+    )
+    ap.add_argument("--stall-window", type=int, default=STALL_WINDOW)
+    ap.add_argument("--stall-tol", type=float, default=STALL_TOL)
+    ap.add_argument(
+        "--diversity-floor", type=float, default=DIVERSITY_FLOOR
+    )
+    ns = ap.parse_args(argv)
+
+    paths = [resolve_log(p) for p in ns.logs]
+    if ns.self_check:
+        out = self_check(paths[0])
+        print(
+            json.dumps(out) if ns.format == "json"
+            else f"self-check: {'OK' if out['ok'] else 'FAIL'} "
+                 f"({out['detail']})"
+        )
+        return 0 if out["ok"] else 1
+    kw = dict(
+        stall_window=ns.stall_window, stall_tol=ns.stall_tol,
+        diversity_floor=ns.diversity_floor,
+    )
+    if len(paths) == 2:
+        cmp = compare_runs(paths[0], paths[1], **kw)
+        print(
+            json.dumps(cmp, indent=2) if ns.format == "json"
+            else render_comparison_text(cmp)
+        )
+        return 0
+    if len(paths) > 2:
+        ap.error("pass one log (doctor) or two (comparison)")
+    report = analyze_run(paths[0], **kw)
+    print(
+        json.dumps(report, indent=2) if ns.format == "json"
+        else render_text(report)
+    )
+    return 0 if report["verdict"] == "healthy" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

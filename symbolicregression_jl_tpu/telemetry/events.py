@@ -43,8 +43,12 @@ SCHEMA_PATH = os.path.join(
 
 def _sanitize(obj):
     """Recursively convert to strict-JSON-serializable Python values:
-    numpy scalars/arrays -> Python, tuples -> lists, non-finite floats ->
-    None, dict keys -> str."""
+    numpy scalars/arrays -> Python, tuples/sets -> lists, non-finite
+    floats -> None, dict keys -> str. The non-finite coercion applies at
+    EVERY nesting level — a metrics snapshot is a dict of dicts of
+    gauges, and an Inf three levels down must become null exactly like a
+    top-level one (unit-tested), or json.dumps(allow_nan=False) would
+    disable the log."""
     import numpy as np
 
     if obj is None or isinstance(obj, (bool, str)):
@@ -54,6 +58,19 @@ def _sanitize(obj):
     if isinstance(obj, (float, np.floating)):
         f = float(obj)
         return f if math.isfinite(f) else None
+    if isinstance(obj, complex):
+        # complex is numeric enough that np.asarray would wrap it as a
+        # non-object array whose tolist() hands it straight back — the
+        # one numeric type that used to recurse without terminating
+        return str(obj)
+    if isinstance(obj, (set, frozenset)):
+        # sets used to fall through to np.asarray (a 0-d object array)
+        # and stringify wholesale; coerce the MEMBERS instead
+        try:
+            members = sorted(obj)
+        except TypeError:
+            members = list(obj)
+        return [_sanitize(v) for v in members]
     if isinstance(obj, np.ndarray):
         if obj.dtype == object:
             # tolist() of an object array hands the wrapped Python

@@ -13,12 +13,31 @@ semantics everyone expects from them:
 :class:`SearchMetrics` is the search-specific feeder: once per
 ``telemetry_every`` iterations it runs ONE fused jitted device reduction
 over the island states (per-island best/mean loss, population length
-bincount) — a single extra dispatch off the hot path, zero primitives
-added to the search programs — and combines it with values the host
-already holds (memo-bank counters, annealing temperature, hall-of-fame
-Pareto size and a dominated-hypervolume proxy, device HBM stats). The
-snapshot is emitted to the event sink as one ``metrics`` event per
-iteration (docs/observability.md lists the full catalog).
+bincount, and the search-dynamics signals below) — a single extra
+dispatch off the hot path, zero primitives added to the search programs
+— and combines it with values the host already holds (memo-bank
+counters, annealing temperature, device HBM stats). The snapshot is
+emitted to the event sink as one ``metrics`` event per iteration
+(docs/observability.md lists the full catalog).
+
+Search-dynamics signals (GP-dynamics literature — TensorGP, arxiv
+2103.07512; Kozax, arxiv 2502.03047 — names diversity collapse and
+operator-acceptance drift as the leading indicators of wasted
+tensorized-GP compute), all folded into the same fused reduction:
+
+* **per-island population diversity** — the unique-tree fraction of each
+  island's population, keyed on the same two-lane FNV-64 content hash
+  the memo bank uses (``cache.hashing.tree_hash_device``): a sort plus
+  one adjacent-difference count per island, entirely on device;
+* **per-mutation-type proposal/acceptance counters** — the cumulative
+  ``IslandState.mut_counts`` aggregates summed across islands and
+  published per kind (``models.evolve.mutation_counts_table``);
+* **Pareto frontier snapshot + exact hypervolume** — the merged
+  hall of fame's (complexity, loss) frontier rides along in the same
+  fetch and the event carries both the raw frontier vector and the
+  EXACT dominated 2-D hypervolume (:func:`hypervolume_2d`, w.r.t. the
+  reference point (maxsize+1, baseline loss)) — replacing the
+  slot-scan proxy earlier revisions emitted.
 """
 
 from __future__ import annotations
@@ -157,26 +176,59 @@ class MetricsRegistry:
 # ---------------------------------------------------------------------------
 
 
-def _hypervolume_proxy(hof_losses, hof_exists, baseline: float) -> float:
-    """Dominated-hypervolume proxy of the hall-of-fame frontier in [0, 1]:
-    the mean over complexity slots 1..S of the baseline-normalized loss
-    improvement ``max(0, 1 - best_loss_at_or_below(c) / baseline)`` —
-    i.e. the area (in normalized-loss x complexity-fraction units) the
-    frontier dominates w.r.t. the reference point (maxsize, baseline
-    loss). Cheap, monotone under frontier improvement, and comparable
-    across iterations of one run (NOT across datasets)."""
+def hypervolume_2d(
+    complexities,
+    losses,
+    ref_complexity: float,
+    ref_loss: float,
+    c_floor: float = 1.0,
+) -> float:
+    """EXACT dominated 2-D hypervolume of a (complexity, loss) point set,
+    both objectives minimized, w.r.t. the reference point
+    ``(ref_complexity, ref_loss)`` — normalized to [0, 1] by the
+    reference box ``(ref_complexity - c_floor) * ref_loss``.
+
+    The staircase sum: points are sorted by complexity, dominated points
+    drop out via a running loss minimum, and each frontier member
+    contributes ``(next_complexity - complexity) * (ref_loss - loss)``.
+    Points at/beyond the reference in either objective contribute
+    nothing; losses are clipped at 0 (a loss cannot dominate below the
+    origin in baseline-normalized units).
+
+    For the hall of fame (one slot per integer complexity ``1..S``,
+    reference ``(S+1, baseline)``, ``c_floor=1``) this equals the mean
+    over slots of the clipped normalized improvement — the quantity
+    earlier revisions approximated with a slot scan — but it is computed
+    from the actual frontier points, so it stays exact for any point
+    spacing. Monotone under frontier improvement; comparable across
+    iterations of one run (NOT across datasets — it is normalized by
+    the run's own baseline loss)."""
     import numpy as np
 
-    losses = np.asarray(hof_losses, np.float64)
-    exists = np.asarray(hof_exists, bool)
-    if baseline is None or not np.isfinite(baseline) or baseline <= 0:
+    if (
+        ref_loss is None
+        or not np.isfinite(ref_loss)
+        or ref_loss <= 0
+        or ref_complexity <= c_floor
+    ):
         return 0.0
-    best = np.where(exists & np.isfinite(losses), losses, np.inf)
-    runmin = np.minimum.accumulate(best)
-    gain = np.where(
-        np.isfinite(runmin), np.clip(1.0 - runmin / baseline, 0.0, 1.0), 0.0
-    )
-    return float(gain.mean())
+    c = np.asarray(complexities, np.float64)
+    l = np.asarray(losses, np.float64)
+    keep = np.isfinite(c) & np.isfinite(l) & (c < ref_complexity)
+    c, l = c[keep], np.clip(l[keep], 0.0, None)
+    if c.size == 0:
+        return 0.0
+    order = np.argsort(c, kind="stable")
+    c, l = c[order], l[order]
+    runmin = np.minimum.accumulate(l)
+    # one step per distinct complexity: the best (lowest-runmin) entry
+    # is the last one at that complexity after the running minimum
+    last = np.r_[c[1:] != c[:-1], True]
+    c, runmin = c[last], runmin[last]
+    widths = np.diff(np.r_[c, ref_complexity])
+    heights = np.clip(ref_loss - runmin, 0.0, None)
+    hv = float(np.sum(widths * heights))
+    return hv / ((ref_complexity - c_floor) * ref_loss)
 
 
 class SearchMetrics:
@@ -200,13 +252,17 @@ class SearchMetrics:
 
         max_len = self.options.max_len
 
-        def reduce_states(losses, lengths, hof_losses, hof_exists,
-                          num_evals):
-            # (I, npop) losses / lengths; (S,) hof. ONE fused program,
-            # outputs a few KB — a single dispatch + fetch per snapshot
-            # (the hof arrays pass through so the host-side hypervolume
-            # proxy reads the same fetch instead of syncing again; on a
+        def reduce_states(trees, losses, hof_losses, hof_exists,
+                          num_evals, mut_counts):
+            # trees: TreeBatch with leading (I, npop); (I, npop) losses;
+            # (S,) hof; (I, K, 2) mut_counts. ONE fused program, outputs
+            # a few KB — a single dispatch + fetch per snapshot (the hof
+            # arrays pass through so the host-side exact hypervolume
+            # reads the same fetch instead of syncing again; on a
             # tunneled TPU each extra round trip is ~70 ms).
+            from ..cache.hashing import tree_hash_device
+
+            lengths = trees.length
             finite = jnp.isfinite(losses)
             big = jnp.asarray(jnp.finfo(jnp.float32).max, losses.dtype)
             best = jnp.min(jnp.where(finite, losses, big), axis=1)
@@ -219,16 +275,33 @@ class SearchMetrics:
             )
             mean_len = jnp.mean(lengths.astype(jnp.float32))
             hof_size = jnp.sum(hof_exists.astype(jnp.int32))
+
+            # per-island diversity: unique-tree fraction on the memo
+            # bank's 64-bit content hash (two uint32 lanes; a collision
+            # needs a 2^-64 pair — docs/memo_bank.md). Sort the lanes
+            # lexicographically per island, count adjacent differences.
+            h1, h2 = tree_hash_device(trees)  # (I, npop) uint32 each
+
+            def _unique_frac(a, b):
+                sa, sb = jax.lax.sort((a, b), num_keys=2)
+                neq = (sa[1:] != sa[:-1]) | (sb[1:] != sb[:-1])
+                return (1 + jnp.sum(neq.astype(jnp.int32))) / a.shape[0]
+
+            diversity = jax.vmap(_unique_frac)(h1, h2)  # (I,) in (0, 1]
+
             return {
                 "island_best_loss": best,
                 "island_mean_loss": mean,
                 "island_finite_frac": n_fin / losses.shape[1],
+                "island_diversity": diversity,
                 "length_counts": len_counts,
                 "mean_length": mean_len,
                 "hof_size": hof_size,
                 "hof_losses": hof_losses,
                 "hof_exists": hof_exists,
                 "num_evals": jnp.sum(num_evals),
+                # cumulative per-kind (proposed, accepted) over islands
+                "mut_counts": jnp.sum(mut_counts, axis=0),
             }
 
         self._reduce = jax.jit(reduce_states)
@@ -256,8 +329,9 @@ class SearchMetrics:
 
         vals = jax.device_get(
             self._reduction_fn()(
-                states.pop.losses, states.pop.trees.length,
+                states.pop.trees, states.pop.losses,
                 ghof.losses, ghof.exists, states.num_evals,
+                states.mut_counts,
             )
         )
         reg = self.registry
@@ -281,11 +355,37 @@ class SearchMetrics:
             int(vals["hof_size"])
         )
         reg.gauge(
-            "hof_hypervolume_proxy",
-            "dominated-hypervolume proxy of the HoF frontier [0,1]",
-        ).set(_hypervolume_proxy(
-            vals["hof_losses"], vals["hof_exists"], baseline
+            "population_diversity",
+            "mean unique-tree fraction across islands (FNV-64 keyed)",
+        ).set(float(np.mean(vals["island_diversity"])))
+
+        # Pareto frontier of the merged HoF: (complexity, loss) for the
+        # occupied finite slots (slot i holds complexity i+1), plus the
+        # EXACT dominated hypervolume w.r.t. (maxsize+1, baseline)
+        hof_losses = np.asarray(vals["hof_losses"], np.float64)
+        hof_exists = np.asarray(vals["hof_exists"], bool)
+        front = hof_exists & np.isfinite(hof_losses)
+        pareto_c = (np.where(front)[0] + 1).tolist()
+        pareto_l = hof_losses[front].tolist()
+        S = hof_losses.shape[0]
+        reg.gauge(
+            "hof_hypervolume",
+            "exact dominated 2-D hypervolume of the HoF frontier [0,1]",
+        ).set(hypervolume_2d(
+            pareto_c, pareto_l, ref_complexity=S + 1,
+            ref_loss=baseline if baseline is not None else float("nan"),
         ))
+
+        # per-mutation proposal/acceptance (cumulative device counters)
+        from ..models.evolve import mutation_counts_table
+
+        mutations = mutation_counts_table(vals["mut_counts"])
+        tot_prop = sum(m["proposed"] for m in mutations.values())
+        tot_acc = sum(m["accepted"] for m in mutations.values())
+        reg.gauge(
+            "mutation_accept_rate",
+            "cumulative accepted/proposed over all mutation kinds",
+        ).set(tot_acc / tot_prop if tot_prop else None)
         reg.gauge("num_evals_total", "cumulative equation evaluations").set(
             float(vals["num_evals"])
         )
@@ -368,6 +468,13 @@ class SearchMetrics:
                             vals["island_mean_loss"], np.float64
                         )
                     ],
+                    "diversity": [
+                        float(v) for v in np.asarray(
+                            vals["island_diversity"], np.float64
+                        )
+                    ],
                 },
+                pareto={"complexity": pareto_c, "loss": pareto_l},
+                mutations=mutations,
             )
         return snap

@@ -138,18 +138,17 @@ def test_metrics_registry_semantics():
     assert reg.snapshot()["gauges"]["best_loss"] is None
 
 
-def test_hypervolume_proxy_bounds():
-    from symbolicregression_jl_tpu.telemetry.metrics import (
-        _hypervolume_proxy,
-    )
+def test_hypervolume_2d_bounds():
+    from symbolicregression_jl_tpu.telemetry.metrics import hypervolume_2d
 
-    losses = np.array([np.inf, 0.5, 0.1, np.inf])
-    exists = np.array([False, True, True, False])
-    hv = _hypervolume_proxy(losses, exists, baseline=1.0)
-    # slots: [0, 0.5, 0.9, 0.9] / 4
+    # the HoF frontier of 4 slots: members at complexity 2 (loss 0.5)
+    # and 3 (loss 0.1), reference (S+1, baseline) — the staircase
+    # covers slots 2..4: [0, 0.5, 0.9, 0.9] / 4 in normalized units
+    hv = hypervolume_2d([2, 3], [0.5, 0.1], ref_complexity=5,
+                        ref_loss=1.0)
     assert math.isclose(hv, (0.0 + 0.5 + 0.9 + 0.9) / 4)
-    assert _hypervolume_proxy(losses, exists, baseline=0.0) == 0.0
-    assert _hypervolume_proxy(losses, np.zeros(4, bool), 1.0) == 0.0
+    assert hypervolume_2d([2, 3], [0.5, 0.1], 5, 0.0) == 0.0
+    assert hypervolume_2d([], [], 5, 1.0) == 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -460,12 +459,79 @@ def test_full_search_telemetry_round_trip(tmp_path):
         snap = m["snapshot"]
         assert snap["gauges"]["best_loss"] is not None
         assert snap["gauges"]["hof_size"] >= 1
-        assert 0.0 <= snap["gauges"]["hof_hypervolume_proxy"] <= 1.0
+        # search-dynamics fields (ISSUE 10): exact hypervolume,
+        # per-island diversity, Pareto snapshot, per-mutation counters
+        assert 0.0 <= snap["gauges"]["hof_hypervolume"] <= 1.0
+        assert 0.0 < snap["gauges"]["population_diversity"] <= 1.0
         assert sum(
             snap["histograms"]["population_length"]["counts"]
         ) == 3 * 16  # islands x npop
         assert len(m["per_island"]["best_loss"]) == 3
+        assert len(m["per_island"]["diversity"]) == 3
+        assert all(0.0 < d <= 1.0 for d in m["per_island"]["diversity"])
+        pareto = m["pareto"]
+        assert len(pareto["complexity"]) == len(pareto["loss"]) >= 1
+        assert pareto["complexity"] == sorted(pareto["complexity"])
+        muts = m["mutations"]
+        from symbolicregression_jl_tpu.models.evolve import (
+            MUTATION_NAMES,
+        )
+
+        assert set(muts) == set(MUTATION_NAMES)
+        for row in muts.values():
+            assert 0 <= row["accepted"] <= row["proposed"]
+    # acceptance counters are cumulative: monotone across snapshots
+    first, last = metrics[0]["mutations"], metrics[-1]["mutations"]
+    assert all(
+        last[k]["proposed"] >= first[k]["proposed"] for k in first
+    )
     assert [e for e in events if e["type"] == "progress"]
+    # the run doctor reads this same log as healthy
+    from symbolicregression_jl_tpu.telemetry.analyze import analyze_run
+
+    report = analyze_run(path)
+    assert report["verdict"] == "healthy", report["reasons"]
+    assert report["spans_complete"]
+
+
+@pytest.mark.slow
+def test_chunked_driver_telemetry_bit_identical(tmp_path):
+    """ISSUE 10 acceptance: telemetry on/off HoF bit-identity holds on
+    the CHUNKED dispatch driver too (max_cycles_per_dispatch set), not
+    only the fused one — the dynamics reduction reads state, never
+    perturbs the phase programs."""
+    import symbolicregression_jl_tpu as sr
+
+    rng = np.random.default_rng(3)
+    X = rng.standard_normal((2, 64)).astype(np.float32)
+    y = X[0] * X[1] + np.cos(X[1])
+    kw = dict(
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        niterations=2, npopulations=3, npop=16, ncycles_per_iteration=8,
+        maxsize=10, seed=11, verbosity=0, progress=False,
+        max_cycles_per_dispatch=3,
+    )
+    r_off = sr.equation_search(X, y, **kw)
+    r_on = sr.equation_search(
+        X, y, telemetry=True, telemetry_dir=str(tmp_path), **kw
+    )
+
+    def frontier(r):
+        return [
+            (c.complexity, float(c.loss), float(c.score), c.equation)
+            for c in r.frontier()
+        ]
+
+    assert frontier(r_off) == frontier(r_on)
+    (path,) = [
+        os.path.join(tmp_path, f) for f in os.listdir(tmp_path)
+        if f.endswith(".jsonl")
+    ]
+    report = validate_events_file(path)
+    assert report["ok"], report["problems"]
+    from symbolicregression_jl_tpu.telemetry.analyze import analyze_run
+
+    assert analyze_run(path)["verdict"] == "healthy"
 
 
 @pytest.mark.slow

@@ -1,0 +1,629 @@
+"""Search-dynamics observability (ISSUE 10): the run doctor
+(telemetry/analyze.py), the exact hypervolume, srtop, the bench
+trajectory aggregator, schema evolution, and the watcher's telemetry
+classification.
+
+File name sorts EARLY (test_ac_*) and everything here is fast CPU-only
+host-side work — synthetic event lists and the checked-in artifacts, no
+searches, no compiles (the full closed loop — real search -> event log
+-> healthy verdict — lives in benchmark/suite.py's `run_doctor` case
+and test_ab_telemetry's slow round trip)."""
+
+import importlib.util
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.telemetry.analyze import (
+    VERDICTS,
+    analyze_run,
+    compare_runs,
+    load_events,
+    resolve_log,
+    self_check,
+)
+from symbolicregression_jl_tpu.telemetry.analyze import main as analyze_main
+from symbolicregression_jl_tpu.telemetry.metrics import hypervolume_2d
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(
+    REPO, "tests", "data", "telemetry", "golden_events.jsonl"
+)
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_test", os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# synthetic event-log builder
+# ---------------------------------------------------------------------------
+
+
+def make_run(
+    best,
+    diversity=None,
+    finite_frac=None,
+    fault=False,
+    saved=False,
+    complete=True,
+    spans=("init", "cycle", "mutate", "eval", "simplify", "optimize",
+           "merge_migrate"),
+):
+    """A synthetic event list shaped like a real run: run_start, one
+    span per stage, one metrics event per entry of `best`, optional
+    fault/saved_state, optional run_end."""
+    t = [0.0]
+
+    def ev(type, **f):
+        t[0] += 1.0
+        return {"v": 1, "t": t[0], "run": "r", "type": type, **f}
+
+    events = [ev("run_start", config_fingerprint="x", backend="cpu",
+                 devices=["TFRT_CPU_0"], nout=1)]
+    for s in spans:
+        events.append(ev("span", name=s, t_start=t[0], duration_s=0.5))
+    for i, b in enumerate(best):
+        gauges = {"best_loss": b}
+        if diversity is not None:
+            gauges["population_diversity"] = diversity[i]
+        if finite_frac is not None:
+            gauges["population_finite_frac"] = finite_frac[i]
+        events.append(ev(
+            "metrics", output=0, iteration=i,
+            snapshot={"counters": {}, "gauges": gauges, "histograms": {}},
+        ))
+    if saved:
+        events.append(ev("saved_state", outputs=1, path="/tmp/x.ckpt",
+                         iteration=len(best)))
+    if fault:
+        events.append(ev(
+            "dispatch_fault", where="iteration",
+            error_type="XlaRuntimeError", error="UNAVAILABLE",
+            iteration=len(best), fatal=True,
+        ))
+    if complete:
+        events.append(ev("run_end", num_evals=100.0, search_time_s=9.0))
+    return events
+
+
+# ---------------------------------------------------------------------------
+# exact hypervolume
+# ---------------------------------------------------------------------------
+
+
+def test_hypervolume_2d_staircase_exact():
+    # two frontier points, reference (10, 2.0), floor 1: widths 3 and 5
+    # at heights 1.0 and 1.8 -> (3*1.0 + 5*1.8) / (9 * 2.0)
+    hv = hypervolume_2d([2, 5], [1.0, 0.2], ref_complexity=10,
+                        ref_loss=2.0)
+    assert math.isclose(hv, (3 * 1.0 + 5 * 1.8) / (9 * 2.0))
+
+
+def test_hypervolume_2d_dominated_points_drop_out():
+    # the complexity-4 point is dominated (higher loss than the
+    # running minimum): adding it must not change the volume
+    base = hypervolume_2d([2, 5], [1.0, 0.2], 10, 2.0)
+    with_dominated = hypervolume_2d([2, 4, 5], [1.0, 1.5, 0.2], 10, 2.0)
+    assert math.isclose(base, with_dominated)
+
+
+def test_hypervolume_2d_matches_slot_scan_on_hof_data():
+    # on integer slot data the exact staircase equals the old per-slot
+    # scan (mean of clipped normalized improvements)
+    rng = np.random.default_rng(0)
+    S, baseline = 12, 2.0
+    losses = rng.uniform(0.05, 3.0, S)
+    exists = rng.random(S) < 0.7
+    c = (np.where(exists)[0] + 1).tolist()
+    l = losses[exists].tolist()
+    best = np.where(exists, losses, np.inf)
+    runmin = np.minimum.accumulate(best)
+    slot_scan = float(np.mean(np.where(
+        np.isfinite(runmin), np.clip(1 - runmin / baseline, 0, 1), 0.0
+    )))
+    assert math.isclose(
+        hypervolume_2d(c, l, S + 1, baseline), slot_scan, rel_tol=1e-12
+    )
+
+
+def test_hypervolume_2d_edge_cases():
+    assert hypervolume_2d([1], [0.5], 2, float("nan")) == 0.0
+    assert hypervolume_2d([5], [0.5], 5, 1.0) == 0.0  # at reference
+    assert hypervolume_2d([1], [float("inf")], 5, 1.0) == 0.0
+    # negative losses clip at 0: cannot dominate beyond the box
+    assert hypervolume_2d([1], [-5.0], 2, 1.0) == 1.0
+
+
+def test_mutation_counts_table():
+    from symbolicregression_jl_tpu.models.evolve import (
+        MUTATION_NAMES,
+        mutation_counts_table,
+    )
+
+    K = len(MUTATION_NAMES)
+    counts = np.zeros((3, K, 2), np.int32)  # (islands, kinds, 2)
+    counts[:, 0, 0] = 4  # mutate_constant proposed 12, accepted 6
+    counts[:, 0, 1] = 2
+    table = mutation_counts_table(counts)
+    assert set(table) == set(MUTATION_NAMES)
+    assert table["mutate_constant"] == {
+        "proposed": 12, "accepted": 6, "accept_rate": 0.5,
+    }
+    assert table["crossover"]["accept_rate"] is None  # never proposed
+
+
+# ---------------------------------------------------------------------------
+# run doctor verdicts
+# ---------------------------------------------------------------------------
+
+
+def test_analyze_healthy_improving_run():
+    ev = make_run(best=[2.0, 1.5, 1.0, 0.6, 0.4, 0.2],
+                  diversity=[0.9] * 6)
+    r = analyze_run(ev)
+    assert r["verdict"] == "healthy"
+    assert r["complete"] and r["spans_complete"]
+    assert r["best_loss"]["improvement"] == pytest.approx(0.9)
+
+
+def test_analyze_stalled_plateau_with_diversity_collapse():
+    # flat best loss over the window AND diversity at the floor
+    ev = make_run(best=[1.0] * 8, diversity=[0.9, 0.8, 0.5, 0.3, 0.15,
+                                             0.12, 0.1, 0.1])
+    r = analyze_run(ev)
+    assert r["verdict"] == "stalled"
+    assert any("plateau" in x for x in r["reasons"])
+
+
+def test_analyze_plateau_with_healthy_diversity_stays_healthy():
+    ev = make_run(best=[1.0] * 8, diversity=[0.9] * 8)
+    r = analyze_run(ev)
+    assert r["verdict"] == "healthy"
+    assert any("plateau" in x for x in r["reasons"])
+
+
+def test_analyze_converged_zero_loss_is_healthy_not_stalled():
+    # a run that found the exact equation: loss pinned at 0 with the
+    # population converged onto the solution — success, not a stall
+    ev = make_run(best=[1.0, 0.1, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0],
+                  diversity=[0.9, 0.5, 0.1, 0.05, 0.05, 0.05, 0.05,
+                             0.05])
+    r = analyze_run(ev)
+    assert r["verdict"] == "healthy"
+    assert any("converged" in x for x in r["reasons"])
+
+
+def test_analyze_short_run_never_stalls():
+    # 2 snapshots cannot span the stall window: a flat tiny run is
+    # healthy (the suite's 2-iteration case must not read as stalled)
+    ev = make_run(best=[1.0, 1.0], diversity=[0.1, 0.1])
+    assert analyze_run(ev)["verdict"] == "healthy"
+
+
+def test_analyze_diverging_on_nan_flood_and_finite_collapse():
+    ev = make_run(best=[1.0, None, None], diversity=[0.9] * 3)
+    assert analyze_run(ev)["verdict"] == "diverging"
+    ev2 = make_run(best=[1.0, 0.9, 0.8],
+                   finite_frac=[1.0, 0.5, 0.05])
+    r2 = analyze_run(ev2)
+    assert r2["verdict"] == "diverging"
+    assert any("finite" in x for x in r2["reasons"])
+
+
+def test_analyze_multi_output_series_not_interleaved():
+    # nout=2, one metrics event per output per iteration: output 0
+    # improves to ~0 while output 1 sits flat at 2.0 with healthy
+    # diversity — the zigzag [2.0, 1e-6, 2.0, ...] must NOT read as a
+    # plateau or divergence; per-output judgment keeps it healthy
+    t = [0.0]
+
+    def ev(type, **f):
+        t[0] += 1.0
+        return {"v": 1, "t": t[0], "run": "r", "type": type, **f}
+
+    events = [ev("run_start", config_fingerprint="x", backend="cpu",
+                 devices=["d"], nout=2)]
+    for s in ("init", "cycle", "mutate", "eval", "simplify", "optimize",
+              "merge_migrate"):
+        events.append(ev("span", name=s, t_start=t[0], duration_s=0.1))
+    b0 = [2.0, 1.0, 0.1, 1e-4, 1e-5, 1e-6, 1e-6, 1e-6]
+    for i in range(len(b0)):
+        for j, b in ((0, b0[i]), (1, 2.0)):
+            events.append(ev(
+                "metrics", output=j, iteration=i,
+                snapshot={"counters": {}, "histograms": {}, "gauges": {
+                    "best_loss": b, "population_diversity": 0.8,
+                }},
+            ))
+    events.append(ev("run_end", num_evals=1.0, search_time_s=1.0))
+    r = analyze_run(events)
+    assert r["verdict"] == "healthy", r["reasons"]
+    assert set(r["per_output"]) == {0, 1}
+    assert r["per_output"][0]["best_loss"] == pytest.approx(1e-6)
+    assert r["per_output"][1]["best_loss"] == 2.0
+    # one output NaN-flooding tips the whole run to diverging
+    events2 = [e for e in events if e["type"] != "run_end"]
+    events2.append(ev(
+        "metrics", output=1, iteration=len(b0),
+        snapshot={"counters": {}, "histograms": {},
+                  "gauges": {"best_loss": None}},
+    ))
+    assert analyze_run(events2)["verdict"] == "diverging"
+
+
+def test_analyze_faulted_resumable_vs_dead():
+    r = analyze_run(make_run(best=[1.0], fault=True, saved=True,
+                             complete=False))
+    assert r["verdict"] == "faulted" and r["resumable"]
+    r2 = analyze_run(make_run(best=[1.0], fault=True, complete=False))
+    assert r2["verdict"] == "faulted" and not r2["resumable"]
+    assert all(v in VERDICTS for v in (r["verdict"], r2["verdict"]))
+
+
+def test_analyze_incomplete_and_empty():
+    r = analyze_run(make_run(best=[2.0, 1.0], complete=False))
+    assert r["verdict"] == "incomplete"
+    assert analyze_run([])["verdict"] == "empty"
+
+
+def test_analyze_tolerates_truncated_file(tmp_path):
+    p = tmp_path / "events.jsonl"
+    lines = [json.dumps(e) for e in make_run(best=[2.0, 1.0])]
+    # a mid-write kill: the last line is cut mid-object
+    p.write_text("\n".join(lines[:-1]) + "\n" + lines[-1][: len(lines[-1]) // 2])
+    events, skipped = load_events(str(p))
+    assert skipped == 1 and len(events) == len(lines) - 1
+    r = analyze_run(str(p))
+    assert r["skipped_lines"] == 1
+    assert r["verdict"] == "incomplete"  # run_end was the cut line
+
+
+def test_analyze_golden_fixture_healthy():
+    r = analyze_run(GOLDEN)
+    assert r["verdict"] == "healthy", r["reasons"]
+    assert r["spans_complete"]
+    assert 0.0 < r["diversity"]["last"] <= 1.0
+    assert 0.0 <= r["hypervolume"]["last"] <= 1.0
+    assert r["mutations"]  # per-mutation acceptance table present
+    assert r["pareto"]["complexity"]
+    out = self_check(GOLDEN)
+    assert out["ok"] and out["verdict"] == "healthy"
+
+
+def test_compare_runs_ratios():
+    a = make_run(best=[2.0, 1.0], diversity=[0.9, 0.8])
+    b = make_run(best=[2.0, 0.5], diversity=[0.9, 0.6])
+    cmp = compare_runs(a, b)
+    assert cmp["verdicts"] == {"a": "healthy", "b": "healthy"}
+    row = cmp["metrics"]["best_loss"]
+    assert row["a"] == 1.0 and row["b"] == 0.5 and row["ratio"] == 0.5
+    assert "cycle" in cmp["stages"]
+
+
+def test_analyze_cli_exit_codes(tmp_path, capsys):
+    # healthy golden -> 0; crafted plateau fixture -> 1, STALLED printed
+    assert analyze_main([GOLDEN]) == 0
+    capsys.readouterr()
+    p = tmp_path / "stalled.jsonl"
+    p.write_text("\n".join(
+        json.dumps(e) for e in make_run(
+            best=[1.0] * 8, diversity=[0.1] * 8
+        )
+    ) + "\n")
+    assert analyze_main([str(p)]) == 1
+    assert "STALLED" in capsys.readouterr().out
+    # self-check mode + directory resolution (events-* naming)
+    assert analyze_main([GOLDEN, "--self-check"]) == 0
+    d = tmp_path / "runs"
+    d.mkdir()
+    (d / "events-x.jsonl").write_text(open(GOLDEN).read())
+    assert resolve_log(str(d)).endswith("events-x.jsonl")
+    empty = tmp_path / "nothing_here"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError):
+        resolve_log(str(empty))
+    # comparison mode exits 0 and prints both verdicts
+    assert analyze_main([GOLDEN, str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "healthy" in out and "stalled" in out
+
+
+# ---------------------------------------------------------------------------
+# schema evolution (v1 is additive-open, required fields are load-bearing)
+# ---------------------------------------------------------------------------
+
+
+def test_schema_accepts_additive_fields():
+    from symbolicregression_jl_tpu.telemetry import validate_event
+
+    base = {
+        "v": 1, "t": 0.0, "run": "r", "type": "metrics",
+        "snapshot": {"counters": {}, "gauges": {}, "histograms": {}},
+    }
+    assert validate_event(base) == []
+    # additive fields — the dynamics extensions and any future ones —
+    # must validate on v1 without a schema bump
+    extended = dict(
+        base,
+        pareto={"complexity": [1, 3], "loss": [2.0, 1.0]},
+        mutations={"add_node": {"proposed": 3, "accepted": 1,
+                                "accept_rate": 1 / 3}},
+        per_island={"diversity": [0.5]},
+        some_future_field={"anything": True},
+    )
+    assert validate_event(extended) == []
+
+
+def test_schema_rejects_removed_and_retyped_required_fields():
+    from symbolicregression_jl_tpu.telemetry import validate_event
+
+    base = {
+        "v": 1, "t": 0.0, "run": "r", "type": "metrics",
+        "snapshot": {"counters": {}, "gauges": {}, "histograms": {}},
+    }
+    removed = {k: v for k, v in base.items() if k != "snapshot"}
+    assert any("snapshot" in p for p in validate_event(removed))
+    retyped = dict(base, snapshot="not-an-object")
+    assert any("snapshot" in p for p in validate_event(retyped))
+    # envelope: retyped run id / wrong version are rejected too
+    assert validate_event(dict(base, run=7))
+    assert validate_event(dict(base, v="1"))
+
+
+def test_schema_file_carries_dynamics_and_roofline():
+    from symbolicregression_jl_tpu.telemetry.events import load_schema
+
+    schema = load_schema()
+    assert "roofline" in schema["properties"]["type"]["enum"]
+    assert "roofline" in schema["definitions"]
+    metrics_props = schema["definitions"]["metrics"]["properties"]
+    assert "pareto" in metrics_props and "mutations" in metrics_props
+    # a roofline event (bench.py) validates: fraction OR skip_reason
+    from symbolicregression_jl_tpu.telemetry import validate_event
+
+    assert validate_event({
+        "v": 1, "t": 0.0, "run": "r", "type": "roofline",
+        "fraction": None, "skip_reason": "cpu-only",
+        "trees_rows_per_s": 1e6,
+    }) == []
+
+
+def test_event_log_nested_nonfinite_coercion(tmp_path):
+    """ISSUE 10 satellite: non-finite -> null applies inside nested
+    metric dicts (and lists/sets) at every depth, not only to top-level
+    values — otherwise json.dumps(allow_nan=False) would disable the
+    log on the first Inf gauge."""
+    from symbolicregression_jl_tpu.telemetry import EventLog
+
+    path = str(tmp_path / "e.jsonl")
+    log = EventLog(path, run_id="r")
+    ev = log.emit(
+        "metrics",
+        snapshot={
+            "counters": {},
+            "gauges": {"best_loss": float("inf"),
+                       "nested": {"deep": float("nan")}},
+            "histograms": {"h": {"edges": [1.0],
+                                 "counts": [float("-inf"), 2]}},
+        },
+        per_island={"best_loss": [1.0, float("nan")]},
+        odd={"set": {1.5, float("inf")}, "complex": complex(1, 2)},
+    )
+    assert ev is not None  # the log survived
+    line = json.loads(open(path).read().splitlines()[0])
+    g = line["snapshot"]["gauges"]
+    assert g["best_loss"] is None
+    assert g["nested"]["deep"] is None
+    assert line["snapshot"]["histograms"]["h"]["counts"] == [None, 2]
+    assert line["per_island"]["best_loss"] == [1.0, None]
+    assert None in line["odd"]["set"] and 1.5 in line["odd"]["set"]
+    assert isinstance(line["odd"]["complex"], str)
+    log.close()
+
+
+# ---------------------------------------------------------------------------
+# srtop
+# ---------------------------------------------------------------------------
+
+
+def test_srtop_renders_complete_and_truncated_logs(tmp_path, capsys):
+    srtop = _load_script("srtop")
+    assert srtop.main([GOLDEN, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "srtop" in out and "stages:" in out and "diversity" in out
+    # truncated mid-write copy: renders without crashing, last event
+    # is simply held back
+    data = open(GOLDEN).read()
+    p = tmp_path / "trunc.jsonl"
+    p.write_text(data[: len(data) - 37])
+    assert srtop.main([str(p), "--once"]) == 0
+    assert "srtop" in capsys.readouterr().out
+    # directory form resolves the newest events-*.jsonl
+    d = tmp_path / "runs"
+    d.mkdir()
+    (d / "events-a.jsonl").write_text(data)
+    assert srtop.main([str(d), "--once"]) == 0
+    capsys.readouterr()
+    # empty dir: waiting frame, no crash
+    e = tmp_path / "empty"
+    e.mkdir()
+    assert srtop.main([str(e), "--once"]) == 0
+    assert "waiting" in capsys.readouterr().out
+    # nonexistent FILE path: waiting frame too, not an empty 'run ?'
+    # dashboard that never fills
+    assert srtop.main([str(tmp_path / "no-such.jsonl"), "--once"]) == 0
+    assert "waiting" in capsys.readouterr().out
+
+
+def test_srtop_logtail_incremental_and_partial_lines(tmp_path):
+    srtop = _load_script("srtop")
+    p = tmp_path / "events.jsonl"
+    p.write_text('{"type": "progress", "t": 1.0}\n{"type": "prog')
+    tail = srtop.LogTail(str(p))
+    events = tail.poll()
+    assert len(events) == 1  # the partial line is buffered, not parsed
+    with open(p, "a") as f:
+        f.write('ress", "t": 2.0}\n')
+    events = tail.poll()
+    assert len(events) == 1 and events[0]["t"] == 2.0
+    assert tail.poll() == []  # nothing new
+    # sparkline handles decades + non-finite entries
+    s = srtop.sparkline([1000.0, 10.0, None, float("nan"), 0.1])
+    assert len(s) == 3
+
+
+# ---------------------------------------------------------------------------
+# bench trajectory
+# ---------------------------------------------------------------------------
+
+
+def test_bench_trajectory_from_checked_in_rounds():
+    bt = _load_script("bench_trajectory")
+    traj = bt.build_trajectory(REPO)
+    rounds = [p.get("round") for p in traj["rounds"]]
+    assert rounds == sorted(rounds) and len(rounds) >= 5
+    # acceptance: throughput, roofline_fraction and multichip
+    # scaling_efficiency series exist over the checked-in artifacts
+    for key in ("throughput", "roofline_fraction",
+                "multichip_scaling_efficiency"):
+        assert key in traj["series"]
+        assert len(traj["series"][key]) >= 5
+    assert any(
+        p["value"] is not None for p in traj["series"]["throughput"]
+    )
+    assert any(
+        p["value"] is not None
+        for p in traj["series"]["multichip_scaling_efficiency"]
+    )
+    md = bt.render_markdown(traj)
+    assert "| round |" in md and "Per-metric summary" in md
+    summary = bt.bench_summary(traj)
+    assert set(summary) >= {"rounds", "throughput", "roofline_fraction",
+                            "multichip_scaling_efficiency",
+                            "regressions"}
+    # the checked-in TRAJECTORY.json is current-format (regenerated by
+    # this PR's scripts/bench_trajectory.py run)
+    with open(os.path.join(REPO, "TRAJECTORY.json")) as f:
+        checked_in = json.load(f)
+    assert checked_in["generated_by"] == "scripts/bench_trajectory.py"
+    assert [p.get("round") for p in checked_in["rounds"]] == rounds
+
+
+def test_bench_trajectory_regression_detection():
+    bt = _load_script("bench_trajectory")
+    points = [
+        {"round": 1, "platform": "cpu", "throughput": 100.0},
+        {"round": 2, "platform": "cpu", "throughput": 120.0},
+        {"round": 3, "platform": "tpu", "throughput": 50.0},  # new plat
+        {"round": 4, "platform": "cpu", "throughput": 90.0},  # -25%
+        {"round": 5, "platform": "cpu", "throughput": None},  # null ok
+    ]
+    regs = bt.detect_regressions(points, metrics=("throughput",),
+                                 threshold=0.10)
+    assert len(regs) == 1
+    r = regs[0]
+    assert r["round"] == 4 and r["platform"] == "cpu"
+    assert r["best_prev"] == 120.0
+    assert math.isclose(r["drop_frac"], 0.25)
+
+
+def test_bench_trajectory_latest_round_regression_renders():
+    # a regression on the MULTICHIP_LATEST point carries round='latest'
+    # — every formatter must survive the non-integer round tag
+    bt = _load_script("bench_trajectory")
+    points = [
+        {"round": 3, "platform": "cpu",
+         "multichip_scaling_efficiency": 0.5},
+        {"round": "latest", "platform": "cpu",
+         "multichip_scaling_efficiency": 0.2},
+    ]
+    regs = bt.detect_regressions(
+        points, metrics=("multichip_scaling_efficiency",), threshold=0.1
+    )
+    assert len(regs) == 1 and regs[0]["round"] == "latest"
+    assert bt.round_label("latest") == "latest"
+    assert bt.round_label(4) == "r04"
+    traj = {
+        "threshold": 0.1, "rounds": [], "multichip": [],
+        "series": {m: [] for m in bt.METRICS},
+        "summary": {}, "regressions": regs,
+    }
+    md = bt.render_markdown(traj)  # must not raise on round='latest'
+    assert "latest" in md
+
+
+def test_bench_trajectory_r04_tail_recovery():
+    bt = _load_script("bench_trajectory")
+    # the real r04 file: parsed is empty, but the last_tpu embed's
+    # trailing on-chip headline pair is recoverable
+    point = bt.load_bench_round(os.path.join(REPO, "BENCH_r04.json"))
+    assert point["platform"] == "tpu"
+    assert point["throughput"] and point["throughput"] > 1e8
+
+
+# ---------------------------------------------------------------------------
+# watcher telemetry classification (ROADMAP #4 groundwork)
+# ---------------------------------------------------------------------------
+
+
+def _write_log(d, name, events):
+    with open(os.path.join(d, name), "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+def test_watcher_reads_telemetry_instead_of_stdout(tmp_path):
+    watcher = _load_script("tpu_watcher")
+    d = str(tmp_path)
+    # no dir / empty dir -> None (stdout-scrape fallback)
+    assert watcher.read_telemetry_verdict(None) is None
+    assert watcher.read_telemetry_verdict(d) is None
+
+    _write_log(d, "events-a.jsonl", [
+        {"type": "run_start", "backend": "tpu"},
+        {"type": "tunnel_state", "state": "up"},
+        {"type": "run_end", "num_evals": 1.0, "search_time_s": 1.0},
+    ])
+    tv = watcher.read_telemetry_verdict(d)
+    assert tv["classification"] == "completed"
+    assert tv["backends"] == ["tpu"] and tv["tunnel_state"] == "up"
+    # step_on_chip prefers the telemetry verdict over stdout scraping:
+    # no platform-stamped JSON rows needed
+    rec = {"rc": 0, "json": [], "stdout_tail": "", "telemetry": tv}
+    assert watcher.step_on_chip("bench", rec) is True
+    rec_cpu = dict(rec, telemetry=dict(tv, backends=["cpu"]))
+    assert watcher.step_on_chip("bench", rec_cpu) is False
+
+
+def test_watcher_fault_with_saved_state_is_resumable(tmp_path):
+    watcher = _load_script("tpu_watcher")
+    d = str(tmp_path)
+    _write_log(d, "events-dead.jsonl", [
+        {"type": "run_start", "backend": "tpu"},
+        {"type": "dispatch_fault", "error_type": "XlaRuntimeError"},
+    ])
+    assert watcher.read_telemetry_verdict(d)["classification"] == "dead"
+    _write_log(d, "events-resume.jsonl", [
+        {"type": "run_start", "backend": "tpu"},
+        {"type": "saved_state", "outputs": 1, "iteration": 7},
+        {"type": "dispatch_fault", "error_type": "XlaRuntimeError"},
+    ])
+    tv = watcher.read_telemetry_verdict(d)
+    assert tv["classification"] == "resumable"
+    assert tv["faults"] == 2 and tv["saved_states"] == 1
+    # in-flight: neither fault nor run_end; truncated lines skipped
+    with open(os.path.join(d, "events-live.jsonl"), "w") as f:
+        f.write(json.dumps({"type": "run_start", "backend": "cpu"}))
+        f.write('\n{"type": "metr')  # mid-write
+    # only the new log (mtime filter keyed on 0 here -> all read)
+    tv2 = watcher.read_telemetry_verdict(d, since_ts=0.0)
+    assert "cpu" in tv2["backends"]
