@@ -139,6 +139,56 @@ def main():
         _run_seed(sr, devices, cases, seed, niter, finished)
 
 
+def _search_case(sr, name, seed, X, y, niter, var):
+    """One case's search. With SRTPU_BENCH_SNAPSHOT_DIR exported (the
+    watcher's --snapshot-dir plumbing, docs/resilience.md) the search
+    runs under the resilience supervisor with a per-(case, seed)
+    snapshot every dispatch: a tunnel drop or watcher-timeout kill
+    mid-case costs at most one iteration, and the retry attempt RESUMES
+    the interrupted case bit-identically instead of restarting it —
+    this step is the watcher's longest, the one whose banked hours the
+    supervised-resume accounting exists to protect. The snapshot is
+    deleted after the case completes so a later round's fresh capture
+    re-measures instead of short-circuiting on a stale file."""
+    kw = dict(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=UNARY_OPS,
+        seed=seed,
+        verbosity=0,
+        progress=False,
+        runtests=False,
+        early_stop_condition=1e-6 * var,
+        **BUDGET,
+    )
+    # the watcher's event-log classification (resumable vs dead, and
+    # the progress signal its attempt accounting compares) only works
+    # if this step actually writes the telemetry trail — without it a
+    # genuinely-resuming retry still burns MAX_ATTEMPTS like a dead
+    # restart
+    tele_dir = os.environ.get("SRTPU_BENCH_TELEMETRY_DIR")
+    if tele_dir:
+        kw.update(telemetry=True, telemetry_dir=tele_dir)
+    snap_dir = os.environ.get("SRTPU_BENCH_SNAPSHOT_DIR")
+    if not snap_dir:
+        return sr.equation_search(X, y, niterations=niter, **kw)
+    os.makedirs(snap_dir, exist_ok=True)
+    snap = os.path.join(
+        snap_dir,
+        f"feynman_{name.replace('.', '_')}_s{seed}_n{niter}.ckpt",
+    )
+    sup = sr.supervised_search(
+        X, y, niterations=niter, snapshot_path=snap,
+        snapshot_every_dispatches=1, max_attempts=2,
+        backoff_base_s=5.0, **kw,
+    )
+    for p in (snap, snap + ".bkup"):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+    return sup.result
+
+
 def _run_seed(sr, devices, cases, seed, niter, finished=None):
     finished = finished or {}
     solved = 0
@@ -158,19 +208,7 @@ def _run_seed(sr, devices, cases, seed, niter, finished=None):
         var = float(np.var(y))
 
         t0 = time.time()
-        res = sr.equation_search(
-            X,
-            y,
-            binary_operators=["+", "-", "*", "/"],
-            unary_operators=UNARY_OPS,
-            niterations=niter,
-            seed=seed,
-            verbosity=0,
-            progress=False,
-            runtests=False,
-            early_stop_condition=1e-6 * var,
-            **BUDGET,
-        )
+        res = _search_case(sr, name, seed, X, y, niter, var)
         dt = time.time() - t0
         best = res.best_loss()
         norm_loss = best.loss / max(var, 1e-12)

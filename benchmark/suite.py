@@ -373,6 +373,104 @@ def bench_run_doctor():
     }]
 
 
+def bench_resilience():
+    """Preemption-tolerant search (ISSUE 11): a fault injected at
+    dispatch 1 of a 2-iteration search (the in-process `raise` form of
+    a preemption — the real-SIGKILL/cross-process form is pinned by
+    tests/test_ad_resilience.py's slow tier, which this case's
+    subprocess budget can't afford), snapshotting every dispatch,
+    auto-resumed by the resilience supervisor — the final hall of fame
+    must be BIT-IDENTICAL to the uninterrupted baseline (the snapshot
+    carries the host key chain, docs/resilience.md), the resumed run's
+    event log must read HEALTHY to the run doctor, and the interrupted
+    attempt's log must read faulted+resumable. This is the closed loop
+    of ROADMAP #3: fault -> snapshot -> classify -> resume, end to end,
+    by construction instead of waiting for a real outage."""
+    import symbolicregression_jl_tpu as sr
+    from symbolicregression_jl_tpu.resilience import (
+        FaultPlan,
+        clear_fault_plan,
+        set_fault_plan,
+        supervised_search,
+    )
+    from symbolicregression_jl_tpu.telemetry.analyze import (
+        analyze_run,
+        resolve_log,
+    )
+
+    tele_d = _suite_telemetry_dir("srtpu_suite_resilience_")
+    snap_d = os.environ.get("SRTPU_BENCH_SNAPSHOT_DIR")
+    if snap_d:
+        os.makedirs(snap_d, exist_ok=True)
+    else:
+        import tempfile
+
+        snap_d = tempfile.mkdtemp(prefix="srtpu_suite_resilience_snap_")
+    snap = os.path.join(snap_d, "resilience_case.ckpt")
+    for stale in (snap, snap + ".bkup"):
+        if os.path.exists(stale):
+            os.remove(stale)  # a fresh scenario, not last window's file
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((3, 128)).astype(np.float32)
+    y = 2.0 * np.cos(X[2]) + X[0] ** 2 - 0.5
+    kw = dict(
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        npopulations=4, npop=24, ncycles_per_iteration=30, maxsize=12,
+        seed=0, verbosity=0, progress=False,
+    )
+    baseline = sr.equation_search(X, y, niterations=2, **kw)
+
+    t0 = time.perf_counter()
+    set_fault_plan(FaultPlan(kind="raise", at=1))
+    try:
+        sup = supervised_search(
+            X, y, niterations=2,
+            snapshot_path=snap, snapshot_every_dispatches=1,
+            max_attempts=3, backoff_base_s=0.05, backoff_jitter=0.0,
+            telemetry=True, telemetry_dir=tele_d, **kw,
+        )
+    finally:
+        clear_fault_plan()
+    wall_s = time.perf_counter() - t0
+
+    frontier = lambda r: [
+        (c.complexity, float(c.loss), float(c.score), c.equation)
+        for c in r.frontier()
+    ]
+    # newest log = the resumed, successful attempt; the faulted
+    # attempt's verdict rides along from the supervisor's history
+    report = analyze_run(resolve_log(tele_d))
+    failed = sup.history[0] if sup.history else {}
+    return [{
+        "suite": "resilience",
+        "case": "kill_resume_bit_identity",
+        "ok": (
+            frontier(baseline) == frontier(sup.result)
+            and report["verdict"] == "healthy"
+            # the closed loop is the contract: the interrupted
+            # attempt's log must have read faulted+resumable, and the
+            # recovery must have been exactly one resume
+            and sup.attempts == 2
+            and failed.get("verdict") == "faulted"
+            and failed.get("resumable") is True
+        ),
+        "hof_bit_identical": frontier(baseline) == frontier(sup.result),
+        "verdict": report["verdict"],
+        "attempts": sup.attempts,
+        "resumes": sup.resumes,
+        "fault_error_type": failed.get("error_type"),
+        "fault_verdict": failed.get("verdict"),
+        "fault_resumable": failed.get("resumable"),
+        "resumed_from_iteration": (
+            (report.get("run", {}).get("resume_from") or {})
+            .get("iteration")
+        ),
+        "search_wall_s": wall_s,
+        "event_log": report.get("path"),
+    }]
+
+
 def bench_multichip():
     """Multi-chip island sharding (ISSUE 9): the REAL production
     `equation_search` sharded over an 8-virtual-device (islands, rows)
@@ -800,6 +898,7 @@ _CASES = [
     (bench_multichip, 1200),
     (bench_telemetry, 900),
     (bench_run_doctor, 900),
+    (bench_resilience, 900),
     (bench_search_iteration, 1200),
     (bench_fitness_cache, 1200),
     (bench_precision_ratio, 1200),

@@ -43,13 +43,24 @@ every step (``SRTPU_BENCH_TELEMETRY_DIR``) and classifies each step
 from the telemetry event logs written during it instead of scraping
 stdout: the ``run_start`` backend replaces the platform-field scrape,
 ``tunnel_state`` events carry the acquisition verdict, and a
-``dispatch_fault`` with a ``saved_state`` event in the same trail is
-classified **resumable**, not dead (ROADMAP #4 groundwork — a faulted
+``dispatch_fault`` (or a kill) with a ``saved_state`` event in the same
+trail is classified **resumable**, not dead (ROADMAP #3 — a faulted
 64x1000 run with a snapshot on disk should be resumed, never
 restarted). Steps without telemetry fall back to the stdout scrape.
 
+Resumable steps take the SUPERVISED-RESUME path, not a dead restart
+(docs/resilience.md): the snapshot directory
+(``SRTPU_BENCH_SNAPSHOT_DIR``, ``--snapshot-dir``; defaults to
+``<telemetry-dir>/snapshots``) persists across attempts, so the step's
+own snapshot/supervisor machinery continues from where the fault cut it
+off — and the attempt accounting distinguishes the two: a resumable
+retry whose newest snapshot ADVANCED past the previous attempt's resets
+the step's attempt counter (real progress must never exhaust
+MAX_ATTEMPTS), while a resumable retry with no new progress keeps the
+decrement (crash loops still terminate).
+
 Usage:  python scripts/tpu_watcher.py [--poll SECONDS] [--fresh]
-            [--telemetry-dir DIR]
+            [--telemetry-dir DIR] [--snapshot-dir DIR]
 """
 
 from __future__ import annotations
@@ -69,6 +80,11 @@ SENTINEL = "/tmp/srtpu_watcher_capturing"
 
 # set by main() from --telemetry-dir; empty = stdout-scrape behavior
 TELEMETRY_DIR = None
+
+# set by main() from --snapshot-dir (default <telemetry-dir>/snapshots):
+# exported to steps as SRTPU_BENCH_SNAPSHOT_DIR so search-state
+# snapshots survive attempts and a resumable retry actually resumes
+SNAPSHOT_DIR = None
 
 # Round-5 order (VERDICT r4 #1/#2/#3): after the ONE short canary, the
 # scale-fault bisect runs FIRST — the 64x1000 northstar iteration has
@@ -176,12 +192,19 @@ def read_telemetry_verdict(telemetry_dir, since_ts=0.0):
     the event-log replacement for scraping a step's stdout:
 
       {"logs", "backends", "tunnel_state", "faults", "saved_states",
-       "complete", "classification"}
+       "last_saved_iteration", "complete", "classification"}
 
-    classification: 'completed' (run_end, no fault), 'resumable'
-    (dispatch_fault WITH a saved_state event in the same trail — resume,
-    don't restart: ROADMAP #4), 'dead' (fault, nothing to resume from),
-    'in-flight' (neither fault nor run_end — still running or killed).
+    classification: 'completed' (a run_end with no fault and no
+    saved_state AFTER it — a supervised step whose faulted attempt was
+    resumed to completion in the same window reads completed, not
+    resumable), 'resumable' (a dispatch_fault newer than any run_end
+    WITH a saved_state event in the trail — or a kill/timeout that left
+    saved_state events newer than any run_end: resume, don't restart,
+    ROADMAP #3), 'dead' (such a fault with nothing to resume from),
+    'in-flight' (no fault, no run_end, no snapshot — still running or
+    killed with nothing recoverable). last_saved_iteration is the newest saved_state
+    event's iteration counter: the progress signal the
+    supervised-resume attempt accounting compares across attempts.
     Returns None when the dir is unset/absent or holds no new logs
     (callers fall back to the stdout scrape); never raises on content —
     truncated lines in a crashed run's log are skipped."""
@@ -197,9 +220,11 @@ def read_telemetry_verdict(telemetry_dir, since_ts=0.0):
         return None
     out = {
         "logs": len(logs), "backends": [], "tunnel_state": None,
-        "faults": 0, "saved_states": 0, "complete": False,
+        "faults": 0, "saved_states": 0, "last_saved_iteration": None,
+        "complete": False,
     }
     backends = set()
+    last_fault_t = last_end_t = last_saved_t = None
     for path in sorted(logs, key=os.path.getmtime):
         try:
             with open(path) as f:
@@ -221,15 +246,52 @@ def read_telemetry_verdict(telemetry_dir, since_ts=0.0):
                 out["tunnel_state"] = e.get("state")
             elif typ == "dispatch_fault":
                 out["faults"] += 1
+                t = e.get("t")
+                if isinstance(t, (int, float)):
+                    last_fault_t = max(last_fault_t or t, t)
             elif typ == "saved_state":
                 out["saved_states"] += 1
+                t = e.get("t")
+                if isinstance(t, (int, float)):
+                    last_saved_t = max(last_saved_t or t, t)
+                it = e.get("iteration")
+                if isinstance(it, int):
+                    prev = out["last_saved_iteration"]
+                    out["last_saved_iteration"] = (
+                        it if prev is None else max(prev, it)
+                    )
             elif typ == "run_end":
                 out["complete"] = True
+                t = e.get("t")
+                if isinstance(t, (int, float)):
+                    last_end_t = max(last_end_t or t, t)
     out["backends"] = sorted(backends)
-    if out["faults"]:
+    # a fault only drives the verdict while it is UNRESOLVED — i.e. no
+    # run_end postdates it. The supervised flow makes fault-then-
+    # completed the normal success trail of one step window (the
+    # interrupted attempt's log + the resumed attempt's), which must
+    # read completed; a fault AFTER the last run_end (a later sub-run
+    # dying) still reads resumable/dead.
+    unresolved_fault = out["faults"] and (
+        last_end_t is None
+        or (last_fault_t is not None and last_fault_t > last_end_t)
+    )
+    # snapshots NEWER than the last run_end mean a later sub-run was
+    # killed mid-flight (a kill writes neither dispatch_fault nor
+    # run_end — the line-buffered log simply stops): resumable even
+    # when an earlier sub-run in the same window completed. The
+    # supervised success trail stays 'completed' — its snapshots all
+    # predate the resumed attempt's final run_end.
+    unresolved_snapshot = out["saved_states"] and (
+        last_end_t is None
+        or (last_saved_t is not None and last_saved_t > last_end_t)
+    )
+    if unresolved_fault:
         out["classification"] = (
             "resumable" if out["saved_states"] else "dead"
         )
+    elif unresolved_snapshot:
+        out["classification"] = "resumable"
     elif out["complete"]:
         out["classification"] = "completed"
     else:
@@ -243,6 +305,12 @@ def run_step(name, argv, timeout, extra_env):
         # every step's telemetry lands in one place; the verdict reader
         # below picks up only the logs this step wrote (mtime >= t0)
         env["SRTPU_BENCH_TELEMETRY_DIR"] = TELEMETRY_DIR
+    if SNAPSHOT_DIR:
+        # snapshots persist ACROSS attempts in one place, so a retry of
+        # a resumable step finds the previous attempt's newest snapshot
+        # and resumes instead of restarting (docs/resilience.md)
+        os.makedirs(SNAPSHOT_DIR, exist_ok=True)
+        env["SRTPU_BENCH_SNAPSHOT_DIR"] = SNAPSHOT_DIR
     if extra_env:
         env.update(extra_env)
     t0 = time.time()
@@ -382,18 +450,58 @@ def load_previous_results():
 MAX_ATTEMPTS = 3  # per step, across tunnel windows AND restarts
 
 
+def adjust_attempts_for_resume(prev_rec, rec, attempts):
+    """Supervised-resume attempt accounting (ISSUE 11): the retry of a
+    RESUMABLE failure is a resume, not a dead restart, and must not
+    burn MAX_ATTEMPTS the same way.
+
+    * resume WITH progress — the failed attempt's newest snapshot
+      advanced past the previous attempt's (`last_saved_iteration`
+      strictly greater, or a first snapshot where none existed): the
+      counter RESETS to 0. A preemptible window that kills a 3-hour run
+      every 40 minutes still finishes it eventually, because each death
+      banked real iterations.
+    * resume WITHOUT progress — a resumable classification whose
+      snapshot never advances keeps the normal decrement: a config that
+      faults at the same dispatch every attempt is a crash loop and the
+      cap must still terminate it.
+    * anything non-resumable (dead/completed/no telemetry) — untouched.
+
+    Pure function of (previous record, new record, attempts-so-far);
+    returns the adjusted attempts count."""
+    tv = (rec or {}).get("telemetry") or {}
+    if tv.get("classification") != "resumable":
+        return attempts
+    cur = tv.get("last_saved_iteration")
+    if cur is None:
+        return attempts
+    prev_tv = ((prev_rec or {}).get("telemetry")) or {}
+    prev = prev_tv.get("last_saved_iteration")
+    if prev is None or cur > prev:
+        return 0
+    return attempts
+
+
 def merge_retry_record(prev, rec):
     """A json-less failed attempt (e.g. JAX init dying in seconds on a
     flapping tunnel) must not destroy an earlier attempt's on-chip JSON —
     hours of finished feynman cases live there. Mutates rec in place,
     carrying the prior attempt's json forward (flagged) and keeping the
-    on-chip attribution that came with it."""
+    on-chip attribution that came with it. The telemetry record carries
+    forward the same way: losing it to one telemetry-less crash would
+    reset the supervised-resume progress memory, letting the next
+    no-progress resumable fault masquerade as a first snapshot and
+    re-zero the attempt cap forever (adjust_attempts_for_resume's
+    'crash loops still terminate' guarantee depends on this)."""
     if prev and prev.get("json") and not rec.get("json"):
         rec["json"] = prev["json"]
         rec["json_from_earlier_attempt"] = True
         rec["on_chip"] = rec.get("on_chip", False) or prev.get(
             "on_chip", False
         )
+    if prev and prev.get("telemetry") and not rec.get("telemetry"):
+        rec["telemetry"] = prev["telemetry"]
+        rec["telemetry_from_earlier_attempt"] = True
 
 
 def compute_resume_state(results):
@@ -425,13 +533,19 @@ def compute_resume_state(results):
 
 
 def main():
-    global TELEMETRY_DIR
+    global TELEMETRY_DIR, SNAPSHOT_DIR
     poll = 120
     if "--poll" in sys.argv:
         poll = int(sys.argv[sys.argv.index("--poll") + 1])
     if "--telemetry-dir" in sys.argv:
         TELEMETRY_DIR = sys.argv[sys.argv.index("--telemetry-dir") + 1]
         os.makedirs(TELEMETRY_DIR, exist_ok=True)
+    if "--snapshot-dir" in sys.argv:
+        SNAPSHOT_DIR = sys.argv[sys.argv.index("--snapshot-dir") + 1]
+    elif TELEMETRY_DIR:
+        # default: snapshots live beside the telemetry they classify,
+        # persisting across attempts so resumable retries resume
+        SNAPSHOT_DIR = os.path.join(TELEMETRY_DIR, "snapshots")
 
     results = {}
     first_captured_at = None
@@ -495,11 +609,25 @@ def main():
                 ok = on_chip and rec["rc"] == 0 and not rec["timed_out"]
                 rec["on_chip"] = on_chip
                 rec["partial"] = not ok
+                prev_rec = results.get(name)
+                if not ok:
+                    # supervised-resume accounting: a resumable failure
+                    # whose snapshot ADVANCED resets the cap — banked
+                    # progress must never exhaust MAX_ATTEMPTS
+                    adjusted = adjust_attempts_for_resume(
+                        prev_rec, rec, attempts[name]
+                    )
+                    if adjusted != attempts[name]:
+                        log(
+                            f"step {name}: supervised resume with "
+                            "progress — attempt counter reset"
+                        )
+                        attempts[name] = adjusted
                 # persisted so the attempt cap survives a restart: a
                 # deterministically failing step must not re-block the
                 # never-run steps behind it in the next window
                 rec["attempts"] = attempts[name]
-                merge_retry_record(results.get(name), rec)
+                merge_retry_record(prev_rec, rec)
                 log(
                     f"step {name}: rc={rec['rc']} {rec['seconds']}s "
                     f"on_chip={on_chip} ok={ok}"

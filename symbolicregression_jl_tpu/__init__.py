@@ -112,6 +112,18 @@ from .utils.checkpoint import (  # noqa: E402
     load_search_state,
     save_search_state,
 )
+
+# Preemption-tolerant search (docs/resilience.md): periodic snapshots
+# (Options.snapshot_path/snapshot_every_dispatches), deterministic
+# fault injection, and the auto-resume supervisor.
+from .resilience import (  # noqa: E402
+    FaultInjected,
+    FaultPlan,
+    SupervisedResult,
+    clear_fault_plan,
+    set_fault_plan,
+    supervised_search,
+)
 from .utils.precompile import (  # noqa: E402
     do_precompilation,
     enable_compilation_cache,
@@ -172,6 +184,12 @@ __all__ = [
     "FitnessMemoBank",
     "clear_memo_banks",
     "tree_hash_host",
+    "FaultInjected",
+    "FaultPlan",
+    "SupervisedResult",
+    "supervised_search",
+    "set_fault_plan",
+    "clear_fault_plan",
     "EventLog",
     "MetricsRegistry",
     "SpanRecorder",

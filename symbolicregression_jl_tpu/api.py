@@ -58,6 +58,8 @@ from .parallel.mesh import (
     shard_island_states,
 )
 from .parallel.migration import merge_hofs_across_islands, migrate
+from .resilience import faults as _faults
+from .utils.checkpoint import save_search_state
 from .utils.output import Candidate, hof_to_candidates, pareto_table, save_hof_csv
 from .utils.preflight import preflight_checks
 from .utils.progress import (
@@ -74,11 +76,21 @@ Array = jax.Array
 @dataclasses.dataclass
 class SearchState:
     """Resumable state (analog of StateType,
-    reference src/SearchUtils.jl:270-273)."""
+    reference src/SearchUtils.jl:270-273).
+
+    `rng_key` is the host loop's per-output master PRNG key at the
+    serialization point: restoring it makes a resumed search the exact
+    continuation of the interrupted one — same iteration key chain,
+    same hall of fame as the uninterrupted run (the bit-identity
+    contract of docs/resilience.md). None (pre-snapshot states, older
+    checkpoints) falls back to re-deriving the key from Options.seed:
+    still deterministic, but a different chain than the original run's
+    continuation."""
 
     island_states: IslandState  # leading (I,)
     global_hof: HallOfFame
     iteration: int = 0
+    rng_key: Optional[Any] = None
 
 
 @dataclasses.dataclass
@@ -769,12 +781,25 @@ def _multi_output_path(path: str, output: int) -> str:
     return f"{root}.out{output}{ext}"
 
 
+def _snapshot_due(global_it: int, nout: int, every: int) -> bool:
+    """Round-aligned snapshot cadence: True when an every-k-dispatches
+    boundary was crossed during the round that just finished (the
+    dispatches in (global_it - nout, global_it]). For nout=1 this is
+    exactly ``global_it % every == 0``; for multi-output it keeps the
+    promised ~k-dispatch cadence — the naive modulo check would only
+    fire when a multiple of `every` happens to land on a round
+    boundary, silently stretching the cadence to lcm(every, nout)."""
+    return (global_it // every) > ((global_it - nout) // every)
+
+
 def _curmaxsize(
     options: Options, iteration: int, niterations: int
 ) -> int:
     """Maxsize warm-up curriculum (reference
     src/SymbolicRegression.jl:838-850): with warmup_maxsize_by=w > 0, the
-    size cap ramps 3 -> maxsize over the first w fraction of iterations."""
+    size cap ramps 3 -> maxsize over the first w fraction of iterations.
+    Callers pass the ABSOLUTE planned total (resume start + remaining)
+    so a resumed run continues the uninterrupted run's exact ramp."""
     if options.warmup_maxsize_by <= 0:
         return options.maxsize
     frac = (iteration / max(niterations * options.warmup_maxsize_by, 1e-9))
@@ -907,6 +932,18 @@ def equation_search(
         and is_primary_host()
         and jax.process_count() == 1
     )
+    # ---- periodic search-state snapshots (options.snapshot_path /
+    # snapshot_every_dispatches; docs/resilience.md): host-side
+    # orchestration between dispatches, single-controller only like the
+    # recorder (the device->host fetch of a multi-host sharded state is
+    # a collective every host would have to issue in lockstep). ----
+    snap_every = options.snapshot_every_dispatches
+    snapshot_on = (
+        options.snapshot_path is not None
+        and snap_every > 0
+        and is_primary_host()
+        and jax.process_count() == 1
+    )
     sink = None
     spans_rec = None
     search_metrics = None
@@ -940,6 +977,36 @@ def equation_search(
             # single-device): a degraded mesh choice (idle devices) is
             # part of the machine-readable record, not just a warning
             **describe_mesh(mesh),
+            # resilience provenance (schema-additive): the snapshot
+            # cadence this run writes under, and — on a resumed run —
+            # where its saved_state came from (null = fresh start)
+            snapshot=(
+                {
+                    "path": options.snapshot_path,
+                    "every_dispatches": snap_every,
+                }
+                if snapshot_on else None
+            ),
+            resume_from=(
+                {
+                    "path": getattr(
+                        saved_state[0], "_source_path", None
+                    ),
+                    "iteration": min(
+                        s.iteration for s in saved_state
+                    ),
+                    "outputs": len(saved_state),
+                    # provenance must be truthful: an incompatible
+                    # state is RECREATED below (fresh populations,
+                    # HoF possibly kept), not resumed — consumers
+                    # keying resumed_from off this field need to know
+                    "populations_compatible": all(
+                        _saved_state_compatible(s, options, I)[0]
+                        for s in saved_state
+                    ),
+                }
+                if saved_state else None
+            ),
         )
         spans_rec = SpanRecorder(sink)
         search_metrics = SearchMetrics(options, sink)
@@ -1057,6 +1124,17 @@ def equation_search(
             state = saved_state[j]
             ok_pop, ok_hof = _saved_state_compatible(state, options, I)
             if ok_pop:
+                if getattr(state, "rng_key", None) is not None:
+                    # restore the host key chain at the serialization
+                    # point: the resumed run's iteration keys continue
+                    # exactly where the interrupted run's stopped (the
+                    # bit-identity contract, docs/resilience.md).
+                    # Compatible-state resumes ONLY — the recreate
+                    # fallback below stays reproducible from
+                    # Options.seed, as SearchState's doc promises.
+                    # Absent on pre-snapshot states: the fresh
+                    # seed-derived chain above.
+                    master_key = jnp.asarray(state.rng_key)
                 states, ghof = state.island_states, state.global_hof
                 if donate:
                     # iteration 1 will donate (delete) these buffers;
@@ -1137,7 +1215,15 @@ def equation_search(
             states = live_states[j]
             its[j] = start_iters[j] + step
             it = its[j]
-            cm_host = _curmaxsize(options, it, max(niterations, 1))
+            # curriculum denominator is the ABSOLUTE planned total
+            # (start + remaining): identical to niterations on a fresh
+            # start, and on a resume it keeps the warm-up ramp exactly
+            # where the interrupted run would have had it — a resumed
+            # run passing only the remaining count must not re-stretch
+            # warmup_maxsize_by over a shorter schedule (bit-identity)
+            cm_host = _curmaxsize(
+                options, it, max(start_iters[j] + niterations, 1)
+            )
             cm = jnp.int32(cm_host)
             out_keys[j], k_it = jax.random.split(out_keys[j])
             if spans_rec is not None:
@@ -1170,6 +1256,11 @@ def equation_search(
             else:
                 memo_args = ()
             try:
+                # deterministic fault injection (resilience.faults): a
+                # no-op without an active plan; raises/kills HERE so an
+                # injected failure takes the same dispatch_fault path a
+                # real device fault would
+                _faults.on_dispatch(global_it)
                 if wj is not None:
                     out = iteration_fn(
                         states, k_it, cm, Xj, yj, wj, bl, scalars,
@@ -1330,6 +1421,53 @@ def equation_search(
             monitor.note(t_host - t_dev, time.time() - t_host)
             monitor.maybe_warn()
 
+            # ---- periodic snapshot: every snap_every dispatches,
+            # aligned to round boundaries (last output) so every
+            # output's saved iteration counter agrees and the resume
+            # math stays exact. Fenced, then fetched to host BEFORE the
+            # next dispatch can donate (delete) these buffers. ----
+            if (
+                snapshot_on
+                and j == nout - 1
+                and _snapshot_due(global_it, nout, snap_every)
+            ):
+                snap_states = [
+                    SearchState(
+                        island_states=live_states[q],
+                        global_hof=live_hofs[q],
+                        iteration=its[q] + 1,
+                        rng_key=out_keys[q],
+                    )
+                    for q in range(nout)
+                ]
+                jax.block_until_ready(
+                    [s.island_states for s in snap_states]
+                )
+                try:
+                    save_search_state(
+                        options.snapshot_path, snap_states, sink=sink,
+                        options=options, dispatch=global_it,
+                        cause="periodic",
+                    )
+                except Exception as e:
+                    # a dying snapshot write (ENOSPC, injected tear)
+                    # must leave the same machine-readable fault trail
+                    # a dying dispatch does — without this the log just
+                    # stops and the doctor calls the run 'incomplete'
+                    # instead of 'faulted'
+                    if sink is not None:
+                        sink.emit(
+                            "dispatch_fault",
+                            where="snapshot",
+                            error_type=type(e).__name__,
+                            error=str(e)[:500],
+                            output=j,
+                            iteration=it,
+                            fatal=True,
+                        )
+                        sink.close()
+                    raise
+
             # global immediate stops: any one trips → the whole search
             # ends, all outputs included (src/SymbolicRegression.jl:899-909)
             if (
@@ -1371,6 +1509,9 @@ def equation_search(
                 island_states=states,
                 global_hof=live_hofs[j],
                 iteration=its[j] + 1,
+                # the host master key at this point: resuming from this
+                # state continues the exact iteration key chain
+                rng_key=out_keys[j],
             )
         )
 

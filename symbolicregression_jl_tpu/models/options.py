@@ -232,6 +232,27 @@ class Options:
     # Emit a metrics snapshot every k-th iteration (spans and lifecycle
     # events are always emitted); 1 = every iteration.
     telemetry_every: int = 1
+    # --- periodic search-state snapshots (resilience/ subsystem) ---
+    # Serialize the compact per-output SearchState (populations, hall of
+    # fame, host PRNG key) to this path every snapshot_every_dispatches
+    # host-loop dispatches, crash-atomically through
+    # utils.checkpoint.save_search_state (docs/resilience.md). Resume
+    # via equation_search(saved_state=load_search_state(path)) — or the
+    # resilience.supervisor retry loop — is a bit-identical continuation
+    # of the interrupted run (same hall of fame, same key chain).
+    # Orchestration-only knobs: host-side between dispatches, absent
+    # from _graph_key, zero primitives added to any jitted program.
+    snapshot_path: Optional[str] = None
+    # Snapshot cadence in dispatches (one dispatch = one iteration of
+    # one output through the production driver). A configured
+    # snapshot_path always snapshots: leaving this 0 with a path set
+    # normalizes to 1 (every dispatch) — a path that silently never
+    # wrote would lose the whole run to the first preemption, the exact
+    # failure the knob exists to prevent. Multi-output runs align
+    # snapshots to round boundaries (after the last output's dispatch)
+    # so every output's saved iteration counter agrees and the resume
+    # math stays exact.
+    snapshot_every_dispatches: int = 0
     # --- evaluation memo bank (cache/ subsystem) ---
     # Opt-in fitness caching, two tiers: intra-batch dedup of every fused
     # eval batch (duplicate programs evaluated once, losses scattered
@@ -418,6 +439,15 @@ class Options:
             raise ValueError("cache_capacity must be >= 1")
         if self.telemetry_every < 1:
             raise ValueError("telemetry_every must be >= 1")
+        if self.snapshot_every_dispatches < 0:
+            raise ValueError("snapshot_every_dispatches must be >= 0")
+        if self.snapshot_path and self.snapshot_every_dispatches == 0:
+            # a configured path always snapshots (see the field doc)
+            object.__setattr__(self, "snapshot_every_dispatches", 1)
+        if self.snapshot_every_dispatches > 0 and not self.snapshot_path:
+            raise ValueError(
+                "snapshot_every_dispatches requires snapshot_path"
+            )
         if self.cache_device_slots < 0:
             raise ValueError("cache_device_slots must be >= 0")
         # build and cache derived structures

@@ -1,0 +1,30 @@
+"""Preemption-tolerant search (docs/resilience.md, ROADMAP #3).
+
+Three pieces that together make every search survivable:
+
+* periodic snapshots — ``Options.snapshot_path`` /
+  ``snapshot_every_dispatches`` serialize the compact per-output
+  ``SearchState`` (populations + hall of fame + host PRNG key) through
+  ``utils.checkpoint`` every k dispatches, crash-atomically;
+* :mod:`~symbolicregression_jl_tpu.resilience.faults` — deterministic
+  fault injection (raise / SIGKILL / tunnel-down at dispatch N, torn
+  checkpoint writes), so recovery paths are tested by construction;
+* :mod:`~symbolicregression_jl_tpu.resilience.supervisor` —
+  :func:`supervised_search`, the retry/backoff loop that resumes from
+  the newest valid snapshot instead of restarting, bit-identically.
+"""
+
+from . import faults
+from .faults import FaultInjected, FaultPlan, clear_fault_plan, set_fault_plan
+from .supervisor import SupervisedResult, backoff_s, supervised_search
+
+__all__ = [
+    "FaultInjected",
+    "FaultPlan",
+    "SupervisedResult",
+    "backoff_s",
+    "clear_fault_plan",
+    "faults",
+    "set_fault_plan",
+    "supervised_search",
+]

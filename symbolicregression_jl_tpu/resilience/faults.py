@@ -1,0 +1,205 @@
+"""Deterministic fault injection — outages by construction, not by luck.
+
+The recurring operational failure of the long-lived search (ROADMAP #3,
+BENCH_r02–r05 ``tunnel_state`` down/half-open) is the device or tunnel
+dying mid-run. Recovery paths that are only exercised by real outages
+rot; this module makes every failure mode a reproducible test input:
+
+* ``raise`` / ``tunnel_down`` — raise :class:`FaultInjected` at exactly
+  dispatch N of the host loop (``tunnel_down`` spells its message like
+  the runtime's ``UNAVAILABLE`` tunnel fault, so classification paths
+  see what they would see in production);
+* ``kill`` — SIGKILL this process at dispatch N (no atexit, no finally:
+  the honest simulation of a preempted VM or an OOM kill);
+* ``tear_checkpoint`` — truncate checkpoint write N mid-byte and die,
+  proving the crash-atomic write discipline of
+  ``utils/checkpoint.py`` (a torn ``.tmp`` must never shadow a good
+  snapshot).
+
+A :class:`FaultPlan` is **one-shot**: once tripped it is spent, so the
+supervisor's resumed attempt (or a restarted process, via the fuse
+file) runs clean instead of re-dying at the same dispatch. Plans come
+from :func:`set_fault_plan` (in-process tests) or the environment
+(``SRTPU_FAULT_PLAN="kill@2"``, crossing the process boundary for
+subprocess kill tests; ``SRTPU_FAULT_FUSE=/path`` persists the spent
+mark across the restart).
+
+Pure host-side stdlib — no jax import; safe to import from anywhere.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+from typing import Optional, Tuple
+
+#: recognized plan kinds (the fault-plan vocabulary, docs/resilience.md)
+FAULT_KINDS = ("raise", "kill", "tunnel_down", "tear_checkpoint")
+
+ENV_PLAN = "SRTPU_FAULT_PLAN"
+ENV_FUSE = "SRTPU_FAULT_FUSE"
+
+
+class FaultInjected(RuntimeError):
+    """The exception every non-kill injected fault raises. A RuntimeError
+    so production handlers (the api loop's dispatch_fault emission, the
+    supervisor's classify-and-resume) treat it exactly like a real
+    device fault — nothing may special-case injected failures, or the
+    test would prove the special case, not the recovery path."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One deterministic failure: ``kind`` at index ``at``.
+
+    ``at`` counts the unit the kind targets: the host loop's 0-based
+    dispatch index for ``raise``/``kill``/``tunnel_down``, the 0-based
+    checkpoint file-write index for ``tear_checkpoint`` (each
+    ``save_search_state`` call performs two file writes — target then
+    ``.bkup`` — so ``at=1`` tears the run's very first backup write)."""
+
+    kind: str
+    at: int = 0
+
+    def __post_init__(self):
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; one of {FAULT_KINDS}"
+            )
+        if self.at < 0:
+            raise ValueError("fault index must be >= 0")
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """``"kind@N"`` (the SRTPU_FAULT_PLAN spelling) -> FaultPlan."""
+        kind, sep, at = spec.strip().partition("@")
+        if not sep:
+            raise ValueError(
+                f"fault plan {spec!r} is not of the form 'kind@N'"
+            )
+        try:
+            n = int(at)
+        except ValueError:
+            raise ValueError(f"fault plan index {at!r} is not an integer")
+        return cls(kind=kind, at=n)
+
+    def spec(self) -> str:
+        return f"{self.kind}@{self.at}"
+
+
+# module state: the active plan (explicit set wins over env), spent plan
+# specs (in-process one-shot), and the checkpoint write counter
+_PLAN: Optional[FaultPlan] = None
+_PLAN_EXPLICIT = False
+_SPENT: set = set()
+_WRITE_COUNT = 0
+
+
+def set_fault_plan(plan: Optional[FaultPlan]) -> None:
+    """Install (or with None, clear) the in-process fault plan. Resets
+    the spent set and the checkpoint write counter: a test installing a
+    plan starts a fresh failure scenario."""
+    global _PLAN, _PLAN_EXPLICIT, _WRITE_COUNT
+    _PLAN = plan
+    _PLAN_EXPLICIT = plan is not None
+    _SPENT.clear()
+    _WRITE_COUNT = 0
+
+
+def clear_fault_plan() -> None:
+    set_fault_plan(None)
+
+
+def get_fault_plan() -> Optional[FaultPlan]:
+    """The active plan: an explicitly set one, else SRTPU_FAULT_PLAN
+    from the environment (re-read every call — the supervisor's retry
+    and a watcher-restarted process both see the current value)."""
+    if _PLAN_EXPLICIT:
+        return _PLAN
+    spec = os.environ.get(ENV_PLAN)
+    if not spec:
+        return None
+    return FaultPlan.parse(spec)
+
+
+def _fuse_path() -> Optional[str]:
+    return os.environ.get(ENV_FUSE) or None
+
+
+def _is_spent(plan: FaultPlan) -> bool:
+    if plan.spec() in _SPENT:
+        return True
+    fuse = _fuse_path()
+    if not fuse or not os.path.exists(fuse):
+        return False
+    # the fuse stores the spec of the plan that blew it: only THAT plan
+    # is spent — a stale fuse from a previous scenario must not silently
+    # disarm a different plan (an unreadable fuse fails safe as spent,
+    # never double-firing a kill)
+    try:
+        with open(fuse) as f:
+            return f.readline().strip() == plan.spec()
+    except OSError:
+        return True
+
+
+def _trip(plan: FaultPlan) -> None:
+    """Mark the plan spent BEFORE the failure fires: for 'kill' there is
+    no after, and the restarted process must find the fuse blown."""
+    _SPENT.add(plan.spec())
+    fuse = _fuse_path()
+    if fuse:
+        try:
+            with open(fuse, "w") as f:
+                f.write(plan.spec() + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError:
+            pass  # a fuse that cannot persist still spends in-process
+
+
+def on_dispatch(index: int) -> None:
+    """Hook called by the search host loop immediately before issuing
+    dispatch `index` (0-based, counted across outputs). Raises or kills
+    per the active plan; a no-op with no plan, a spent plan, or a
+    non-matching index."""
+    plan = get_fault_plan()
+    if (
+        plan is None
+        or plan.kind not in ("raise", "kill", "tunnel_down")
+        or index != plan.at
+        or _is_spent(plan)
+    ):
+        return
+    _trip(plan)
+    if plan.kind == "kill":
+        os.kill(os.getpid(), signal.SIGKILL)
+    if plan.kind == "tunnel_down":
+        raise FaultInjected(
+            f"UNAVAILABLE: simulated tunnel down at dispatch {index} "
+            "(fault-injected)"
+        )
+    raise FaultInjected(
+        f"injected dispatch fault at dispatch {index}"
+    )
+
+
+def on_checkpoint_write(payload: bytes) -> Tuple[bytes, bool]:
+    """Hook called by ``utils.checkpoint`` once per checkpoint FILE
+    write with the full payload about to be written. Returns
+    ``(bytes_to_write, torn)``: with an active ``tear_checkpoint`` plan
+    at this write index, the payload comes back truncated mid-byte and
+    ``torn`` is True — the writer must write the torn bytes (the
+    process "died" part-way through) and then raise
+    :class:`FaultInjected` WITHOUT completing the atomic rename."""
+    global _WRITE_COUNT
+    plan = get_fault_plan()
+    if plan is None or plan.kind != "tear_checkpoint" or _is_spent(plan):
+        return payload, False
+    index = _WRITE_COUNT
+    _WRITE_COUNT += 1
+    if index != plan.at:
+        return payload, False
+    _trip(plan)
+    return payload[: max(1, len(payload) // 2)], True

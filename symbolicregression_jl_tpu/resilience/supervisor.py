@@ -1,0 +1,243 @@
+"""Auto-resume supervisor: retry/backoff around ``equation_search`` that
+resumes from the newest valid snapshot instead of restarting.
+
+PR 7 made the sharded search a compiled contract, PR 8 taught the
+telemetry doctor to call a fault-with-``saved_state`` *resumable*, and
+the snapshot plumbing (Options ``snapshot_path`` /
+``snapshot_every_dispatches``) makes mid-run state durable — this module
+closes the loop (ROADMAP #3): a dispatch fault, a tunnel drop, or an
+injected failure costs at most ``snapshot_every_dispatches`` dispatches
+of work, never the run.
+
+Policy (docs/resilience.md):
+
+* **capped attempts** — at most ``max_attempts`` ``equation_search``
+  calls, then the last exception re-raises (a deterministically failing
+  config must not loop forever);
+* **exponential backoff with jitter** — attempt k sleeps
+  ``min(cap, base * 2**(k-1)) * (1 + jitter*u)`` before retrying, so a
+  flapping tunnel is not hammered in lockstep;
+* **resume, not restart** — every attempt first loads the newest valid
+  snapshot at ``snapshot_path`` (``load_search_state`` falls back to
+  ``.bkup`` on a torn main file) and runs only the REMAINING
+  iterations; the snapshot's Options fingerprint is checked at load, so
+  a stale file from a different config restarts cleanly instead of
+  resuming garbage;
+* **classified failures** — with telemetry enabled, each failed
+  attempt's event log goes through ``telemetry.analyze.analyze_run``
+  and the verdict (``faulted``/``resumable``) is recorded in the
+  returned :class:`SupervisedResult.history` — the machine-readable
+  story of what died and what was recovered.
+
+Resumes are bit-identical continuations: the snapshot carries each
+output's host PRNG key, so a supervised run that faulted and resumed
+produces the same hall of fame as the uninterrupted run (asserted in
+tests/test_ad_resilience.py on fused and chunked drivers, donation on
+and off).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .faults import FaultInjected  # noqa: F401  (re-exported convenience)
+
+#: equation_search kwargs that are NOT Options kwargs (the same split
+#: equation_search itself performs); everything else in **search_kwargs
+#: constructs the Options.
+_SEARCH_ONLY_KWARGS = frozenset((
+    "weights", "variable_names", "saved_state", "warm_start_file",
+    "return_state", "runtests", "on_iteration", "parallelism",
+    "numprocs", "procs", "addprocs_function",
+))
+
+
+@dataclasses.dataclass
+class SupervisedResult:
+    """`equation_search` result plus the supervision record."""
+
+    result: Any  # EquationSearchResult
+    attempts: int = 1
+    resumes: int = 0
+    #: one entry per FAILED attempt: {"attempt", "error_type", "error",
+    #: "verdict", "resumable", "resumed_from_iteration", "backoff_s"}
+    history: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+
+
+def backoff_s(
+    attempt: int,
+    base_s: float,
+    cap_s: float,
+    jitter: float,
+    rng: random.Random,
+) -> float:
+    """Delay before the retry following failed attempt `attempt`
+    (1-based): exponential in the attempt index, capped, with
+    multiplicative jitter in [0, jitter]."""
+    d = min(cap_s, base_s * (2.0 ** max(0, attempt - 1)))
+    if jitter > 0:
+        d *= 1.0 + jitter * rng.random()
+    return d
+
+
+def _newest_event_log(telemetry_dir: str, since_ts: float) -> Optional[str]:
+    try:
+        cands = [
+            os.path.join(telemetry_dir, f)
+            for f in os.listdir(telemetry_dir)
+            if f.startswith("events-") and f.endswith(".jsonl")
+        ]
+        cands = [p for p in cands if os.path.getmtime(p) >= since_ts]
+        return max(cands, key=os.path.getmtime) if cands else None
+    except OSError:
+        return None
+
+
+def _classify(telemetry_dir: Optional[str], since_ts: float) -> Dict[str, Any]:
+    """The doctor's view of the attempt that just failed: verdict +
+    resumable flag from the newest event log the attempt wrote, or
+    {} when there is no telemetry to read."""
+    if not telemetry_dir:
+        return {}
+    path = _newest_event_log(telemetry_dir, since_ts)
+    if path is None:
+        return {}
+    from ..telemetry.analyze import analyze_run
+
+    try:
+        report = analyze_run(path)
+    except OSError:
+        return {}
+    return {
+        "verdict": report.get("verdict"),
+        "resumable": bool(report.get("resumable")),
+        "event_log": path,
+    }
+
+
+def supervised_search(
+    X,
+    y,
+    *,
+    snapshot_path: str,
+    snapshot_every_dispatches: int = 1,
+    niterations: int = 10,
+    max_attempts: int = 3,
+    backoff_base_s: float = 1.0,
+    backoff_cap_s: float = 60.0,
+    backoff_jitter: float = 0.25,
+    sleep_fn: Callable[[float], None] = time.sleep,
+    rng: Optional[random.Random] = None,
+    **search_kwargs,
+) -> SupervisedResult:
+    """Run ``equation_search(X, y, niterations=..., **search_kwargs)``
+    under supervision: snapshots every ``snapshot_every_dispatches``
+    dispatches to ``snapshot_path``, and on failure retries (backoff,
+    capped attempts) resuming from the newest valid snapshot — including
+    a snapshot left by a previous PROCESS (a supervised run restarted
+    after SIGKILL picks up exactly where the dead one's last snapshot
+    stopped).
+
+    Accepts the same kwargs as ``equation_search`` (``options=`` or
+    option kwargs, plus ``return_state``/``weights``/...). The snapshot
+    knobs are forced into the Options; ``saved_state`` is owned by the
+    supervisor and may not be passed. Raises the last failure when
+    ``max_attempts`` is exhausted."""
+    if "saved_state" in search_kwargs:
+        raise ValueError(
+            "supervised_search owns saved_state (it resumes from "
+            "snapshot_path); pass a fresh snapshot_path instead"
+        )
+    from ..api import equation_search
+    from ..models.options import make_options
+    from ..utils.checkpoint import CheckpointIncompatible, load_search_state
+
+    options = search_kwargs.pop("options", None)
+    search_only = {
+        k: v for k, v in search_kwargs.items() if k in _SEARCH_ONLY_KWARGS
+    }
+    option_kwargs = {
+        k: v for k, v in search_kwargs.items()
+        if k not in _SEARCH_ONLY_KWARGS
+    }
+    if options is None:
+        options = make_options(**option_kwargs)
+    elif option_kwargs:
+        raise ValueError("Pass either options= or option kwargs, not both")
+    options = dataclasses.replace(
+        options,
+        snapshot_path=snapshot_path,
+        snapshot_every_dispatches=snapshot_every_dispatches,
+    )
+    rng = rng or random.Random(options.seed)
+    telemetry_dir = (
+        (options.telemetry_dir or ".") if options.telemetry else None
+    )
+
+    history: List[Dict[str, Any]] = []
+    resumes = 0
+    attempt = 0
+    while True:
+        attempt += 1
+        # newest valid snapshot (main, else .bkup) decides resume vs
+        # fresh start. A fingerprint mismatch is a RESTART (the file is
+        # from another config), recorded in history immediately — the
+        # decision must be visible even when the fresh attempt then
+        # succeeds. Generic corruption (both twins unreadable)
+        # PROPAGATES: load's contract says a destroyed checkpoint is
+        # never silently a fresh start, and the supervisor must not
+        # convert hours of banked progress into a quiet rerun.
+        saved = None
+        if os.path.exists(snapshot_path) or os.path.exists(
+            snapshot_path + ".bkup"
+        ):
+            try:
+                saved = load_search_state(snapshot_path, options=options)
+            except CheckpointIncompatible as e:
+                history.append({
+                    "attempt": attempt,
+                    "snapshot_error": f"{type(e).__name__}: {e}",
+                })
+            except FileNotFoundError:
+                saved = None  # raced away between exists() and load
+        done = min((s.iteration for s in saved), default=0) if saved else 0
+        remaining = max(0, niterations - done)
+        if saved is not None:
+            # attempt 1 can already be a resume: a supervised run
+            # restarted after SIGKILL starts from the dead run's snapshot
+            resumes += 1
+        t_attempt = time.time()
+        try:
+            result = equation_search(
+                X, y, options=options, niterations=remaining,
+                saved_state=saved, **search_only,
+            )
+            return SupervisedResult(
+                result=result,
+                attempts=attempt,
+                resumes=resumes,
+                history=history,
+            )
+        except Exception as e:
+            entry: Dict[str, Any] = {
+                "attempt": attempt,
+                "error_type": type(e).__name__,
+                "error": str(e)[:500],
+                "resumed_from_iteration": done if saved else None,
+            }
+            entry.update(_classify(telemetry_dir, t_attempt))
+            if attempt >= max_attempts:
+                entry["gave_up"] = True
+                history.append(entry)
+                raise
+            delay = backoff_s(
+                attempt, backoff_base_s, backoff_cap_s, backoff_jitter,
+                rng,
+            )
+            entry["backoff_s"] = round(delay, 3)
+            history.append(entry)
+            sleep_fn(delay)

@@ -187,6 +187,9 @@ def analyze_run(
         for k in (
             "run", "config_fingerprint", "backend", "niterations",
             "nout", "mesh_shape", "n_devices", "device_kind",
+            # resilience provenance (ISSUE 11): snapshot cadence and,
+            # on a resumed run, where its saved_state came from
+            "snapshot", "resume_from",
         )
         if start.get(k) is not None
     }

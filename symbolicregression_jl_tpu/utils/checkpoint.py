@@ -4,32 +4,61 @@ The reference's exact-resume state lives only in the Julia session
 (`return_state=true` → pass the tuple back to EquationSearch,
 src/SearchUtils.jl:270-273); its only on-disk artifact is the hall-of-fame
 CSV. Here the complete `SearchState` (per-island populations, statistics,
-PRNG keys, hall of fame, iteration counter) round-trips through a file, so
-an exact resume survives a process restart:
+PRNG keys — device-side per-island keys AND the host-loop master key —
+hall of fame, iteration counter) round-trips through a file, so an exact
+resume survives a process restart:
 
     res = equation_search(X, y, return_state=True, ...)
-    save_search_state("run.ckpt", res.state)
+    save_search_state("run.ckpt", res.state, options=options)
     # ... new process ...
-    state = load_search_state("run.ckpt")
+    state = load_search_state("run.ckpt", options=options)
     res2 = equation_search(X, y, saved_state=state, ...)
 
 Arrays are stored as host numpy inside a pickle (the state is small —
-populations, not datasets); `equation_search` feeds them straight back to
-jit, and its shape validation (`_saved_state_compatible`) still guards a
-changed Options. Under multi-host SPMD, shards spanning other processes
-are all-gathered first, so every process can materialize the global
-state; writing is the caller's to gate (process 0).
+populations, not datasets). The payload is stamped with the schema magic
+version and an Options fingerprint (the `_saved_state_compatible`-adjacent
+shape fields), so an incompatible resume fails HERE with a clear message
+instead of deep inside `equation_search`'s shape validation.
+
+Every file write is **crash-atomic**: the payload goes to a `.tmp`
+sibling, is fsync'd, then `os.replace`d over the target — first the main
+file, then the `.bkup` twin. A kill at ANY byte leaves both the main and
+backup files either absent or wholly intact (never torn), and a kill
+between the two replaces leaves the main file new and the backup one
+snapshot behind — both loadable. `resilience.faults` can tear a write
+mid-byte on purpose (`tear_checkpoint@N`) to prove exactly this.
+
+Under multi-host SPMD, shards spanning other processes are all-gathered
+first, so every process can materialize the global state; writing is the
+caller's to gate (process 0).
 """
 
 from __future__ import annotations
 
+import os
 import pickle
-from typing import List
+from typing import List, Optional
 
 import jax
 import numpy as np
 
-_MAGIC = "srtpu-search-state-v1"
+# v2 adds the Options fingerprint + per-output host PRNG key; v1
+# payloads (no stamp, no rng_key) still load — fingerprint checking is
+# simply skipped for them.
+_MAGIC = "srtpu-search-state-v2"
+_MAGIC_V1 = "srtpu-search-state-v1"
+
+#: the Options fields a checkpoint must agree on to resume into the same
+#: compiled shapes — the `_saved_state_compatible`-adjacent set, plus
+#: precision (a dtype change passes shape checks but poisons the math).
+_FINGERPRINT_FIELDS = (
+    "npopulations", "npop", "maxsize", "max_len", "precision",
+)
+
+
+def options_fingerprint(options) -> dict:
+    """The shape-compatibility stamp written into every checkpoint."""
+    return {f: getattr(options, f) for f in _FINGERPRINT_FIELDS}
 
 
 def _to_host(x) -> np.ndarray:
@@ -42,14 +71,40 @@ def _to_host(x) -> np.ndarray:
     return np.asarray(x)
 
 
+def _write_atomic(path: str, payload: bytes) -> None:
+    """One crash-atomic file write: `.tmp` sibling, fsync, os.replace.
+    The resilience fault hook may hand back a truncated payload
+    (`tear_checkpoint`): the torn bytes are written — the simulated
+    death happened mid-write — and FaultInjected raises BEFORE the
+    rename, so a torn `.tmp` can never shadow a good checkpoint."""
+    from ..resilience import faults
+
+    to_write, torn = faults.on_checkpoint_write(payload)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(to_write)
+        f.flush()
+        os.fsync(f.fileno())
+    if torn:
+        raise faults.FaultInjected(
+            f"injected torn checkpoint write at {path!r} "
+            f"({len(to_write)}/{len(payload)} bytes)"
+        )
+    os.replace(tmp, path)
+
+
 def save_search_state(path: str, state: List["SearchState"],
-                      sink=None) -> str:
+                      sink=None, options=None, dispatch: Optional[int] = None,
+                      cause: Optional[str] = None) -> str:
     """Write the list of per-output SearchStates (from
-    `equation_search(..., return_state=True).state`) to `path`. Uses the
-    same double-write discipline as the CSV checkpoint (file + .bkup).
-    `sink` (a telemetry EventLog) records the serialization point as a
-    ``saved_state`` event — the resume-not-restart trail of ROADMAP
-    item 4 keys off these."""
+    `equation_search(..., return_state=True).state`) to `path` and its
+    `.bkup` twin, each write crash-atomic (see module doc). `options`
+    stamps the payload with the shape fingerprint `load_search_state`
+    checks on resume. `sink` (a telemetry EventLog) records the
+    serialization point as a ``saved_state`` event — with the snapshot
+    cadence provenance (`dispatch`, `cause`) when the periodic-snapshot
+    plumbing is the caller — the resume-not-restart trail the watcher
+    and supervisor key off."""
     if state is None:
         raise ValueError(
             "state is None — run equation_search with return_state=True"
@@ -61,36 +116,96 @@ def save_search_state(path: str, state: List["SearchState"],
             ),
             "global_hof": jax.tree_util.tree_map(_to_host, s.global_hof),
             "iteration": int(s.iteration),
+            "rng_key": (
+                None if getattr(s, "rng_key", None) is None
+                else np.asarray(s.rng_key)
+            ),
         }
         for s in state
     ]
-    payload = pickle.dumps({"magic": _MAGIC, "outputs": host},
-                           protocol=pickle.HIGHEST_PROTOCOL)
+    record = {"magic": _MAGIC, "outputs": host}
+    if options is not None:
+        record["options_fingerprint"] = options_fingerprint(options)
+    payload = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
     for p in (path, path + ".bkup"):
-        with open(p, "wb") as f:
-            f.write(payload)
+        _write_atomic(p, payload)
     if sink is not None:
-        sink.emit(
-            "saved_state",
+        fields = dict(
             path=path,
             outputs=len(host),
             iteration=max((d["iteration"] for d in host), default=0),
         )
+        if dispatch is not None:
+            fields["dispatch"] = int(dispatch)
+        if cause is not None:
+            fields["cause"] = cause
+        sink.emit("saved_state", **fields)
     return path
 
 
-def load_search_state(path: str) -> List["SearchState"]:
+class CheckpointIncompatible(ValueError):
+    """The checkpoint loaded structurally but was written under
+    incompatible Options (shape fingerprint mismatch). Raised
+    immediately — the `.bkup` twin carries the same fingerprint, so
+    falling back to it could only mask the mismatch."""
+
+
+def _parse_payload(p: str, options) -> List["SearchState"]:
+    from ..api import SearchState
+
+    with open(p, "rb") as f:
+        data = pickle.load(f)
+    magic = data.get("magic") if isinstance(data, dict) else None
+    if magic not in (_MAGIC, _MAGIC_V1):
+        raise ValueError(f"{p!r} is not a search-state checkpoint")
+    stamp = data.get("options_fingerprint")
+    if options is not None and stamp is not None:
+        want = options_fingerprint(options)
+        mismatched = {
+            k: (stamp.get(k), want[k])
+            for k in want if stamp.get(k) != want[k]
+        }
+        if mismatched:
+            detail = ", ".join(
+                f"{k}: checkpoint={a!r} vs options={b!r}"
+                for k, (a, b) in sorted(mismatched.items())
+            )
+            raise CheckpointIncompatible(
+                f"checkpoint {p!r} was written under incompatible "
+                f"Options ({detail}); resume with the original "
+                "configuration or start fresh"
+            )
+    states = [
+        SearchState(
+            island_states=d["island_states"],
+            global_hof=d["global_hof"],
+            iteration=d["iteration"],
+            rng_key=d.get("rng_key"),
+        )
+        for d in data["outputs"]
+    ]
+    for s in states:
+        # provenance for the telemetry run_start `resume_from` field:
+        # which file this resumed state actually came from (the .bkup
+        # when the main file was torn)
+        s._source_path = p
+    return states
+
+
+def load_search_state(path: str,
+                      options=None) -> List["SearchState"]:
     """Load a checkpoint written by save_search_state; falls back to the
     .bkup copy if the main file is missing or torn.
+
+    With `options`, the payload's fingerprint stamp is checked and an
+    incompatible checkpoint raises :class:`CheckpointIncompatible` (a
+    ValueError) with the mismatched fields named — failing HERE beats
+    failing deep inside `equation_search`'s shape validation.
 
     Raises FileNotFoundError only when NO checkpoint file exists (the
     resume-if-present pattern); corrupt-but-present checkpoints raise
     ValueError so a destroyed checkpoint is never silently mistaken for
     a fresh start."""
-    import os
-
-    from ..api import SearchState
-
     last_err: Exception | None = None
     existed = False
     for p in (path, path + ".bkup"):
@@ -98,18 +213,11 @@ def load_search_state(path: str) -> List["SearchState"]:
             continue
         existed = True
         try:
-            with open(p, "rb") as f:
-                data = pickle.load(f)
-            if data.get("magic") != _MAGIC:
-                raise ValueError(f"{p!r} is not a search-state checkpoint")
-            return [
-                SearchState(
-                    island_states=d["island_states"],
-                    global_hof=d["global_hof"],
-                    iteration=d["iteration"],
-                )
-                for d in data["outputs"]
-            ]
+            return _parse_payload(p, options)
+        except CheckpointIncompatible:
+            # both twins carry the same stamp: fail loud, never fall
+            # through to an equally incompatible .bkup
+            raise
         # corrupt pickles raise a zoo of types (AttributeError,
         # ImportError, struct.error, ...): any failure means "try bkup"
         except Exception as e:

@@ -1,0 +1,706 @@
+"""Preemption-tolerant search (ISSUE 11, docs/resilience.md): periodic
+snapshots resume BIT-IDENTICALLY to the uninterrupted run (same hall of
+fame, same host key chain) on fused and chunked drivers with donation on
+and off; checkpoint writes are crash-atomic under injected torn writes;
+corrupt checkpoints fail loud (never a silent fresh start); and the
+auto-resume supervisor turns an injected mid-search fault into the
+uninterrupted run's exact result. Fast, CPU-only; the one real-SIGKILL
+subprocess round trip is marked slow."""
+
+import dataclasses
+import os
+import pickle
+import random
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+import symbolicregression_jl_tpu as sr
+from symbolicregression_jl_tpu.resilience import (
+    FaultInjected,
+    FaultPlan,
+    backoff_s,
+    clear_fault_plan,
+    faults,
+    set_fault_plan,
+    supervised_search,
+)
+from symbolicregression_jl_tpu.utils.checkpoint import (
+    CheckpointIncompatible,
+    load_search_state,
+    options_fingerprint,
+    save_search_state,
+)
+
+# DELIBERATELY the exact Options shape of test_dispatch_chunking's fast
+# e2e test (same _graph_key -> the iteration/init factories' lru_caches
+# share one compile per driver/donation variant across both files —
+# tier-1 dot-budget hygiene)
+KW = dict(
+    binary_operators=["+", "*"],
+    npop=10,
+    npopulations=2,
+    ncycles_per_iteration=5,
+    tournament_selection_n=4,
+    maxsize=8,
+    progress=False,
+    verbosity=0,
+    save_to_file=False,
+    seed=0,
+    deterministic=True,
+)
+
+# search-level kwargs for every equation_search in this file: preflight
+# already ran in earlier test files; skipping it here keeps each tiny
+# search compile-bound only (bit-identity is unaffected — preflight is
+# validation, not state)
+SKW = dict(runtests=False)
+
+
+def _data():
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((2, 48)).astype(np.float32)
+    y = (X[0] * X[0] + 0.5).astype(np.float32)
+    return X, y
+
+
+def _frontier(r):
+    return [
+        (c.complexity, float(c.loss), float(c.score), c.equation)
+        for c in r.frontier()
+    ]
+
+
+def _assert_hof_bit_identical(sa, sb):
+    for a, b in zip(
+        jax.tree_util.tree_leaves(sa.global_hof),
+        jax.tree_util.tree_leaves(sb.global_hof),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_plan():
+    clear_fault_plan()
+    yield
+    clear_fault_plan()
+
+
+@pytest.fixture(scope="module")
+def tiny_run():
+    """One 1-iteration search whose state feeds the checkpoint unit
+    tests (module-scoped: the compile is paid once)."""
+    X, y = _data()
+    return sr.equation_search(
+        X, y, niterations=1, return_state=True, **KW, **SKW
+    )
+
+
+# ---------------------------------------------------------------------------
+# tentpole: fault -> snapshot -> supervisor resume, bit-identical
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+@pytest.mark.parametrize(
+    "driver_kw",
+    [
+        pytest.param({}, id="fused"),
+        # the chunked driver compiles five phase programs nothing else
+        # in the fast tier pays for (~2 min on the 1-core CI box):
+        # slow tier, same budget policy as PR 7's sharded searches —
+        # the fast tier keeps the fused combo, whose graphs the rest
+        # of this file reuses
+        pytest.param(
+            {"max_cycles_per_dispatch": 2}, id="chunked",
+            marks=pytest.mark.slow,
+        ),
+    ],
+)
+@pytest.mark.parametrize(
+    "donate",
+    [
+        pytest.param("1", id="donate"),
+        # donation-off compiles a whole graph set nothing else in the
+        # fast tier uses (donate is part of the jit factories' cache
+        # key): slow tier, like the other SRTPU_DONATE A/B searches
+        pytest.param("0", id="nodonate", marks=pytest.mark.slow),
+    ],
+)
+def test_supervised_resume_bit_identical(
+    tmp_path, monkeypatch, driver_kw, donate
+):
+    """The acceptance contract: a fault-injected kill of dispatch 1,
+    snapshotting every dispatch, supervisor-resumed — the final hall of
+    fame AND the host key chain must be bit-identical to the
+    uninterrupted run, on both drivers, donation on and off."""
+    monkeypatch.setenv("SRTPU_DONATE", donate)
+    X, y = _data()
+    kw = {**KW, **driver_kw}
+    base = sr.equation_search(
+        X, y, niterations=2, return_state=True, **kw, **SKW
+    )
+
+    snap = str(tmp_path / "run.ckpt")
+    set_fault_plan(FaultPlan(kind="raise", at=1))
+    sup = supervised_search(
+        X, y, niterations=2,
+        snapshot_path=snap, snapshot_every_dispatches=1,
+        max_attempts=3, backoff_base_s=0.0, backoff_jitter=0.0,
+        sleep_fn=lambda s: None, return_state=True, **kw, **SKW,
+    )
+    assert sup.attempts == 2
+    assert sup.resumes == 1
+    assert sup.history[0]["error_type"] == "FaultInjected"
+    assert sup.history[0]["resumed_from_iteration"] is None  # fresh start
+
+    assert _frontier(base) == _frontier(sup.result)
+    sa, sb = base.state[0], sup.result.state[0]
+    _assert_hof_bit_identical(sa, sb)
+    # same key chain: the resumed run continued the interrupted one's
+    # host PRNG stream exactly
+    np.testing.assert_array_equal(
+        np.asarray(sa.rng_key), np.asarray(sb.rng_key)
+    )
+
+
+@pytest.mark.fast
+def test_resume_twice_from_one_snapshot_bit_identical(tmp_path):
+    """One snapshot, two resumes: both must equal each other AND the
+    uninterrupted 3-iteration run (the snapshot is a pure serialization
+    point, not a consumable)."""
+    X, y = _data()
+    full = sr.equation_search(
+        X, y, niterations=3, return_state=True, **KW, **SKW
+    )
+    snap = str(tmp_path / "snap.ckpt")
+    sr.equation_search(
+        X, y, niterations=2, snapshot_path=snap,
+        snapshot_every_dispatches=2, **KW, **SKW,
+    )
+    s1 = load_search_state(snap)
+    s2 = load_search_state(snap)
+    assert s1[0].iteration == 2
+    assert s1[0].rng_key is not None
+    r1 = sr.equation_search(
+        X, y, niterations=1, saved_state=s1, return_state=True, **KW,
+        **SKW,
+    )
+    r2 = sr.equation_search(
+        X, y, niterations=1, saved_state=s2, return_state=True, **KW,
+        **SKW,
+    )
+    assert _frontier(r1) == _frontier(r2) == _frontier(full)
+    _assert_hof_bit_identical(r1.state[0], full.state[0])
+
+
+@pytest.mark.fast
+def test_resume_bit_identical_under_warmup_curriculum(tmp_path):
+    """warmup_maxsize_by > 0: the curriculum denominator is the
+    ABSOLUTE planned total (resume start + remaining), so the resumed
+    run's size-cap ramp — and therefore its hall of fame — matches the
+    uninterrupted run exactly even though it passes only the remaining
+    iteration count. (warmup/curmaxsize are host-side + traced: this
+    reuses the already-compiled graphs.)"""
+    X, y = _data()
+    kw = {**KW, "warmup_maxsize_by": 0.67}
+    full = sr.equation_search(
+        X, y, niterations=3, return_state=True, **kw, **SKW
+    )
+    snap = str(tmp_path / "w.ckpt")
+    sr.equation_search(
+        X, y, niterations=1, snapshot_path=snap, **kw, **SKW
+    )
+    resumed = sr.equation_search(
+        X, y, niterations=2, saved_state=load_search_state(snap),
+        return_state=True, **kw, **SKW,
+    )
+    assert _frontier(resumed) == _frontier(full)
+    _assert_hof_bit_identical(resumed.state[0], full.state[0])
+
+
+@pytest.mark.fast
+def test_supervisor_exhausts_attempts_and_reraises(tmp_path):
+    """max_attempts=1 with a fault at dispatch 0: nothing to resume
+    from, the cap trips immediately, and the original exception
+    propagates (a deterministically failing config must not loop)."""
+    X, y = _data()
+    set_fault_plan(FaultPlan(kind="raise", at=0))
+    with pytest.raises(FaultInjected):
+        supervised_search(
+            X, y, niterations=1,
+            snapshot_path=str(tmp_path / "never.ckpt"),
+            max_attempts=1, sleep_fn=lambda s: None, **KW, **SKW,
+        )
+
+
+@pytest.mark.fast
+def test_supervisor_restarts_clean_on_stale_snapshot(tmp_path, tiny_run):
+    """A snapshot from a DIFFERENT config at snapshot_path (fingerprint
+    mismatch) must cause a clean fresh start, not a crash and not a
+    garbage resume. The stale file is forged by doctoring a real
+    snapshot's stamp (same search shape everywhere: no extra compile)."""
+    X, y = _data()
+    snap = str(tmp_path / "stale.ckpt")
+    save_search_state(snap, tiny_run.state, options=tiny_run.options)
+    for p in (snap, snap + ".bkup"):
+        with open(p, "rb") as f:
+            data = pickle.load(f)
+        data["options_fingerprint"]["npop"] = 999
+        with open(p, "wb") as f:
+            pickle.dump(data, f)
+    sup = supervised_search(
+        X, y, niterations=1, snapshot_path=snap,
+        max_attempts=2, sleep_fn=lambda s: None, **KW, **SKW,
+    )
+    assert sup.attempts == 1
+    assert sup.resumes == 0
+    assert sup.result.frontier()
+    # the restart decision is on the record even though the fresh
+    # attempt succeeded
+    assert "snapshot_error" in sup.history[0]
+    assert "npop" in sup.history[0]["snapshot_error"]
+
+
+@pytest.mark.fast
+def test_supervisor_propagates_corrupt_checkpoint(tmp_path):
+    """Both twins unreadable is NOT a fresh start: the load contract's
+    refusal propagates through the supervisor — banked progress must
+    never silently become a rerun."""
+    X, y = _data()
+    snap = str(tmp_path / "corrupt.ckpt")
+    for p in (snap, snap + ".bkup"):
+        with open(p, "wb") as f:
+            f.write(b"not a pickle")
+    with pytest.raises(ValueError, match="refusing"):
+        supervised_search(
+            X, y, niterations=1, snapshot_path=snap,
+            max_attempts=2, sleep_fn=lambda s: None, **KW, **SKW,
+        )
+
+
+@pytest.mark.fast
+def test_snapshot_cadence_round_aligned_not_stretched():
+    """Multi-output cadence: a snapshot fires at the first round end
+    after every k-dispatch boundary — never stretched to
+    lcm(k, nout) by requiring the boundary to LAND on a round end."""
+    from symbolicregression_jl_tpu.api import _snapshot_due
+
+    # nout=1: exactly the every-k schedule
+    fires = [g for g in range(1, 13) if _snapshot_due(g, 1, 3)]
+    assert fires == [3, 6, 9, 12]
+    # nout=2, every=5: round ends at 2,4,6,...; boundaries 5,10 are
+    # picked up at the NEXT round end (6, 10) — cadence ~5, not 10
+    fires = [g for g in range(2, 21, 2) if _snapshot_due(g, 2, 5)]
+    assert fires == [6, 10, 16, 20]
+    # nout=5, every=7: cadence ~7 (10, 15, 25, ...), not lcm=35
+    fires = [g for g in range(5, 41, 5) if _snapshot_due(g, 5, 7)]
+    assert fires == [10, 15, 25, 30, 35]
+
+
+@pytest.mark.fast
+def test_recreate_fallback_ignores_checkpoint_rng_key(tiny_run):
+    """An INCOMPATIBLE saved state (populations recreated with a
+    warning) must not leak the dead run's key chain into the fresh
+    init: the recreate fallback stays reproducible from Options.seed
+    (SearchState's documented contract). The saved state is made
+    incompatible by truncating its population arrays — same Options
+    everywhere, no extra compile."""
+    X, y = _data()
+    s0 = tiny_run.state[0]
+    pop = s0.island_states.pop
+    bad = [dataclasses.replace(
+        s0,
+        island_states=s0.island_states._replace(
+            pop=pop._replace(scores=pop.scores[:, :-1])
+        ),
+        # a key chain the fallback must NOT adopt
+        rng_key=np.asarray(jax.random.PRNGKey(12345)),
+    )]
+    with pytest.warns(UserWarning, match="recreating"):
+        recreated = sr.equation_search(
+            X, y, niterations=1, saved_state=bad,
+            return_state=True, **KW, **SKW,
+        )
+    # same seed-derived chain as the never-resumed run of these Options
+    np.testing.assert_array_equal(
+        np.asarray(tiny_run.state[0].rng_key),
+        np.asarray(recreated.state[0].rng_key),
+    )
+
+
+@pytest.mark.fast
+def test_snapshot_write_fault_propagates_without_torn_files(tmp_path):
+    """An injected tear during the in-loop periodic snapshot must
+    propagate out of equation_search (the supervisor's classify-and-
+    resume path) while the crash-atomic discipline keeps the torn
+    bytes quarantined in the .tmp sibling."""
+    X, y = _data()
+    snap = str(tmp_path / "t.ckpt")
+    set_fault_plan(FaultPlan(kind="tear_checkpoint", at=0))
+    with pytest.raises(FaultInjected):
+        sr.equation_search(
+            X, y, niterations=1, snapshot_path=snap,
+            snapshot_every_dispatches=1, **KW, **SKW,
+        )
+    assert not os.path.exists(snap)
+    assert os.path.exists(snap + ".tmp")
+
+
+@pytest.mark.fast
+def test_snapshot_path_alone_defaults_to_every_dispatch():
+    """A configured snapshot_path must never be a silent no-op: the
+    default cadence 0 normalizes to 1 (every dispatch)."""
+    o = sr.make_options(snapshot_path="x.ckpt")
+    assert o.snapshot_every_dispatches == 1
+    o2 = sr.make_options(snapshot_path="x.ckpt",
+                         snapshot_every_dispatches=4)
+    assert o2.snapshot_every_dispatches == 4
+    with pytest.raises(ValueError, match="requires snapshot_path"):
+        sr.make_options(snapshot_every_dispatches=2)
+
+
+@pytest.mark.slow
+def test_real_sigkill_then_cross_process_supervised_resume(tmp_path):
+    """The honest preemption: a child process SIGKILLs ITSELF mid-search
+    (fault plan from the environment, fuse file persisting the spent
+    mark), then a fresh supervisor in THIS process picks up the dead
+    child's snapshot and finishes — bit-identical to uninterrupted."""
+    X, y = _data()
+    base = sr.equation_search(X, y, niterations=2, **KW, **SKW)
+    snap = str(tmp_path / "killed.ckpt")
+    fuse = str(tmp_path / "fuse")
+    code = (
+        "import numpy as np\n"
+        "import symbolicregression_jl_tpu as sr\n"
+        "rng = np.random.default_rng(1)\n"
+        "X = rng.standard_normal((2, 48)).astype(np.float32)\n"
+        "y = (X[0] * X[0] + 0.5).astype(np.float32)\n"
+        f"sr.equation_search(X, y, niterations=2, snapshot_path={snap!r},"
+        f" snapshot_every_dispatches=1, runtests=False, **{KW!r})\n"
+    )
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "SRTPU_FAULT_PLAN": "kill@1",
+        "SRTPU_FAULT_FUSE": fuse,
+    }
+    p = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=600,
+    )
+    assert p.returncode != 0  # SIGKILLed mid-run
+    assert os.path.exists(fuse)  # the plan spent itself before dying
+    assert os.path.exists(snap)  # dispatch 0's snapshot survived
+
+    sup = supervised_search(
+        X, y, niterations=2, snapshot_path=snap,
+        snapshot_every_dispatches=1, max_attempts=2,
+        sleep_fn=lambda s: None, **KW, **SKW,
+    )
+    assert sup.attempts == 1
+    assert sup.resumes == 1  # attempt 1 started from the dead run's file
+    assert _frontier(base) == _frontier(sup.result)
+
+
+@pytest.mark.fast
+def test_saved_state_event_carries_cadence_and_schema_accepts(tiny_run, tmp_path):
+    """Cheap schema-agreement checks (no search, no phased compile): a
+    periodic save_search_state emits the cadence provenance
+    (dispatch/cause), and the additive run_start `snapshot`/`resume_from`
+    fields validate against the checked-in schema exactly as api.py
+    emits them — the full telemetry round trip is the slow test below
+    and the suite `resilience` case."""
+    from symbolicregression_jl_tpu.telemetry.events import (
+        EventLog,
+        load_schema,
+        validate_event,
+    )
+
+    log_path = str(tmp_path / "events-x.jsonl")
+    sink = EventLog(log_path, run_id="r")
+    snap = str(tmp_path / "s.ckpt")
+    save_search_state(
+        snap, tiny_run.state, sink=sink, options=tiny_run.options,
+        dispatch=3, cause="periodic",
+    )
+    start = sink.emit(
+        "run_start",
+        config_fingerprint="x", backend="cpu", devices=["cpu:0"],
+        snapshot={"path": snap, "every_dispatches": 3},
+        resume_from={"path": snap, "iteration": 1, "outputs": 1,
+                     "populations_compatible": True},
+    )
+    sink.close()
+    schema = load_schema()
+    assert validate_event(start, schema) == []
+    import json
+
+    with open(log_path) as f:
+        events = [json.loads(line) for line in f]
+    saved_ev = events[0]
+    assert saved_ev["type"] == "saved_state"
+    assert saved_ev["dispatch"] == 3
+    assert saved_ev["cause"] == "periodic"
+    assert saved_ev["iteration"] == tiny_run.state[0].iteration
+    assert validate_event(saved_ev, schema) == []
+
+
+@pytest.mark.slow
+def test_snapshot_and_resume_telemetry_events_validate(tmp_path):
+    """The schema-additive trail end to end: a snapshotting run's log
+    carries `saved_state` events with cadence provenance (dispatch/
+    cause) and a `run_start.snapshot` block; the resumed run's
+    `run_start` carries `resume_from`; both logs validate against the
+    checked-in schema and the doctor reads the resumed run as healthy.
+    Slow tier: telemetry forces the phased driver, a compile set
+    nothing in the fast tier otherwise pays for."""
+    import json
+
+    from symbolicregression_jl_tpu.telemetry import validate_events_file
+    from symbolicregression_jl_tpu.telemetry.analyze import analyze_run
+
+    X, y = _data()
+    tele = str(tmp_path / "tele")
+    snap = str(tmp_path / "s.ckpt")
+    sr.equation_search(
+        X, y, niterations=1, snapshot_path=snap,
+        snapshot_every_dispatches=1, telemetry=True, telemetry_dir=tele,
+        **KW, **SKW,
+    )
+    saved = load_search_state(snap)
+    sr.equation_search(
+        X, y, niterations=1, saved_state=saved, telemetry=True,
+        telemetry_dir=tele, **KW, **SKW,
+    )
+    logs = sorted(
+        (os.path.join(tele, f) for f in os.listdir(tele)),
+        key=os.path.getmtime,
+    )
+    assert len(logs) == 2
+    for log in logs:
+        assert validate_events_file(log)["ok"], log
+
+    def events(path):
+        with open(path) as f:
+            return [json.loads(line) for line in f]
+
+    first, second = events(logs[0]), events(logs[1])
+    start1 = first[0]
+    assert start1["type"] == "run_start"
+    assert start1["snapshot"] == {"path": snap, "every_dispatches": 1}
+    assert start1["resume_from"] is None
+    saved_evs = [e for e in first if e["type"] == "saved_state"
+                 and not e.get("in_memory")]
+    assert saved_evs and saved_evs[0]["cause"] == "periodic"
+    assert saved_evs[0]["dispatch"] == 1
+    assert saved_evs[0]["path"] == snap
+
+    start2 = second[0]
+    assert start2["resume_from"]["path"] == snap
+    assert start2["resume_from"]["iteration"] == 1
+    report = analyze_run(logs[1])
+    assert report["verdict"] == "healthy"
+    assert report["run"]["resume_from"]["path"] == snap
+
+
+# ---------------------------------------------------------------------------
+# satellite: crash-atomic checkpoint writes + loud corrupt-load failures
+# ---------------------------------------------------------------------------
+
+
+def _bump(state, by=5):
+    return [dataclasses.replace(s, iteration=s.iteration + by)
+            for s in state]
+
+
+@pytest.mark.fast
+def test_torn_first_write_leaves_both_files_intact(tmp_path, tiny_run):
+    """Kill mid-byte during the MAIN file's write: with the tmp+fsync+
+    os.replace discipline neither the main file nor .bkup moves — the
+    torn bytes live only in the .tmp sibling the loader never reads
+    (the exact hole the old sequential open(.., 'wb') pair had)."""
+    snap = str(tmp_path / "a.ckpt")
+    save_search_state(snap, tiny_run.state, options=tiny_run.options)
+    v1_main = open(snap, "rb").read()
+    v1_bkup = open(snap + ".bkup", "rb").read()
+
+    set_fault_plan(FaultPlan(kind="tear_checkpoint", at=0))
+    with pytest.raises(FaultInjected):
+        save_search_state(
+            snap, _bump(tiny_run.state), options=tiny_run.options
+        )
+    assert open(snap, "rb").read() == v1_main
+    assert open(snap + ".bkup", "rb").read() == v1_bkup
+    assert os.path.exists(snap + ".tmp")  # the torn write, quarantined
+    loaded = load_search_state(snap, options=tiny_run.options)
+    assert loaded[0].iteration == tiny_run.state[0].iteration
+
+
+@pytest.mark.fast
+def test_torn_backup_write_leaves_loadable_bkup(tmp_path, tiny_run):
+    """Kill between the two writes (tear at file-write index 1): the
+    main file already holds the NEW snapshot, .bkup still holds the old
+    one — and when the main file is later destroyed, load falls back to
+    that loadable .bkup instead of silently fresh-starting."""
+    snap = str(tmp_path / "b.ckpt")
+    save_search_state(snap, tiny_run.state, options=tiny_run.options)
+    old_iter = tiny_run.state[0].iteration
+
+    set_fault_plan(FaultPlan(kind="tear_checkpoint", at=1))
+    with pytest.raises(FaultInjected):
+        save_search_state(
+            snap, _bump(tiny_run.state), options=tiny_run.options
+        )
+    # main advanced, backup one snapshot behind — both loadable
+    assert load_search_state(snap)[0].iteration == old_iter + 5
+    payload = open(snap, "rb").read()
+    with open(snap, "wb") as f:
+        f.write(payload[: len(payload) // 2])
+    assert load_search_state(snap)[0].iteration == old_iter
+
+
+@pytest.mark.fast
+def test_truncated_checkpoint_raises_never_fresh_start(tmp_path, tiny_run):
+    snap = str(tmp_path / "c.ckpt")
+    save_search_state(snap, tiny_run.state)
+    payload = open(snap, "rb").read()
+    for p in (snap, snap + ".bkup"):
+        with open(p, "wb") as f:
+            f.write(payload[: len(payload) // 2])
+    with pytest.raises(ValueError, match="refusing"):
+        load_search_state(snap)
+    with pytest.raises(FileNotFoundError):
+        load_search_state(str(tmp_path / "missing.ckpt"))
+
+
+@pytest.mark.fast
+def test_wrong_magic_raises(tmp_path):
+    snap = str(tmp_path / "d.ckpt")
+    with open(snap, "wb") as f:
+        pickle.dump({"magic": "not-a-checkpoint", "outputs": []}, f)
+    with pytest.raises(ValueError, match="refusing"):
+        load_search_state(snap)
+
+
+@pytest.mark.fast
+def test_fingerprint_mismatch_fails_at_load_with_named_fields(
+    tmp_path, tiny_run
+):
+    """Satellite: an incompatible resume fails AT load_search_state,
+    naming the mismatched Options fields — not deep inside
+    equation_search's shape validation."""
+    snap = str(tmp_path / "e.ckpt")
+    save_search_state(snap, tiny_run.state, options=tiny_run.options)
+    other = sr.make_options(**{**KW, "npop": 12})
+    with pytest.raises(CheckpointIncompatible, match="npop"):
+        load_search_state(snap, options=other)
+    # the compatible config still loads; unstamped (options=None) too
+    assert load_search_state(snap, options=tiny_run.options)
+    assert load_search_state(snap)
+    # stamp matches the documented fingerprint fields
+    fp = options_fingerprint(tiny_run.options)
+    assert fp["npop"] == KW["npop"]
+    assert "precision" in fp
+
+
+@pytest.mark.fast
+def test_unstamped_v1_checkpoint_still_loads(tmp_path, tiny_run):
+    """Back-compat: a payload without fingerprint/rng_key (the v1
+    schema) loads with fingerprint checking skipped."""
+    snap = str(tmp_path / "f.ckpt")
+    save_search_state(snap, tiny_run.state)
+    with open(snap, "rb") as f:
+        data = pickle.load(f)
+    data["magic"] = "srtpu-search-state-v1"
+    data.pop("options_fingerprint", None)
+    for d in data["outputs"]:
+        d.pop("rng_key", None)
+    with open(snap, "wb") as f:
+        pickle.dump(data, f)
+    os.remove(snap + ".bkup")
+    loaded = load_search_state(snap, options=tiny_run.options)
+    assert loaded[0].rng_key is None
+    assert loaded[0].iteration == tiny_run.state[0].iteration
+
+
+# ---------------------------------------------------------------------------
+# fault-plan + backoff units (no search, no jax dispatch)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_fault_plan_parse_and_validation():
+    p = FaultPlan.parse("raise@3")
+    assert p == FaultPlan(kind="raise", at=3)
+    assert p.spec() == "raise@3"
+    with pytest.raises(ValueError, match="kind"):
+        FaultPlan.parse("explode@1")
+    with pytest.raises(ValueError, match="form"):
+        FaultPlan.parse("raise")
+    with pytest.raises(ValueError, match="integer"):
+        FaultPlan.parse("raise@soon")
+    with pytest.raises(ValueError, match=">= 0"):
+        FaultPlan(kind="raise", at=-1)
+
+
+@pytest.mark.fast
+def test_fault_plan_is_one_shot_and_index_exact():
+    set_fault_plan(FaultPlan(kind="raise", at=2))
+    faults.on_dispatch(0)  # below the index: no-op
+    faults.on_dispatch(1)
+    with pytest.raises(FaultInjected):
+        faults.on_dispatch(2)
+    faults.on_dispatch(2)  # spent: the resumed attempt runs clean
+
+
+@pytest.mark.fast
+def test_tunnel_down_fault_spells_unavailable():
+    set_fault_plan(FaultPlan(kind="tunnel_down", at=0))
+    with pytest.raises(FaultInjected, match="UNAVAILABLE"):
+        faults.on_dispatch(0)
+
+
+@pytest.mark.fast
+def test_env_plan_and_fuse_survive_process_restart(tmp_path, monkeypatch):
+    fuse = str(tmp_path / "fuse")
+    monkeypatch.setenv(faults.ENV_PLAN, "raise@0")
+    monkeypatch.setenv(faults.ENV_FUSE, fuse)
+    clear_fault_plan()  # no explicit plan: the env drives
+    assert faults.get_fault_plan() == FaultPlan(kind="raise", at=0)
+    with pytest.raises(FaultInjected):
+        faults.on_dispatch(0)
+    assert os.path.exists(fuse)
+    # "restart": in-process spent marks cleared, env unchanged — the
+    # blown fuse alone keeps the plan inert
+    clear_fault_plan()
+    faults.on_dispatch(0)
+    # the fuse stores WHICH plan blew it: a stale fuse from the
+    # previous scenario must not disarm a different plan
+    monkeypatch.setenv(faults.ENV_PLAN, "raise@5")
+    clear_fault_plan()
+    with pytest.raises(FaultInjected):
+        faults.on_dispatch(5)
+
+
+@pytest.mark.fast
+def test_backoff_exponential_capped_jittered():
+    rng = random.Random(0)
+    assert backoff_s(1, 1.0, 60.0, 0.0, rng) == 1.0
+    assert backoff_s(3, 1.0, 60.0, 0.0, rng) == 4.0
+    assert backoff_s(30, 1.0, 60.0, 0.0, rng) == 60.0
+    d = backoff_s(1, 1.0, 60.0, 0.5, random.Random(7))
+    assert 1.0 <= d <= 1.5
+
+
+@pytest.mark.fast
+def test_supervisor_rejects_saved_state_kwarg():
+    X, y = _data()
+    with pytest.raises(ValueError, match="saved_state"):
+        supervised_search(
+            X, y, snapshot_path="x.ckpt", saved_state=[], **KW
+        )

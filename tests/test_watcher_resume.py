@@ -225,6 +225,151 @@ def test_jsonless_retry_preserves_prior_on_chip_json(watcher):
     assert rec3["json"] == []
 
 
+def test_jsonless_retry_preserves_prior_telemetry(watcher):
+    """The supervised-resume progress memory must survive a
+    telemetry-less crash between attempts: without the carry, the next
+    no-progress resumable fault would look like a FIRST snapshot and
+    re-zero the attempt cap forever."""
+    prev = {"telemetry": {"classification": "resumable",
+                          "last_saved_iteration": 5},
+            "json": [], "partial": True, "attempts": 1}
+    rec = {"json": [], "partial": True, "attempts": 2}
+    watcher.merge_retry_record(prev, rec)
+    assert rec["telemetry"]["last_saved_iteration"] == 5
+    assert rec["telemetry_from_earlier_attempt"]
+    # a retry with its own telemetry keeps it
+    rec2 = {"telemetry": {"classification": "dead"}}
+    watcher.merge_retry_record(prev, rec2)
+    assert rec2["telemetry"]["classification"] == "dead"
+    assert "telemetry_from_earlier_attempt" not in rec2
+    # the accounting consequence: after the carry, a resumable fault
+    # stuck at the same iteration does NOT reset the counter
+    assert watcher.adjust_attempts_for_resume(
+        rec, _tele_rec("resumable", 5), 2
+    ) == 2
+
+
+def _tele_rec(classification, last_saved_iteration=None):
+    return {
+        "telemetry": {
+            "classification": classification,
+            "last_saved_iteration": last_saved_iteration,
+        }
+    }
+
+
+def test_supervised_resume_attempt_accounting(watcher):
+    """ISSUE 11 satellite: a supervised resume must not burn an attempt
+    from MAX_ATTEMPTS the way a dead restart does — resume WITH progress
+    (snapshot advanced) resets the counter; resume WITHOUT progress
+    keeps the decrement (crash loops still terminate)."""
+    adjust = watcher.adjust_attempts_for_resume
+    # first snapshot ever = progress: reset
+    assert adjust(None, _tele_rec("resumable", 3), 2) == 0
+    # snapshot advanced past the previous attempt's: reset
+    assert adjust(
+        _tele_rec("resumable", 3), _tele_rec("resumable", 7), 2
+    ) == 0
+    # resumable but the snapshot never moved: keep the decrement
+    assert adjust(
+        _tele_rec("resumable", 7), _tele_rec("resumable", 7), 2
+    ) == 2
+    assert adjust(
+        _tele_rec("resumable", 7), _tele_rec("resumable", 5), 2
+    ) == 2
+    # non-resumable classifications are untouched
+    assert adjust(None, _tele_rec("dead"), 2) == 2
+    assert adjust(None, _tele_rec("in-flight", 4), 2) == 2
+    # resumable with no iteration evidence: no reset (no proof of
+    # progress), and records without telemetry are untouched
+    assert adjust(None, _tele_rec("resumable"), 2) == 2
+    assert adjust(None, {}, 1) == 1
+    assert adjust(None, None, 1) == 1
+
+
+def _write_events(dirpath, name, events):
+    import json as _json
+
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, name)
+    with open(path, "w") as f:
+        for e in events:
+            f.write(_json.dumps(e) + "\n")
+    return path
+
+
+def test_telemetry_verdict_kill_with_snapshot_is_resumable(
+    watcher, tmp_path
+):
+    """A SIGKILLed run writes no dispatch_fault — the log just stops.
+    With saved_state events in the trail the step is RESUMABLE (the
+    supervised-resume path), and last_saved_iteration carries the
+    progress signal the attempt accounting compares."""
+    d = str(tmp_path / "tele")
+    ev = [
+        {"v": 1, "t": 1.0, "run": "r", "type": "run_start",
+         "backend": "cpu"},
+        {"v": 1, "t": 2.0, "run": "r", "type": "saved_state",
+         "outputs": 1, "iteration": 2, "path": "s.ckpt"},
+        {"v": 1, "t": 3.0, "run": "r", "type": "saved_state",
+         "outputs": 1, "iteration": 4, "path": "s.ckpt"},
+    ]
+    _write_events(d, "events-a.jsonl", ev)
+    tv = watcher.read_telemetry_verdict(d, since_ts=0.0)
+    assert tv["classification"] == "resumable"
+    assert tv["last_saved_iteration"] == 4
+    assert tv["saved_states"] == 2
+
+    # a run_end flips it to completed; a fault with no snapshot is dead
+    _write_events(
+        d, "events-a.jsonl",
+        ev + [{"v": 1, "t": 4.0, "run": "r", "type": "run_end",
+               "num_evals": 1, "search_time_s": 1.0}],
+    )
+    assert watcher.read_telemetry_verdict(d, 0.0)[
+        "classification"] == "completed"
+    _write_events(
+        d, "events-a.jsonl",
+        [ev[0], {"v": 1, "t": 2.0, "run": "r", "type": "dispatch_fault",
+                 "where": "iteration", "error_type": "XlaRuntimeError"}],
+    )
+    tv = watcher.read_telemetry_verdict(d, 0.0)
+    assert tv["classification"] == "dead"
+    assert tv["last_saved_iteration"] is None
+
+    # killed with NOTHING recoverable stays in-flight (dead restart)
+    _write_events(d, "events-a.jsonl", [ev[0]])
+    assert watcher.read_telemetry_verdict(d, 0.0)[
+        "classification"] == "in-flight"
+
+    fault = {"v": 1, "t": 2.5, "run": "r", "type": "dispatch_fault",
+             "where": "iteration", "error_type": "FaultInjected"}
+    done = {"v": 1, "t": 4.0, "run": "r2", "type": "run_end",
+            "num_evals": 1, "search_time_s": 1.0}
+    # the supervised success trail — faulted attempt's log + resumed
+    # attempt's run_end AFTER it in the same window — reads COMPLETED
+    _write_events(d, "events-a.jsonl", ev + [fault, done])
+    assert watcher.read_telemetry_verdict(d, 0.0)[
+        "classification"] == "completed"
+    # ...but a fault NEWER than the last run_end (a later sub-run
+    # dying) still reads resumable
+    _write_events(
+        d, "events-a.jsonl",
+        [ev[0], dict(done, t=1.5)] + ev[1:] + [fault],
+    )
+    assert watcher.read_telemetry_verdict(d, 0.0)[
+        "classification"] == "resumable"
+    # ...and so does a KILL after an earlier sub-run completed: the
+    # snapshots postdate the last run_end (no fault event, the killed
+    # run's log simply stops) — an early completed case in the window
+    # must not mask the preempted-but-progressing one
+    _write_events(
+        d, "events-a.jsonl", [ev[0], dict(done, t=1.5)] + ev[1:],
+    )
+    assert watcher.read_telemetry_verdict(d, 0.0)[
+        "classification"] == "resumable"
+
+
 def test_finalize_when_fully_covered(watcher, monkeypatch):
     write_capture(
         watcher, {s[0]: clean_rec(watcher, s[0]) for s in watcher.STEPS}
