@@ -845,14 +845,17 @@ def _last_tpu_block():
 
 
 def _roofline_skip_reason(platform, pallas_routed, error=None):
-    """Why roofline_fraction is null, as a machine-checkable string
+    """Why roofline_measured is null, as a machine-checkable string
     (distinct reasons, never a silent null): 'cpu-only' — a CPU run has
     no VPU-issue roofline bound; 'interpreter-path' — the device run's
     scoring stayed on the jnp interpreter (work-volume gate or
     eval_backend), so the kernel roofline does not describe it;
     'import-failure' — the roofline model itself could not be imported;
     'error: <Type>' — the model imported but the computation failed.
-    Returns None exactly when the fraction should have a value."""
+    Returns None exactly when the measured fraction should have a
+    value. The MODELED fraction (roofline_modeled, srprof) has no skip
+    reason: it exists on every platform — CPU-only rounds carry it
+    instead of a silent null."""
     if platform == "cpu":
         return "cpu-only"
     if not pallas_routed:
@@ -1036,12 +1039,13 @@ def main(verbose=True):
                 print(f"# bucketed interp measurement failed: {e}",
                       file=sys.stderr)
 
-    # achieved fraction of the kernel's VPU-issue roofline (see
-    # benchmark/roofline.py for the model; CPU runs have no such bound).
-    # Computed from the telemetry eval-stage span's measured throughput;
-    # when the fraction is null, roofline_skip_reason says WHY (distinct
-    # reasons — a null with no reason is a bug, not a benign skip).
-    roofline_fraction = None
+    # MEASURED roofline: achieved fraction of the kernel's VPU-issue
+    # roofline (see benchmark/roofline.py for the model; CPU runs have
+    # no such bound). Computed from the telemetry eval-stage span's
+    # measured throughput; when the fraction is null,
+    # roofline_skip_reason says WHY (distinct reasons — a null with no
+    # reason is a bug, not a benign skip).
+    roofline_measured = None
     pallas_routed = False
     if platform != "cpu":
         try:
@@ -1106,25 +1110,70 @@ def main(verbose=True):
                     span_rate = ev_span.attrs.get(
                         "trees_rows_per_s", value
                     )
-            roofline_fraction = round(span_rate / rl["bound"], 4)
+            roofline_measured = round(span_rate / rl["bound"], 4)
         except Exception as e:  # pragma: no cover
             roofline_error = e
             if verbose:
                 print(f"# roofline unavailable: {e}", file=sys.stderr)
     roofline_skip_reason = (
-        None if roofline_fraction is not None
+        None if roofline_measured is not None
         else _roofline_skip_reason(platform, pallas_routed, roofline_error)
     )
-    # the event log carries the roofline verdict too (fraction OR the
+
+    # MODELED roofline (srprof; docs/observability.md "Profiling"):
+    # analysis/cost.py models the element-ops/bytes of the exact
+    # scoring program this run timed, telemetry.profile joins that with
+    # the measured rate against the device-kind peak table (CPU peaks
+    # calibrated by a one-shot microbench) — so CPU-only rounds carry a
+    # non-null roofline column instead of just a skip reason, and on
+    # chip the modeled and measured fractions cross-check each other.
+    roofline_modeled = None
+    try:
+        import jax as _jax
+
+        from symbolicregression_jl_tpu.analysis.cost import jaxpr_cost
+        from symbolicregression_jl_tpu.models.fitness import score_trees
+        from symbolicregression_jl_tpu.telemetry.profile import (
+            device_peaks,
+            roofline_join,
+        )
+
+        nt = min(n_trees, CHUNK)
+        trees_aval = _jax.eval_shape(
+            lambda: _build_workload(jax, jnp, options, nt, 1)
+        )
+        _cost = jaxpr_cost(_jax.make_jaxpr(
+            lambda t, X, y, bl: score_trees(t, X, y, None, bl, options)
+        )(
+            trees_aval,
+            _jax.ShapeDtypeStruct((1, N_ROWS), jnp.float32),
+            _jax.ShapeDtypeStruct((N_ROWS,), jnp.float32),
+            _jax.ShapeDtypeStruct((), jnp.float32),
+        ))
+        # seconds one scoring dispatch took at the measured
+        # (overhead-subtracted) rate
+        _measured_s = nt * N_ROWS / value
+        _join = roofline_join(
+            _cost["flops"], _cost["bytes"], _measured_s,
+            device_peaks(main_dev), io_bytes=_cost.get("io_bytes"),
+        )
+        if _join["fraction"] is not None:
+            roofline_modeled = round(_join["fraction"], 4)
+    except Exception as e:  # pragma: no cover - defensive
+        if verbose:
+            print(f"# modeled roofline unavailable: {e}", file=sys.stderr)
+
+    # the event log carries the roofline verdict too (fractions OR the
     # machine-checkable skip reason — never a silent null): the run
     # doctor (telemetry.analyze) and TRAJECTORY.json read it from here
     # whenever the eval-stage span exists, so a probe re-exec or a
     # downstream consumer that only has the log still sees WHY the
-    # fraction is absent
+    # measured fraction is absent
     if sink is not None:
         sink.emit(
             "roofline",
-            fraction=roofline_fraction,
+            fraction=roofline_measured,
+            modeled_fraction=roofline_modeled,
             skip_reason=roofline_skip_reason,
             trees_rows_per_s=value,
         )
@@ -1264,7 +1313,13 @@ def main(verbose=True):
             round(bucketed_ratio, 3) if bucketed_ratio is not None else None
         ),
         "first_call_s": round(compile_s, 1),
-        "roofline_fraction": roofline_fraction,
+        # the old roofline_fraction split in two (ISSUE 12): measured =
+        # achieved vs the kernel VPU-issue bound (on-chip Pallas runs
+        # only; skip_reason says why it is null), modeled = srprof's
+        # cost-model fraction vs the device peak table (every platform,
+        # never a silent null on this CPU image)
+        "roofline_measured": roofline_measured,
+        "roofline_modeled": roofline_modeled,
         "roofline_skip_reason": roofline_skip_reason,
         # real-search island-sharding capture (benchmark/multichip.py);
         # the skip reason names why no ON-PLATFORM capture exists

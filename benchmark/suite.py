@@ -373,6 +373,60 @@ def bench_run_doctor():
     }]
 
 
+def bench_profile():
+    """srprof end to end (ISSUE 12): a tiny search with telemetry on
+    must leave an event log whose `profile` events let the report CLI
+    render per-stage modeled element-ops/bytes, measured wall time, and
+    a non-null modeled roofline fraction in (0, 1] for ALL seven stages
+    — the modeled-vs-measured closed loop ROADMAP #2's exit criterion
+    asks for, asserted from a real search log."""
+    import symbolicregression_jl_tpu as sr
+    from symbolicregression_jl_tpu.telemetry.analyze import resolve_log
+    from symbolicregression_jl_tpu.telemetry.profile import (
+        profile_report,
+        render_text,
+    )
+
+    d = _suite_telemetry_dir("srtpu_suite_profile_")
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((3, 128)).astype(np.float32)
+    y = 2.0 * np.cos(X[2]) + X[0] ** 2 - 0.5
+    t0 = time.perf_counter()
+    sr.equation_search(
+        X, y,
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        npopulations=4, npop=24, ncycles_per_iteration=30, maxsize=12,
+        niterations=2, seed=0, verbosity=0, progress=False,
+        telemetry=True, telemetry_dir=d,
+    )
+    wall_s = time.perf_counter() - t0
+    report = profile_report(resolve_log(d))
+    text = render_text(report)
+    stages = report["stages"]
+    fracs = {
+        s: row.get("roofline_fraction") for s, row in stages.items()
+    }
+    fracs_ok = len(fracs) == 7 and all(
+        isinstance(f, float) and 0.0 < f <= 1.0 for f in fracs.values()
+    )
+    row = {
+        "suite": "profile",
+        "case": "modeled_vs_measured",
+        "ok": report["complete"] and fracs_ok and bool(text),
+        "stages": len(stages),
+        "fractions_ok": fracs_ok,
+        "compile_total_s": report.get("compile_total_s"),
+        "search_wall_s": wall_s,
+        "report_lines": text.count("\n") + 1,
+        "event_log": report.get("path"),
+    }
+    row.update({
+        f"roofline_{s}": (round(f, 4) if isinstance(f, float) else None)
+        for s, f in fracs.items()
+    })
+    return [row]
+
+
 def bench_resilience():
     """Preemption-tolerant search (ISSUE 11): a fault injected at
     dispatch 1 of a 2-iteration search (the in-process `raise` form of
@@ -831,9 +885,11 @@ def bench_static_analysis():
         }]
     surface = payload.get("surface") or {}
     memory = payload.get("memory") or {}
+    cost = payload.get("cost") or {}
     docs = payload.get("docs") or {}
     tele = payload.get("telemetry_schema") or {}
     mem_configs = memory.get("configs", {})
+    cost_configs = cost.get("configs", {})
     return [
         {
             "suite": "static_analysis",
@@ -864,6 +920,20 @@ def bench_static_analysis():
                 default=0,
             ) / 1e6, 2),
             "hbm_budget_gb": memory.get("hbm_budget_gb", 0),
+        },
+        {
+            "suite": "static_analysis",
+            "case": "srcost",
+            "ok": cost.get("ok", False),
+            "configs": len(cost_configs),
+            "baseline_match": cost.get("baseline_match", False),
+            "problems": len(cost.get("problems", [])),
+            # headline modeled numbers of the base config — the
+            # per-dispatch cost the baseline gates on
+            "base_flops": (cost_configs.get("base") or {}).get("flops"),
+            "base_padded_waste": (
+                cost_configs.get("base") or {}
+            ).get("padded_waste_fraction"),
         },
         {
             "suite": "static_analysis",
@@ -898,6 +968,7 @@ _CASES = [
     (bench_multichip, 1200),
     (bench_telemetry, 900),
     (bench_run_doctor, 900),
+    (bench_profile, 900),
     (bench_resilience, 900),
     (bench_search_iteration, 1200),
     (bench_fitness_cache, 1200),

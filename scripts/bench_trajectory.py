@@ -53,6 +53,7 @@ METRICS = (
     "throughput",
     "vs_baseline",
     "roofline_fraction",
+    "roofline_modeled",
     "interp_bucketed_vs_flat",
     "multichip_scaling_efficiency",
     "multichip_speedup",
@@ -153,7 +154,15 @@ def load_bench_round(path: str):
         "tunnel_state": parsed.get("tunnel_state"),
         "throughput": _num(parsed.get("value")),
         "vs_baseline": _num(parsed.get("vs_baseline")),
-        "roofline_fraction": _num(parsed.get("roofline_fraction")),
+        # PR 10 split the old roofline_fraction into measured/modeled:
+        # the measured series keeps its historical column name (old
+        # rounds recorded it as roofline_fraction), the modeled series
+        # — non-null even on CPU-only rounds — charts alongside it
+        "roofline_fraction": _num(
+            parsed.get("roofline_measured",
+                       parsed.get("roofline_fraction"))
+        ),
+        "roofline_modeled": _num(parsed.get("roofline_modeled")),
         "roofline_skip_reason": parsed.get("roofline_skip_reason"),
         "interp_bucketed_vs_flat": _num(
             parsed.get("interp_bucketed_vs_flat")
@@ -326,8 +335,9 @@ def render_markdown(traj) -> str:
         "report, not a gate.*",
         "",
         "| round | platform | tunnel | trees-rows/s | vs_baseline | "
-        "roofline | bucketed/flat | mc scaling | mc speedup |",
-        "|---|---|---|---|---|---|---|---|---|",
+        "roofline | roofline (modeled) | bucketed/flat | mc scaling | "
+        "mc speedup |",
+        "|---|---|---|---|---|---|---|---|---|---|",
     ]
 
     def cell(v, spec=".3g"):
@@ -349,6 +359,7 @@ def render_markdown(traj) -> str:
             f"| {cell(p.get('throughput'), '.3e')} "
             f"| {cell(p.get('vs_baseline'))} "
             f"| {roof_cell} "
+            f"| {cell(p.get('roofline_modeled'))} "
             f"| {cell(p.get('interp_bucketed_vs_flat'))} "
             f"| {cell(p.get('multichip_scaling_efficiency'))} "
             f"| {cell(p.get('multichip_speedup'))} |"
@@ -357,6 +368,7 @@ def render_markdown(traj) -> str:
     for p in mc_latest:
         lines.append(
             f"| latest | {cell(p.get('platform'))} | — | — | — | — | — "
+            f"| — "
             f"| {cell(p.get('multichip_scaling_efficiency'))} "
             f"| {cell(p.get('multichip_speedup'))} |"
         )
@@ -404,6 +416,9 @@ def bench_summary(traj) -> dict:
         ],
         "roofline_fraction": [
             p["value"] for p in traj["series"]["roofline_fraction"]
+        ],
+        "roofline_modeled": [
+            p["value"] for p in traj["series"]["roofline_modeled"]
         ],
         "multichip_scaling_efficiency": [
             p["value"]

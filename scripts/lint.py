@@ -1,10 +1,11 @@
 #!/usr/bin/env python
 """Repo-wide static-analysis gate: srlint + compile-surface + srmem HBM
-gate + doc drift.
+gate + srcost analytic-cost gate + doc drift.
 
 The one command CI (and benchmark/suite.py's `static_analysis` case) runs:
 
-    python scripts/lint.py [--format text|json] [--only lint|surface|memory]
+    python scripts/lint.py [--format text|json]
+        [--only lint|surface|memory|cost]
         [--update-baseline] [--hbm-budget-gb G] [--xla-memory] [--skip-docs]
 
 Wraps `python -m symbolicregression_jl_tpu.analysis` and adds the
@@ -159,6 +160,7 @@ def main(argv=None) -> int:
         lint=ns.only in (None, "lint"),
         surface=ns.only in (None, "surface"),
         memory=ns.only in (None, "memory"),
+        cost=ns.only in (None, "cost"),
         update_baseline=ns.update_baseline,
         hbm_budget_gb=ns.hbm_budget_gb,
         xla_memory=ns.xla_memory,

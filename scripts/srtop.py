@@ -8,7 +8,11 @@ renders, refreshing in place:
 * run header — run id, backend, mesh/device state, last-event age (the
   liveness signal: a growing age on an ``incomplete`` run is the "dead
   vs mid-run fault" distinction ROADMAP #4 cares about);
-* per-stage wall-time split (the span breakdown, summed live);
+* per-stage wall-time split (the span breakdown, summed live) with a
+  utilization column once the run's srprof ``profile`` events land:
+  each stage's wall share next to its modeled-cost share, flagging
+  (``!``) stages whose wall share far exceeds their modeled share —
+  the "this stage burns time its work doesn't justify" signal;
 * best/mean loss per island + a sparkline of the global best-loss
   trajectory, population diversity, exact hypervolume;
 * mutation acceptance and memo-bank hit rates;
@@ -25,8 +29,13 @@ moment, or truncated by a kill.
 Usage:
     python scripts/srtop.py RUN_DIR_OR_LOG [--interval 2] [--once]
 
-``--once`` renders a single frame and exits (also the test hook).
-Exit: 0 on 'q'/Ctrl-C or --once; the dashboard never modifies the log.
+``--once`` renders a single frame and exits (also the test hook / CI
+gate): its exit status is 0 only when the tailed log's run-doctor
+verdict is ``healthy`` (nonzero otherwise — so CI can gate on
+``srtop.py DIR --once``). The verdict comes from the real doctor
+(telemetry.analyze, imported lazily with the platform pinned to CPU);
+the follow-loop dashboard itself stays stdlib-only. The dashboard
+never modifies the log.
 """
 
 from __future__ import annotations
@@ -103,9 +112,17 @@ class LogTail:
 class Dashboard:
     """Accumulates events and renders frames."""
 
+    #: utilization flag threshold: a stage whose wall-time share
+    #: exceeds its modeled-cost share by this factor (and is not
+    #: negligible) gets the '!' marker
+    SKEW_FLAG = 2.0
+    SKEW_MIN_WALL = 0.10
+
     def __init__(self):
         self.start = {}
         self.stages = {}
+        self.profile = {}        # stage -> last srprof profile event
+        self.compile_s = {}      # stage -> summed compile seconds
         self.metrics_tail = []   # last N metrics events
         self.best_series = []
         self.progress_last = None
@@ -140,6 +157,17 @@ class Dashboard:
                 g = (e.get("snapshot") or {}).get("gauges") or {}
                 self.best_series.append(g.get("best_loss"))
                 del self.best_series[:-self.MAX_TAIL]
+            elif typ == "profile":
+                if isinstance(e.get("stage"), str):
+                    self.profile[e["stage"]] = e
+            elif typ == "compile":
+                d = e.get("duration_s")
+                if isinstance(e.get("name"), str) and isinstance(
+                    d, (int, float)
+                ) and math.isfinite(d):
+                    self.compile_s[e["name"]] = (
+                        self.compile_s.get(e["name"], 0.0) + d
+                    )
             elif typ == "progress":
                 self.progress_last = e
             elif typ == "dispatch_fault":
@@ -245,17 +273,68 @@ class Dashboard:
                     ))
 
         if self.stages:
-            total = sum(v["total_s"] for v in self.stages.values()) or 1.0
-            parts = []
-            for name, v in sorted(
-                self.stages.items(), key=lambda kv: -kv[1]["total_s"]
-            ):
-                parts.append(
-                    f"{name} {v['total_s']:.1f}s "
-                    f"({100 * v['total_s'] / total:.0f}%)"
+            # wall shares with compile time folded out (the doctor's
+            # convention: a first dispatch's span includes its compile)
+            net = {
+                name: max(
+                    v["total_s"] - self.compile_s.get(name, 0.0), 0.0
                 )
+                for name, v in self.stages.items()
+            }
+            total = sum(net.values()) or 1.0
+            # modeled-cost shares from the srprof profile events
+            # (present once a telemetry run ends); utilization = wall
+            # share x modeled share, '!' when wall far exceeds model.
+            # Per-dispatch modeled flops weight by the live span COUNT
+            # — the wall side sums every dispatch, so an unweighted
+            # share would inflate per-iteration stages' skew by
+            # niterations vs the one-shot probe stages
+            mf = {
+                s: p["flops"] * self.stages.get(
+                    s, {"count": 0}
+                )["count"]
+                for s, p in self.profile.items()
+                if isinstance(p.get("flops"), (int, float))
+            }
+            mtot = sum(mf.values()) or None
+            parts = []
+            for name, wall in sorted(net.items(), key=lambda kv: -kv[1]):
+                ws = wall / total
+                cell = f"{name} {wall:.1f}s ({100 * ws:.0f}%"
+                if mtot and name in mf:
+                    ms = mf[name] / mtot
+                    cell += f"|mod {100 * ms:.0f}%"
+                    if (ws > self.SKEW_MIN_WALL
+                            and ms > 0
+                            and ws / ms > self.SKEW_FLAG):
+                        cell += " !"
+                parts.append(cell + ")")
             L.append("stages: " + "  ".join(parts))
+            ctot = sum(self.compile_s.values())
+            if ctot:
+                L.append(f"compile: {ctot:.1f}s (excluded from shares)")
         return "\n".join(L)
+
+
+def _doctor_verdict(events):
+    """The --once CI gate: run the real doctor (telemetry.analyze) over
+    the collected events. Imported lazily with the platform pinned to
+    CPU (the analyzer itself never touches jax, but the package import
+    must not route backend init at a TPU tunnel); returns None when the
+    package is unavailable — the dashboard itself stays stdlib-only and
+    a box without the package still renders frames."""
+    try:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        if repo not in sys.path:
+            sys.path.insert(0, repo)
+        from symbolicregression_jl_tpu.telemetry.analyze import analyze_run
+    except Exception:
+        return None
+    try:
+        return analyze_run(events).get("verdict")
+    except Exception:
+        return None
 
 
 def resolve(path: str):
@@ -281,7 +360,8 @@ def main(argv=None) -> int:
     ap.add_argument("--interval", type=float, default=2.0)
     ap.add_argument(
         "--once", action="store_true",
-        help="render one frame and exit (no follow loop)",
+        help="render one frame and exit; exit 0 only when the log's "
+        "run-doctor verdict is healthy (the CI gate)",
     )
     ns = ap.parse_args(argv)
 
@@ -291,10 +371,12 @@ def main(argv=None) -> int:
     try:
         while True:
             path = resolve(ns.log)
+            events = []
             if path is not None:
                 if tail is None or tail.path != path:
                     tail, dash = LogTail(path), Dashboard()
-                dash.feed(tail.poll())
+                events = tail.poll()
+                dash.feed(events)
                 frame = dash.render()
             else:
                 frame = (
@@ -302,13 +384,23 @@ def main(argv=None) -> int:
                     f"{'events-*.jsonl in ' if os.path.isdir(ns.log) else ''}"
                     f"{ns.log} (not there yet)"
                 )
+            if ns.once and path is not None:
+                # one frame = one complete read of the log: gate on the
+                # doctor's verdict so `srtop DIR --once` is a CI check
+                verdict = _doctor_verdict(events)
+                if verdict is not None:
+                    frame += f"\ndoctor verdict: {verdict}"
             if last_lines and sys.stdout.isatty():
                 sys.stdout.write(f"\x1b[{last_lines}F\x1b[0J")
             sys.stdout.write(frame + "\n")
             sys.stdout.flush()
             last_lines = frame.count("\n") + 1
             if ns.once:
-                return 0
+                if path is None:
+                    return 0  # nothing to judge: waiting, not broken
+                return (
+                    0 if verdict in (None, "healthy") else 1
+                )
             time.sleep(ns.interval)
     except KeyboardInterrupt:
         return 0

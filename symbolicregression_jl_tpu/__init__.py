@@ -95,7 +95,8 @@ from .telemetry import (
 
 
 def __getattr__(name):
-    if name in ("analyze_run", "compare_runs"):
+    if name in ("analyze_run", "compare_runs", "profile_report",
+                "device_peaks"):
         from . import telemetry
 
         return getattr(telemetry, name)
@@ -195,7 +196,9 @@ __all__ = [
     "SpanRecorder",
     "analyze_run",
     "compare_runs",
+    "device_peaks",
     "hypervolume_2d",
     "open_event_log",
+    "profile_report",
     "validate_events_file",
 ]

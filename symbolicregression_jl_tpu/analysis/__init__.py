@@ -18,6 +18,11 @@ Three engines, one CLI (``python -m symbolicregression_jl_tpu.analysis``):
   peak temp HBM per config and per stage, diffs against the checked-in
   ``memory_baseline.json`` (>10% regressions fail), and gates every config
   against an HBM budget (default 16GB, one v5e).
+- **srcost** (cost.py): a jaxpr-walking analytic cost model (per-primitive
+  FLOPs, bytes moved, padded-waste fraction, scan trip counts included)
+  attributed per search stage, diffed against the checked-in
+  ``cost_baseline.json`` (>10% regressions fail) — the modeled half of
+  the srprof roofline join (telemetry/profile.py).
 
 See docs/static_analysis.md for the rule catalog and workflows.
 """
@@ -76,14 +81,15 @@ def add_engine_args(parser) -> None:
         help="report format (default: text)",
     )
     parser.add_argument(
-        "--only", choices=("lint", "surface", "memory"), default=None,
-        help="run a single engine (default: all three)",
+        "--only", choices=("lint", "surface", "memory", "cost"),
+        default=None,
+        help="run a single engine (default: all four)",
     )
     parser.add_argument(
         "--update-baseline", action="store_true",
         help="rewrite the checked-in baselines (compile_baseline.json / "
-        "memory_baseline.json) for the engines being run, instead of "
-        "diffing against them",
+        "memory_baseline.json / cost_baseline.json) for the engines "
+        "being run, instead of diffing against them",
     )
     parser.add_argument(
         "--hbm-budget-gb", type=float, default=None, metavar="G",
@@ -102,14 +108,16 @@ def run_analysis(
     lint: bool = True,
     surface: bool = True,
     memory: bool = True,
+    cost: bool = True,
     update_baseline: bool = False,
     hbm_budget_gb: Optional[float] = None,
     xla_memory: bool = False,
 ) -> AnalysisReport:
-    """Run srlint / the compile-surface checker / srmem on this repo.
+    """Run srlint / the compile-surface checker / srmem / srcost on this
+    repo.
 
-    Importing compile_surface or memory pulls in jax; callers that only
-    lint stay AST-only (no backend initialization)."""
+    Importing compile_surface, memory, or cost pulls in jax; callers
+    that only lint stay AST-only (no backend initialization)."""
     report = AnalysisReport()
     if lint:
         report.violations = lint_package()
@@ -128,4 +136,8 @@ def run_analysis(
             ),
             xla_memory=xla_memory,
         )
+    if cost:
+        from .cost import check_cost
+
+        report.cost = check_cost(update_baseline=update_baseline)
     return report

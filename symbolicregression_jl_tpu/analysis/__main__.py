@@ -1,12 +1,12 @@
 """CLI for the static-analysis subsystem.
 
     python -m symbolicregression_jl_tpu.analysis [--format text|json]
-        [--only lint|surface|memory] [--update-baseline]
+        [--only lint|surface|memory|cost] [--update-baseline]
         [--hbm-budget-gb G] [--xla-memory]
 
 Exit status: 0 when clean, 1 on violations / surface problems / HBM
-budget or baseline regressions (CI contract — benchmark/suite.py and
-scripts/lint.py both rely on it). Platform handling: see
+budget, cost, or baseline regressions (CI contract — benchmark/suite.py
+and scripts/lint.py both rely on it). Platform handling: see
 `analysis.pin_platform`.
 """
 
@@ -22,7 +22,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m symbolicregression_jl_tpu.analysis",
         description="srlint + compile-surface checker + srmem "
-        "HBM-footprint gate (docs/static_analysis.md)",
+        "HBM-footprint gate + srcost analytic cost gate "
+        "(docs/static_analysis.md)",
     )
     add_engine_args(ap)
     ns = ap.parse_args(argv)
@@ -32,6 +33,7 @@ def main(argv=None) -> int:
         lint=ns.only in (None, "lint"),
         surface=ns.only in (None, "surface"),
         memory=ns.only in (None, "memory"),
+        cost=ns.only in (None, "cost"),
         update_baseline=ns.update_baseline,
         hbm_budget_gb=ns.hbm_budget_gb,
         xla_memory=ns.xla_memory,

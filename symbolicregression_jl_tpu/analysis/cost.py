@@ -1,0 +1,497 @@
+"""srcost — analytic per-stage cost model for the search hot path.
+
+ROADMAP #2's exit criterion is a captured roofline fraction, and the
+telemetry stack (PR 6/8) measures per-stage WALL time — but nothing said
+what the compiled programs *should* cost, so "is the kernel fast?" was
+answered by eyeballing trees-rows/s against a hand-picked anchor.
+TensorGP (arxiv 2103.07512) shows padded-lockstep waste dominates
+tensorized GP and is only visible with per-op FLOP/byte accounting; the
+Julia->TPU line (arxiv 1810.09868) uses exactly this kind of XLA-level
+accounting to guide the port. This module is the modeled half of that
+loop; ``telemetry/profile.py`` joins it with the measured half.
+
+Three layers, all trace-only (``jax.make_jaxpr`` over aval inputs;
+nothing executes, so it runs on CPU in CI):
+
+- **per-jaxpr cost estimate** (:func:`jaxpr_cost`): walks a jaxpr with a
+  per-primitive element-op weight table (``FLOP_WEIGHTS``) and a bytes-
+  moved model (input + output aval bytes per equation), descending into
+  sub-jaxprs and multiplying ``scan`` bodies by their trip count. The
+  "flops" it reports are *vector element-ops of any numeric dtype* —
+  the quantity the VPU issue rate bounds (benchmark/roofline.py uses the
+  same convention), not strict IEEE FLOPs. It also reports the
+  **padded-waste fraction**: the share of modeled element-ops spent in
+  masking/select machinery (``MASK_PRIMITIVES``) — the ops that exist
+  purely to keep padded-lockstep execution correct (PAD-slot muxes,
+  domain masks, validity selects), the TensorGP waste signature made
+  machine-readable.
+- **per-stage attribution** (:func:`stage_costs`): the same seven-stage
+  decomposition ``analysis/memory.py::build_stage_programs`` traces
+  (init / cycle / mutate / eval / simplify / optimize / merge_migrate),
+  so modeled cost joins measured spans and srmem HBM attribution on one
+  stage vocabulary.
+- **baseline gate** (:func:`check_cost`): per-config flops/bytes diffed
+  against the checked-in ``cost_baseline.json`` over the compile_surface
+  Options matrix — CI fails on a >10% modeled-cost regression, exactly
+  like the compile/memory baselines. Shrinking costs never fail; they
+  surface as refresh notes.
+
+The model ignores fusion, CSE, and rematerialization: its VALUE drifts
+from what XLA executes, but the RATIO between two versions of the same
+program tracks real regressions — which is what the gate needs — and
+the magnitude is a sound upper-ish anchor for the roofline join.
+
+CLI: ``python -m symbolicregression_jl_tpu.analysis --only cost
+[--update-baseline]`` (docs/static_analysis.md, docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .compile_surface import _BASE_KWARGS, _MATRIX, _NFEAT, _NROWS
+from .memory import aval_bytes, build_stage_programs
+
+BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "cost_baseline.json"
+)
+
+#: Modeled-cost growth beyond this fraction of the baseline fails CI.
+REGRESSION_TOLERANCE = 0.10
+
+#: element-op weight per output element (or per input element for
+#: reductions). Aligned with benchmark/roofline.py's VPU-issue cost
+#: table: arithmetic 1, div/sqrt 4, transcendentals 8, pow 12. Unlisted
+#: primitives: 1 if they produce numeric output (the conservative
+#: "it issues at least one vector op" default), except the pure data-
+#: movement set below, which models as bytes only.
+FLOP_WEIGHTS: Dict[str, float] = {
+    "div": 4.0, "sqrt": 4.0, "rsqrt": 4.0, "cbrt": 8.0,
+    "exp": 8.0, "exp2": 8.0, "expm1": 9.0, "log": 8.0, "log1p": 9.0,
+    "sin": 8.0, "cos": 8.0, "tan": 10.0, "tanh": 9.0,
+    "asin": 10.0, "acos": 10.0, "atan": 10.0, "atan2": 12.0,
+    "sinh": 10.0, "cosh": 10.0, "asinh": 12.0, "acosh": 12.0,
+    "atanh": 12.0, "erf": 10.0, "erfc": 10.0, "erf_inv": 12.0,
+    "lgamma": 16.0, "digamma": 16.0, "pow": 12.0, "integer_pow": 4.0,
+    "rem": 6.0, "logistic": 9.0, "cumsum": 1.0, "cumlogsumexp": 9.0,
+    # counter-based RNG: a multi-round integer hash per emitted element
+    "threefry2x32": 16.0, "random_bits": 16.0, "random_seed": 1.0,
+    "random_wrap": 0.0, "random_fold_in": 16.0,
+    "select_n": 1.0, "clamp": 2.0, "sort": 8.0,  # ~log2(n) passes
+}
+
+#: primitives that move/reshape data without issuing vector math: they
+#: contribute bytes, never element-ops.
+DATA_MOVEMENT = frozenset({
+    "reshape", "transpose", "broadcast_in_dim", "squeeze", "concatenate",
+    "slice", "dynamic_slice", "dynamic_update_slice", "gather",
+    "scatter", "rev", "pad", "iota", "convert_element_type",
+    "bitcast_convert_type", "copy", "device_put", "stop_gradient",
+    "split", "expand_dims", "random_wrap",
+})
+
+#: the padded-lockstep machinery: masks, compares, selects, and pads
+#: that exist to keep every tree/slot/row in lockstep over PAD slots
+#: and domain-invalid lanes. Their share of total modeled element-ops
+#: is the padded-waste fraction (the TensorGP waste signature).
+MASK_PRIMITIVES = frozenset({
+    "select_n", "pad", "clamp", "is_finite",
+    "eq", "ne", "lt", "le", "gt", "ge",
+    "and", "or", "not", "xor",
+})
+
+#: reductions price by INPUT element count (the work is over the
+#: reduced operand, not the small output).
+_REDUCE_PRIMS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "reduce_xor", "argmax", "argmin",
+    "reduce_precision", "cumsum", "cummax", "cummin", "cumprod",
+    "cumlogsumexp", "sort",
+})
+
+_TOP_PRIMS = 8
+
+
+def _aval_elems(aval) -> int:
+    n = 1
+    for d in getattr(aval, "shape", ()):
+        n *= int(d)
+    return n
+
+
+def _sub_jaxprs(params):
+    import jax.core as jcore
+
+    for v in params.values():
+        if isinstance(v, (jcore.Jaxpr, jcore.ClosedJaxpr)):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                if isinstance(item, (jcore.Jaxpr, jcore.ClosedJaxpr)):
+                    yield item
+
+
+def _dot_general_flops(eqn) -> float:
+    """2*M*N*K multiply-accumulates of a dot_general (batch dims fold
+    into M)."""
+    out_elems = sum(_aval_elems(v.aval) for v in eqn.outvars)
+    dnums = eqn.params.get("dimension_numbers")
+    contract = dnums[0][0] if dnums else ()
+    lhs = eqn.invars[0].aval
+    k = 1
+    for d in contract:
+        k *= int(lhs.shape[d])
+    return 2.0 * out_elems * k
+
+
+def eqn_cost(eqn) -> Tuple[float, int, float]:
+    """(element_ops, bytes_moved, mask_element_ops) of ONE equation,
+    sub-jaxprs excluded (the walker descends into those itself)."""
+    import jax.core as jcore
+
+    name = eqn.primitive.name
+    in_b = sum(
+        aval_bytes(v.aval) for v in eqn.invars
+        if isinstance(v, jcore.Var) or hasattr(v, "aval")
+    )
+    out_b = sum(aval_bytes(v.aval) for v in eqn.outvars)
+    bytes_moved = in_b + out_b
+    if any(_sub_jaxprs(eqn.params)):
+        # control-flow shells (scan/while/cond/pjit): all cost lives in
+        # the body the walker descends into
+        return 0.0, bytes_moved, 0.0
+    if name in DATA_MOVEMENT:
+        return 0.0, bytes_moved, 0.0
+    if name == "dot_general":
+        return _dot_general_flops(eqn), bytes_moved, 0.0
+    if name in _REDUCE_PRIMS or name.startswith("reduce_"):
+        elems = sum(_aval_elems(v.aval) for v in eqn.invars
+                    if hasattr(v, "aval"))
+        weight = FLOP_WEIGHTS.get(name, 1.0)
+    else:
+        elems = sum(_aval_elems(v.aval) for v in eqn.outvars)
+        weight = FLOP_WEIGHTS.get(name, 1.0)
+    flops = weight * elems
+    mask = flops if name in MASK_PRIMITIVES else 0.0
+    return flops, bytes_moved, mask
+
+
+def jaxpr_cost(jaxpr) -> dict:
+    """Modeled cost of one (Closed)Jaxpr.
+
+    Returns ``{"flops", "bytes", "mask_flops", "padded_waste_fraction",
+    "by_primitive", "while_loops"}``. ``scan`` bodies multiply by their
+    ``length`` trip count; ``while`` bodies (trip count unknowable from
+    the jaxpr) count ONCE and are tallied in ``while_loops`` — the
+    modeled numbers are a lower bound wherever that tally is nonzero
+    (the BFGS optimizer's bounded iteration loops are the main source).
+    ``cond`` branches take the most expensive branch — by element-ops,
+    bytes as the tie-break (the lockstep engine usually executes both
+    sides' select form anyway)."""
+    by_prim: Dict[str, float] = {}
+    state = {"while": 0}
+
+    def walk(jx, mult: float) -> Tuple[float, float, float]:
+        """Totals of one (sub-)jaxpr, already scaled by `mult` (the
+        product of enclosing scan trip counts)."""
+        if hasattr(jx, "jaxpr"):
+            jx = jx.jaxpr
+        flops = bytes_moved = mask = 0.0
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            subs = list(_sub_jaxprs(eqn.params))
+            if not subs:
+                f, b, m = eqn_cost(eqn)
+                if f:
+                    by_prim[name] = by_prim.get(name, 0.0) + f * mult
+                flops += f * mult
+                bytes_moved += b * mult
+                mask += m * mult
+                continue
+            # control-flow shells: the shell itself moves its operand
+            # bytes once per execution; the body cost multiplies by the
+            # trip count where the jaxpr states one (scan)
+            _, shell_b, _ = eqn_cost(eqn)
+            bytes_moved += shell_b * mult
+            if name == "scan":
+                trips = float(eqn.params.get("length", 1))
+                sf, sb, sm = walk(subs[0], mult * trips)
+                flops += sf
+                bytes_moved += sb
+                mask += sm
+            elif name == "while":
+                # trip count unknowable from the jaxpr: cond + body
+                # count once (a lower bound, tallied in while_loops)
+                state["while"] += 1
+                for sub in subs:
+                    sf, sb, sm = walk(sub, mult)
+                    flops += sf
+                    bytes_moved += sb
+                    mask += sm
+            elif name == "cond":
+                # most expensive branch by element-ops, bytes as the
+                # tie-break — so a cond whose branches are pure data
+                # movement (every sf == 0) still contributes its
+                # heaviest branch's bytes instead of dropping them
+                best = (0.0, 0.0, 0.0)
+                for sub in subs:
+                    sf, sb, sm = walk(sub, mult)
+                    if (sf, sb) > (best[0], best[1]):
+                        best = (sf, sb, sm)
+                flops += best[0]
+                bytes_moved += best[1]
+                mask += best[2]
+            else:  # pjit / custom_* / remat / closed_call: once
+                for sub in subs:
+                    sf, sb, sm = walk(sub, mult)
+                    flops += sf
+                    bytes_moved += sb
+                    mask += sm
+        return flops, bytes_moved, mask
+
+    flops, bytes_moved, mask = walk(jaxpr, 1.0)
+    top = dict(sorted(
+        by_prim.items(), key=lambda kv: -kv[1]
+    )[:_TOP_PRIMS])
+    # io_bytes: the program's top-level inputs + outputs — what a
+    # PERFECTLY fused execution must still move through HBM. `bytes`
+    # above counts every intermediate (an un-fused upper bound); the
+    # roofline join in telemetry/profile.py prices arithmetic intensity
+    # off io_bytes so a well-fused stage is not misread as memory-bound.
+    inner = jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+    io_bytes = sum(
+        aval_bytes(v.aval)
+        for v in list(inner.invars) + list(inner.constvars)
+        + list(inner.outvars)
+        if hasattr(v, "aval")
+    )
+    return {
+        "flops": float(flops),
+        "bytes": float(bytes_moved),
+        "io_bytes": float(io_bytes),
+        "mask_flops": float(mask),
+        "padded_waste_fraction": (
+            round(mask / flops, 6) if flops > 0 else 0.0
+        ),
+        "by_primitive": {k: float(v) for k, v in top.items()},
+        "while_loops": state["while"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# stage attribution
+# ---------------------------------------------------------------------------
+
+
+def stage_costs(
+    options, nfeatures: int = _NFEAT, nrows: int = _NROWS
+) -> Dict[str, dict]:
+    """Modeled cost per production stage — the seven-stage decomposition
+    ``analysis.memory.build_stage_programs`` traces, at the given data
+    shape, so the numbers join measured spans (telemetry.spans.STAGES)
+    and srmem attribution on one vocabulary. Trace-only; the weighted
+    path is modeled unweighted (weights add one multiply per row —
+    noise at this model's resolution)."""
+    import jax
+
+    out: Dict[str, dict] = {}
+    for stage, (fn, sargs) in build_stage_programs(
+        options, nfeatures, nrows
+    ).items():
+        out[stage] = jaxpr_cost(jax.make_jaxpr(fn)(*sargs))
+    return out
+
+
+def iteration_cost(options) -> dict:
+    """Modeled cost of the fused production iteration program (the
+    headline per-config number the baseline gates)."""
+    import jax
+
+    from ..api import _make_iteration_fn
+    from .compile_surface import _abstract_inputs
+
+    I = options.npopulations
+    states, key, cm, X, y, bl, scalars, memo, _ = _abstract_inputs(
+        options, I
+    )
+    it_fn = _make_iteration_fn(options, False)
+    args = (states, key, cm, X, y, bl, scalars) + (
+        (memo,) if memo is not None else ()
+    )
+    return jaxpr_cost(jax.make_jaxpr(it_fn)(*args))
+
+
+# ---------------------------------------------------------------------------
+# baseline gate
+# ---------------------------------------------------------------------------
+
+
+def diff_cost_baseline(
+    configs: Dict[str, dict],
+    baseline: dict,
+    tolerance: float = REGRESSION_TOLERANCE,
+) -> Tuple[List[str], List[str]]:
+    """(problems, notes): modeled flops/bytes that GREW beyond tolerance
+    fail; shrinking beyond it only suggests a refresh (improvements
+    never break CI, but a stale baseline hides the next regression)."""
+    problems: List[str] = []
+    notes: List[str] = []
+    base_configs = baseline.get("configs", {})
+
+    def check(tag: str, metric: str, want: float, got: float) -> None:
+        if want <= 0:
+            return
+        ratio = got / want
+        if ratio > 1.0 + tolerance:
+            problems.append(
+                f"{tag}: modeled {metric} grew {want:.4g} -> {got:.4g} "
+                f"(+{(ratio - 1) * 100:.0f}%, tolerance "
+                f"{tolerance * 100:.0f}%) — a per-dispatch cost "
+                "regression; fix it or refresh with --update-baseline "
+                "and justify in the PR"
+            )
+        elif ratio < 1.0 - tolerance:
+            notes.append(
+                f"{tag}: modeled {metric} shrank {want:.4g} -> {got:.4g} "
+                f"({(1 - ratio) * 100:.0f}% better) — refresh the "
+                "baseline with --update-baseline to lock it in"
+            )
+
+    for name, entry in configs.items():
+        if name not in base_configs:
+            problems.append(
+                f"cost baseline has no config {name!r} — run with "
+                "--update-baseline"
+            )
+            continue
+        base = base_configs[name]
+        check(name, "flops", base.get("flops", 0), entry["flops"])
+        check(name, "bytes", base.get("bytes", 0), entry["bytes"])
+        base_stages = base.get("stages", {})
+        for stage, s_entry in entry["stages"].items():
+            if stage in base_stages:
+                check(f"{name}.{stage}", "flops",
+                      base_stages[stage].get("flops", 0),
+                      s_entry["flops"])
+                check(f"{name}.{stage}", "bytes",
+                      base_stages[stage].get("bytes", 0),
+                      s_entry["bytes"])
+            else:
+                problems.append(
+                    f"cost baseline has no stage {name}.{stage} — "
+                    "refresh with --update-baseline"
+                )
+        for stage in base_stages:
+            if stage not in entry["stages"]:
+                problems.append(
+                    f"cost baseline stage {name}.{stage} no longer "
+                    "produced — its recorded cost would silently stop "
+                    "being gated; refresh with --update-baseline"
+                )
+    for name in base_configs:
+        if name not in configs:
+            problems.append(
+                f"cost baseline config {name!r} no longer produced — "
+                "refresh with --update-baseline"
+            )
+    return problems, notes
+
+
+def check_cost(
+    update_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    configs: Optional[Tuple[Tuple[str, dict], ...]] = None,
+    tolerance: float = REGRESSION_TOLERANCE,
+) -> dict:
+    """Run the srcost gate over the compile_surface Options matrix;
+    returns the report dict rendered by report.render_cost_text (and
+    embedded in the CLI JSON)."""
+    import jax
+
+    from ..models.options import make_options
+    from .report import write_baseline_json
+
+    baseline_path = baseline_path or BASELINE_PATH
+    matrix = list(configs if configs is not None else _MATRIX)
+    out_configs: Dict[str, dict] = {}
+    problems: List[str] = []
+    notes: List[str] = []
+    for name, extra in matrix:
+        options = make_options(**{**_BASE_KWARGS, **extra})
+        est = iteration_cost(options)
+        entry = {
+            "flops": est["flops"],
+            "bytes": est["bytes"],
+            "padded_waste_fraction": est["padded_waste_fraction"],
+            "by_primitive": est["by_primitive"],
+            "while_loops": est["while_loops"],
+            "stages": {},
+        }
+        for stage, s_est in stage_costs(options).items():
+            entry["stages"][stage] = {
+                "flops": s_est["flops"],
+                "bytes": s_est["bytes"],
+                "padded_waste_fraction": s_est["padded_waste_fraction"],
+            }
+        out_configs[name] = entry
+
+    baseline_checked = baseline_match = False
+    if update_baseline:
+        payload = {
+            "schema_version": 1,
+            "jax_version": jax.__version__,
+            "configs": {
+                name: {
+                    "flops": e["flops"],
+                    "bytes": e["bytes"],
+                    "padded_waste_fraction": e["padded_waste_fraction"],
+                    "stages": {
+                        s: {
+                            "flops": se["flops"],
+                            "bytes": se["bytes"],
+                            "padded_waste_fraction":
+                                se["padded_waste_fraction"],
+                        }
+                        for s, se in e["stages"].items()
+                    },
+                }
+                for name, e in out_configs.items()
+            },
+        }
+        write_baseline_json(baseline_path, payload)
+    elif os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+        baseline_checked = True
+        base_problems, base_notes = diff_cost_baseline(
+            out_configs, baseline, tolerance
+        )
+        baseline_match = not base_problems
+        problems += base_problems
+        notes += base_notes
+        if baseline.get("jax_version") != jax.__version__:
+            baseline_match = False
+            problems.append(
+                "cost baseline was written under jax "
+                f"{baseline.get('jax_version')} but this is "
+                f"{jax.__version__} — refresh with --update-baseline"
+            )
+    else:
+        problems.append(
+            f"no cost baseline at {baseline_path} — create one with "
+            "--update-baseline"
+        )
+
+    return {
+        "ok": not problems,
+        "problems": problems,
+        "notes": notes,
+        "configs": out_configs,
+        "baseline_checked": baseline_checked,
+        "baseline_match": baseline_match,
+        "baseline_path": baseline_path,
+        "tolerance": tolerance,
+        "jax_version": jax.__version__,
+    }

@@ -14,7 +14,8 @@ JSON schema (schema_version 1):
       "suppressed": int,              # pragma-suppressed findings
       "violations": [Violation.to_dict(), ...],
       "surface": {...} | null,        # compile-surface section, if run
-      "memory": {...} | null          # srmem section, if run
+      "memory": {...} | null,         # srmem section, if run
+      "cost": {...} | null            # srcost section, if run
     }
 """
 
@@ -33,6 +34,7 @@ class AnalysisReport:
     violations: List[Violation] = dataclasses.field(default_factory=list)
     surface: Optional[dict] = None  # compile_surface.check_surface() output
     memory: Optional[dict] = None  # memory.check_memory() output
+    cost: Optional[dict] = None  # cost.check_cost() output
 
     @property
     def active(self) -> List[Violation]:
@@ -45,6 +47,8 @@ class AnalysisReport:
         if self.surface is not None and not self.surface.get("ok", True):
             return False
         if self.memory is not None and not self.memory.get("ok", True):
+            return False
+        if self.cost is not None and not self.cost.get("ok", True):
             return False
         return True
 
@@ -64,6 +68,7 @@ class AnalysisReport:
             "violations": [v.to_dict() for v in self.violations],
             "surface": self.surface,
             "memory": self.memory,
+            "cost": self.cost,
         }
 
     def to_json(self) -> str:
@@ -97,6 +102,8 @@ class AnalysisReport:
             lines.append(render_surface_text(self.surface))
         if self.memory is not None:
             lines.append(render_memory_text(self.memory))
+        if self.cost is not None:
+            lines.append(render_cost_text(self.cost))
         return "\n".join(lines)
 
 
@@ -172,6 +179,43 @@ def render_memory_text(memory: dict) -> str:
             " (baseline match)"
             if memory.get("baseline_match") else
             (" (baseline MISMATCH)" if memory.get("baseline_checked")
+             else " (no baseline check)")
+        )
+    )
+    return "\n".join(lines)
+
+
+def _eng(n: float) -> str:
+    return f"{n:.3g}" if n < 1e4 else f"{n:.2e}"
+
+
+def render_cost_text(cost: dict) -> str:
+    lines: List[str] = []
+    for problem in cost.get("problems", []):
+        lines.append(f"srcost: {problem}")
+    for note in cost.get("notes", []):
+        lines.append(f"srcost: note: {note}")
+    configs = cost.get("configs", {})
+    for name in sorted(configs):
+        entry = configs[name]
+        stages = entry.get("stages", {})
+        top = max(
+            stages.items(), key=lambda kv: kv[1].get("flops", 0),
+            default=(None, None),
+        )[0]
+        lines.append(
+            f"srcost: {name}: {_eng(entry['flops'])} element-ops, "
+            f"{_eng(entry['bytes'])} bytes, padded waste "
+            f"{entry.get('padded_waste_fraction', 0) * 100:.0f}%"
+            + (f" (dominant stage: {top})" if top else "")
+        )
+    status = "ok" if cost.get("ok", False) else "FAIL"
+    lines.append(
+        f"srcost: {status} — {len(configs)} config(s)"
+        + (
+            " (baseline match)"
+            if cost.get("baseline_match") else
+            (" (baseline MISMATCH)" if cost.get("baseline_checked")
              else " (no baseline check)")
         )
     )

@@ -580,6 +580,36 @@ def _make_iteration_driver(options: Options, has_weights: bool,
         for pos in range(0, ncycles, k)
     ]
 
+    # first-dispatch compile accounting (telemetry only): jit compiles
+    # eagerly at call time and returns before the async execution, so
+    # time-to-return of a phase's FIRST dispatch ~= its trace + lower +
+    # backend-compile wall time. Emitted as a `compile` event per phase
+    # so the run doctor reports compile separately instead of smearing
+    # it into the first stage span (a warm lru_cache means a later
+    # search in the same process legitimately records ~0s here).
+    _phase_stage = {
+        "cycle": "cycle", "simplify": "simplify",
+        "optimize": "optimize", "optimize_mut": "optimize",
+        "merge_migrate": "merge_migrate",
+    }
+    _uncompiled = set(fns) if spans.sink is not None else set()
+
+    def _call(phase, *args):
+        if phase not in _uncompiled:
+            return fns[phase](*args)
+        _uncompiled.discard(phase)
+        t0 = time.perf_counter()
+        out = fns[phase](*args)
+        dt = time.perf_counter() - t0
+        spans.note_compile(_phase_stage[phase], dt)
+        spans.sink.emit(
+            "compile",
+            name=_phase_stage[phase],
+            phase=phase if phase != _phase_stage[phase] else None,
+            duration_s=dt,
+        )
+        return out
+
     def driver(states, key, curmaxsize, X, y, *rest):
         rest = list(rest)
         memo = rest.pop() if options.cache_fitness else None
@@ -593,7 +623,8 @@ def _make_iteration_driver(options: Options, has_weights: bool,
         with spans.span("cycle", chunks=len(_chunks),
                         ncycles=ncycles) as sp:
             for chunk, is_last in _chunks:
-                out = fns["cycle"](
+                out = _call(
+                    "cycle",
                     states, curmaxsize, X, y, weights, baseline, scalars,
                     chunk, is_last,
                 )
@@ -606,7 +637,8 @@ def _make_iteration_driver(options: Options, has_weights: bool,
         with spans.span("simplify") as sp:
             # memo passed positionally: a jit carrying explicit
             # in_shardings requires every sharded argument positional
-            states = fns["simplify"](
+            states = _call(
+                "simplify",
                 states, curmaxsize, X, y, weights, baseline, scalars,
                 memo,
             )
@@ -629,13 +661,15 @@ def _make_iteration_driver(options: Options, has_weights: bool,
             passes = 0
             if (options.should_optimize_constants
                     and options.optimizer_probability > 0):
-                states = fns["optimize"](
+                states = _call(
+                    "optimize",
                     jax.random.split(k_opt, I), states, X, y, weights,
                     baseline, scalars,
                 )
                 passes += 1
             if expected_optimize_count(options) > 0:
-                states = fns["optimize_mut"](
+                states = _call(
+                    "optimize_mut",
                     jax.random.split(k_opt_mut, I), states, X, y,
                     weights, baseline, scalars,
                 )
@@ -643,7 +677,7 @@ def _make_iteration_driver(options: Options, has_weights: bool,
             sp.fence = states
             sp.attrs["passes"] = passes
         with spans.span("merge_migrate") as sp:
-            states, ghof = fns["merge_migrate"](k_mig, states, scalars)
+            states, ghof = _call("merge_migrate", k_mig, states, scalars)
             sp.fence = (states, ghof)
         outs = (states, ghof)
         if options.recorder:
@@ -1011,6 +1045,37 @@ def equation_search(
         spans_rec = SpanRecorder(sink)
         search_metrics = SearchMetrics(options, sink)
 
+    # ---- XLA profiler trace capture (options.profile_trace_dir;
+    # docs/observability.md "Profiling"): wraps the whole search —
+    # init compiles included — so the spans' srtpu/<stage> annotations
+    # land on the device timeline. Orchestration-only; a capture
+    # failure degrades to no trace, never into the search. Stopped on
+    # every dispatch-fault path and on normal completion; an exception
+    # escaping elsewhere (e.g. Ctrl-C) can leave the process-wide
+    # profiler running, so the start below first reclaims any trace a
+    # previous interrupted search leaked — the NEXT profiled search
+    # always captures. ----
+    _trace = {"on": False}
+    if options.profile_trace_dir is not None and is_primary_host():
+        try:
+            jax.profiler.start_trace(options.profile_trace_dir)
+            _trace["on"] = True
+        except Exception as e:
+            try:  # reclaim a leaked trace and retry once
+                jax.profiler.stop_trace()
+                jax.profiler.start_trace(options.profile_trace_dir)
+                _trace["on"] = True
+            except Exception:  # pragma: no cover - defensive
+                print(f"profile trace unavailable: {e}", file=sys.stderr)
+
+    def _stop_trace():
+        if _trace["on"]:
+            _trace["on"] = False
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # pragma: no cover - defensive
+                pass
+
     iteration_fn = _make_iteration_driver(
         options, weights is not None, donate, spans=spans_rec, mesh=mesh
     )
@@ -1107,17 +1172,35 @@ def equation_search(
             init_keys = jax.random.split(k_init, I)
             init_fn = _make_init_fn(options, nfeatures, wj is not None,
                                     donate, mesh)
-            if spans_rec is not None:
-                with spans_rec.span("init", output=_j) as sp:
-                    if wj is not None:
-                        sts = init_fn(init_keys, Xj, yj, wj, bl, scalars)
-                    else:
-                        sts = init_fn(init_keys, Xj, yj, bl, scalars)
-                    sp.fence = sts
-            elif wj is not None:
-                sts = init_fn(init_keys, Xj, yj, wj, bl, scalars)
-            else:
-                sts = init_fn(init_keys, Xj, yj, bl, scalars)
+            try:
+                if spans_rec is not None:
+                    with spans_rec.span("init", output=_j) as sp:
+                        t0 = time.perf_counter()
+                        if wj is not None:
+                            sts = init_fn(
+                                init_keys, Xj, yj, wj, bl, scalars
+                            )
+                        else:
+                            sts = init_fn(init_keys, Xj, yj, bl, scalars)
+                        if _j == 0 and sink is not None:
+                            # first-dispatch compile accounting, like
+                            # the phase programs (time-to-return:
+                            # compile wall time, async excluded)
+                            dt = time.perf_counter() - t0
+                            spans_rec.note_compile("init", dt)
+                            sink.emit(
+                                "compile", name="init", duration_s=dt
+                            )
+                        sp.fence = sts
+                elif wj is not None:
+                    sts = init_fn(init_keys, Xj, yj, wj, bl, scalars)
+                else:
+                    sts = init_fn(init_keys, Xj, yj, bl, scalars)
+            except BaseException:
+                # the init dispatch is outside the main loop's
+                # dispatch-fault handlers — don't leak the trace
+                _stop_trace()
+                raise
             return sts, key
 
         if saved_state is not None:
@@ -1297,6 +1380,7 @@ def equation_search(
                         fatal=True,
                     )
                     sink.close()
+                _stop_trace()
                 raise
             t_host = time.time()
             live_states[j] = states
@@ -1466,6 +1550,7 @@ def equation_search(
                             fatal=True,
                         )
                         sink.close()
+                    _stop_trace()
                     raise
 
             # global immediate stops: any one trips → the whole search
@@ -1497,6 +1582,7 @@ def equation_search(
             for c in latest_cands
         ):
             break
+    _stop_trace()
 
     for j in range(nout):
         states = live_states[j]
@@ -1547,6 +1633,27 @@ def equation_search(
         }
 
     if sink is not None:
+        # ---- srprof modeled-vs-measured join (telemetry.profile):
+        # model every stage's cost at this run's shapes and join it
+        # with the measured span totals into per-stage `profile`
+        # events — the roofline attribution the report CLI renders.
+        # Trace-only + host math; a failure degrades to a probe_error
+        # event, never into the search result. ----
+        if spans_rec is not None:
+            try:
+                from .telemetry.profile import emit_profile_events
+
+                emit_profile_events(
+                    sink, spans_rec.stage_totals(), options,
+                    nfeatures, int(X.shape[1]),
+                    compile_totals=spans_rec.compile_s,
+                )
+            except Exception as e:  # pragma: no cover - defensive
+                sink.emit(
+                    "probe_error",
+                    error=f"profile: {type(e).__name__}: "
+                          f"{str(e)[:200]}",
+                )
         if return_state:
             # in-memory serialization point (the caller may persist it
             # with utils.checkpoint.save_search_state, which emits its

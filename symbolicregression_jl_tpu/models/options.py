@@ -232,6 +232,16 @@ class Options:
     # Emit a metrics snapshot every k-th iteration (spans and lifecycle
     # events are always emitted); 1 = every iteration.
     telemetry_every: int = 1
+    # Capture a jax.profiler (XLA/Perfetto) trace of the whole search
+    # into this directory (view with `tensorboard --logdir DIR`). The
+    # telemetry spans' `srtpu/<stage>` annotations appear on the traced
+    # timeline, so the per-stage attribution and the op-level profile
+    # line up (docs/observability.md "Profiling"). Orchestration-only:
+    # absent from _graph_key, zero primitives added to any jitted
+    # program, hall of fame bit-identical with tracing on or off.
+    # Independent of `telemetry` (a trace can be captured without the
+    # event log); single-controller, like every other capture knob.
+    profile_trace_dir: Optional[str] = None
     # --- periodic search-state snapshots (resilience/ subsystem) ---
     # Serialize the compact per-output SearchState (populations, hall of
     # fame, host PRNG key) to this path every snapshot_every_dispatches

@@ -27,11 +27,13 @@ from .metrics import (
 )
 from .spans import STAGES, Span, SpanRecorder
 
-# The run doctor (analyze.py) is exported LAZILY (PEP 562): importing it
-# during package init would put the module in sys.modules before runpy
-# executes the documented CLI `python -m ...telemetry.analyze`, tripping
-# the double-import RuntimeWarning on every invocation.
+# The run doctor (analyze.py) and the srprof profiler (profile.py) are
+# exported LAZILY (PEP 562): importing either during package init would
+# put the module in sys.modules before runpy executes its documented
+# CLI (`python -m ...telemetry.analyze` / `...telemetry.profile`),
+# tripping the double-import RuntimeWarning on every invocation.
 _ANALYZE_EXPORTS = ("VERDICTS", "analyze_run", "compare_runs")
+_PROFILE_EXPORTS = ("device_peaks", "profile_report", "roofline_join")
 
 
 def __getattr__(name):
@@ -39,6 +41,10 @@ def __getattr__(name):
         from . import analyze
 
         return getattr(analyze, name)
+    if name in _PROFILE_EXPORTS:
+        from . import profile
+
+        return getattr(profile, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}"
     )
@@ -58,8 +64,11 @@ __all__ = [
     "SpanRecorder",
     "analyze_run",
     "compare_runs",
+    "device_peaks",
     "hypervolume_2d",
     "open_event_log",
+    "profile_report",
+    "roofline_join",
     "validate_event",
     "validate_events_file",
 ]

@@ -215,11 +215,44 @@ def analyze_run(
         row = stages.setdefault(name, {"total_s": 0.0, "count": 0})
         row["total_s"] += d
         row["count"] += 1
+    # compile events (the phased driver's first-dispatch compile wall
+    # times) fold OUT of the stage rows: a first dispatch's span
+    # includes its compile, and leaving it there would smear a 40s
+    # compile into "the cycle stage is slow". Reported separately, and
+    # a run that spent most of its wall on compilation is flagged
+    # compile-bound (a 2-iteration smoke run recompiling everything is
+    # a different problem than a slow kernel).
+    compile_by: Dict[str, float] = {}
+    for ce in by_type.get("compile", []):
+        name = ce.get("name")
+        d = _finite(ce.get("duration_s"))
+        if isinstance(name, str) and d is not None:
+            compile_by[name] = compile_by.get(name, 0.0) + d
+    compile_total = sum(compile_by.values())
+    for name, c in compile_by.items():
+        if name in stages:
+            stages[name]["total_s"] = max(
+                stages[name]["total_s"] - c, 0.0
+            )
     report["stages"] = {
         k: {"total_s": round(v["total_s"], 6), "count": v["count"]}
         for k, v in sorted(stages.items())
     }
     report["spans_complete"] = all(s in stages for s in STAGES)
+    if compile_by:
+        report["compile"] = {
+            "total_s": round(compile_total, 6),
+            "by_stage": {
+                k: round(v, 6) for k, v in sorted(compile_by.items())
+            },
+        }
+    stage_total = sum(v["total_s"] for v in stages.values())
+    compile_share = (
+        compile_total / (compile_total + stage_total)
+        if (compile_total + stage_total) > 0 else 0.0
+    )
+    report["compile_share"] = round(compile_share, 4)
+    report["compile_bound"] = compile_share > 0.5
 
     # ---- fault / tunnel timeline (ROADMAP #4: the machine-readable
     # trail distinguishing a mid-run fault from a dead run) ----
@@ -393,6 +426,15 @@ def analyze_run(
             reasons.append(f"missing stage spans: {missing}")
         if not reasons:
             reasons.append("completed, loss improving, no faults")
+    if report["compile_bound"]:
+        # a flag, not a verdict: the run may be perfectly healthy, but
+        # its wall time says "compilation", not "search" — warm caches
+        # (utils.precompile.enable_compilation_cache) before reading
+        # stage times as kernel performance
+        reasons.append(
+            f"compile-bound: {report['compile_share']:.0%} of "
+            "measured wall time went to first-dispatch compilation"
+        )
     report["verdict"] = verdict
     return report
 
@@ -527,7 +569,7 @@ def render_text(report: Dict[str, Any]) -> str:
     stages = report.get("stages", {})
     if stages:
         total = sum(v["total_s"] for v in stages.values()) or 1.0
-        lines.append("stage wall time:")
+        lines.append("stage wall time (compile excluded):")
         for name, v in sorted(
             stages.items(), key=lambda kv: -kv[1]["total_s"]
         ):
@@ -535,6 +577,16 @@ def render_text(report: Dict[str, Any]) -> str:
                 f"  {name:>14}: {v['total_s']:9.3f}s "
                 f"({100 * v['total_s'] / total:5.1f}%) x{v['count']}"
             )
+    comp = report.get("compile")
+    if comp:
+        lines.append(
+            f"compile: {comp['total_s']:.3f}s "
+            f"({report.get('compile_share', 0.0) * 100:.0f}% of wall"
+            + (", COMPILE-BOUND" if report.get("compile_bound") else "")
+            + ") — " + ", ".join(
+                f"{k} {v:.2f}s" for k, v in comp["by_stage"].items()
+            )
+        )
     if report.get("faults"):
         lines.append(f"faults: {len(report['faults'])} "
                      f"(resumable: {report.get('resumable')})")

@@ -1,0 +1,456 @@
+"""srprof — modeled-vs-measured per-stage profiler with roofline
+attribution.
+
+The closing of the loop ROADMAP #2 asks for: ``analysis/cost.py``
+models what every search stage SHOULD cost (element-ops, bytes moved,
+padded-waste fraction); PR 6's ``SpanRecorder`` measures what each
+stage's dispatches actually TOOK; this module joins the two against a
+per-device-kind peak table into per-stage achieved throughput,
+arithmetic intensity, and a **modeled roofline fraction** — emitted as
+additive schema-v1 ``profile`` events at the end of every telemetry run
+and rendered by the report CLI:
+
+    python -m symbolicregression_jl_tpu.telemetry.profile LOG
+        [--format json|text]
+
+Peak numbers: TPU kinds are TABLED (coarse public VPU-issue and HBM
+figures — scale anchors, not promises; the same convention as
+benchmark/roofline.py, whose v5e VPU number this table reuses). The CPU
+entry is MEASURED by a one-shot calibration microbench (a fused
+multiply-add chain for the element-op rate, a streaming add for
+bandwidth; ~1s, cached per process) — so a CPU-only image still gets a
+meaningful denominator instead of a null.
+
+The roofline join is the standard one: ``attainable = min(peak_ops,
+intensity * peak_bandwidth)``; ``fraction = achieved / attainable``,
+clamped into (0, 1] (the analytic model over-counts what fusion
+eliminates, so raw fractions can exceed 1 on tiny programs —
+``fraction_raw`` keeps the unclamped value).
+
+Everything here is host-side orchestration: the modeled half is
+trace-only (``jax.make_jaxpr``), the measured half reads spans already
+taken — zero primitives are added to any jitted search program and the
+hall of fame is bit-identical with profiling on or off (asserted in
+tests). See docs/observability.md "Profiling (srprof)".
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+#: Coarse per-device-kind peaks: VPU f32 element-op issue rate (op/s)
+#: and HBM bandwidth (B/s). Matched by substring against
+#: ``jax.Device.device_kind`` (first hit wins, longest keys first).
+#: v5e VPU reuses benchmark/roofline.py's V5E_VPU_OPS derivation
+#: (8 sublanes x 128 lanes x 4 SIMD subunits x ~0.94 GHz).
+TPU_PEAKS: Dict[str, Dict[str, float]] = {
+    "v5 lite": {"flops_per_s": 3.9e12, "bytes_per_s": 8.2e11},
+    "v5e": {"flops_per_s": 3.9e12, "bytes_per_s": 8.2e11},
+    "v5p": {"flops_per_s": 4.7e12, "bytes_per_s": 2.77e12},
+    "v6 lite": {"flops_per_s": 7.0e12, "bytes_per_s": 1.6e12},
+    "v6e": {"flops_per_s": 7.0e12, "bytes_per_s": 1.6e12},
+    "v4": {"flops_per_s": 3.2e12, "bytes_per_s": 1.2e12},
+    "v3": {"flops_per_s": 1.6e12, "bytes_per_s": 9.0e11},
+    "v2": {"flops_per_s": 1.3e12, "bytes_per_s": 7.0e11},
+}
+
+#: fallback for an unrecognized accelerator kind: the v5e row (the
+#: fleet's common denominator), tagged so the report says it guessed.
+_DEFAULT_TPU = {"flops_per_s": 3.9e12, "bytes_per_s": 8.2e11}
+
+_CPU_PEAKS: Optional[Dict[str, float]] = None
+
+
+def _calibrate_cpu_peaks() -> Dict[str, float]:
+    """One-shot CPU peak measurement (cached per process).
+
+    Element-op rate: a jitted 64-deep fused multiply-add chain over a
+    2^20-element f32 vector (2 ops per element per link; long enough
+    that dispatch overhead amortizes, small enough to stay in cache —
+    this measures ISSUE rate, which is what the model's element-ops are
+    priced in). Bandwidth: a streaming ``x + 1.0`` over 2^23 elements
+    (read + write = 8 bytes/element, too large for cache). Both are
+    medians of 3 timed reps after a warmup."""
+    global _CPU_PEAKS
+    if _CPU_PEAKS is not None:
+        return _CPU_PEAKS
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        n_c = 1 << 20
+        chain = 64
+
+        def _chain(x):
+            def body(i, v):
+                return v * jnp.float32(1.0000001) + jnp.float32(1e-9)
+            return jax.lax.fori_loop(0, chain, body, x)
+
+        f = jax.jit(_chain)
+        x = jnp.ones((n_c,), jnp.float32)
+        jax.block_until_ready(f(x))
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(x))
+            ts.append(time.perf_counter() - t0)
+        flops_per_s = 2.0 * chain * n_c / float(np.median(ts))
+
+        n_b = 1 << 23
+        g = jax.jit(lambda x: x + jnp.float32(1.0))
+        xb = jnp.ones((n_b,), jnp.float32)
+        jax.block_until_ready(g(xb))
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(g(xb))
+            ts.append(time.perf_counter() - t0)
+        bytes_per_s = 8.0 * n_b / float(np.median(ts))
+    _CPU_PEAKS = {
+        "flops_per_s": float(flops_per_s),
+        "bytes_per_s": float(bytes_per_s),
+    }
+    return _CPU_PEAKS
+
+
+def device_peaks(device=None) -> Dict[str, Any]:
+    """Peak table entry for ``device`` (default: ``jax.devices()[0]``):
+    ``{"device_kind", "flops_per_s", "bytes_per_s", "source"}`` where
+    ``source`` says whether the numbers were tabled
+    (``table:<key>``), guessed (``table:default``), or measured
+    (``calibrated:cpu``)."""
+    import jax
+
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "") or ""
+    if device.platform == "cpu":
+        peaks = _calibrate_cpu_peaks()
+        return {"device_kind": kind or "cpu", "source": "calibrated:cpu",
+                **peaks}
+    low = kind.lower()
+    for key in sorted(TPU_PEAKS, key=len, reverse=True):
+        if key in low:
+            return {"device_kind": kind, "source": f"table:{key}",
+                    **TPU_PEAKS[key]}
+    return {"device_kind": kind, "source": "table:default",
+            **_DEFAULT_TPU}
+
+
+def roofline_join(
+    flops: float, bytes_moved: float, measured_s: float,
+    peaks: Dict[str, Any], io_bytes: Optional[float] = None,
+) -> Dict[str, Any]:
+    """The modeled roofline attribution of one program dispatch:
+    achieved rate vs the attainable bound at the program's arithmetic
+    intensity. ``fraction`` is clamped into (0, 1] (``fraction_raw``
+    unclamped — a clamped 1.0 with raw >> 1 flags a measurement the
+    model cannot resolve: a sub-millisecond dispatch, or execution
+    overlapped with the compile window on the first call).
+
+    Intensity for the attainable bound uses ``io_bytes`` (the program's
+    fused lower bound on HBM traffic — top-level inputs + outputs) when
+    given: the analytic ``bytes_moved`` counts every unfused
+    intermediate, and pricing the memory ceiling off it would misread
+    anything XLA fuses well as memory-bound with an absurdly low
+    ceiling. ``bytes_moved`` still prices ``achieved_bytes_per_s`` and
+    the reported ``arithmetic_intensity`` context."""
+    if measured_s <= 0 or flops <= 0:
+        return {
+            "achieved_flops_per_s": None,
+            "achieved_bytes_per_s": None,
+            "arithmetic_intensity": None,
+            "attainable_flops_per_s": None,
+            "fraction": None,
+            "fraction_raw": None,
+            "bound": None,
+        }
+    ai = flops / max(bytes_moved, 1.0)
+    ai_roof = flops / max(
+        io_bytes if io_bytes is not None else bytes_moved, 1.0
+    )
+    attainable = min(
+        peaks["flops_per_s"], ai_roof * peaks["bytes_per_s"]
+    )
+    achieved = flops / measured_s
+    raw = achieved / attainable
+    return {
+        "achieved_flops_per_s": achieved,
+        "achieved_bytes_per_s": bytes_moved / measured_s,
+        "arithmetic_intensity": ai,
+        "attainable_flops_per_s": attainable,
+        "fraction": min(max(raw, 1e-12), 1.0),
+        "fraction_raw": raw,
+        "bound": (
+            "compute"
+            if peaks["flops_per_s"] <= ai_roof * peaks["bytes_per_s"]
+            else "memory"
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# run-end emission (called by api.equation_search when telemetry is on)
+# ---------------------------------------------------------------------------
+
+
+def emit_profile_events(
+    sink,
+    span_totals: Dict[str, Tuple[float, int]],
+    options,
+    nfeatures: int,
+    nrows: int,
+    device=None,
+    compile_totals: Optional[Dict[str, float]] = None,
+) -> List[dict]:
+    """Model every stage's cost at this run's shapes, join it with the
+    measured span totals, and emit one ``profile`` event per stage.
+
+    ``span_totals`` is ``SpanRecorder.stage_totals()``. The modeled
+    numbers are per DISPATCH (one stage program execution), so the join
+    divides each stage's total by its span count; the two in-scan
+    stages (mutate / eval) join against their one-shot probe spans.
+    ``compile_totals`` (``SpanRecorder.compile_s``) is subtracted from
+    the matching stage's span total first — a first dispatch's span
+    includes its compile, and on a short run that would swamp the
+    steady-state rate the roofline describes. Trace-only + host
+    arithmetic: nothing is added to any jitted search program. Returns
+    the emitted rows (also useful to tests)."""
+    from ..analysis.cost import stage_costs
+
+    peaks = device_peaks(device)
+    compile_totals = compile_totals or {}
+    rows: List[dict] = []
+    for stage, cost in stage_costs(options, nfeatures, nrows).items():
+        tot = span_totals.get(stage)
+        raw_total_s, count = (tot if tot else (None, 0))
+        measured_total_s = (
+            max(raw_total_s - compile_totals.get(stage, 0.0), 0.0)
+            if raw_total_s is not None else None
+        )
+        measured_s = (
+            measured_total_s / count if count else None
+        )
+        join = roofline_join(
+            cost["flops"], cost["bytes"], measured_s or 0.0, peaks,
+            io_bytes=cost.get("io_bytes"),
+        )
+        row = {
+            "stage": stage,
+            "flops": cost["flops"],
+            "bytes": cost["bytes"],
+            "io_bytes": cost.get("io_bytes"),
+            "padded_waste_fraction": cost["padded_waste_fraction"],
+            "while_loops": cost["while_loops"],
+            "measured_s": measured_s,
+            "measured_total_s": measured_total_s,
+            "compile_s": compile_totals.get(stage),
+            "count": count,
+            "roofline_fraction": join["fraction"],
+            "roofline_fraction_raw": join["fraction_raw"],
+            "achieved_flops_per_s": join["achieved_flops_per_s"],
+            "achieved_bytes_per_s": join["achieved_bytes_per_s"],
+            "arithmetic_intensity": join["arithmetic_intensity"],
+            "attainable_flops_per_s": join["attainable_flops_per_s"],
+            "bound": join["bound"],
+            "device_kind": peaks["device_kind"],
+            "peak_source": peaks["source"],
+            "peak_flops_per_s": peaks["flops_per_s"],
+            "peak_bytes_per_s": peaks["bytes_per_s"],
+        }
+        rows.append(row)
+        if sink is not None:
+            sink.emit("profile", **row)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# report (CLI over an event log)
+# ---------------------------------------------------------------------------
+
+
+def profile_report(source: Union[str, List[dict]]) -> Dict[str, Any]:
+    """One event log (path or pre-loaded event list) -> the srprof
+    report: per-stage modeled cost, measured wall time, roofline
+    fraction (from the run's ``profile`` events), the per-stage compile
+    wall time (``compile`` events), and the utilization skew — the
+    stages whose share of measured wall time far exceeds their share of
+    modeled cost (the profile's "look here first" column)."""
+    from .analyze import _finite, load_events
+    from .spans import STAGES
+
+    if isinstance(source, str):
+        events, skipped = load_events(source)
+        path = source
+    else:
+        events, skipped, path = list(source), 0, None
+
+    stages: Dict[str, dict] = {}
+    compile_by: Dict[str, dict] = {}
+    run = {}
+    for e in events:
+        typ = e.get("type")
+        if typ == "run_start":
+            run = {
+                k: e.get(k)
+                for k in ("run", "backend", "device_kind", "nout",
+                          "niterations")
+                if e.get(k) is not None
+            }
+        elif typ == "profile" and isinstance(e.get("stage"), str):
+            stages[e["stage"]] = e  # last write wins (one run = one set)
+        elif typ == "compile" and isinstance(e.get("name"), str):
+            row = compile_by.setdefault(
+                e["name"], {"total_s": 0.0, "count": 0}
+            )
+            d = _finite(e.get("duration_s"))
+            if d is not None:
+                row["total_s"] += d
+                row["count"] += 1
+
+    # modeled share weights per-dispatch flops by the stage's DISPATCH
+    # COUNT (the wall side, measured_total_s, is count-multiplied too —
+    # sharing a per-dispatch numerator with a total denominator would
+    # inflate every per-iteration stage's skew by niterations relative
+    # to the one-shot probe stages and invert the "look here first"
+    # column)
+    def _work(s) -> float:
+        f = _finite(s.get("flops")) or 0.0
+        n = s.get("count") or 0
+        return f * n
+
+    total_work = sum(_work(s) for s in stages.values())
+    total_wall = sum(
+        _finite(s.get("measured_total_s")) or 0.0
+        for s in stages.values()
+    )
+    for s in stages.values():
+        w = _finite(s.get("measured_total_s")) or 0.0
+        s["modeled_share"] = (
+            _work(s) / total_work if total_work else None
+        )
+        s["wall_share"] = w / total_wall if total_wall else None
+        # utilization skew: wall share over modeled share — >> 1 means
+        # the stage burns far more wall time than its modeled work
+        # justifies (dispatch overhead, poor kernel, host sync)
+        ms, ws = s["modeled_share"], s["wall_share"]
+        s["skew"] = (ws / ms) if (ms and ws is not None) else None
+
+    missing = [s for s in STAGES if s not in stages]
+    return {
+        "path": path,
+        "run": run,
+        "events": len(events),
+        "skipped_lines": skipped,
+        "stages": {s: stages[s] for s in STAGES if s in stages},
+        "missing_stages": missing,
+        "complete": not missing,
+        "compile": compile_by,
+        "compile_total_s": round(
+            sum(v["total_s"] for v in compile_by.values()), 6
+        ),
+        "measured_total_s": round(total_wall, 6),
+    }
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    """Human rendering of one profile_report."""
+    lines = []
+    run = report.get("run", {})
+    lines.append(
+        f"srprof — run {run.get('run', '?')} [{run.get('backend', '?')}]"
+        f" stages {len(report.get('stages', {}))}/7"
+        + (f" MISSING {report['missing_stages']}"
+           if report.get("missing_stages") else "")
+    )
+    stages = report.get("stages", {})
+    if stages:
+        any_row = next(iter(stages.values()))
+        lines.append(
+            f"peaks [{any_row.get('peak_source')}] "
+            f"{any_row.get('device_kind')}: "
+            f"{_fmt(any_row.get('peak_flops_per_s'))} op/s, "
+            f"{_fmt(any_row.get('peak_bytes_per_s'))} B/s"
+        )
+        lines.append(
+            f"{'stage':>14} {'el-ops':>9} {'bytes':>9} {'AI':>6} "
+            f"{'waste':>6} {'wall s':>9} {'share':>6} {'roofline':>8} "
+            f"{'skew':>6}"
+        )
+        for name, s in stages.items():
+            lines.append(
+                f"{name:>14} {_fmt(s.get('flops')):>9} "
+                f"{_fmt(s.get('bytes')):>9} "
+                f"{_fmt(s.get('arithmetic_intensity'), '.2f'):>6} "
+                f"{_pct(s.get('padded_waste_fraction')):>6} "
+                f"{_fmt(s.get('measured_total_s'), '.4f'):>9} "
+                f"{_pct(s.get('wall_share')):>6} "
+                f"{_pct(s.get('roofline_fraction')):>8} "
+                f"{_fmt(s.get('skew'), '.1f'):>6}"
+            )
+    comp = report.get("compile", {})
+    if comp:
+        total = report.get("compile_total_s", 0.0)
+        parts = ", ".join(
+            f"{k} {v['total_s']:.2f}s" for k, v in sorted(comp.items())
+        )
+        lines.append(f"compile: {total:.2f}s ({parts})")
+    return "\n".join(lines)
+
+
+def _fmt(v, spec=".3g") -> str:
+    if isinstance(v, (int, float)) and math.isfinite(v):
+        return format(v, spec)
+    return "-"
+
+
+def _pct(v) -> str:
+    if isinstance(v, (int, float)) and math.isfinite(v):
+        return f"{100 * v:.0f}%"
+    return "-"
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from .analyze import resolve_log
+
+    ap = argparse.ArgumentParser(
+        prog="python -m symbolicregression_jl_tpu.telemetry.profile",
+        description=(
+            "srprof report over a telemetry event log: per-stage "
+            "modeled element-ops/bytes, measured wall time, and the "
+            "modeled roofline fraction (docs/observability.md). Exit 0 "
+            "iff the log carries profile rows for all 7 stages."
+        ),
+    )
+    ap.add_argument(
+        "log",
+        help="event log path, or a telemetry dir (newest events-*.jsonl)",
+    )
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ns = ap.parse_args(argv)
+
+    report = profile_report(resolve_log(ns.log))
+    print(
+        json.dumps(report, indent=2) if ns.format == "json"
+        else render_text(report)
+    )
+    if not report["stages"]:
+        print(
+            "srprof: no profile events in this log (telemetry runs "
+            "emit them at run end since schema additions v1/PR 10)",
+            file=sys.stderr,
+        )
+    return 0 if report["complete"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,7 +32,7 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 #: The per-iteration stage vocabulary, shared with
 #: ``analysis.memory.build_stage_programs`` (asserted there) and the
@@ -91,6 +91,14 @@ class SpanRecorder:
         )
         self.spans: List[Span] = []
         self._ctx: Dict[str, Any] = {}
+        # cumulative per-name (total_s, count): unlike `spans`, never
+        # truncated — the srprof modeled-vs-measured join at run end
+        # needs every dispatch's time, not the last MAX_RETAINED
+        self._totals: Dict[str, List[float]] = {}
+        # per-stage first-dispatch compile seconds (note_compile): the
+        # share of the stage's span total that was compilation, which
+        # the srprof join subtracts before computing achieved rates
+        self.compile_s: Dict[str, float] = {}
 
     def set_context(self, **ctx) -> None:
         """Merge ambient span attributes; a value of None removes the key."""
@@ -137,6 +145,9 @@ class SpanRecorder:
                     self._record(Span(name, t_wall, duration, a))
 
     def _record(self, sp: Span) -> None:
+        tot = self._totals.setdefault(sp.name, [0.0, 0])
+        tot[0] += sp.duration_s
+        tot[1] += 1
         self.spans.append(sp)
         if len(self.spans) > self.max_retained:
             del self.spans[0]  # oldest out; the sink has the full trail
@@ -152,6 +163,18 @@ class SpanRecorder:
     def total_s(self, name: str) -> float:
         """Summed duration of every span named `name`."""
         return sum(s.duration_s for s in self.spans if s.name == name)
+
+    def stage_totals(self) -> Dict[str, Tuple[float, int]]:
+        """Cumulative ``{name: (total_s, count)}`` over every span ever
+        recorded (survives the retained-span cap) — the measured half of
+        the srprof join (telemetry.profile)."""
+        return {k: (v[0], int(v[1])) for k, v in self._totals.items()}
+
+    def note_compile(self, name: str, seconds: float) -> None:
+        """Record first-dispatch compile wall time charged to stage
+        `name` (the api drivers call this alongside emitting the
+        `compile` event, so the srprof join can subtract it)."""
+        self.compile_s[name] = self.compile_s.get(name, 0.0) + seconds
 
 
 class NullSpanRecorder(SpanRecorder):

@@ -440,13 +440,15 @@ def test_srtop_renders_complete_and_truncated_logs(tmp_path, capsys):
     assert srtop.main([GOLDEN, "--once"]) == 0
     out = capsys.readouterr().out
     assert "srtop" in out and "stages:" in out and "diversity" in out
-    # truncated mid-write copy: renders without crashing, last event
-    # is simply held back
+    # truncated mid-write copy: renders without crashing, last event is
+    # simply held back — and --once now gates on the doctor verdict
+    # (ISSUE 12), so the run_end-less copy reads incomplete -> exit 1
     data = open(GOLDEN).read()
     p = tmp_path / "trunc.jsonl"
     p.write_text(data[: len(data) - 37])
-    assert srtop.main([str(p), "--once"]) == 0
-    assert "srtop" in capsys.readouterr().out
+    assert srtop.main([str(p), "--once"]) == 1
+    out = capsys.readouterr().out
+    assert "srtop" in out and "doctor verdict: incomplete" in out
     # directory form resolves the newest events-*.jsonl
     d = tmp_path / "runs"
     d.mkdir()

@@ -1,0 +1,696 @@
+"""srprof (ISSUE 12): the analytic cost model (analysis/cost.py), the
+modeled-vs-measured profiler (telemetry/profile.py), the cost-baseline
+gate, the doctor's compile-event folding, srtop's utilization column and
+CI exit code, and the bench-trajectory modeled-roofline series.
+
+File name sorts between test_ad_* and test_analysis; everything outside
+the `slow` marker is CPU-only host-side work on hand-computable jaxprs
+and synthetic event lists (the CPU peak calibration microbench is the
+one timed piece, ~1s). The real-search modeled-vs-measured join and the
+profiling-on/off hall-of-fame bit-identity live under `slow`.
+"""
+
+import importlib.util
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_test", os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# cost model: hand-computable jaxprs
+# ---------------------------------------------------------------------------
+
+
+def test_cost_matmul_flops_and_bytes():
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.analysis.cost import jaxpr_cost
+
+    a = jnp.ones((8, 16), jnp.float32)
+    b = jnp.ones((16, 32), jnp.float32)
+    c = jaxpr_cost(jax.make_jaxpr(lambda a, b: a @ b)(a, b))
+    # 2*M*N*K multiply-accumulates
+    assert c["flops"] == 2 * 8 * 32 * 16
+    # bytes: both inputs + the output, f32
+    assert c["bytes"] == 4 * (8 * 16 + 16 * 32 + 8 * 32)
+    assert c["io_bytes"] == 4 * (8 * 16 + 16 * 32 + 8 * 32)
+    assert c["padded_waste_fraction"] == 0.0
+
+
+def test_cost_reduce_prices_input_and_transcendental_weight():
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.analysis.cost import (
+        FLOP_WEIGHTS,
+        jaxpr_cost,
+    )
+
+    x = jnp.ones((1000,), jnp.float32)
+    c = jaxpr_cost(jax.make_jaxpr(jnp.sum)(x))
+    assert c["flops"] == 1000  # reductions price by INPUT elements
+
+    c = jaxpr_cost(jax.make_jaxpr(jnp.exp)(x))
+    assert c["flops"] == FLOP_WEIGHTS["exp"] * 1000
+
+
+def test_cost_scan_multiplies_body_by_trip_count():
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.analysis.cost import jaxpr_cost
+
+    def f(x):
+        def body(c, _):
+            return c * 2.0, None
+
+        out, _ = jax.lax.scan(body, x, None, length=10)
+        return out
+
+    c = jaxpr_cost(jax.make_jaxpr(f)(jnp.ones((64,), jnp.float32)))
+    assert c["flops"] == 64 * 10  # one mul per element per trip
+    assert c["by_primitive"]["mul"] == 640.0
+
+
+def test_cost_while_counts_once_and_tallies():
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.analysis.cost import jaxpr_cost
+
+    def f(x):
+        return jax.lax.while_loop(
+            lambda v: jnp.sum(v) < 1e6, lambda v: v * 2.0, x
+        )
+
+    c = jaxpr_cost(jax.make_jaxpr(f)(jnp.ones((64,), jnp.float32)))
+    assert c["while_loops"] == 1
+    # body (64 muls) + cond (64-elem reduce + compare) counted ONCE
+    assert c["flops"] >= 64 + 64
+    assert c["flops"] < 64 * 10  # no phantom trip multiplier
+
+
+def test_cost_padded_waste_fraction_hand_computed():
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.analysis.cost import jaxpr_cost
+
+    # gt (mask, 100) + mul (compute, 100) + select_n (mask, 100):
+    # waste = 200/300
+    c = jaxpr_cost(jax.make_jaxpr(
+        lambda x: jnp.where(x > 0, x * 2.0, x)
+    )(jnp.ones((100,), jnp.float32)))
+    assert math.isclose(c["padded_waste_fraction"], 2 / 3, abs_tol=1e-5)
+    assert c["mask_flops"] == 200.0
+
+
+def test_cost_data_movement_is_bytes_only():
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.analysis.cost import jaxpr_cost
+
+    c = jaxpr_cost(jax.make_jaxpr(
+        lambda x: jnp.transpose(x).reshape(-1)
+    )(jnp.ones((8, 16), jnp.float32)))
+    assert c["flops"] == 0.0
+    assert c["bytes"] > 0
+
+
+def test_cost_cond_data_movement_branches_keep_bytes():
+    """A cond whose branches are all flop-free still takes its heaviest
+    branch's BYTES (bytes are the tie-break when element-ops tie) —
+    dropping them would let data movement added inside a cond slip
+    under the baseline gate."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from symbolicregression_jl_tpu.analysis.cost import jaxpr_cost
+
+    x = jnp.ones((1024,), jnp.float32)
+    heavy = jaxpr_cost(jax.make_jaxpr(
+        lambda p, v: lax.cond(
+            p, lambda a: lax.rev(a, (0,)), lambda a: a, v
+        )
+    )(True, x))
+    light = jaxpr_cost(jax.make_jaxpr(
+        lambda p, v: lax.cond(p, lambda a: a, lambda a: a, v)
+    )(True, x))
+    assert heavy["flops"] == light["flops"] == 0.0
+    assert heavy["bytes"] > light["bytes"]
+
+
+# ---------------------------------------------------------------------------
+# roofline join + device peaks
+# ---------------------------------------------------------------------------
+
+
+def test_roofline_join_compute_and_memory_bounds():
+    from symbolicregression_jl_tpu.telemetry.profile import roofline_join
+
+    peaks = {"flops_per_s": 1e12, "bytes_per_s": 1e11}
+    # high intensity (io): compute ceiling binds
+    j = roofline_join(1e9, 1e9, 0.01, peaks, io_bytes=1e6)
+    assert j["bound"] == "compute"
+    assert math.isclose(j["fraction_raw"], (1e9 / 0.01) / 1e12)
+    assert 0 < j["fraction"] <= 1.0
+    # low intensity even fused: memory ceiling binds
+    j = roofline_join(1e6, 1e9, 0.01, peaks, io_bytes=1e9)
+    assert j["bound"] == "memory"
+    attainable = (1e6 / 1e9) * 1e11
+    assert math.isclose(j["attainable_flops_per_s"], attainable)
+    # degenerate inputs -> all-null row, never a crash
+    j = roofline_join(0.0, 1e6, 0.0, peaks)
+    assert j["fraction"] is None
+
+
+def test_roofline_join_clamps_and_keeps_raw():
+    from symbolicregression_jl_tpu.telemetry.profile import roofline_join
+
+    peaks = {"flops_per_s": 1e6, "bytes_per_s": 1e12}
+    j = roofline_join(1e9, 1e3, 0.01, peaks, io_bytes=1e3)
+    assert j["fraction"] == 1.0  # clamped
+    assert j["fraction_raw"] > 1.0  # overshoot preserved
+
+
+def test_device_peaks_cpu_calibrated_and_tpu_tabled():
+    from symbolicregression_jl_tpu.telemetry import profile as prof
+
+    p = prof.device_peaks()  # CPU under the test harness
+    assert p["source"] == "calibrated:cpu"
+    assert p["flops_per_s"] > 0 and p["bytes_per_s"] > 0
+    # cached: second call returns the identical measurement
+    assert prof.device_peaks()["flops_per_s"] == p["flops_per_s"]
+
+    class FakeDev:
+        platform = "tpu"
+        device_kind = "TPU v5 lite"
+
+    t = prof.device_peaks(FakeDev())
+    assert t["source"] == "table:v5 lite"
+    assert t["flops_per_s"] == prof.TPU_PEAKS["v5 lite"]["flops_per_s"]
+
+    class OddDev:
+        platform = "tpu"
+        device_kind = "TPU v99"
+
+    assert prof.device_peaks(OddDev())["source"] == "table:default"
+
+
+# ---------------------------------------------------------------------------
+# cost baseline gate
+# ---------------------------------------------------------------------------
+
+
+def _fake_cost_entry(flops, bytes_, stages):
+    return {
+        "flops": flops, "bytes": bytes_, "padded_waste_fraction": 0.3,
+        "stages": {
+            s: {"flops": f, "bytes": b, "padded_waste_fraction": 0.3}
+            for s, (f, b) in stages.items()
+        },
+    }
+
+
+def test_cost_baseline_diff_catches_injected_regression():
+    from symbolicregression_jl_tpu.analysis.cost import diff_cost_baseline
+
+    baseline = {"configs": {
+        "base": _fake_cost_entry(1000.0, 5000.0, {"cycle": (800.0, 4000.0)}),
+    }}
+    # +50% flops on the config and the stage: both fail
+    grown = {
+        "base": _fake_cost_entry(1500.0, 5000.0, {"cycle": (1200.0, 4000.0)})
+    }
+    problems, notes = diff_cost_baseline(grown, baseline)
+    assert any("base: modeled flops grew" in p for p in problems)
+    assert any("base.cycle: modeled flops grew" in p for p in problems)
+    # -50%: a note, never a failure
+    shrunk = {
+        "base": _fake_cost_entry(500.0, 5000.0, {"cycle": (400.0, 4000.0)})
+    }
+    problems, notes = diff_cost_baseline(shrunk, baseline)
+    assert not problems and any("shrank" in n for n in notes)
+    # within tolerance: silent
+    ok = {
+        "base": _fake_cost_entry(1050.0, 5100.0, {"cycle": (820.0, 4100.0)})
+    }
+    problems, notes = diff_cost_baseline(ok, baseline)
+    assert not problems and not notes
+    # a stage/config that vanishes must fail, not silently stop gating
+    gone = {"base": _fake_cost_entry(1000.0, 5000.0, {})}
+    problems, _ = diff_cost_baseline(gone, baseline)
+    assert any("no longer produced" in p for p in problems)
+    problems, _ = diff_cost_baseline({}, baseline)
+    assert any("base" in p and "no longer produced" in p
+               for p in problems)
+
+
+def test_checked_in_cost_baseline_well_formed():
+    from symbolicregression_jl_tpu.analysis.cost import BASELINE_PATH
+    from symbolicregression_jl_tpu.telemetry.spans import STAGES
+
+    with open(BASELINE_PATH) as f:
+        baseline = json.load(f)
+    assert baseline["schema_version"] == 1
+    configs = baseline["configs"]
+    # the compile_surface matrix, stage-attributed on the shared
+    # seven-stage vocabulary, every figure positive
+    assert set(configs) == {"base", "cache", "islands4", "pop32",
+                            "bucketed"}
+    for entry in configs.values():
+        assert entry["flops"] > 0 and entry["bytes"] > 0
+        assert 0.0 < entry["padded_waste_fraction"] < 1.0
+        assert set(entry["stages"]) == set(STAGES)
+        for s in entry["stages"].values():
+            assert s["flops"] > 0 and s["bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# schema evolution: profile / compile events
+# ---------------------------------------------------------------------------
+
+
+def _env(type, **fields):
+    return {"v": 1, "t": 1.0, "run": "r", "type": type, **fields}
+
+
+def test_schema_accepts_profile_and_compile_events():
+    from symbolicregression_jl_tpu.telemetry import validate_event
+
+    assert validate_event(_env(
+        "profile", stage="cycle", flops=1e6, bytes=1e7,
+        padded_waste_fraction=0.4, measured_s=0.1,
+        roofline_fraction=0.2, bound="compute",
+        device_kind="cpu", peak_source="calibrated:cpu",
+    )) == []
+    assert validate_event(_env(
+        "compile", name="cycle", phase=None, duration_s=12.5,
+    )) == []
+    # nulls where the stage recorded no span are legal
+    assert validate_event(_env(
+        "profile", stage="eval", flops=1.0, bytes=1.0,
+        measured_s=None, roofline_fraction=None,
+    )) == []
+
+
+def test_schema_rejects_malformed_profile_and_compile():
+    from symbolicregression_jl_tpu.telemetry import validate_event
+
+    # missing required fields
+    assert validate_event(_env("profile", stage="cycle"))
+    assert validate_event(_env("compile", name="cycle"))
+    # retyped required field
+    assert validate_event(_env(
+        "profile", stage=3, flops=1.0, bytes=1.0,
+    ))
+    assert validate_event(_env(
+        "compile", name="cycle", duration_s="slow",
+    ))
+
+
+def test_roofline_event_accepts_modeled_fraction():
+    from symbolicregression_jl_tpu.telemetry import validate_event
+
+    assert validate_event(_env(
+        "roofline", fraction=None, modeled_fraction=0.31,
+        skip_reason="cpu-only", trees_rows_per_s=1e6,
+    )) == []
+
+
+# ---------------------------------------------------------------------------
+# profiler report from synthetic events
+# ---------------------------------------------------------------------------
+
+_STAGES = ("init", "cycle", "mutate", "eval", "simplify", "optimize",
+           "merge_migrate")
+
+
+def _profile_events(stages=_STAGES, frac=0.2):
+    events = [_env("run_start", config_fingerprint="x", backend="cpu",
+                   devices=["c"], nout=1)]
+    for i, s in enumerate(stages):
+        events.append(_env(
+            "profile", stage=s, flops=1e6 * (i + 1), bytes=1e7,
+            padded_waste_fraction=0.4, measured_s=0.01 * (i + 1),
+            measured_total_s=0.02 * (i + 1), count=2,
+            roofline_fraction=frac, roofline_fraction_raw=frac,
+            bound="compute", device_kind="cpu",
+            peak_source="calibrated:cpu",
+        ))
+    events.append(_env("compile", name="cycle", duration_s=30.0))
+    events.append(_env("run_end", num_evals=10.0, search_time_s=1.0))
+    return events
+
+
+def test_profile_report_complete_and_rendered(tmp_path, capsys):
+    from symbolicregression_jl_tpu.telemetry.profile import (
+        main,
+        profile_report,
+        render_text,
+    )
+
+    report = profile_report(_profile_events())
+    assert report["complete"] and not report["missing_stages"]
+    assert list(report["stages"]) == list(_STAGES)  # STAGES order
+    cyc = report["stages"]["cycle"]
+    assert cyc["modeled_share"] is not None
+    assert cyc["wall_share"] is not None and cyc["skew"] is not None
+    assert report["compile"]["cycle"]["total_s"] == 30.0
+    text = render_text(report)
+    for s in _STAGES:
+        assert s in text
+    assert "compile: 30.00s" in text
+
+    # CLI: complete log -> 0, missing stage -> 1
+    p = tmp_path / "events-full.jsonl"
+    p.write_text("".join(
+        json.dumps(e) + "\n" for e in _profile_events()
+    ))
+    assert main([str(p)]) == 0
+    q = tmp_path / "events-part.jsonl"
+    q.write_text("".join(
+        json.dumps(e) + "\n" for e in _profile_events(_STAGES[:3])
+    ))
+    assert main([str(q)]) == 1
+    capsys.readouterr()
+
+
+def test_profile_report_skew_weights_modeled_share_by_count():
+    """modeled_share weights per-dispatch flops by dispatch count (the
+    wall side is count-multiplied): a stage dispatched 10x with the
+    same per-dispatch cost and per-dispatch wall as a one-shot probe
+    stage must show the same skew ~1, not a 10x-inflated one."""
+    from symbolicregression_jl_tpu.telemetry.profile import (
+        profile_report,
+    )
+
+    events = [
+        _env("run_start", config_fingerprint="x", backend="cpu",
+             devices=["c"], nout=1),
+        _env("profile", stage="cycle", flops=1e6, bytes=1e7,
+             measured_total_s=1.0, count=10),
+        _env("profile", stage="eval", flops=1e6, bytes=1e7,
+             measured_total_s=0.1, count=1),
+        _env("run_end", num_evals=1.0, search_time_s=1.0),
+    ]
+    rep = profile_report(events)
+    cyc, ev = rep["stages"]["cycle"], rep["stages"]["eval"]
+    assert math.isclose(cyc["skew"], 1.0)
+    assert math.isclose(ev["skew"], 1.0)
+    assert math.isclose(cyc["modeled_share"], 10 / 11)
+
+
+def test_emit_profile_events_joins_and_subtracts_compile():
+    """The join math, without tracing: stub stage_costs so the test is
+    pure host arithmetic."""
+    from symbolicregression_jl_tpu.telemetry import profile as prof
+
+    class FakeSink:
+        def __init__(self):
+            self.events = []
+
+        def emit(self, type, **f):
+            self.events.append({"type": type, **f})
+
+    orig = None
+    import symbolicregression_jl_tpu.analysis.cost as cost_mod
+
+    orig = cost_mod.stage_costs
+
+    def fake_stage_costs(options, nfeatures, nrows):
+        return {
+            "cycle": {"flops": 1e6, "bytes": 1e7, "io_bytes": 1e5,
+                      "padded_waste_fraction": 0.4, "while_loops": 0},
+            "eval": {"flops": 2e5, "bytes": 1e6, "io_bytes": 1e4,
+                     "padded_waste_fraction": 0.4, "while_loops": 0},
+        }
+
+    cost_mod.stage_costs = fake_stage_costs
+    try:
+        sink = FakeSink()
+        rows = prof.emit_profile_events(
+            sink,
+            # cycle's 10.2s span total includes 10s of compile
+            {"cycle": (10.2, 2), "eval": (0.01, 1)},
+            options=None, nfeatures=2, nrows=32,
+            compile_totals={"cycle": 10.0},
+        )
+    finally:
+        cost_mod.stage_costs = orig
+    by = {r["stage"]: r for r in rows}
+    assert math.isclose(by["cycle"]["measured_total_s"], 0.2)
+    assert math.isclose(by["cycle"]["measured_s"], 0.1)
+    assert by["cycle"]["compile_s"] == 10.0
+    assert by["eval"]["compile_s"] is None
+    for r in rows:
+        assert 0.0 < r["roofline_fraction"] <= 1.0
+    assert len(sink.events) == 2
+    assert all(e["type"] == "profile" for e in sink.events)
+
+
+# ---------------------------------------------------------------------------
+# run doctor: compile folding + compile-bound flag
+# ---------------------------------------------------------------------------
+
+
+def _doctor_events(compile_s, cycle_span_s, extra_span_s=1.0):
+    events = [_env("run_start", config_fingerprint="x", backend="cpu",
+                   devices=["c"], nout=1)]
+    for s in _STAGES:
+        events.append(_env(
+            "span", name=s, t_start=1.0,
+            duration_s=cycle_span_s if s == "cycle" else extra_span_s,
+        ))
+    if compile_s:
+        events.append(_env("compile", name="cycle",
+                           duration_s=compile_s))
+    events.append(_env(
+        "metrics", output=0, iteration=0,
+        snapshot={"counters": {}, "gauges": {"best_loss": 1.0},
+                  "histograms": {}},
+    ))
+    events.append(_env("run_end", num_evals=10.0, search_time_s=1.0))
+    return events
+
+
+def test_doctor_folds_compile_out_of_stage_breakdown():
+    from symbolicregression_jl_tpu.telemetry.analyze import analyze_run
+
+    report = analyze_run(_doctor_events(compile_s=30.0, cycle_span_s=32.0))
+    # the cycle row shows steady-state time, not compile+steady
+    assert math.isclose(report["stages"]["cycle"]["total_s"], 2.0)
+    assert report["compile"]["total_s"] == 30.0
+    assert report["compile"]["by_stage"] == {"cycle": 30.0}
+    # 30 / (30 + 2 + 6x1) -> ~79% compile share: flagged
+    assert report["compile_bound"] is True
+    assert any("compile-bound" in r for r in report["reasons"])
+    assert report["verdict"] == "healthy"  # a flag, not a verdict
+
+    from symbolicregression_jl_tpu.telemetry.analyze import render_text
+
+    text = render_text(report)
+    assert "COMPILE-BOUND" in text and "compile excluded" in text
+
+
+def test_doctor_compile_under_half_not_flagged():
+    from symbolicregression_jl_tpu.telemetry.analyze import analyze_run
+
+    report = analyze_run(_doctor_events(compile_s=3.0, cycle_span_s=10.0))
+    assert report["compile_bound"] is False
+    assert not any("compile-bound" in r for r in report["reasons"])
+    # no compile events at all: no compile section, share 0
+    report = analyze_run(_doctor_events(compile_s=0.0, cycle_span_s=10.0))
+    assert "compile" not in report
+    assert report["compile_share"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# srtop: utilization column + --once CI gate
+# ---------------------------------------------------------------------------
+
+
+def test_srtop_utilization_column_and_flag(tmp_path, capsys):
+    srtop = _load_script("srtop")
+    events = _doctor_events(compile_s=0.0, cycle_span_s=10.0)
+    # modeled shares: merge_migrate tiny model share but large wall
+    # share -> flagged; cycle's wall share matches its model share
+    for i, s in enumerate(_STAGES):
+        events.append(_env(
+            "profile", stage=s,
+            flops=(1e8 if s == "cycle" else 1e3), bytes=1e7,
+            measured_s=0.1, roofline_fraction=0.5,
+        ))
+    p = tmp_path / "events-u.jsonl"
+    p.write_text("".join(json.dumps(e) + "\n" for e in events))
+    rc = srtop.main([str(p), "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0  # healthy log
+    assert "|mod " in out
+    # every non-cycle stage shares 1s of 16s wall (6%) with ~0% model
+    # share; none crosses the 10% wall floor except... cycle dominates
+    # wall AND model: no spurious flag on it
+    assert "cycle 10.0s (62%|mod 100%)" in out
+
+
+def test_srtop_once_exits_nonzero_on_unhealthy(tmp_path, capsys):
+    srtop = _load_script("srtop")
+    # incomplete log (no run_end): verdict incomplete -> rc 1
+    events = _doctor_events(compile_s=0.0, cycle_span_s=1.0)[:-1]
+    p = tmp_path / "events-bad.jsonl"
+    p.write_text("".join(json.dumps(e) + "\n" for e in events))
+    rc = srtop.main([str(p), "--once"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "doctor verdict: incomplete" in out
+    # faulted log -> rc 1 as well
+    events.append(_env("dispatch_fault", where="iteration",
+                       error_type="XlaRuntimeError"))
+    p.write_text("".join(json.dumps(e) + "\n" for e in events))
+    assert srtop.main([str(p), "--once"]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# bench trajectory: the modeled roofline series
+# ---------------------------------------------------------------------------
+
+
+def test_bench_trajectory_picks_up_split_roofline(tmp_path):
+    bt = _load_script("bench_trajectory")
+    # old-era round: single roofline_fraction key
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "parsed": {"value": 1e6, "vs_baseline": 0.2, "platform": "cpu",
+                   "roofline_fraction": None,
+                   "roofline_skip_reason": "cpu-only"},
+    }))
+    # new-era round: split keys, modeled non-null on CPU
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps({
+        "parsed": {"value": 1.1e6, "vs_baseline": 0.21, "platform": "cpu",
+                   "roofline_measured": None,
+                   "roofline_modeled": 0.31,
+                   "roofline_skip_reason": "cpu-only"},
+    }))
+    traj = bt.build_trajectory(str(tmp_path))
+    assert "roofline_modeled" in traj["series"]
+    vals = [p["value"] for p in traj["series"]["roofline_modeled"]]
+    assert vals == [None, 0.31]
+    md = bt.render_markdown(traj)
+    assert "roofline (modeled)" in md
+    assert "0.31" in md
+    # the bench-embedded summary block carries the modeled series too
+    assert bt.bench_summary(traj)["roofline_modeled"] == [None, 0.31]
+
+
+def test_checked_in_trajectory_carries_modeled_column():
+    with open(os.path.join(REPO, "TRAJECTORY.json")) as f:
+        traj = json.load(f)
+    assert "roofline_modeled" in traj["series"]
+    with open(os.path.join(REPO, "TRAJECTORY.md")) as f:
+        assert "roofline (modeled)" in f.read()
+
+
+# ---------------------------------------------------------------------------
+# real-search round trips (slow: real compiles)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_real_search_modeled_vs_measured_join(tmp_path):
+    """ISSUE 12 acceptance: a real 2-iteration CPU search's log reports
+    per-stage modeled element-ops/bytes, measured wall time, and a
+    non-null modeled roofline fraction for ALL seven stages."""
+    import symbolicregression_jl_tpu as sr
+    from symbolicregression_jl_tpu.telemetry import validate_events_file
+    from symbolicregression_jl_tpu.telemetry.analyze import (
+        analyze_run,
+        resolve_log,
+    )
+    from symbolicregression_jl_tpu.telemetry.profile import (
+        main as profile_main,
+        profile_report,
+    )
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((2, 64)).astype(np.float32)
+    y = 2.0 * np.cos(X[1]) + X[0] ** 2
+    sr.equation_search(
+        X, y,
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        niterations=2, npopulations=3, npop=16,
+        ncycles_per_iteration=8, maxsize=10, seed=5, verbosity=0,
+        progress=False, telemetry=True, telemetry_dir=str(tmp_path),
+    )
+    log = resolve_log(str(tmp_path))
+    val = validate_events_file(log)
+    assert val["ok"], val["problems"]
+    report = profile_report(log)
+    assert report["complete"], report["missing_stages"]
+    for stage, row in report["stages"].items():
+        assert row["flops"] > 0 and row["bytes"] > 0, stage
+        assert row["measured_total_s"] is not None, stage
+        f = row["roofline_fraction"]
+        assert isinstance(f, float) and 0.0 < f <= 1.0, (stage, f)
+        assert 0.0 < row["padded_waste_fraction"] < 1.0, stage
+    # the report CLI renders it and exits 0
+    assert profile_main([log]) == 0
+    # compile events landed for init + every phased-driver program, and
+    # the doctor folds them out rather than smearing the first spans
+    doctor = analyze_run(log)
+    assert set(doctor["compile"]["by_stage"]) == {
+        "init", "cycle", "simplify", "optimize", "merge_migrate",
+    }
+    assert doctor["verdict"] == "healthy", doctor["reasons"]
+
+
+@pytest.mark.slow
+def test_profile_trace_dir_bit_identical_and_captures(tmp_path):
+    """Options.profile_trace_dir captures an XLA trace without touching
+    the search: hall of fame bit-identical with tracing on vs off."""
+    import symbolicregression_jl_tpu as sr
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((2, 64)).astype(np.float32)
+    y = 2.0 * np.cos(X[1]) + X[0] ** 2
+    kw = dict(
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        niterations=2, npopulations=3, npop=16,
+        ncycles_per_iteration=8, maxsize=10, seed=5, verbosity=0,
+        progress=False,
+    )
+    r_off = sr.equation_search(X, y, **kw)
+    trace_dir = tmp_path / "trace"
+    r_on = sr.equation_search(
+        X, y, profile_trace_dir=str(trace_dir), **kw
+    )
+
+    def frontier(r):
+        return [
+            (c.complexity, float(c.loss), float(c.score), c.equation)
+            for c in r.frontier()
+        ]
+
+    assert frontier(r_off) == frontier(r_on)
+    # the capture actually wrote a trace
+    captured = [
+        os.path.join(root, f)
+        for root, _, files in os.walk(trace_dir) for f in files
+    ]
+    assert captured, "profile_trace_dir produced no trace files"
