@@ -905,8 +905,17 @@ def main(verbose=True):
             "SRTPU_BENCH_TELEMETRY_DIR"
         ) or tempfile.mkdtemp(prefix="srtpu_bench_telemetry_")
         sink = open_event_log(tdir)
+        # fleet provenance (additive run_start fields + registration):
+        # a watcher-launched bench stamps the step's retry counter and
+        # registers into the fleet root srfleet watches
+        try:
+            _attempt = max(1, int(os.environ.get("SRTPU_RUN_ATTEMPT", "1")))
+        except ValueError:
+            _attempt = 1
         sink.emit(
             "run_start",
+            run_id=sink.run_id,
+            attempt=_attempt,
             config_fingerprint=(
                 f"bench-{N_POPULATIONS}x{NPOP}-rows{N_ROWS}"
                 f"-maxsize{MAXSIZE}"
@@ -916,6 +925,16 @@ def main(verbose=True):
             nout=1,
             x_shape=[1, N_ROWS],
         )
+        _fleet_root = os.environ.get("SRTPU_FLEET_ROOT")
+        if _fleet_root:
+            from symbolicregression_jl_tpu.telemetry.fleet import (
+                register_run,
+            )
+
+            register_run(
+                _fleet_root, source="bench", run_id=sink.run_id,
+                telemetry_dir=tdir, attempt=_attempt,
+            )
         sink.emit(
             "tunnel_state",
             state=ACQUISITION["tunnel_state"],

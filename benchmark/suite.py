@@ -525,6 +525,101 @@ def bench_resilience():
     }]
 
 
+def bench_fleet():
+    """Fleet observability end to end (ISSUE 13): two real tiny
+    searches write telemetry into one fleet root; the fleet scanner
+    must index BOTH as healthy rows in fleet_index.json, the
+    OpenMetrics exposition of that index must pass the self-check
+    validator, and `scripts/srfleet.py --once` must exit 0 on the clean
+    fleet and nonzero after a stalled run is injected — the exit code
+    matches the alert state, which is the whole CI contract."""
+    import subprocess
+    import tempfile
+
+    import symbolicregression_jl_tpu as sr
+    from symbolicregression_jl_tpu.telemetry.export import (
+        render_openmetrics,
+        validate_exposition,
+    )
+    from symbolicregression_jl_tpu.telemetry.fleet import FleetScanner
+
+    root = tempfile.mkdtemp(prefix="srtpu_suite_fleet_")
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((3, 128)).astype(np.float32)
+    y = 2.0 * np.cos(X[2]) + X[0] ** 2 - 0.5
+    t0 = time.perf_counter()
+    for i, seed in enumerate((0, 1)):
+        sr.equation_search(
+            X, y,
+            binary_operators=["+", "-", "*"], unary_operators=["cos"],
+            npopulations=4, npop=24, ncycles_per_iteration=30,
+            maxsize=12, niterations=2, seed=seed, verbosity=0,
+            progress=False,
+            telemetry=True, telemetry_dir=os.path.join(root, f"run{i}"),
+        )
+    wall_s = time.perf_counter() - t0
+
+    index = FleetScanner(root).refresh()
+    rows = index["runs"]
+    rows_ok = len(rows) == 2 and all(
+        r["verdict"] == "healthy" for r in rows
+    )
+    text = render_openmetrics(fleet_index=index)
+    problems = validate_exposition(text)
+
+    srfleet = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "scripts", "srfleet.py",
+    )
+    run_once = lambda: subprocess.run(
+        [sys.executable, srfleet, root, "--once"],
+        capture_output=True, text=True, timeout=300,
+    ).returncode
+    rc_clean = run_once()
+    # inject a stalled run (flat best loss, collapsed diversity over
+    # more than the doctor's stall window) — the stalled_run alert must
+    # fire and flip srfleet's exit code
+    stall_dir = os.path.join(root, "stalled")
+    os.makedirs(stall_dir, exist_ok=True)
+    with open(
+        os.path.join(stall_dir, "events-stalled.jsonl"), "w"
+    ) as f:
+        ev = {"v": 1, "run": "stalled-run", "type": "run_start",
+              "t": 1.0, "run_id": "stalled-run", "attempt": 1,
+              "config_fingerprint": "x", "backend": "cpu",
+              "devices": ["TFRT_CPU_0"], "nout": 1}
+        f.write(json.dumps(ev) + "\n")
+        for i in range(8):
+            f.write(json.dumps({
+                "v": 1, "run": "stalled-run", "type": "metrics",
+                "t": 2.0 + i, "output": 0, "iteration": i,
+                "snapshot": {"counters": {}, "histograms": {},
+                             "gauges": {"best_loss": 1.0,
+                                        "population_diversity": 0.05}},
+            }) + "\n")
+        f.write(json.dumps({
+            "v": 1, "run": "stalled-run", "type": "run_end", "t": 11.0,
+            "num_evals": 100.0, "search_time_s": 10.0,
+        }) + "\n")
+    rc_alert = run_once()
+    return [{
+        "suite": "fleet",
+        "case": "two_searches_one_root",
+        "ok": (
+            rows_ok and not problems
+            and rc_clean == 0 and rc_alert != 0
+        ),
+        "index_rows": len(rows),
+        "verdicts": [r["verdict"] for r in rows],
+        "exposition_ok": not problems,
+        "exposition_problems": problems[:3],
+        "srfleet_rc_clean": rc_clean,
+        "srfleet_rc_with_stall": rc_alert,
+        "search_wall_s": wall_s,
+        "fleet_root": root,
+    }]
+
+
 def bench_multichip():
     """Multi-chip island sharding (ISSUE 9): the REAL production
     `equation_search` sharded over an 8-virtual-device (islands, rows)
@@ -948,6 +1043,16 @@ def bench_static_analysis():
         },
         {
             "suite": "static_analysis",
+            "case": "fleet_exposition",
+            "ok": (payload.get("fleet_exposition") or {}).get(
+                "ok", False
+            ),
+            "samples": (payload.get("fleet_exposition") or {}).get(
+                "samples", 0
+            ),
+        },
+        {
+            "suite": "static_analysis",
             "case": "summary",
             "ok": payload.get("ok", False),
             "rc": proc.returncode,
@@ -970,6 +1075,7 @@ _CASES = [
     (bench_run_doctor, 900),
     (bench_profile, 900),
     (bench_resilience, 900),
+    (bench_fleet, 1200),
     (bench_search_iteration, 1200),
     (bench_fitness_cache, 1200),
     (bench_precision_ratio, 1200),

@@ -16,10 +16,15 @@ regressions: a round whose value dropped more than ``--threshold``
 (default 10%) below the best earlier value captured on the SAME
 platform (a CPU-fallback round is not a regression against an on-chip
 round — the platform column keeps the comparison apples-to-apples).
-Everything is a REPORT, not a gate: scripts/lint.py prints it
-non-fatally and bench.py embeds a summary in its JSON, so a regression
-is visible the moment the artifact lands without ever blocking a
-capture.
+By default everything is a REPORT, not a gate: scripts/lint.py prints
+it non-fatally and bench.py embeds a summary in its JSON, so a
+regression is visible the moment the artifact lands without ever
+blocking a capture. ``--gate`` opts the gate in: exit 2 when the
+LATEST round regresses (any metric of the newest bench round — or the
+'latest' multichip point — more than --threshold below the best
+earlier same-platform round). Historical rounds never gate (they are
+already shipped); lint.py prints the gate's would-be verdict on every
+run so the flag is visible before anyone opts in.
 
 Tolerant by design: BENCH_r04-style records whose ``parsed`` block is
 empty fall back to scanning the step's stdout tail for the headline
@@ -28,10 +33,11 @@ scaling_efficiency yet) contribute null points, never errors.
 
 Usage:
     python scripts/bench_trajectory.py [--repo DIR] [--threshold 0.1]
-        [--no-write] [--print]
+        [--no-write] [--print] [--gate]
 
 Writes TRAJECTORY.json + TRAJECTORY.md at the repo root by default.
-Exit is always 0 unless the repo holds no rounds at all.
+Exit is 0 unless the repo holds no rounds at all (1) or --gate is set
+and the latest round regressed (2).
 """
 
 from __future__ import annotations
@@ -241,6 +247,23 @@ def detect_regressions(points, metrics=METRICS,
     return out
 
 
+def latest_round_regressions(traj):
+    """The regression entries the --gate verdict keys on: only flags on
+    the LATEST bench round (highest integer round number) or the
+    'latest'-tagged multichip point. Older rounds' flags stay a report —
+    they already shipped; the gate exists to stop the NEXT one."""
+    rounds = [
+        p.get("round") for p in traj.get("rounds", [])
+        if isinstance(p.get("round"), int)
+    ]
+    latest = max(rounds, default=None)
+    return [
+        r for r in traj.get("regressions", [])
+        if r.get("round") == "latest"
+        or (latest is not None and r.get("round") == latest)
+    ]
+
+
 def build_trajectory(repo: str = REPO,
                      threshold: float = DEFAULT_THRESHOLD):
     """Aggregate every checked-in round artifact under `repo` into the
@@ -310,7 +333,7 @@ def build_trajectory(repo: str = REPO,
                 "best": max(v for _, v in vals),
                 "best_round": max(vals, key=lambda rv: rv[1])[0],
             }
-    return {
+    traj = {
         "generated_by": "scripts/bench_trajectory.py",
         "threshold": threshold,
         "rounds": rounds,
@@ -319,6 +342,10 @@ def build_trajectory(repo: str = REPO,
         "summary": summary,
         "regressions": regressions,
     }
+    # the subset the opt-in --gate exits nonzero on (and lint.py
+    # surfaces as the gate's would-be verdict)
+    traj["latest_regressions"] = latest_round_regressions(traj)
+    return traj
 
 
 def render_markdown(traj) -> str:
@@ -438,6 +465,12 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--print", dest="do_print", action="store_true",
                     help="print the JSON payload to stdout")
+    ap.add_argument(
+        "--gate", action="store_true",
+        help="exit 2 when the LATEST round regressed more than "
+        "--threshold below the best earlier same-platform round "
+        "(opt-in: the default run stays a report, never a gate)",
+    )
     ns = ap.parse_args(argv)
 
     traj = build_trajectory(ns.repo, threshold=ns.threshold)
@@ -463,6 +496,15 @@ def main(argv=None) -> int:
             f"# regression: {r['metric']} {round_label(r['round'])} "
             f"{r['drop_frac']:.0%} below best", file=sys.stderr,
         )
+    if ns.gate and traj["latest_regressions"]:
+        mets = ", ".join(
+            f"{r['metric']} ({r['drop_frac']:.0%})"
+            for r in traj["latest_regressions"]
+        )
+        print(
+            f"# GATE: latest round regressed — {mets}", file=sys.stderr
+        )
+        return 2
     return 0
 
 

@@ -99,7 +99,10 @@ def trajectory_report() -> dict:
     the round-over-round series + regression flags, printed alongside
     the gates so a throughput/roofline/scaling drop is visible on every
     lint run — but never failing it (capture conditions, not code,
-    usually move these numbers)."""
+    usually move these numbers). `latest_regressions` is the subset the
+    opt-in `bench_trajectory.py --gate` would exit nonzero on — printed
+    here as the gate's would-be verdict so the flag is visible on every
+    lint run before anyone opts in."""
     try:
         sys.path.insert(0, os.path.join(REPO, "scripts"))
         from bench_trajectory import build_trajectory
@@ -108,9 +111,45 @@ def trajectory_report() -> dict:
         return {
             "rounds": len(traj["rounds"]),
             "regressions": traj["regressions"],
+            "latest_regressions": traj["latest_regressions"],
         }
     except Exception as e:  # pragma: no cover - defensive
         return {"error": f"{type(e).__name__}: {e}"}
+
+
+def check_fleet_exposition() -> dict:
+    """Fleet OpenMetrics gate (ISSUE 13): the checked-in fleet-index
+    fixture (tests/data/telemetry/golden_fleet_index.json — captured
+    from a real two-search + supervised-fault fleet) must render to a
+    text exposition that passes telemetry/export.py's self-check
+    validator — so the index schema, the renderer, and the validator
+    cannot drift apart without CI noticing (the scrape path has no
+    Prometheus binary in this container to notice for us)."""
+    from symbolicregression_jl_tpu.telemetry.export import (
+        render_openmetrics,
+        validate_exposition,
+    )
+
+    fixture = os.path.join(
+        REPO, "tests", "data", "telemetry", "golden_fleet_index.json"
+    )
+    try:
+        with open(fixture) as f:
+            index = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"ok": False, "samples": 0,
+                "detail": f"fixture unreadable: {e}"}
+    text = render_openmetrics(fleet_index=index)
+    problems = validate_exposition(text)
+    samples = sum(
+        1 for line in text.splitlines()
+        if line and not line.startswith("#")
+    )
+    detail = problems[0] if problems else ""
+    if not problems and not index.get("runs"):
+        problems = ["fixture index has no runs"]
+        detail = problems[0]
+    return {"ok": not problems, "samples": samples, "detail": detail}
 
 
 def check_docs() -> dict:
@@ -170,18 +209,24 @@ def main(argv=None) -> int:
         None if (ns.skip_telemetry_schema or ns.only is not None)
         else check_telemetry_schema()
     )
+    fleet = (
+        None if (ns.skip_telemetry_schema or ns.only is not None)
+        else check_fleet_exposition()
+    )
     # non-fatal: the bench trajectory is a report, never a gate
     trajectory = None if ns.only is not None else trajectory_report()
     ok = (
         report.ok
         and (docs is None or docs["api_reference_current"])
         and (telemetry is None or telemetry["ok"])
+        and (fleet is None or fleet["ok"])
     )
 
     if ns.format == "json":
         payload = report.to_dict()
         payload["docs"] = docs
         payload["telemetry_schema"] = telemetry
+        payload["fleet_exposition"] = fleet
         payload["trajectory"] = trajectory
         payload["ok"] = ok
         print(json.dumps(payload, indent=2, sort_keys=True))
@@ -200,6 +245,12 @@ def main(argv=None) -> int:
                 else f"INVALID ({telemetry['detail']})"
             )
             print(f"telemetry golden fixture: {state}")
+        if fleet is not None:
+            state = (
+                f"valid ({fleet['samples']} samples)" if fleet["ok"]
+                else f"INVALID ({fleet['detail']})"
+            )
+            print(f"fleet OpenMetrics exposition: {state}")
         if trajectory is not None and "error" not in trajectory:
             n_reg = len(trajectory["regressions"])
             print(
@@ -214,6 +265,15 @@ def main(argv=None) -> int:
                     f"  - {r['metric']} {lab} [{r['platform']}]: "
                     f"{r['drop_frac']:.0%} below best earlier round"
                 )
+            latest = trajectory.get("latest_regressions") or []
+            print(
+                "  gate (bench_trajectory --gate, opt-in): "
+                + (
+                    "latest round REGRESSED — "
+                    + ", ".join(r["metric"] for r in latest)
+                    if latest else "latest round clean"
+                )
+            )
     return 0 if ok else 1
 
 

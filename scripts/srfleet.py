@@ -1,0 +1,236 @@
+#!/usr/bin/env python
+"""srfleet — live terminal dashboard over a FLEET of telemetry runs.
+
+The multi-run sibling of srtop: point it at a fleet root (the directory
+the watcher/supervisor/suite/bench write their event logs under — e.g.
+whatever ``SRTPU_BENCH_TELEMETRY_DIR`` points at) and it renders,
+refreshing in place, one line per live/recent run:
+
+* the fleet header — run count, verdict histogram, fault rate,
+  aggregate trees-rows/s, alerts firing;
+* per run: run_id, doctor verdict, supervisor attempt, last-event age
+  (the liveness signal), backend, best loss, eval throughput, the
+  dominant stage of its wall-time split, and any alert rules firing
+  for it;
+* the firing-alert tail (rule, severity, message).
+
+Every frame is one ``FleetScanner.refresh()``: logs are tailed
+incrementally (srtop's byte-offset discipline — a frame costs only the
+new bytes), ``fleet_index.json`` is atomically rewritten, and each
+NEWLY-firing alert is appended to ``fleet_alerts.jsonl`` as a schema-v1
+``alert`` event. The dashboard never modifies any run's own log.
+
+Usage:
+    python scripts/srfleet.py FLEET_ROOT [--interval 5] [--once]
+        [--stall-after 600] [--threshold 0.1] [--trajectory PATH]
+        [--metrics-out FILE]
+
+``--once`` renders a single frame and exits — the CI gate: exit status
+is 0 iff NO alert rule at ``--fail-on`` severity or above fires
+(default ``warning`` — ``info`` notes like ``compile_bound`` on a
+cold-start smoke run report without failing), so
+``srfleet.py ROOT --once`` gates a pipeline on fleet health the same
+way ``srtop.py DIR --once`` gates on one run's. ``--trajectory`` opts
+the same-platform throughput-regression rule in (pass the repo's
+TRAJECTORY.json); ``--metrics-out`` additionally writes the OpenMetrics
+exposition of every frame atomically to FILE for a node-exporter-style
+textfile collector (serving an HTTP ``/metrics`` endpoint instead is
+``telemetry.export.serve_metrics`` — one call from any driver).
+
+Curses-free like srtop: ANSI rewind-and-redraw on TTYs, plain append
+when piped. The package import pins ``JAX_PLATFORMS=cpu`` first (the
+fleet layer is host-side file reading, but the package import must not
+route backend init at a TPU tunnel).
+"""
+
+from __future__ import annotations
+
+import argparse
+import math
+import os
+import sys
+import time
+
+# the fleet layer is host-side only, but importing the package pulls
+# jax — pin CPU before anything backend-shaped can initialize (srtop's
+# --once gate does the same)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def fmt(v, spec=".3g"):
+    if isinstance(v, (int, float)) and not isinstance(v, bool) \
+            and math.isfinite(v):
+        return format(v, spec)
+    return "-"
+
+
+def _age_s(v):
+    if v is None:
+        return "-"
+    if v < 120:
+        return f"{v:.0f}s"
+    if v < 7200:
+        return f"{v / 60:.1f}m"
+    return f"{v / 3600:.1f}h"
+
+
+def render_frame(index) -> str:
+    """One dashboard frame from one fleet index dict."""
+    rollup = index.get("rollup", {}) or {}
+    rows = index.get("runs", [])
+    alerts = index.get("alerts", [])
+    L = []
+    verd = rollup.get("verdicts") or {}
+    verd_s = " ".join(f"{k}:{v}" for k, v in sorted(verd.items()))
+    L.append(
+        f"srfleet — {index.get('root')}   runs: {rollup.get('runs', 0)}"
+        + (f" ({verd_s})" if verd_s else "")
+    )
+    agg = rollup.get("throughput_trees_rows_per_s")
+    bits = [
+        f"alerts firing: {rollup.get('alerts_firing', 0)}",
+        f"fault rate: {fmt(rollup.get('fault_rate'), '.0%')}",
+    ]
+    if rollup.get("resume_success_rate") is not None:
+        bits.append(
+            f"resume success: {fmt(rollup['resume_success_rate'], '.0%')}"
+        )
+    if agg is not None:
+        bits.append(f"agg eval t-r/s: {fmt(agg, '.3g')}")
+    if rollup.get("stale_runs"):
+        bits.append(f"stale: {rollup['stale_runs']}")
+    if rollup.get("pending_runs"):
+        bits.append(f"pending: {rollup['pending_runs']}")
+    L.append("   ".join(bits))
+    if rows:
+        L.append(
+            f"{'run_id':<18} {'verdict':<10} {'att':>3} {'age':>6} "
+            f"{'backend':<7} {'best':>9} {'t-r/s':>9} "
+            f"{'top stage':<18} alerts"
+        )
+    for row in rows:
+        shares = row.get("stage_shares") or {}
+        top = max(shares.items(), key=lambda kv: kv[1])[0] if shares \
+            else None
+        top_s = f"{top} {shares[top]:.0%}" if top else "-"
+        if row.get("compile_bound"):
+            top_s += " [compile!]"
+        resumed = "+r" if row.get("resumed") else ""
+        L.append(
+            f"{str(row.get('run_id'))[:18]:<18} "
+            f"{str(row.get('verdict')):<10} "
+            f"{str(row.get('attempt', 1)) + resumed:>3} "
+            f"{_age_s(row.get('last_event_age_s')):>6} "
+            f"{str(row.get('backend') or '-'):<7} "
+            f"{fmt(row.get('best_loss')):>9} "
+            f"{fmt(row.get('throughput_trees_rows_per_s')):>9} "
+            f"{top_s:<18} "
+            + (",".join(row.get("alerts") or []) or "-")
+        )
+    if alerts:
+        L.append("alerts:")
+        for a in alerts:
+            L.append(
+                f"  [{a['severity']}] {a['rule']} "
+                f"run {str(a.get('run_id'))[:18]}: {a['message']}"
+            )
+    return "\n".join(L)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument(
+        "root",
+        help="fleet root: every events-*.jsonl under it (recursively) "
+        "is one run",
+    )
+    ap.add_argument("--interval", type=float, default=5.0)
+    ap.add_argument(
+        "--once", action="store_true",
+        help="render one frame and exit; exit 0 iff no alert at "
+        "--fail-on severity or above fires (the CI gate)",
+    )
+    ap.add_argument(
+        "--fail-on", choices=("info", "warning", "critical"),
+        default="warning",
+        help="minimum alert severity that flips the --once exit code "
+        "(default warning: info notes never fail the gate)",
+    )
+    ap.add_argument(
+        "--stall-after", type=float, default=None, metavar="SECONDS",
+        help="last-event age past which an in-flight run alerts as "
+        "stale (default 600)",
+    )
+    ap.add_argument(
+        "--threshold", type=float, default=0.10,
+        help="throughput-regression fraction vs the trajectory's best "
+        "same-platform round (with --trajectory)",
+    )
+    ap.add_argument(
+        "--trajectory", default=None, metavar="TRAJECTORY_JSON",
+        help="opt the throughput-regression rule in against this "
+        "TRAJECTORY.json",
+    )
+    ap.add_argument(
+        "--metrics-out", default=None, metavar="FILE",
+        help="also write the OpenMetrics exposition of each frame "
+        "atomically to FILE (textfile-collector handoff)",
+    )
+    ns = ap.parse_args(argv)
+
+    from symbolicregression_jl_tpu.telemetry.fleet import (
+        STALE_AFTER_S,
+        FleetScanner,
+    )
+
+    trajectory = None
+    if ns.trajectory:
+        import json
+
+        with open(ns.trajectory) as f:
+            trajectory = json.load(f)
+    scanner = FleetScanner(
+        ns.root,
+        stale_after_s=(
+            STALE_AFTER_S if ns.stall_after is None else ns.stall_after
+        ),
+        trajectory=trajectory,
+        regression_threshold=ns.threshold,
+    )
+    last_lines = 0
+    try:
+        while True:
+            index = scanner.refresh()
+            frame = render_frame(index)
+            if ns.metrics_out:
+                from symbolicregression_jl_tpu.telemetry.export import (
+                    render_openmetrics,
+                    write_textfile,
+                )
+
+                write_textfile(
+                    ns.metrics_out, render_openmetrics(fleet_index=index)
+                )
+            if last_lines and sys.stdout.isatty():
+                sys.stdout.write(f"\x1b[{last_lines}F\x1b[0J")
+            sys.stdout.write(frame + "\n")
+            sys.stdout.flush()
+            last_lines = frame.count("\n") + 1
+            if ns.once:
+                rank = {"info": 0, "warning": 1, "critical": 2}
+                firing = [
+                    a for a in index.get("alerts", [])
+                    if rank.get(a.get("severity"), 2)
+                    >= rank[ns.fail_on]
+                ]
+                return 1 if firing else 0
+            time.sleep(ns.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,8 +59,23 @@ the step's attempt counter (real progress must never exhaust
 MAX_ATTEMPTS), while a resumable retry with no new progress keeps the
 decrement (crash loops still terminate).
 
+Fleet observability (ISSUE 13, docs/observability.md "Fleet"): with a
+telemetry dir the watcher also acts as a fleet producer — the fleet
+root (``--fleet-root``, default: the telemetry dir itself) is exported
+to steps as ``SRTPU_FLEET_ROOT`` (so supervised searches register
+themselves), each step is registered into the root's
+``fleet_registry.jsonl`` before it runs (one strict-JSON line, written
+inline — the watcher must never import the package: importing jax at a
+flapping tunnel is exactly what its subprocess probes guard against;
+the line format is the compatibility contract documented in
+``telemetry/fleet.py::register_run``), and the step's attempt counter
+is exported as ``SRTPU_RUN_ATTEMPT`` so every search the step launches
+stamps the additive ``attempt`` field into its ``run_start`` — fleet
+joins by (run_id, attempt), not filename inference. Watch the whole
+root live with ``python scripts/srfleet.py <dir>``.
+
 Usage:  python scripts/tpu_watcher.py [--poll SECONDS] [--fresh]
-            [--telemetry-dir DIR] [--snapshot-dir DIR]
+            [--telemetry-dir DIR] [--snapshot-dir DIR] [--fleet-root DIR]
 """
 
 from __future__ import annotations
@@ -85,6 +100,11 @@ TELEMETRY_DIR = None
 # exported to steps as SRTPU_BENCH_SNAPSHOT_DIR so search-state
 # snapshots survive attempts and a resumable retry actually resumes
 SNAPSHOT_DIR = None
+
+# set by main() from --fleet-root (default: the telemetry dir): the
+# fleet-index root steps are registered into and srfleet watches;
+# exported to steps as SRTPU_FLEET_ROOT
+FLEET_ROOT = None
 
 # Round-5 order (VERDICT r4 #1/#2/#3): after the ONE short canary, the
 # scale-fault bisect runs FIRST — the 64x1000 northstar iteration has
@@ -299,12 +319,45 @@ def read_telemetry_verdict(telemetry_dir, since_ts=0.0):
     return out
 
 
-def run_step(name, argv, timeout, extra_env):
+def register_fleet_step(name, attempt):
+    """Announce this step into the fleet root's registry so the fleet
+    index (telemetry/fleet.py, srfleet) sees it as launched even before
+    it writes any event log. Written INLINE — one strict-JSON line in
+    register_run's documented key format — because the watcher must
+    never import the package (jax init at a flapping tunnel). Never
+    fatal: observability must not block the capture."""
+    if not FLEET_ROOT:
+        return
+    try:
+        os.makedirs(FLEET_ROOT, exist_ok=True)
+        line = json.dumps({
+            "t": time.time(),
+            "source": f"watcher:{name}",
+            "run_id": None,  # steps launch many searches; no single id
+            "telemetry_dir": TELEMETRY_DIR,
+            "attempt": attempt,
+        })
+        with open(
+            os.path.join(FLEET_ROOT, "fleet_registry.jsonl"), "a"
+        ) as f:
+            f.write(line + "\n")
+    except (OSError, ValueError):
+        pass
+
+
+def run_step(name, argv, timeout, extra_env, attempt=1):
     env = dict(os.environ)
     if TELEMETRY_DIR:
         # every step's telemetry lands in one place; the verdict reader
         # below picks up only the logs this step wrote (mtime >= t0)
         env["SRTPU_BENCH_TELEMETRY_DIR"] = TELEMETRY_DIR
+    if FLEET_ROOT:
+        # steps (and the supervised searches inside them) register into
+        # and stamp provenance for the same fleet root srfleet watches
+        env["SRTPU_FLEET_ROOT"] = FLEET_ROOT
+    # the step's retry counter becomes every launched search's additive
+    # run_start `attempt` field (fleet joins are exact, not inferred)
+    env["SRTPU_RUN_ATTEMPT"] = str(max(1, int(attempt)))
     if SNAPSHOT_DIR:
         # snapshots persist ACROSS attempts in one place, so a retry of
         # a resumable step finds the previous attempt's newest snapshot
@@ -533,7 +586,7 @@ def compute_resume_state(results):
 
 
 def main():
-    global TELEMETRY_DIR, SNAPSHOT_DIR
+    global TELEMETRY_DIR, SNAPSHOT_DIR, FLEET_ROOT
     poll = 120
     if "--poll" in sys.argv:
         poll = int(sys.argv[sys.argv.index("--poll") + 1])
@@ -546,6 +599,13 @@ def main():
         # default: snapshots live beside the telemetry they classify,
         # persisting across attempts so resumable retries resume
         SNAPSHOT_DIR = os.path.join(TELEMETRY_DIR, "snapshots")
+    if "--fleet-root" in sys.argv:
+        FLEET_ROOT = sys.argv[sys.argv.index("--fleet-root") + 1]
+    elif TELEMETRY_DIR:
+        # default: the telemetry dir IS the fleet root — every step's
+        # event logs already land under it, so the fleet index and the
+        # registry live next to the trails they describe
+        FLEET_ROOT = TELEMETRY_DIR
 
     results = {}
     first_captured_at = None
@@ -604,7 +664,11 @@ def main():
                 attempts[name] = attempts.get(name, 0) + 1
                 log(f"step {name} (attempt {attempts[name]}): "
                     f"{' '.join(argv)}")
-                rec = run_step(name, argv, timeout, extra_env)
+                register_fleet_step(name, attempts[name])
+                rec = run_step(
+                    name, argv, timeout, extra_env,
+                    attempt=attempts[name],
+                )
                 on_chip = step_on_chip(name, rec)
                 ok = on_chip and rec["rc"] == 0 and not rec["timed_out"]
                 rec["on_chip"] = on_chip

@@ -96,7 +96,13 @@ from .telemetry import (
 
 def __getattr__(name):
     if name in ("analyze_run", "compare_runs", "profile_report",
-                "device_peaks"):
+                "device_peaks",
+                # fleet layer (telemetry/fleet.py, alerts.py,
+                # export.py — docs/observability.md "Fleet")
+                "FleetScanner", "register_run", "AlertRule",
+                "DEFAULT_ALERT_RULES", "evaluate_alerts",
+                "render_openmetrics", "validate_exposition",
+                "write_textfile", "serve_metrics"):
         from . import telemetry
 
         return getattr(telemetry, name)
@@ -201,4 +207,13 @@ __all__ = [
     "open_event_log",
     "profile_report",
     "validate_events_file",
+    "AlertRule",
+    "DEFAULT_ALERT_RULES",
+    "FleetScanner",
+    "evaluate_alerts",
+    "register_run",
+    "render_openmetrics",
+    "serve_metrics",
+    "validate_exposition",
+    "write_textfile",
 ]

@@ -997,8 +997,24 @@ def equation_search(
             ).encode()
         ).hexdigest()[:16]
         sink = open_event_log(options.telemetry_dir)
+        # fleet provenance (additive schema fields): the stable logical
+        # run id the fleet index joins attempts on (the supervisor
+        # threads one id through every attempt; standalone runs default
+        # to this log's own id) and the 1-based attempt index (the
+        # watcher exports SRTPU_RUN_ATTEMPT into retried steps)
+        if options.telemetry_attempt is not None:
+            run_attempt = int(options.telemetry_attempt)
+        else:
+            try:
+                run_attempt = max(
+                    1, int(os.environ.get("SRTPU_RUN_ATTEMPT", "1"))
+                )
+            except ValueError:
+                run_attempt = 1
         sink.emit(
             "run_start",
+            run_id=options.telemetry_run_id or sink.run_id,
+            attempt=run_attempt,
             config_fingerprint=fingerprint,
             backend=jax.default_backend(),
             devices=[str(d) for d in jax.devices()],

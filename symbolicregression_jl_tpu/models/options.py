@@ -232,6 +232,19 @@ class Options:
     # Emit a metrics snapshot every k-th iteration (spans and lifecycle
     # events are always emitted); 1 = every iteration.
     telemetry_every: int = 1
+    # Stable logical run id stamped into the run_start event (additive
+    # `run_id` schema field) — the fleet layer's join key
+    # (docs/observability.md "Fleet"). The resilience supervisor
+    # threads ONE id through every attempt of a supervised run, so the
+    # fleet index collapses the attempt trail into a single row instead
+    # of inferring lineage from filenames. None (default) = the event
+    # log's own id. Orchestration-only: absent from _graph_key.
+    telemetry_run_id: Optional[str] = None
+    # 1-based supervisor attempt index stamped into run_start (additive
+    # `attempt` field). None = take SRTPU_RUN_ATTEMPT from the
+    # environment (the TPU watcher exports it into retried steps),
+    # defaulting to 1. Orchestration-only.
+    telemetry_attempt: Optional[int] = None
     # Capture a jax.profiler (XLA/Perfetto) trace of the whole search
     # into this directory (view with `tensorboard --logdir DIR`). The
     # telemetry spans' `srtpu/<stage>` annotations appear on the traced
@@ -449,6 +462,8 @@ class Options:
             raise ValueError("cache_capacity must be >= 1")
         if self.telemetry_every < 1:
             raise ValueError("telemetry_every must be >= 1")
+        if self.telemetry_attempt is not None and self.telemetry_attempt < 1:
+            raise ValueError("telemetry_attempt must be >= 1 (1-based)")
         if self.snapshot_every_dispatches < 0:
             raise ValueError("snapshot_every_dispatches must be >= 0")
         if self.snapshot_path and self.snapshot_every_dispatches == 0:

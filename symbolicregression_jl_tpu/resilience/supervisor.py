@@ -66,6 +66,10 @@ class SupervisedResult:
     #: one entry per FAILED attempt: {"attempt", "error_type", "error",
     #: "verdict", "resumable", "resumed_from_iteration", "backoff_s"}
     history: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
+    #: the stable logical run id threaded through every attempt's
+    #: run_start (the fleet index's join key — docs/observability.md
+    #: "Fleet"); None only on pre-fleet results
+    run_id: Optional[str] = None
 
 
 def backoff_s(
@@ -132,6 +136,7 @@ def supervised_search(
     backoff_jitter: float = 0.25,
     sleep_fn: Callable[[float], None] = time.sleep,
     rng: Optional[random.Random] = None,
+    fleet_root: Optional[str] = None,
     **search_kwargs,
 ) -> SupervisedResult:
     """Run ``equation_search(X, y, niterations=..., **search_kwargs)``
@@ -146,7 +151,18 @@ def supervised_search(
     option kwargs, plus ``return_state``/``weights``/...). The snapshot
     knobs are forced into the Options; ``saved_state`` is owned by the
     supervisor and may not be passed. Raises the last failure when
-    ``max_attempts`` is exhausted."""
+    ``max_attempts`` is exhausted.
+
+    Fleet provenance (docs/observability.md "Fleet"): one stable
+    ``run_id`` is generated per supervised run and threaded — with the
+    1-based attempt index — through every attempt's Options, so each
+    attempt's ``run_start`` event carries it and the fleet index
+    collapses the whole resumable->resumed trail into ONE row. With a
+    ``fleet_root`` (or ``SRTPU_FLEET_ROOT`` in the environment) the run
+    is also registered into ``<fleet_root>/fleet_registry.jsonl`` before
+    the first attempt, so the fleet sees it even before any event log
+    exists. Purely host-side file writes: the hall of fame is
+    bit-identical with registration on or off."""
     if "saved_state" in search_kwargs:
         raise ValueError(
             "supervised_search owns saved_state (it resumes from "
@@ -177,6 +193,25 @@ def supervised_search(
     telemetry_dir = (
         (options.telemetry_dir or ".") if options.telemetry else None
     )
+
+    # one stable logical run id for ALL attempts: the fleet join key
+    # (each attempt's run_start carries run_id + its attempt index)
+    import uuid
+
+    run_id = options.telemetry_run_id or uuid.uuid4().hex[:16]
+    fleet_root = fleet_root or os.environ.get("SRTPU_FLEET_ROOT") or None
+    if fleet_root:
+        from ..telemetry.fleet import register_run
+
+        register_run(
+            fleet_root,
+            source="supervisor",
+            run_id=run_id,
+            telemetry_dir=telemetry_dir,
+            snapshot_path=snapshot_path,
+            niterations=niterations,
+            max_attempts=max_attempts,
+        )
 
     history: List[Dict[str, Any]] = []
     resumes = 0
@@ -213,7 +248,16 @@ def supervised_search(
         t_attempt = time.time()
         try:
             result = equation_search(
-                X, y, options=options, niterations=remaining,
+                X, y,
+                # the fleet provenance rides the Options (orchestration
+                # only — _graph_key unchanged, no recompiles): this
+                # attempt's run_start carries (run_id, attempt)
+                options=dataclasses.replace(
+                    options,
+                    telemetry_run_id=run_id,
+                    telemetry_attempt=attempt,
+                ),
+                niterations=remaining,
                 saved_state=saved, **search_only,
             )
             return SupervisedResult(
@@ -221,6 +265,7 @@ def supervised_search(
                 attempts=attempt,
                 resumes=resumes,
                 history=history,
+                run_id=run_id,
             )
         except Exception as e:
             entry: Dict[str, Any] = {

@@ -27,13 +27,23 @@ from .metrics import (
 )
 from .spans import STAGES, Span, SpanRecorder
 
-# The run doctor (analyze.py) and the srprof profiler (profile.py) are
-# exported LAZILY (PEP 562): importing either during package init would
-# put the module in sys.modules before runpy executes its documented
-# CLI (`python -m ...telemetry.analyze` / `...telemetry.profile`),
-# tripping the double-import RuntimeWarning on every invocation.
+# The run doctor (analyze.py), the srprof profiler (profile.py), and
+# the fleet layer (fleet.py / alerts.py / export.py) are exported
+# LAZILY (PEP 562): importing any of them during package init would put
+# the module in sys.modules before runpy executes its documented CLI
+# (`python -m ...telemetry.analyze` / `...telemetry.profile`), tripping
+# the double-import RuntimeWarning on every invocation — and the fleet
+# layer is pure host-side file reading most runs never touch.
 _ANALYZE_EXPORTS = ("VERDICTS", "analyze_run", "compare_runs")
 _PROFILE_EXPORTS = ("device_peaks", "profile_report", "roofline_join")
+_FLEET_EXPORTS = ("FleetScanner", "register_run", "load_fleet_index")
+_ALERT_EXPORTS = ("AlertRule", "DEFAULT_ALERT_RULES", "evaluate_alerts")
+_EXPORTER_EXPORTS = (
+    "render_openmetrics",
+    "validate_exposition",
+    "write_textfile",
+    "serve_metrics",
+)
 
 
 def __getattr__(name):
@@ -45,6 +55,18 @@ def __getattr__(name):
         from . import profile
 
         return getattr(profile, name)
+    if name in _FLEET_EXPORTS:
+        from . import fleet
+
+        return getattr(fleet, name)
+    if name in _ALERT_EXPORTS:
+        from . import alerts
+
+        return getattr(alerts, name)
+    if name in _EXPORTER_EXPORTS:
+        from . import export
+
+        return getattr(export, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}"
     )
@@ -54,8 +76,11 @@ __all__ = [
     "STAGES",
     "SCHEMA_VERSION",
     "VERDICTS",
+    "AlertRule",
     "Counter",
+    "DEFAULT_ALERT_RULES",
     "EventLog",
+    "FleetScanner",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
@@ -65,10 +90,17 @@ __all__ = [
     "analyze_run",
     "compare_runs",
     "device_peaks",
+    "evaluate_alerts",
     "hypervolume_2d",
+    "load_fleet_index",
     "open_event_log",
     "profile_report",
+    "register_run",
+    "render_openmetrics",
     "roofline_join",
+    "serve_metrics",
     "validate_event",
     "validate_events_file",
+    "validate_exposition",
+    "write_textfile",
 ]

@@ -1,0 +1,241 @@
+"""Declarative alert rules over the fleet index rows.
+
+The fleet scanner (:mod:`.fleet`) turns many event logs into index
+rows; this module is the policy layer over them: a small vocabulary of
+threshold rules, each a pure function of ``(row, ctx)``, evaluated on
+every refresh. A firing rule yields one alert dict; the scanner appends
+each NEW firing to the alerts log as a schema-v1 ``alert`` event and
+``scripts/srfleet.py --once`` exits nonzero iff any rule fires — the
+CI/pager form of "is the fleet healthy?".
+
+Rule vocabulary (:data:`DEFAULT_ALERT_RULES`, docs/observability.md
+"Fleet"):
+
+* ``stalled_run`` — the run doctor read the run as ``stalled``
+  (best-loss plateau with collapsed diversity): its islands are burning
+  compute that will not help;
+* ``diverging_run`` — doctor verdict ``diverging`` (NaN/Inf flood);
+* ``fault_unresumable`` — a ``dispatch_fault`` with NO ``saved_state``
+  to resume from: work is actually lost, not just interrupted (the
+  resumable complement is the supervisor's normal recovery path and
+  does not alert);
+* ``stale_run`` — an in-flight run whose last event is older than
+  ``ctx["stale_after_s"]`` (default 600 s): either the process is dead
+  (killed without a fault event — the line-buffered log just stops) or
+  it is wedged on a hung tunnel; both need a human or the supervisor;
+* ``compile_bound`` — the doctor's compile-share flag (> 50% of
+  measured wall in first-dispatch compilation): warm the compilation
+  cache before trusting any timing from this run. Severity ``info``, a
+  note rather than a page: every cold-start smoke run is legitimately
+  compile-bound, and srfleet's ``--once`` gate only fails at
+  ``--fail-on`` severity or above (default ``warning``);
+* ``throughput_regression`` — the run's eval-stage trees-rows/s sits
+  more than ``ctx["regression_threshold"]`` (default 10%) below the
+  best SAME-PLATFORM round in ``ctx["trajectory"]`` (a TRAJECTORY.json
+  payload). Opt-in: it only evaluates when a trajectory is supplied
+  (``srfleet --trajectory``) — tiny smoke searches would otherwise
+  drown the fleet in false regressions.
+
+Severities: ``critical`` (work lost / wasted), ``warning`` (needs a
+look), ``info`` (a note). Custom policies pass their own rule tuple to
+:class:`..fleet.FleetScanner` — a rule is just
+``AlertRule(name, severity, description, check)``.
+
+Host-side only; no jax import.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """One declarative rule: ``check(row, ctx)`` returns None (not
+    firing) or a dict with at least ``message`` (optionally ``value`` /
+    ``threshold`` for the exposition and the alert event)."""
+
+    name: str
+    severity: str  # "critical" | "warning" | "info"
+    description: str
+    check: Callable[[Dict[str, Any], Dict[str, Any]], Optional[dict]]
+
+
+def _stalled(row, ctx):
+    if row.get("verdict") == "stalled":
+        return {"message": "; ".join(row.get("reasons") or ["stalled"])}
+    return None
+
+
+def _diverging(row, ctx):
+    if row.get("verdict") == "diverging":
+        return {"message": "; ".join(row.get("reasons") or ["diverging"])}
+    return None
+
+
+def _fault_unresumable(row, ctx):
+    if row.get("verdict") == "faulted" and not row.get("resumable"):
+        return {
+            "message": (
+                f"{row.get('faults', 0)} fault(s) with no saved_state "
+                "to resume from — work lost"
+            ),
+            "value": float(row.get("faults") or 0),
+        }
+    return None
+
+
+def _stale(row, ctx):
+    age = row.get("last_event_age_s")
+    limit = float(ctx.get("stale_after_s") or 0.0)
+    if (
+        row.get("verdict") == "incomplete"
+        and age is not None
+        and limit > 0
+        and age > limit
+    ):
+        return {
+            "message": (
+                f"in-flight run silent for {age:.0f}s "
+                f"(> {limit:.0f}s): dead or wedged"
+            ),
+            "value": age,
+            "threshold": limit,
+        }
+    return None
+
+
+def _compile_bound(row, ctx):
+    if row.get("compile_bound"):
+        share = row.get("compile_share")
+        return {
+            "message": (
+                f"{(share or 0.0):.0%} of measured wall went to "
+                "first-dispatch compilation — warm the cache before "
+                "reading timings"
+            ),
+            "value": share,
+            "threshold": 0.5,
+        }
+    return None
+
+
+def trajectory_best_throughput(trajectory: Optional[dict]) -> Dict[str, float]:
+    """Best recorded trees-rows/s per platform from a TRAJECTORY.json
+    payload (scripts/bench_trajectory.py schema) — the regression bar
+    the ``throughput_regression`` rule compares against."""
+    best: Dict[str, float] = {}
+    if not isinstance(trajectory, dict):
+        return best
+    for p in trajectory.get("series", {}).get("throughput", []):
+        v, plat = p.get("value"), p.get("platform")
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and isinstance(plat, str):
+            if plat not in best or v > best[plat]:
+                best[plat] = float(v)
+    return best
+
+
+def _throughput_regression(row, ctx):
+    best = trajectory_best_throughput(ctx.get("trajectory"))
+    plat = row.get("backend")
+    tp = row.get("throughput_trees_rows_per_s")
+    thr = float(ctx.get("regression_threshold") or 0.10)
+    bar = best.get(plat)
+    if bar and tp is not None and tp < bar * (1.0 - thr):
+        return {
+            "message": (
+                f"eval throughput {tp:.3g} trees-rows/s is "
+                f"{1.0 - tp / bar:.0%} below the best {plat} round's "
+                f"{bar:.3g} (threshold {thr:.0%})"
+            ),
+            "value": tp,
+            "threshold": bar * (1.0 - thr),
+        }
+    return None
+
+
+DEFAULT_ALERT_RULES: Sequence[AlertRule] = (
+    AlertRule(
+        "fault_unresumable", "critical",
+        "dispatch_fault with no saved_state in the trail — work lost",
+        _fault_unresumable,
+    ),
+    AlertRule(
+        "diverging_run", "critical",
+        "run doctor verdict diverging (NaN/Inf flood)",
+        _diverging,
+    ),
+    AlertRule(
+        "stalled_run", "warning",
+        "run doctor verdict stalled (plateau + diversity collapse)",
+        _stalled,
+    ),
+    AlertRule(
+        "stale_run", "warning",
+        "in-flight run with no events for stale_after_s seconds",
+        _stale,
+    ),
+    AlertRule(
+        "compile_bound", "info",
+        "more than half the measured wall time was compilation "
+        "(every cold-start smoke run trips this — info, not a page)",
+        _compile_bound,
+    ),
+    AlertRule(
+        "throughput_regression", "warning",
+        "eval throughput below the best same-platform trajectory round "
+        "(requires a trajectory in ctx)",
+        _throughput_regression,
+    ),
+)
+
+
+def evaluate_alerts(
+    rows: Sequence[Dict[str, Any]],
+    ctx: Dict[str, Any],
+    rules: Optional[Sequence[AlertRule]] = None,
+) -> List[dict]:
+    """Evaluate every rule against every row (``rules=None`` means
+    :data:`DEFAULT_ALERT_RULES`). Returns the firing alerts
+    (severity-major order: critical first, then by rule/run for a
+    stable rendering). A rule that raises is reported as an alert about
+    ITSELF (``rule_error``) rather than silently skipped — a broken
+    pager rule is an outage of the pager."""
+    if rules is None:
+        rules = DEFAULT_ALERT_RULES
+    sev_rank = {"critical": 0, "warning": 1, "info": 2}
+    out: List[dict] = []
+    for row in rows:
+        for rule in rules:
+            try:
+                hit = rule.check(row, ctx)
+            except Exception as e:
+                hit = {
+                    "message": (
+                        f"alert rule {rule.name!r} raised "
+                        f"{type(e).__name__}: {e}"
+                    ),
+                }
+                out.append({
+                    "rule": "rule_error",
+                    "severity": "warning",
+                    "run_id": row.get("run_id"),
+                    **hit,
+                })
+                continue
+            if hit is None:
+                continue
+            out.append({
+                "rule": rule.name,
+                "severity": rule.severity,
+                "run_id": row.get("run_id"),
+                "message": hit.get("message", rule.description),
+                "value": hit.get("value"),
+                "threshold": hit.get("threshold"),
+            })
+    out.sort(key=lambda a: (
+        sev_rank.get(a["severity"], 3), a["rule"], str(a["run_id"])
+    ))
+    return out

@@ -187,6 +187,10 @@ def analyze_run(
         for k in (
             "run", "config_fingerprint", "backend", "niterations",
             "nout", "mesh_shape", "n_devices", "device_kind",
+            # fleet provenance (ISSUE 13): the stable logical run id
+            # the fleet index joins a supervised run's attempts on,
+            # and this log's 1-based attempt index
+            "run_id", "attempt",
             # resilience provenance (ISSUE 11): snapshot cadence and,
             # on a resumed run, where its saved_state came from
             "snapshot", "resume_from",

@@ -1,0 +1,465 @@
+"""OpenMetrics/Prometheus text exposition of the telemetry state.
+
+The metrics registry (:class:`..metrics.MetricsRegistry`) and the fleet
+index (:mod:`.fleet`) are JSON-shaped; the monitoring world scrapes the
+Prometheus text exposition format. This module renders both into one
+exposition, two delivery paths:
+
+* **textfile** — :func:`write_textfile`: atomic (temp + ``os.replace``)
+  write for node-exporter-style textfile collectors; the write
+  self-checks through :func:`validate_exposition` first, so a malformed
+  exposition can never land on disk;
+* **HTTP** — :func:`serve_metrics`: an optional stdlib-only
+  ``http.server`` ``/metrics`` endpoint (background thread, ephemeral
+  port by default) for direct Prometheus scrapes — no third-party
+  dependency, matching the container constraint.
+
+Rendering rules (the subset of the format the validator then enforces):
+one ``# TYPE`` (and optional ``# HELP``) line per family before its
+samples; counters named ``*_total``; histograms as cumulative
+``_bucket{le=...}`` + ``_count`` (no ``_sum`` — the registry's
+fixed-bucket histograms do not track one, and a fabricated 0 would be a
+lie); ``None``/non-finite values are SKIPPED, never rendered as ``NaN``
+(a gauge that was never observed has no sample — the absence IS the
+signal); label values escaped per the spec; the exposition ends with
+``# EOF`` (the OpenMetrics terminator).
+
+:func:`validate_exposition` is the self-check: a minimal parser of
+exactly the grammar the renderer emits (metric-name/label syntax,
+TYPE-before-samples, duplicate detection, float-parseable values,
+``# EOF`` last). It exists so the lint gate (``scripts/lint.py``), the
+suite ``fleet`` case, and the writer itself can all assert "this scrape
+target is well-formed" without a Prometheus binary in the container.
+
+Host-side only; no jax import. See docs/observability.md "Fleet".
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+#: content type Prometheus accepts for the text format
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+
+
+def _valid_name(name: str) -> str:
+    """Coerce an arbitrary metric name into the exposition charset."""
+    name = re.sub(r"[^a-zA-Z0-9_:]", "_", str(name))
+    if not name or not _NAME_RE.match(name):
+        name = "_" + name
+    return name
+
+
+def escape_label_value(v: Any) -> str:
+    """Backslash-escape a label value per the exposition format
+    (backslash, double-quote, newline)."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _fmt_value(v: float) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+class _Families:
+    """Ordered family collector: TYPE/HELP once per family, samples
+    appended under it — the invariant the validator then checks."""
+
+    def __init__(self, prefix: str):
+        self.prefix = prefix
+        self._order: List[str] = []
+        self._fam: Dict[str, dict] = {}
+
+    def family(self, name: str, mtype: str, help: str = "") -> dict:
+        name = _valid_name(self.prefix + name)
+        if name not in self._fam:
+            self._fam[name] = {"type": mtype, "help": help, "samples": []}
+            self._order.append(name)
+        return self._fam[name]
+
+    def sample(
+        self,
+        name: str,
+        value,
+        *,
+        mtype: str = "gauge",
+        help: str = "",
+        labels: Optional[Dict[str, Any]] = None,
+        suffix: str = "",
+    ) -> None:
+        """Add one sample (skipped when the value is None/non-finite:
+        an unobserved gauge has no sample, never a NaN)."""
+        if value is None:
+            return
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            if not math.isfinite(float(value)):
+                return
+        fam = self.family(name, mtype, help)
+        fam["samples"].append((suffix, labels or {}, value))
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for name in self._order:
+            fam = self._fam[name]
+            if not fam["samples"]:
+                continue
+            if fam["help"]:
+                lines.append(
+                    f"# HELP {name} "
+                    + fam["help"].replace("\\", "\\\\").replace("\n", " ")
+                )
+            lines.append(f"# TYPE {name} {fam['type']}")
+            for suffix, labels, value in fam["samples"]:
+                label_s = ""
+                if labels:
+                    inner = ",".join(
+                        f'{_valid_name(k)}="{escape_label_value(v)}"'
+                        for k, v in labels.items()
+                    )
+                    label_s = "{" + inner + "}"
+                lines.append(f"{name}{suffix}{label_s} {_fmt_value(value)}")
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
+
+def _render_registry(fams: _Families, registry) -> None:
+    """Every instrument of a MetricsRegistry -> families. Counters gain
+    the ``_total`` convention; histograms render cumulative buckets +
+    ``_count``."""
+    from .metrics import Counter, Gauge, Histogram
+
+    for name, inst in sorted(registry._instruments.items()):
+        if isinstance(inst, Counter):
+            n = name if name.endswith("_total") else name + "_total"
+            fams.sample(n, inst.value, mtype="counter", help=inst.help)
+        elif isinstance(inst, Gauge):
+            fams.sample(name, inst.value, mtype="gauge", help=inst.help)
+        elif isinstance(inst, Histogram):
+            fam = fams.family(name, "histogram", inst.help)
+            cum = 0
+            for edge, count in zip(inst.edges, inst.counts):
+                cum += int(count)
+                fam["samples"].append(
+                    ("_bucket", {"le": _fmt_value(edge)}, cum)
+                )
+            cum += int(inst.counts[-1])
+            fam["samples"].append(("_bucket", {"le": "+Inf"}, cum))
+            fam["samples"].append(("_count", {}, cum))
+
+
+def _render_fleet(fams: _Families, index: dict) -> None:
+    """Fleet index rollups + per-run gauges -> families (the serving
+    health plane ROADMAP #1/#3 sit on, scrapeable)."""
+    rollup = index.get("rollup", {}) or {}
+    fams.sample(
+        "fleet_runs", rollup.get("runs"),
+        help="logical runs in the fleet index",
+    )
+    for verdict, n in (rollup.get("verdicts") or {}).items():
+        fams.sample(
+            "fleet_runs_by_verdict", n,
+            help="fleet runs per run-doctor verdict",
+            labels={"verdict": verdict},
+        )
+    for key, help_s in (
+        ("fault_rate", "fraction of runs with a dispatch_fault"),
+        ("resume_success_rate",
+         "fraction of resumable runs whose final verdict is healthy"),
+        ("live_runs", "in-flight runs with recent events"),
+        ("stale_runs",
+         "in-flight runs silent past the staleness threshold"),
+        ("oldest_last_event_age_s",
+         "oldest last-event age among in-flight runs"),
+        ("throughput_trees_rows_per_s",
+         "aggregate eval-stage trees-rows/s over runs reporting one"),
+        ("pending_runs", "registered runs with no events yet"),
+        ("vanished_logs", "event logs that disappeared between scans"),
+        ("alerts_firing", "alert rules currently firing"),
+        ("events", "events parsed across every run"),
+        ("skipped_lines", "unparseable lines skipped across every run"),
+    ):
+        fams.sample("fleet_" + key, rollup.get(key), help=help_s)
+
+    for row in index.get("runs", []):
+        rid = row.get("run_id")
+        if not rid:
+            continue
+        labels = {"run_id": rid}
+        fams.sample(
+            "run_info", 1,
+            help="one series per run; verdict/backend ride as labels",
+            labels={
+                "run_id": rid,
+                "verdict": str(row.get("verdict")),
+                "backend": str(row.get("backend")),
+            },
+        )
+        fams.sample(
+            "run_last_event_age_s", row.get("last_event_age_s"),
+            help="seconds since the run's newest event", labels=labels,
+        )
+        fams.sample(
+            "run_best_loss", row.get("best_loss"),
+            help="latest best population loss", labels=labels,
+        )
+        fams.sample(
+            "run_throughput_trees_rows_per_s",
+            row.get("throughput_trees_rows_per_s"),
+            help="eval-stage trees-rows/s", labels=labels,
+        )
+        fams.sample(
+            "run_attempts", len(row.get("attempts") or []),
+            help="supervisor attempts collapsed into this row",
+            labels=labels,
+        )
+        fams.sample(
+            "run_faults", row.get("faults"),
+            help="dispatch_fault events across the run's attempts",
+            labels=labels,
+        )
+        fams.sample(
+            "run_alerts_firing", len(row.get("alerts") or []),
+            help="alert rules currently firing for this run",
+            labels=labels,
+        )
+
+
+def render_openmetrics(
+    registry=None,
+    fleet_index: Optional[dict] = None,
+    prefix: str = "srtpu_",
+) -> str:
+    """Render a MetricsRegistry and/or a fleet index dict into one
+    Prometheus/OpenMetrics text exposition (ends with ``# EOF``)."""
+    fams = _Families(prefix)
+    if registry is not None:
+        _render_registry(fams, registry)
+    if fleet_index is not None:
+        _render_fleet(fams, fleet_index)
+    return fams.render()
+
+
+# ---------------------------------------------------------------------------
+# self-check validator
+# ---------------------------------------------------------------------------
+
+_VALUE_RE = re.compile(
+    r"^[+-]?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|Inf|NaN)$"
+)
+
+
+def _parse_labels(block: str, path: str, problems: List[str]) -> str:
+    """Validate one ``{...}`` label block; returns a canonical string
+    for duplicate detection."""
+    inner = block[1:-1]
+    pairs: List[Tuple[str, str]] = []
+    i = 0
+    while i < len(inner):
+        m = re.match(r'([a-zA-Z_][a-zA-Z0-9_]*)="', inner[i:])
+        if not m:
+            problems.append(f"{path}: bad label syntax at {inner[i:]!r}")
+            return block
+        name = m.group(1)
+        j = i + m.end()
+        val = []
+        while j < len(inner):
+            c = inner[j]
+            if c == "\\":
+                if j + 1 >= len(inner) or inner[j + 1] not in '\\"n':
+                    problems.append(f"{path}: bad escape in label {name}")
+                    return block
+                val.append(inner[j:j + 2])
+                j += 2
+                continue
+            if c == '"':
+                break
+            if c == "\n":
+                problems.append(f"{path}: raw newline in label {name}")
+                return block
+            val.append(c)
+            j += 1
+        else:
+            problems.append(f"{path}: unterminated label value ({name})")
+            return block
+        pairs.append((name, "".join(val)))
+        j += 1  # closing quote
+        if j < len(inner) and inner[j] == ",":
+            j += 1
+        i = j
+    names = [n for n, _ in pairs]
+    if len(set(names)) != len(names):
+        problems.append(f"{path}: duplicate label name")
+    return "{" + ",".join(f'{n}="{v}"' for n, v in sorted(pairs)) + "}"
+
+
+def validate_exposition(text: str, max_problems: int = 20) -> List[str]:
+    """Problems (empty = valid) for one text exposition: every line is
+    a comment (``# HELP``/``# TYPE``/``# EOF``) or a sample; ``# TYPE``
+    at most once per family and before any of its samples; sample names
+    belong to a declared family's sample set (``name``, and for
+    histograms ``_bucket``/``_count``/``_sum``); label syntax and value
+    floats parse; no duplicate (name, labels) sample; the last line is
+    ``# EOF`` with nothing after it."""
+    problems: List[str] = []
+    types: Dict[str, str] = {}
+    sampled_names: set = set()
+    seen_samples: set = set()
+    eof_seen = False
+
+    def _family_of(name: str) -> Optional[str]:
+        if name in types:
+            return name
+        for suffix in ("_bucket", "_count", "_sum"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                base = name[: -len(suffix)]
+                if types[base] in ("histogram", "summary"):
+                    return base
+        return None
+
+    lines = text.split("\n")
+    for lineno, line in enumerate(lines, 1):
+        if len(problems) >= max_problems:
+            problems.append("... (truncated)")
+            break
+        path = f"line {lineno}"
+        if line == "":
+            # only legal as the trailing newline's split artifact
+            if lineno != len(lines):
+                problems.append(f"{path}: blank line inside exposition")
+            continue
+        if eof_seen:
+            problems.append(f"{path}: content after # EOF")
+            continue
+        if line.startswith("#"):
+            if line == "# EOF":
+                eof_seen = True
+                continue
+            m = re.match(r"^# (HELP|TYPE) ([a-zA-Z_:][a-zA-Z0-9_:]*)(?: (.*))?$", line)
+            if not m:
+                problems.append(f"{path}: malformed comment {line!r}")
+                continue
+            kind, name = m.group(1), m.group(2)
+            if kind == "TYPE":
+                t = (m.group(3) or "").strip()
+                if t not in _TYPES:
+                    problems.append(f"{path}: unknown type {t!r}")
+                if name in types:
+                    problems.append(f"{path}: duplicate TYPE for {name}")
+                if name in sampled_names:
+                    problems.append(
+                        f"{path}: TYPE for {name} after its samples"
+                    )
+                types[name] = t
+            continue
+        m = re.match(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)(?:\s+(\S+))?$",
+            line,
+        )
+        if not m:
+            problems.append(f"{path}: not a sample line {line!r}")
+            continue
+        name, labels, value, ts = m.groups()
+        sampled_names.add(name)
+        if _family_of(name) is None:
+            problems.append(f"{path}: sample {name} has no TYPE")
+        canon = _parse_labels(labels, path, problems) if labels else ""
+        if not _VALUE_RE.match(value):
+            problems.append(f"{path}: unparseable value {value!r}")
+        if ts is not None and not re.match(r"^-?[0-9]+(\.[0-9]+)?$", ts):
+            problems.append(f"{path}: unparseable timestamp {ts!r}")
+        key = (name, canon)
+        if key in seen_samples:
+            problems.append(f"{path}: duplicate sample {name}{canon}")
+        seen_samples.add(key)
+    if not eof_seen:
+        problems.append("missing # EOF terminator")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# delivery: atomic textfile + stdlib HTTP endpoint
+# ---------------------------------------------------------------------------
+
+
+def write_textfile(path: str, text: str, validate: bool = True) -> None:
+    """Atomically write one exposition for a textfile collector: temp
+    file in the target directory, fsync, ``os.replace`` — a scraper can
+    never observe a torn file. ``validate=True`` (default) self-checks
+    the exposition first and raises ``ValueError`` on problems: a
+    malformed exposition must never reach the scrape path."""
+    if validate:
+        problems = validate_exposition(text)
+        if problems:
+            raise ValueError(
+                f"invalid exposition ({len(problems)} problem(s)): "
+                + problems[0]
+            )
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def serve_metrics(render_fn, host: str = "127.0.0.1", port: int = 0):
+    """Start a background stdlib HTTP server exposing ``GET /metrics``.
+
+    ``render_fn()`` is called per scrape and must return the exposition
+    text (e.g. ``lambda: render_openmetrics(fleet_index=scanner.refresh())``).
+    Returns the server; ``server.server_address[1]`` is the bound port
+    (``port=0`` picks an ephemeral one). Stop with ``server.shutdown()``
+    then ``server.server_close()``. A render failure answers 500 with
+    the error text — the scrape target degrades, the fleet process does
+    not die."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib API name)
+            if self.path.split("?")[0].rstrip("/") not in ("", "/metrics"):
+                self.send_error(404)
+                return
+            try:
+                body = render_fn().encode()
+            except Exception as e:
+                msg = f"render failed: {type(e).__name__}: {e}\n".encode()
+                self.send_response(500)
+                self.send_header("Content-Length", str(len(msg)))
+                self.end_headers()
+                self.wfile.write(msg)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # scrapes must not spam stderr
+            pass
+
+    server = ThreadingHTTPServer((host, port), _Handler)
+    thread = threading.Thread(
+        target=server.serve_forever, name="srtpu-metrics", daemon=True
+    )
+    thread.start()
+    server._srtpu_thread = thread
+    return server

@@ -1,0 +1,646 @@
+"""Fleet telemetry: the multi-run index over one root of event logs.
+
+Every observability artifact so far is *per-run* — the event log (one
+``events-<run>.jsonl`` per search), the run doctor's verdict over it,
+srtop's live tail, srprof's roofline join. Production is *many
+concurrent runs*: watcher steps, supervisor attempts, suite cases, and
+(ROADMAP #1) tenant jobs, all writing into directories under one root
+(``SRTPU_BENCH_TELEMETRY_DIR`` already funnels the watcher's steps
+there). This module is the layer that reads them all:
+
+* :class:`FleetScanner` — discovers every ``events-*.jsonl`` under a
+  fleet root (recursively), tails each **incrementally** with srtop's
+  byte-offset/partial-line discipline (a refresh costs only the new
+  bytes; a half-written last line is held until its newline lands; a
+  truncated/rotated file resets its tail; a file or directory that
+  disappears between scans drops out without an error), summarizes
+  every run through the run doctor (:func:`..analyze.analyze_run`), and
+  collapses a supervised run's multi-attempt trail into ONE row keyed
+  on the ``run_start`` event's stable ``run_id`` (the resilience
+  supervisor threads one id through every attempt — the
+  ``resumable`` -> resumed lineage is exact, not filename-inferred);
+* ``fleet_index.json`` — the crash-safe (write-to-temp + atomic
+  ``os.replace``) machine-readable index the scanner maintains: one row
+  per logical run (verdict, backend, throughput, stage/compile shares,
+  modeled roofline fraction, fault/resume timeline, last-event age)
+  plus fleet rollups (verdict histogram, fault rate, resume-success
+  rate, aggregate trees-rows/s, staleness);
+* the alert loop — every refresh evaluates the declarative rules in
+  :mod:`.alerts` over the rows and appends each NEWLY-firing alert to
+  ``fleet_alerts.jsonl`` as an additive schema-v1 ``alert`` event (the
+  envelope ``run`` carries the run_id the rule fired for); an alert
+  that stops firing re-arms, so a later recurrence is logged again;
+* :func:`register_run` — producers (the resilience supervisor, the TPU
+  watcher, bench) announce runs into ``fleet_registry.jsonl`` under the
+  root, so the index can show what was *launched*, not only what has
+  already written events. One strict-JSON line per registration,
+  append-only and crash-safe like the event log itself. The watcher
+  writes the same line format inline (it must never import this
+  package — importing jax at the tunnel is exactly what it guards
+  against), so the format here is a compatibility contract: keep it to
+  the documented keys.
+
+Everything here is host-side file reading — no jax import, zero
+primitives added to any jitted program, and registration on/off leaves
+the hall of fame bit-identical (it is a file append).
+
+Consumers: ``scripts/srfleet.py`` (the live dashboard + ``--once`` CI
+gate), ``telemetry/export.py`` (the OpenMetrics exposition of the
+rollups), ``benchmark/suite.py``'s ``fleet`` case, and
+``scripts/lint.py``'s fleet-exposition gate. See docs/observability.md
+"Fleet".
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+from .events import SCHEMA_VERSION
+from .analyze import analyze_run
+
+#: file names the fleet layer owns under the root
+INDEX_NAME = "fleet_index.json"
+REGISTRY_NAME = "fleet_registry.jsonl"
+ALERTS_LOG_NAME = "fleet_alerts.jsonl"
+
+#: default seconds of last-event silence after which an incomplete run
+#: is considered stale (the `stale_run` alert; srfleet `--stall-after`)
+STALE_AFTER_S = 600.0
+
+
+def _finite(v) -> Optional[float]:
+    if isinstance(v, (int, float)) and not isinstance(v, bool) and \
+            math.isfinite(v):
+        return float(v)
+    return None
+
+
+class _LogTail:
+    """Incremental reader of one JSONL event log, retaining the parsed
+    events. Same discipline as srtop's tail: ``poll()`` reads only the
+    NEW bytes; a partial trailing line (mid-write) stays buffered until
+    its newline arrives; a file rewritten shorter (rotation) resets the
+    tail and the retained events; a vanished file returns False so the
+    scanner can drop it without an error."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+        self.buf = ""
+        self.events: List[dict] = []
+        self.skipped = 0
+
+    def poll(self) -> bool:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return False  # vanished between scans
+        if size < self.offset:
+            # rewritten/rotated shorter: everything retained came from a
+            # file that no longer exists — start over
+            self.offset, self.buf = 0, ""
+            self.events, self.skipped = [], 0
+        try:
+            with open(self.path) as f:
+                f.seek(self.offset)
+                chunk = f.read()
+                self.offset = f.tell()
+        except OSError:
+            return False
+        self.buf += chunk
+        while "\n" in self.buf:
+            line, self.buf = self.buf.split("\n", 1)
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                e = json.loads(line)
+            except ValueError:
+                self.skipped += 1  # corrupt line: count, keep tailing
+                continue
+            if isinstance(e, dict):
+                self.events.append(e)
+            else:
+                self.skipped += 1
+        return True
+
+
+def discover_logs(root: str) -> List[str]:
+    """Every ``events-*.jsonl`` under `root`, recursively (the watcher,
+    the supervisor, the suite, and bench each write into their own
+    subdirectory of one telemetry root). The fleet's own files
+    (registry/alerts/index) deliberately do not match the pattern."""
+    out: List[str] = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for f in filenames:
+            if f.startswith("events-") and f.endswith(".jsonl"):
+                out.append(os.path.join(dirpath, f))
+    return sorted(out)
+
+
+def register_run(
+    fleet_root: str,
+    *,
+    source: str,
+    run_id: Optional[str] = None,
+    telemetry_dir: Optional[str] = None,
+    attempt: Optional[int] = None,
+    **extra,
+) -> Optional[dict]:
+    """Append one registration line to ``<fleet_root>/fleet_registry.jsonl``.
+
+    Producers call this when they LAUNCH a run, so the fleet index can
+    distinguish "registered but no events yet" from "nothing there".
+    One strict-JSON line per call (append-only, crash-safe — a SIGKILL
+    loses at most the line in flight); never raises: observability must
+    not kill the run it observes. Returns the written record (None on
+    failure). Keys are a compatibility contract with
+    ``scripts/tpu_watcher.py``, which writes the same lines inline:
+    ``t`` / ``source`` / ``run_id`` / ``telemetry_dir`` / ``attempt``.
+    """
+    rec = {
+        "t": time.time(),
+        "source": str(source),
+        "run_id": run_id,
+        "telemetry_dir": telemetry_dir,
+        "attempt": attempt,
+    }
+    for k, v in extra.items():
+        rec[str(k)] = v
+    try:
+        os.makedirs(fleet_root, exist_ok=True)
+        with open(
+            os.path.join(fleet_root, REGISTRY_NAME), "a", buffering=1
+        ) as f:
+            f.write(json.dumps(rec, allow_nan=False) + "\n")
+    except (OSError, ValueError, TypeError) as e:
+        print(f"fleet: registration failed ({e})", file=sys.stderr)
+        return None
+    return rec
+
+
+def load_registry(fleet_root: str) -> List[dict]:
+    """Tolerant reader of the registration trail (unparsable lines —
+    e.g. the one a killed producer left half-written — are skipped)."""
+    path = os.path.join(fleet_root, REGISTRY_NAME)
+    out: List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def load_fleet_index(path: str) -> Optional[dict]:
+    """Read one ``fleet_index.json``: absent returns None; a corrupt
+    file raises ValueError so a consumer knows the index is damaged
+    rather than silently empty (the writer is atomic — corruption means
+    something other than the scanner touched it)."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# per-log summaries -> per-run rows
+# ---------------------------------------------------------------------------
+
+
+def _log_summary(events: List[dict], skipped: int, path: str) -> dict:
+    """One log -> {run_id, attempt, report, ...}: the doctor's report
+    plus the fleet join keys and the throughput/roofline extractions the
+    doctor does not compute."""
+    report = analyze_run(events)
+    start = next(
+        (e for e in events if e.get("type") == "run_start"), {}
+    )
+    run_env = start.get("run") or (
+        events[0].get("run") if events else None
+    )
+    run_id = start.get("run_id") or run_env or os.path.basename(path)
+    attempt = start.get("attempt")
+    if not isinstance(attempt, int) or attempt < 1:
+        attempt = 1
+
+    # eval-stage throughput: bench stamps the overhead-subtracted
+    # trees_rows_per_s on its eval span; searches carry trees/rows on
+    # the one-shot eval probe span — derive from the last one present
+    throughput = None
+    for e in events:
+        if e.get("type") != "span" or e.get("name") != "eval":
+            continue
+        attrs = e.get("attrs") or {}
+        v = _finite(attrs.get("trees_rows_per_s"))
+        if v is None:
+            trees = _finite(attrs.get("trees"))
+            rows = _finite(attrs.get("rows"))
+            dur = _finite(e.get("duration_s"))
+            if trees and rows and dur:
+                v = trees * rows / dur
+        if v is not None:
+            throughput = v
+
+    # modeled roofline: bench's `roofline` event carries it directly;
+    # searches emit per-stage srprof `profile` events — take the eval
+    # stage's fraction (the scoring program is the roofline the repo
+    # tracks, TRAJECTORY.md's roofline_modeled column)
+    roofline_modeled = None
+    for e in events:
+        if e.get("type") == "roofline":
+            v = _finite(e.get("modeled_fraction"))
+            if v is not None:
+                roofline_modeled = v
+        elif e.get("type") == "profile" and e.get("stage") == "eval":
+            v = _finite(e.get("roofline_fraction"))
+            if v is not None:
+                roofline_modeled = v
+
+    return {
+        "run_id": str(run_id),
+        "run": run_env,
+        "attempt": attempt,
+        "log": path,
+        "events": len(events),
+        "skipped_lines": skipped,
+        "report": report,
+        "throughput": throughput,
+        "roofline_modeled": roofline_modeled,
+    }
+
+
+def _timeline(events_by_attempt: List[dict]) -> List[dict]:
+    """The fault/resume timeline across a run's attempts, in time
+    order: every dispatch_fault, saved_state, resume (run_start with
+    resume_from), and run_end — the compact story srfleet and the index
+    row render."""
+    out: List[dict] = []
+    for s in events_by_attempt:
+        r = s["report"]
+        for f in r.get("faults", []):
+            out.append({
+                "t": _finite(f.get("t")), "attempt": s["attempt"],
+                "kind": "fault", "error_type": f.get("error_type"),
+            })
+        saved = r.get("last_saved_state")
+        if saved:
+            out.append({
+                "t": _finite(saved.get("t")), "attempt": s["attempt"],
+                "kind": "saved_state",
+                "iteration": saved.get("iteration"),
+            })
+        resume = (r.get("run") or {}).get("resume_from")
+        if resume:
+            out.append({
+                "t": r.get("t_first"), "attempt": s["attempt"],
+                "kind": "resume", "iteration": resume.get("iteration"),
+            })
+        if r.get("complete"):
+            out.append({
+                "t": r.get("t_last"), "attempt": s["attempt"],
+                "kind": "run_end",
+            })
+    out.sort(key=lambda e: (e["t"] is None, e["t"] or 0.0))
+    return out
+
+
+def _build_row(summaries: List[dict], now: float) -> dict:
+    """Collapse one logical run's per-attempt summaries (sorted) into
+    one index row. The NEWEST attempt drives the verdict; the lineage
+    list keeps every attempt's verdict so a resumable->resumed story is
+    readable straight off the row."""
+    latest = summaries[-1]
+    report = latest["report"]
+    run = report.get("run", {}) or {}
+    stages = report.get("stages", {}) or {}
+    stage_total = sum(v.get("total_s", 0.0) for v in stages.values())
+    stage_shares = {
+        k: round(v.get("total_s", 0.0) / stage_total, 4)
+        for k, v in stages.items()
+    } if stage_total > 0 else {}
+    t_last = max(
+        (s["report"].get("t_last") for s in summaries
+         if s["report"].get("t_last") is not None),
+        default=None,
+    )
+    t_first = min(
+        (s["report"].get("t_first") for s in summaries
+         if s["report"].get("t_first") is not None),
+        default=None,
+    )
+    resumed = len(summaries) > 1 or bool(run.get("resume_from"))
+    return {
+        "run_id": latest["run_id"],
+        "verdict": report.get("verdict"),
+        "reasons": report.get("reasons", []),
+        "backend": run.get("backend"),
+        "device_kind": run.get("device_kind"),
+        "nout": run.get("nout"),
+        "niterations": run.get("niterations"),
+        "attempt": latest["attempt"],
+        "attempts": [
+            {
+                "attempt": s["attempt"],
+                "run": s["run"],
+                "log": s["log"],
+                "verdict": s["report"].get("verdict"),
+                "resumable": bool(s["report"].get("resumable")),
+                "complete": bool(s["report"].get("complete")),
+            }
+            for s in summaries
+        ],
+        "resumed": resumed,
+        "resume_from": run.get("resume_from"),
+        "complete": bool(report.get("complete")),
+        "resumable": bool(report.get("resumable")),
+        "faults": sum(len(s["report"].get("faults", []))
+                      for s in summaries),
+        "saved_states": sum(s["report"].get("saved_states", 0)
+                            for s in summaries),
+        "timeline": _timeline(summaries),
+        "best_loss": (report.get("best_loss") or {}).get("last"),
+        "throughput_trees_rows_per_s": latest["throughput"],
+        "evals_per_s": (
+            report["num_evals"] / report["wall_s"]
+            if report.get("num_evals") and report.get("wall_s")
+            else None
+        ),
+        "stage_shares": stage_shares,
+        "compile_share": report.get("compile_share"),
+        "compile_bound": bool(report.get("compile_bound")),
+        "roofline_modeled": latest["roofline_modeled"],
+        "t_first": t_first,
+        "t_last": t_last,
+        "last_event_age_s": (
+            round(now - t_last, 3) if t_last is not None else None
+        ),
+        "events": sum(s["events"] for s in summaries),
+        "skipped_lines": sum(s["skipped_lines"] for s in summaries),
+        "logs": [s["log"] for s in summaries],
+    }
+
+
+def _rollup(rows: List[dict], now: float, stale_after_s: float) -> dict:
+    """Fleet-level aggregates over the rows — the numbers the
+    OpenMetrics exposition and the srfleet header render."""
+    verdicts: Dict[str, int] = {}
+    for r in rows:
+        v = str(r.get("verdict"))
+        verdicts[v] = verdicts.get(v, 0) + 1
+    n = len(rows)
+    faulted_rows = [r for r in rows if r["faults"]]
+    # resume-success: among runs that ever were resumable (a fault or
+    # kill with a snapshot banked) or actually resumed, the fraction
+    # whose FINAL verdict is healthy — the fleet-level answer to "does
+    # the resume loop actually recover work?"
+    resumable_rows = [
+        r for r in rows
+        if r["resumed"] or any(a["resumable"] for a in r["attempts"])
+    ]
+    resumed_ok = [
+        r for r in resumable_rows if r["verdict"] == "healthy"
+    ]
+    incomplete = [r for r in rows if not r["complete"]
+                  and r["verdict"] not in ("faulted", "empty")]
+    ages = [r["last_event_age_s"] for r in incomplete
+            if r["last_event_age_s"] is not None]
+    throughputs = [
+        r["throughput_trees_rows_per_s"] for r in rows
+        if r["throughput_trees_rows_per_s"] is not None
+    ]
+    return {
+        "runs": n,
+        "verdicts": dict(sorted(verdicts.items())),
+        "fault_rate": round(len(faulted_rows) / n, 4) if n else None,
+        "resumable_runs": len(resumable_rows),
+        "resume_success_rate": (
+            round(len(resumed_ok) / len(resumable_rows), 4)
+            if resumable_rows else None
+        ),
+        "live_runs": sum(1 for a in ages if a <= stale_after_s),
+        "stale_runs": sum(1 for a in ages if a > stale_after_s),
+        "oldest_last_event_age_s": (
+            round(max(ages), 3) if ages else None
+        ),
+        "throughput_trees_rows_per_s": (
+            sum(throughputs) if throughputs else None
+        ),
+        "events": sum(r["events"] for r in rows),
+        "skipped_lines": sum(r["skipped_lines"] for r in rows),
+    }
+
+
+def write_index_atomic(path: str, index: dict) -> None:
+    """Crash-safe index write: temp file in the same directory, fsync,
+    atomic ``os.replace`` — a reader (or a kill) can never observe a
+    torn ``fleet_index.json``."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(index, f, indent=1, sort_keys=True)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class FleetScanner:
+    """Incremental multi-run scanner over one fleet root.
+
+    ``refresh()`` re-discovers logs, tails each for new bytes, rebuilds
+    the per-run rows and rollups, evaluates the alert rules, appends
+    newly-firing alerts to the alerts log, atomically rewrites
+    ``fleet_index.json``, and returns the index dict. Designed to be
+    called in a loop (srfleet) or once (CI): state (tails, fired-alert
+    set) lives on the instance, so repeated refreshes cost only the new
+    bytes and re-log only state CHANGES.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        stale_after_s: float = STALE_AFTER_S,
+        alert_rules=None,
+        trajectory: Optional[dict] = None,
+        regression_threshold: float = 0.10,
+        index_path: Optional[str] = None,
+        alerts_log_path: Optional[str] = None,
+        write_index: bool = True,
+        emit_alert_events: bool = True,
+    ):
+        self.root = root
+        self.stale_after_s = float(stale_after_s)
+        self.alert_rules = alert_rules
+        self.trajectory = trajectory
+        self.regression_threshold = float(regression_threshold)
+        self.index_path = index_path or os.path.join(root, INDEX_NAME)
+        self.alerts_log_path = alerts_log_path or os.path.join(
+            root, ALERTS_LOG_NAME
+        )
+        self.write_index = write_index
+        self.emit_alert_events = emit_alert_events
+        self._tails: Dict[str, _LogTail] = {}
+        # per-log summary cache keyed by (events, skipped) counts: a
+        # refresh that read zero new bytes re-runs NO analyze_run — the
+        # "repeated refreshes cost only the new bytes" contract covers
+        # the analysis, not just the I/O
+        self._summaries: Dict[str, tuple] = {}
+        self._fired: set = set()
+        self._vanished = 0
+
+    def refresh(self, now: Optional[float] = None) -> dict:
+        from .alerts import DEFAULT_ALERT_RULES, evaluate_alerts
+
+        now = time.time() if now is None else now
+        paths = set(discover_logs(self.root))
+        for p in paths:
+            self._tails.setdefault(p, _LogTail(p))
+        for p, tail in list(self._tails.items()):
+            if not tail.poll():
+                # the file (or its whole run directory) disappeared
+                # between scans: drop the tail, count the loss — never
+                # an error, never a stale ghost row
+                del self._tails[p]
+                self._summaries.pop(p, None)
+                self._vanished += 1
+
+        groups: Dict[str, List[dict]] = {}
+        for p, tail in sorted(self._tails.items()):
+            if not tail.events:
+                continue  # nothing parseable yet (mid-create)
+            key = (len(tail.events), tail.skipped)
+            cached = self._summaries.get(p)
+            if cached is None or cached[0] != key:
+                cached = (key, _log_summary(tail.events, tail.skipped, p))
+                self._summaries[p] = cached
+            groups.setdefault(cached[1]["run_id"], []).append(cached[1])
+        rows = []
+        for key, summaries in sorted(groups.items()):
+            summaries.sort(key=lambda s: (
+                s["attempt"],
+                s["report"].get("t_first") or 0.0,
+                s["log"],
+            ))
+            rows.append(_build_row(summaries, now))
+        rows.sort(key=lambda r: (-(r["t_last"] or 0.0), r["run_id"]))
+
+        registry = load_registry(self.root)
+        seen_ids = {r["run_id"] for r in rows}
+        # a run is "pending" while it is registered but silent — the
+        # launched-but-no-events state the registry exists to expose.
+        # Id-stamped registrations (the supervisor) join exactly;
+        # anonymous ones (watcher steps launch MANY searches and cannot
+        # pre-know their ids) stay pending until any log under their
+        # telemetry_dir (or anywhere, when unset) starts at/after the
+        # registration time.
+        log_starts = [
+            (os.path.abspath(s["log"]), s["report"].get("t_first"))
+            for _, s in self._summaries.values()
+        ]
+        pending = []
+        for rec in registry:
+            rid = rec.get("run_id")
+            if rid:
+                if rid not in seen_ids:
+                    pending.append(rec)
+                continue
+            t_reg = rec.get("t") or 0.0
+            d = rec.get("telemetry_dir")
+            prefix = os.path.abspath(d) + os.sep if d else None
+            satisfied = any(
+                # 1s grace for clock fuzz between registrar and run
+                t_first is not None and t_first >= t_reg - 1.0
+                and (prefix is None or path.startswith(prefix))
+                for path, t_first in log_starts
+            )
+            if not satisfied:
+                pending.append(rec)
+
+        rollup = _rollup(rows, now, self.stale_after_s)
+        rollup["vanished_logs"] = self._vanished
+        rollup["registered"] = len(registry)
+        rollup["pending_runs"] = len(pending)
+
+        ctx = {
+            "now": now,
+            "stale_after_s": self.stale_after_s,
+            "trajectory": self.trajectory,
+            "regression_threshold": self.regression_threshold,
+        }
+        rules = (
+            DEFAULT_ALERT_RULES if self.alert_rules is None
+            else self.alert_rules
+        )
+        alerts = evaluate_alerts(rows, ctx, rules=rules)
+        by_run: Dict[str, List[str]] = {}
+        for a in alerts:
+            by_run.setdefault(a["run_id"], []).append(a["rule"])
+        for r in rows:
+            r["alerts"] = by_run.get(r["run_id"], [])
+        rollup["alerts_firing"] = len(alerts)
+
+        if self.emit_alert_events:
+            self._emit_alert_events(alerts, now)
+
+        index = {
+            "generated_by": "symbolicregression_jl_tpu.telemetry.fleet",
+            "v": 1,
+            "t": now,
+            "root": self.root,
+            "stale_after_s": self.stale_after_s,
+            "runs": rows,
+            "rollup": rollup,
+            "alerts": alerts,
+            "pending": pending,
+        }
+        if self.write_index:
+            try:
+                write_index_atomic(self.index_path, index)
+            except OSError as e:  # pragma: no cover - defensive
+                print(f"fleet: index write failed ({e})", file=sys.stderr)
+        return index
+
+    def _emit_alert_events(self, alerts: List[dict], now: float) -> None:
+        """Append each NEWLY-firing (rule, run_id) pair to the alerts
+        log as one schema-v1 ``alert`` event. An alert that stops firing
+        re-arms — a later recurrence logs again (the log is the history;
+        the index's ``alerts`` field is the current state)."""
+        keys = {(a["rule"], a["run_id"]) for a in alerts}
+        new = [a for a in alerts
+               if (a["rule"], a["run_id"]) not in self._fired]
+        self._fired = keys
+        if not new:
+            return
+        try:
+            with open(self.alerts_log_path, "a", buffering=1) as f:
+                for a in new:
+                    event = {
+                        "v": SCHEMA_VERSION,
+                        "t": now,
+                        "run": a["run_id"],
+                        "type": "alert",
+                        "rule": a["rule"],
+                        "severity": a["severity"],
+                        "message": a["message"],
+                        "value": _finite(a.get("value")),
+                        "threshold": _finite(a.get("threshold")),
+                        "fleet": self.root,
+                    }
+                    f.write(json.dumps(event, allow_nan=False) + "\n")
+        except (OSError, ValueError) as e:  # pragma: no cover
+            print(f"fleet: alert log write failed ({e})", file=sys.stderr)

@@ -397,6 +397,72 @@ def test_schema_file_carries_dynamics_and_roofline():
     }) == []
 
 
+def test_schema_run_start_fleet_provenance():
+    """ISSUE 13: run_start's additive `run_id` (stable uuid, the fleet
+    join key) and `attempt` (1-based supervisor attempt index) validate
+    on v1 without a bump; retyping them fails; old logs without them
+    (the pre-fleet golden fixtures) still validate."""
+    from symbolicregression_jl_tpu.telemetry import validate_event
+    from symbolicregression_jl_tpu.telemetry.events import load_schema
+
+    schema = load_schema()
+    props = schema["definitions"]["run_start"]["properties"]
+    assert "run_id" in props and "attempt" in props
+    base = {
+        "v": 1, "t": 0.0, "run": "r", "type": "run_start",
+        "config_fingerprint": "x", "backend": "cpu", "devices": ["d"],
+    }
+    assert validate_event(base) == []  # additive: absent is fine
+    assert validate_event(
+        dict(base, run_id="abc123", attempt=2)
+    ) == []
+    assert validate_event(dict(base, run_id=7))
+    assert validate_event(dict(base, attempt="two"))
+
+
+def test_schema_alert_events():
+    """ISSUE 13: the fleet alert engine's `alert` events are schema-v1
+    (rule/severity/message required, severity from the fixed set)."""
+    from symbolicregression_jl_tpu.telemetry import validate_event
+    from symbolicregression_jl_tpu.telemetry.events import load_schema
+
+    assert "alert" in load_schema()["properties"]["type"]["enum"]
+    base = {
+        "v": 1, "t": 0.0, "run": "run-one", "type": "alert",
+        "rule": "stalled_run", "severity": "warning",
+        "message": "plateau", "value": 1.0, "threshold": None,
+        "fleet": "/tmp/fleet",
+    }
+    assert validate_event(base) == []
+    assert validate_event(
+        {k: v for k, v in base.items() if k != "rule"}
+    )
+    assert validate_event(dict(base, severity="page-me"))
+
+
+def test_analyze_run_surfaces_fleet_provenance():
+    """The doctor's report["run"] carries run_id/attempt so the fleet
+    scanner (and any consumer) joins on the doctor's view, not on a
+    second parse of the raw log."""
+    events = make_run([1.0, 0.5])
+    events[0]["run_id"] = "stable-id"
+    events[0]["attempt"] = 3
+    report = analyze_run(events)
+    assert report["run"]["run_id"] == "stable-id"
+    assert report["run"]["attempt"] == 3
+
+
+def test_golden_fixture_carries_fleet_provenance():
+    """The regenerated golden fixture is from a post-fleet run: its
+    run_start must stamp run_id + attempt (the lint gate validates the
+    schema; this pins the writer actually emitting the fields)."""
+    with open(GOLDEN) as f:
+        start = json.loads(f.readline())
+    assert start["type"] == "run_start"
+    assert isinstance(start.get("run_id"), str) and start["run_id"]
+    assert start.get("attempt") == 1
+
+
 def test_event_log_nested_nonfinite_coercion(tmp_path):
     """ISSUE 10 satellite: non-finite -> null applies inside nested
     metric dicts (and lists/sets) at every depth, not only to top-level

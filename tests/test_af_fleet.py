@@ -1,0 +1,802 @@
+"""Fleet observability (ISSUE 13): the multi-run scanner/index
+(telemetry/fleet.py), the alert rules (telemetry/alerts.py), the
+OpenMetrics exposition + validator + HTTP endpoint
+(telemetry/export.py), srfleet, and the bench-trajectory gate.
+
+File name sorts after the other telemetry tiers (test_af_*) and
+everything here is fast CPU-only host-side work — synthetic event logs,
+no searches, no compiles (the real-search closed loop lives in
+benchmark/suite.py's `fleet` case and the slow acceptance test at the
+bottom)."""
+
+import importlib.util
+import json
+import os
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from symbolicregression_jl_tpu.telemetry.alerts import (
+    AlertRule,
+    evaluate_alerts,
+    trajectory_best_throughput,
+)
+from symbolicregression_jl_tpu.telemetry.events import validate_event
+from symbolicregression_jl_tpu.telemetry.export import (
+    render_openmetrics,
+    serve_metrics,
+    validate_exposition,
+    write_textfile,
+)
+from symbolicregression_jl_tpu.telemetry.fleet import (
+    ALERTS_LOG_NAME,
+    INDEX_NAME,
+    FleetScanner,
+    discover_logs,
+    load_fleet_index,
+    load_registry,
+    register_run,
+)
+from symbolicregression_jl_tpu.telemetry.metrics import MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_script(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_test", os.path.join(REPO, "scripts", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# synthetic event-log builder
+# ---------------------------------------------------------------------------
+
+NOW = 1_700_000_000.0
+
+STAGES7 = ("init", "cycle", "mutate", "eval", "simplify", "optimize",
+           "merge_migrate")
+
+
+def run_events(
+    run_id,
+    *,
+    attempt=1,
+    t0=NOW - 100.0,
+    best=(1.0, 0.5, 0.2),
+    diversity=0.8,
+    backend="cpu",
+    fault=False,
+    saved=False,
+    complete=True,
+    resume=None,
+    eval_attrs=None,
+):
+    """A synthetic run trail shaped like a real one (run_start with
+    fleet provenance, the seven stage spans, metrics, optional
+    fault/saved_state/run_end)."""
+    run = f"{run_id}-a{attempt}"
+    t = [t0]
+
+    def ev(type, **f):
+        t[0] += 1.0
+        return {"v": 1, "t": t[0], "run": run, "type": type, **f}
+
+    events = [ev(
+        "run_start", run_id=run_id, attempt=attempt,
+        config_fingerprint="x", backend=backend,
+        devices=["TFRT_CPU_0"], nout=1, niterations=3,
+        **({"resume_from": resume} if resume else {}),
+    )]
+    for s in STAGES7:
+        attrs = dict(eval_attrs or {"trees": 100, "rows": 50}) \
+            if s == "eval" else {}
+        events.append(ev("span", name=s, t_start=t[0], duration_s=0.5,
+                         attrs=attrs))
+    for i, b in enumerate(best):
+        events.append(ev(
+            "metrics", output=0, iteration=i,
+            snapshot={"counters": {}, "histograms": {},
+                      "gauges": {"best_loss": b,
+                                 "population_diversity": diversity}},
+        ))
+    if saved:
+        events.append(ev("saved_state", outputs=1, path="/tmp/x.ckpt",
+                         iteration=len(best)))
+    if fault:
+        events.append(ev(
+            "dispatch_fault", where="iteration",
+            error_type="XlaRuntimeError", error="UNAVAILABLE",
+            iteration=len(best), fatal=True,
+        ))
+    if complete:
+        events.append(ev("run_end", num_evals=100.0, search_time_s=9.0))
+    return events
+
+
+def write_log(dirpath, name, events):
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, f"events-{name}.jsonl")
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    return path
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exposition + validator
+# ---------------------------------------------------------------------------
+
+
+def test_registry_exposition_valid_and_typed():
+    reg = MetricsRegistry()
+    reg.counter("iterations_total", "iters").inc(3)
+    reg.gauge("best_loss", "best").set(0.25)
+    reg.gauge("never_observed")  # no sample, never NaN
+    h = reg.histogram("population_length", [4, 8], "lengths")
+    h.add_counts([3, 2, 1])
+    text = render_openmetrics(registry=reg)
+    assert validate_exposition(text) == []
+    assert "# TYPE srtpu_iterations_total counter" in text
+    assert "srtpu_best_loss 0.25" in text
+    assert "never_observed" not in text
+    # cumulative buckets + +Inf + count
+    assert 'srtpu_population_length_bucket{le="4"} 3' in text
+    assert 'srtpu_population_length_bucket{le="8"} 5' in text
+    assert 'srtpu_population_length_bucket{le="+Inf"} 6' in text
+    assert "srtpu_population_length_count 6" in text
+    assert text.rstrip("\n").endswith("# EOF")
+
+
+def test_exposition_skips_none_and_nonfinite():
+    reg = MetricsRegistry()
+    reg.gauge("g").set(float("inf"))  # snapshot would null it; render skips
+    text = render_openmetrics(registry=reg)
+    assert validate_exposition(text) == []
+    assert "srtpu_g" not in text
+
+
+def test_exposition_label_escaping():
+    index = {"rollup": {"runs": 1}, "runs": [{
+        "run_id": 'we"ird\\id\nx', "verdict": "healthy",
+        "backend": "cpu", "attempts": [], "alerts": [],
+        "last_event_age_s": 1.0, "best_loss": None,
+        "throughput_trees_rows_per_s": None, "faults": 0,
+    }]}
+    text = render_openmetrics(fleet_index=index)
+    assert validate_exposition(text) == []
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+
+
+def test_validator_catches_malformations():
+    good = "# TYPE a gauge\na 1\n# EOF\n"
+    assert validate_exposition(good) == []
+    assert any("EOF" in p for p in validate_exposition("# TYPE a gauge\na 1\n"))
+    assert any("no TYPE" in p for p in validate_exposition("b 1\n# EOF\n"))
+    assert any("duplicate sample" in p for p in validate_exposition(
+        "# TYPE a gauge\na 1\na 2\n# EOF\n"
+    ))
+    assert any("after its samples" in p for p in validate_exposition(
+        "a 1\n# TYPE a gauge\n# EOF\n"
+    ))
+    assert any("not a sample" in p for p in validate_exposition(
+        "# TYPE a gauge\na one two three four\n# EOF\n"
+    ))
+    assert any("unparseable value" in p for p in validate_exposition(
+        "# TYPE a gauge\na abc\n# EOF\n"
+    ))
+    assert any("blank line" in p for p in validate_exposition(
+        "# TYPE a gauge\n\na 1\n# EOF\n"
+    ))
+    assert any("content after" in p for p in validate_exposition(
+        "# TYPE a gauge\na 1\n# EOF\nz 2\n"
+    ))
+    assert any("bad label" in p or "unterminated" in p
+               for p in validate_exposition(
+                   '# TYPE a gauge\na{x="y} 1\n# EOF\n'
+               ))
+
+
+def test_write_textfile_atomic_and_self_checking(tmp_path):
+    path = str(tmp_path / "metrics.prom")
+    good = "# TYPE a gauge\na 1\n# EOF\n"
+    write_textfile(path, good)
+    with open(path) as f:
+        assert f.read() == good
+    assert not os.path.exists(path + ".tmp")
+    with pytest.raises(ValueError):
+        write_textfile(path, "garbage without eof\n")
+    with open(path) as f:
+        assert f.read() == good  # the bad write never landed
+
+
+def test_serve_metrics_http_endpoint():
+    text = "# TYPE a gauge\na 1\n# EOF\n"
+    srv = serve_metrics(lambda: text)
+    port = srv.server_address[1]
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        assert body == text
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/nope", timeout=10
+            )
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_serve_metrics_render_failure_degrades_to_500():
+    def boom():
+        raise RuntimeError("nope")
+
+    srv = serve_metrics(boom)
+    port = srv.server_address[1]
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            )
+        assert ei.value.code == 500
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# fleet scanner: discovery, rows, rollups, index
+# ---------------------------------------------------------------------------
+
+
+def test_two_runs_two_rows_and_rollup(tmp_path):
+    root = str(tmp_path)
+    write_log(os.path.join(root, "a"), "r1", run_events("run-one"))
+    write_log(os.path.join(root, "b"), "r2", run_events("run-two"))
+    index = FleetScanner(root).refresh(now=NOW)
+    assert len(index["runs"]) == 2
+    assert {r["verdict"] for r in index["runs"]} == {"healthy"}
+    roll = index["rollup"]
+    assert roll["runs"] == 2
+    assert roll["verdicts"] == {"healthy": 2}
+    assert roll["fault_rate"] == 0.0
+    # eval span (100 trees x 50 rows / 0.5 s) x 2 runs
+    assert roll["throughput_trees_rows_per_s"] == pytest.approx(20000.0)
+    # the exposition of a real index validates
+    assert validate_exposition(
+        render_openmetrics(fleet_index=index)
+    ) == []
+    # index file is on disk, atomic, loadable
+    idx = load_fleet_index(os.path.join(root, INDEX_NAME))
+    assert idx["rollup"]["runs"] == 2
+    assert not os.path.exists(os.path.join(root, INDEX_NAME) + ".tmp")
+
+
+def test_row_fields(tmp_path):
+    root = str(tmp_path)
+    write_log(os.path.join(root, "a"), "r1", run_events("run-one"))
+    row = FleetScanner(root).refresh(now=NOW)["runs"][0]
+    assert row["run_id"] == "run-one"
+    assert row["backend"] == "cpu"
+    assert row["attempt"] == 1 and not row["resumed"]
+    assert row["complete"] and row["faults"] == 0
+    assert row["best_loss"] == pytest.approx(0.2)
+    assert set(row["stage_shares"]) == set(STAGES7)
+    assert sum(row["stage_shares"].values()) == pytest.approx(1.0, abs=0.01)
+    assert row["last_event_age_s"] is not None
+    assert row["alerts"] == []
+
+
+def test_truncated_mid_write_log_is_held_then_completed(tmp_path):
+    """srtop's partial-line discipline: a half-written trailing line is
+    buffered (not parsed, not an error) until its newline lands — the
+    next refresh picks up exactly the completed events."""
+    root = str(tmp_path)
+    events = run_events("run-one", complete=False)
+    path = write_log(os.path.join(root, "a"), "r1", events)
+    end_event = json.dumps({
+        "v": 1, "t": NOW, "run": "run-one-a1", "type": "run_end",
+        "num_evals": 100.0, "search_time_s": 9.0,
+    })
+    with open(path, "a") as f:
+        f.write(end_event[:20])  # mid-write: no newline, half a line
+    sc = FleetScanner(root)
+    index = sc.refresh(now=NOW)
+    row = index["runs"][0]
+    assert row["verdict"] == "incomplete" and not row["complete"]
+    with open(path, "a") as f:
+        f.write(end_event[20:] + "\n")
+    row2 = sc.refresh(now=NOW)["runs"][0]
+    assert row2["complete"] and row2["verdict"] == "healthy"
+
+
+def test_corrupt_lines_counted_never_fatal(tmp_path):
+    root = str(tmp_path)
+    path = write_log(os.path.join(root, "a"), "r1", run_events("run-one"))
+    with open(path, "a") as f:
+        f.write("{not json at all\n[5]\n")
+    index = FleetScanner(root).refresh(now=NOW)
+    row = index["runs"][0]
+    assert row["verdict"] == "healthy"
+    assert row["skipped_lines"] == 2
+
+
+def test_vanishing_run_dir_between_scans(tmp_path):
+    """A run directory deleted between refreshes drops its row — no
+    exception, no ghost — and the loss is counted in the rollup."""
+    import shutil
+
+    root = str(tmp_path)
+    write_log(os.path.join(root, "a"), "r1", run_events("run-one"))
+    write_log(os.path.join(root, "b"), "r2", run_events("run-two"))
+    sc = FleetScanner(root)
+    assert len(sc.refresh(now=NOW)["runs"]) == 2
+    shutil.rmtree(os.path.join(root, "b"))
+    index = sc.refresh(now=NOW)
+    assert [r["run_id"] for r in index["runs"]] == ["run-one"]
+    assert index["rollup"]["vanished_logs"] == 1
+
+
+def test_run_without_run_end_is_incomplete_and_ages(tmp_path):
+    root = str(tmp_path)
+    write_log(os.path.join(root, "a"), "r1",
+              run_events("run-one", complete=False, t0=NOW - 100.0))
+    index = FleetScanner(root, stale_after_s=30.0).refresh(now=NOW)
+    row = index["runs"][0]
+    assert row["verdict"] == "incomplete"
+    assert row["last_event_age_s"] > 30.0
+    assert "stale_run" in row["alerts"]
+    assert index["rollup"]["stale_runs"] == 1
+
+
+def test_multi_attempt_trail_collapses_into_one_row(tmp_path):
+    """The supervisor threads one run_id through every attempt: the
+    fleet index must show ONE row whose lineage reads
+    faulted+resumable -> resumed healthy (ISSUE 13 acceptance)."""
+    root = str(tmp_path)
+    d = os.path.join(root, "supervised")
+    write_log(d, "a1", run_events(
+        "run-sup", attempt=1, fault=True, saved=True, complete=False,
+        t0=NOW - 200.0,
+    ))
+    write_log(d, "a2", run_events(
+        "run-sup", attempt=2, t0=NOW - 100.0,
+        resume={"path": "/tmp/x.ckpt", "iteration": 3, "outputs": 1,
+                "populations_compatible": True},
+    ))
+    index = FleetScanner(root).refresh(now=NOW)
+    assert len(index["runs"]) == 1
+    row = index["runs"][0]
+    assert row["run_id"] == "run-sup"
+    assert row["verdict"] == "healthy"
+    assert row["resumed"] and row["attempt"] == 2
+    assert [(a["attempt"], a["verdict"], a["resumable"])
+            for a in row["attempts"]] == [
+        (1, "faulted", True), (2, "healthy", False),
+    ]
+    assert row["faults"] == 1 and row["saved_states"] == 1
+    kinds = [e["kind"] for e in row["timeline"]]
+    assert kinds == ["saved_state", "fault", "resume", "run_end"]
+    roll = index["rollup"]
+    assert roll["resumable_runs"] == 1
+    assert roll["resume_success_rate"] == 1.0
+
+
+def test_registry_pending_rows(tmp_path):
+    root = str(tmp_path)
+    rec = register_run(root, source="supervisor", run_id="not-yet",
+                       telemetry_dir=os.path.join(root, "x"))
+    assert rec is not None
+    assert load_registry(root)[0]["run_id"] == "not-yet"
+    write_log(os.path.join(root, "a"), "r1", run_events("run-one"))
+    register_run(root, source="supervisor", run_id="run-one")
+    index = FleetScanner(root).refresh(now=NOW)
+    assert index["rollup"]["registered"] == 2
+    assert index["rollup"]["pending_runs"] == 1
+    assert [p["run_id"] for p in index["pending"]] == ["not-yet"]
+
+
+def test_anonymous_registration_pending_until_logs_appear(tmp_path):
+    """A watcher step registers WITHOUT a run_id (it launches many
+    searches and cannot pre-know their ids): it must still read as
+    pending while silent, and clear once any log under its
+    telemetry_dir starts after the registration."""
+    root = str(tmp_path)
+    step_dir = os.path.join(root, "step")
+    register_run(root, source="watcher:bench", run_id=None,
+                 telemetry_dir=step_dir, attempt=1)
+    # register_run stamps wall-clock t; rewrite with a controlled one
+    reg_path = os.path.join(root, "fleet_registry.jsonl")
+    with open(reg_path) as f:
+        rec = json.loads(f.readline())
+    rec["t"] = NOW - 50.0
+    with open(reg_path, "w") as f:
+        f.write(json.dumps(rec) + "\n")
+    sc = FleetScanner(root)
+    index = sc.refresh(now=NOW)
+    assert index["rollup"]["pending_runs"] == 1
+    # a log under the step's dir starting AFTER the registration clears it
+    write_log(step_dir, "r1", run_events("run-one", t0=NOW - 40.0))
+    index2 = sc.refresh(now=NOW)
+    assert index2["rollup"]["pending_runs"] == 0
+    # ...but a log elsewhere would not have (dir-scoped join)
+    register_run(root, source="watcher:suite", run_id=None,
+                 telemetry_dir=os.path.join(root, "other"))
+    index3 = sc.refresh(now=NOW)
+    assert index3["rollup"]["pending_runs"] == 1
+
+
+def test_refresh_caches_summaries_when_no_new_bytes(tmp_path, monkeypatch):
+    """An idle refresh costs only the (zero) new bytes: analyze_run is
+    not re-run over logs that did not grow."""
+    import symbolicregression_jl_tpu.telemetry.fleet as fleet_mod
+
+    root = str(tmp_path)
+    write_log(os.path.join(root, "a"), "r1", run_events("run-one"))
+    sc = FleetScanner(root)
+    sc.refresh(now=NOW)
+    calls = []
+    real = fleet_mod.analyze_run
+    monkeypatch.setattr(
+        fleet_mod, "analyze_run",
+        lambda events, **kw: (calls.append(1), real(events, **kw))[1],
+    )
+    index = sc.refresh(now=NOW)  # no new bytes anywhere
+    assert calls == []
+    assert index["runs"][0]["verdict"] == "healthy"  # rows still built
+    # growth re-analyzes exactly the grown log
+    with open(os.path.join(root, "a", "events-r1.jsonl"), "a") as f:
+        f.write(json.dumps({
+            "v": 1, "t": NOW, "run": "run-one-a1", "type": "progress",
+            "num_evals": 200.0,
+        }) + "\n")
+    sc.refresh(now=NOW)
+    assert len(calls) == 1
+
+
+def test_fleet_files_not_discovered_as_runs(tmp_path):
+    root = str(tmp_path)
+    write_log(os.path.join(root, "a"), "r1", run_events("run-one"))
+    sc = FleetScanner(root)
+    sc.refresh(now=NOW)
+    # registry/alerts/index live under the root but are not run logs
+    register_run(root, source="test", run_id="x")
+    assert all(
+        os.path.basename(p).startswith("events-")
+        for p in discover_logs(root)
+    )
+    assert len(sc.refresh(now=NOW)["runs"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# alert rules + alert events
+# ---------------------------------------------------------------------------
+
+
+def test_fault_without_saved_state_alerts_critical(tmp_path):
+    root = str(tmp_path)
+    write_log(os.path.join(root, "a"), "r1",
+              run_events("run-dead", fault=True, saved=False,
+                         complete=False))
+    index = FleetScanner(root).refresh(now=NOW)
+    alerts = index["alerts"]
+    assert [a["rule"] for a in alerts] == ["fault_unresumable"]
+    assert alerts[0]["severity"] == "critical"
+    # the resumable complement is the supervisor's normal path: no alert
+    root2 = str(tmp_path / "b")
+    write_log(os.path.join(root2, "a"), "r1",
+              run_events("run-resumable", fault=True, saved=True,
+                         complete=False))
+    index2 = FleetScanner(root2).refresh(now=NOW)
+    assert index2["alerts"] == []
+
+
+def test_stalled_and_diverging_rules():
+    rows = [
+        {"run_id": "s", "verdict": "stalled", "reasons": ["plateau"],
+         "complete": True, "resumable": False, "faults": 0},
+        {"run_id": "d", "verdict": "diverging", "reasons": ["NaN"],
+         "complete": True, "resumable": False, "faults": 0},
+    ]
+    alerts = evaluate_alerts(rows, {"stale_after_s": 600.0})
+    assert [(a["rule"], a["severity"]) for a in alerts] == [
+        ("diverging_run", "critical"), ("stalled_run", "warning"),
+    ]
+
+
+def test_compile_bound_rule_is_info():
+    rows = [{"run_id": "c", "verdict": "healthy", "compile_bound": True,
+             "compile_share": 0.9, "faults": 0}]
+    alerts = evaluate_alerts(rows, {})
+    assert [(a["rule"], a["severity"]) for a in alerts] == [
+        ("compile_bound", "info"),
+    ]
+
+
+def test_throughput_regression_rule_requires_trajectory():
+    row = {"run_id": "r", "verdict": "healthy", "backend": "cpu",
+           "throughput_trees_rows_per_s": 1000.0, "faults": 0}
+    # no trajectory in ctx: never fires
+    assert evaluate_alerts([row], {}) == []
+    traj = {"series": {"throughput": [
+        {"round": 3, "platform": "cpu", "value": 4.7e6},
+        {"round": 4, "platform": "tpu", "value": 1.0e9},
+        {"round": 5, "platform": "cpu", "value": None},
+    ]}}
+    assert trajectory_best_throughput(traj) == {
+        "cpu": 4.7e6, "tpu": 1.0e9,
+    }
+    alerts = evaluate_alerts(
+        [row], {"trajectory": traj, "regression_threshold": 0.10}
+    )
+    assert [a["rule"] for a in alerts] == ["throughput_regression"]
+    # same-platform only: a TPU bar must not judge a CPU run
+    fast_cpu = dict(row, throughput_trees_rows_per_s=4.6e6)
+    assert evaluate_alerts(
+        [fast_cpu], {"trajectory": traj, "regression_threshold": 0.10}
+    ) == []
+
+
+def test_broken_rule_reports_itself():
+    def boom(row, ctx):
+        raise RuntimeError("bad rule")
+
+    rules = (AlertRule("x", "warning", "boom", boom),)
+    alerts = evaluate_alerts(
+        [{"run_id": "r", "faults": 0}], {}, rules=rules
+    )
+    assert [a["rule"] for a in alerts] == ["rule_error"]
+
+
+def test_alert_events_emitted_once_and_schema_valid(tmp_path):
+    """Each (rule, run) firing appends ONE schema-v1 alert event; a
+    steady-state refresh re-emits nothing; a cleared-then-recurring
+    alert logs again (the log is the history, the index the state)."""
+    root = str(tmp_path)
+    path = write_log(os.path.join(root, "a"), "r1",
+                     run_events("run-dead", fault=True, saved=False,
+                                complete=False))
+    sc = FleetScanner(root)
+    sc.refresh(now=NOW)
+    sc.refresh(now=NOW)  # steady state: no duplicate
+    alog = os.path.join(root, ALERTS_LOG_NAME)
+    with open(alog) as f:
+        lines = [json.loads(ln) for ln in f if ln.strip()]
+    assert len(lines) == 1
+    assert validate_event(lines[0]) == []
+    assert lines[0]["type"] == "alert"
+    assert lines[0]["run"] == "run-dead"
+    assert lines[0]["rule"] == "fault_unresumable"
+    # the alert clears (a NEW log of the same logical run completes
+    # healthy — logs are append-only, so clearing means a new trail,
+    # never an in-place rewrite), then recurs: the recurrence logs
+    # again — the alerts log is the history, the index the state
+    os.remove(path)
+    path2 = write_log(os.path.join(root, "a"), "r2",
+                      run_events("run-dead"))
+    assert sc.refresh(now=NOW)["alerts"] == []
+    os.remove(path2)
+    write_log(os.path.join(root, "a"), "r3",
+              run_events("run-dead", fault=True, saved=False,
+                         complete=False))
+    sc.refresh(now=NOW)
+    with open(alog) as f:
+        assert sum(1 for ln in f if ln.strip()) == 2
+
+
+# ---------------------------------------------------------------------------
+# srfleet CLI
+# ---------------------------------------------------------------------------
+
+
+def test_srfleet_once_exit_matches_alert_state(tmp_path, capsys):
+    srfleet = _load_script("srfleet")
+    root = str(tmp_path)
+    write_log(os.path.join(root, "a"), "r1", run_events("run-one"))
+    assert srfleet.main([root, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "run-one" in out and "healthy" in out
+    # inject a stalled run: the gate flips
+    write_log(os.path.join(root, "b"), "r2", run_events(
+        "run-stalled", best=[1.0] * 8, diversity=0.05,
+    ))
+    assert srfleet.main([root, "--once"]) == 1
+    out = capsys.readouterr().out
+    assert "stalled_run" in out
+
+
+def test_srfleet_fail_on_severity(tmp_path, capsys):
+    """info alerts (compile_bound on a cold smoke run) report without
+    failing the default gate; --fail-on info makes them fail."""
+    srfleet = _load_script("srfleet")
+    root = str(tmp_path)
+    events = run_events("run-one")
+    # dwarf the stage spans with compile time -> compile-bound
+    events.insert(2, {
+        "v": 1, "t": NOW - 99.0, "run": "run-one-a1", "type": "compile",
+        "name": "cycle", "duration_s": 100.0,
+    })
+    write_log(os.path.join(root, "a"), "r1", events)
+    assert srfleet.main([root, "--once"]) == 0
+    capsys.readouterr()
+    assert srfleet.main([root, "--once", "--fail-on", "info"]) == 1
+    out = capsys.readouterr().out
+    assert "compile_bound" in out
+
+
+def test_srfleet_metrics_out_writes_valid_exposition(tmp_path):
+    srfleet = _load_script("srfleet")
+    root = str(tmp_path / "root")
+    write_log(os.path.join(root, "a"), "r1", run_events("run-one"))
+    out = str(tmp_path / "metrics.prom")
+    assert srfleet.main([root, "--once", "--metrics-out", out]) == 0
+    with open(out) as f:
+        assert validate_exposition(f.read()) == []
+
+
+# ---------------------------------------------------------------------------
+# bench_trajectory --gate
+# ---------------------------------------------------------------------------
+
+
+def _write_bench_round(repo, n, value, vs_baseline, platform="cpu"):
+    with open(os.path.join(repo, f"BENCH_r{n:02d}.json"), "w") as f:
+        json.dump({
+            "n": n,
+            "parsed": {"value": value, "vs_baseline": vs_baseline,
+                       "platform": platform},
+        }, f)
+
+
+def test_trajectory_gate_exits_nonzero_on_latest_regression(tmp_path):
+    bt = _load_script("bench_trajectory")
+    repo = str(tmp_path)
+    _write_bench_round(repo, 1, 4.0e6, 0.2)
+    _write_bench_round(repo, 2, 2.0e6, 0.1)  # latest: 50% drop
+    traj = bt.build_trajectory(repo)
+    assert {r["metric"] for r in traj["latest_regressions"]} == {
+        "throughput", "vs_baseline",
+    }
+    assert bt.main(["--repo", repo, "--no-write"]) == 0  # report only
+    assert bt.main(["--repo", repo, "--no-write", "--gate"]) == 2
+
+
+def test_trajectory_gate_ignores_historical_regressions(tmp_path):
+    """Only the LATEST round gates: an old dip that later recovered is
+    a report forever, never an exit code."""
+    bt = _load_script("bench_trajectory")
+    repo = str(tmp_path)
+    _write_bench_round(repo, 1, 4.0e6, 0.2)
+    _write_bench_round(repo, 2, 2.0e6, 0.1)  # historical dip
+    _write_bench_round(repo, 3, 4.1e6, 0.21)  # recovered
+    traj = bt.build_trajectory(repo)
+    assert traj["regressions"]  # the dip is still reported
+    assert traj["latest_regressions"] == []
+    assert bt.main(["--repo", repo, "--no-write", "--gate"]) == 0
+
+
+def test_trajectory_gate_clean_exits_zero(tmp_path):
+    bt = _load_script("bench_trajectory")
+    repo = str(tmp_path)
+    _write_bench_round(repo, 1, 4.0e6, 0.2)
+    _write_bench_round(repo, 2, 4.2e6, 0.22)
+    assert bt.main(["--repo", repo, "--no-write", "--gate"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# checked-in fixture + lint gate plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_golden_fleet_index_fixture_renders_valid_exposition():
+    """The lint gate's contract, asserted from the tests too: the
+    checked-in fleet index (captured from the real two-search +
+    supervised-fault acceptance scenario) renders to a valid
+    exposition, and carries the 3-row resumable->resumed story."""
+    path = os.path.join(
+        REPO, "tests", "data", "telemetry", "golden_fleet_index.json"
+    )
+    with open(path) as f:
+        index = json.load(f)
+    rows = index["runs"]
+    assert len(rows) == 3
+    assert all(r["verdict"] == "healthy" for r in rows)
+    sup = [r for r in rows if r["resumed"]]
+    assert len(sup) == 1
+    assert [(a["attempt"], a["verdict"], a["resumable"])
+            for a in sup[0]["attempts"]] == [
+        (1, "faulted", True), (2, "healthy", False),
+    ]
+    text = render_openmetrics(fleet_index=index)
+    assert validate_exposition(text) == []
+
+
+def test_lint_fleet_exposition_gate():
+    lint = _load_script("lint")
+    out = lint.check_fleet_exposition()
+    assert out["ok"], out
+    assert out["samples"] > 10
+
+
+# ---------------------------------------------------------------------------
+# slow: the full acceptance loop with real searches
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_acceptance_end_to_end(tmp_path):
+    """ISSUE 13 acceptance on CPU: two searches + one supervisor-resumed
+    faulted search under one fleet root -> 3 index rows with correct
+    verdicts (the faulted run shows resumable->resumed lineage via
+    run_id/attempt), a valid exposition, and HoF bit-identity with
+    fleet registration on vs off."""
+    import symbolicregression_jl_tpu as sr
+    from symbolicregression_jl_tpu.resilience import (
+        FaultPlan,
+        clear_fault_plan,
+        set_fault_plan,
+        supervised_search,
+    )
+
+    root = str(tmp_path / "fleet")
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((3, 128)).astype(np.float32)
+    y = 2.0 * np.cos(X[2]) + X[0] ** 2 - 0.5
+    kw = dict(
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        npopulations=4, npop=24, ncycles_per_iteration=30, maxsize=12,
+        verbosity=0, progress=False,
+    )
+    frontier = lambda r: [
+        (c.complexity, float(c.loss), c.equation) for c in r.frontier()
+    ]
+    baseline = sr.equation_search(X, y, niterations=2, seed=0, **kw)
+    results = []
+    for i, seed in enumerate((0, 1)):
+        results.append(sr.equation_search(
+            X, y, niterations=2, seed=seed, telemetry=True,
+            telemetry_dir=os.path.join(root, f"run{i}"), **kw,
+        ))
+    # fleet registration/telemetry on vs off: bit-identical HoF
+    assert frontier(results[0]) == frontier(baseline)
+
+    snap = str(tmp_path / "snap.ckpt")
+    set_fault_plan(FaultPlan(kind="raise", at=1))
+    try:
+        sup = supervised_search(
+            X, y, niterations=2, seed=0,
+            snapshot_path=snap, snapshot_every_dispatches=1,
+            max_attempts=3, backoff_base_s=0.05, backoff_jitter=0.0,
+            telemetry=True,
+            telemetry_dir=os.path.join(root, "supervised"),
+            fleet_root=root, **kw,
+        )
+    finally:
+        clear_fault_plan()
+    assert sup.attempts == 2 and sup.run_id
+    assert frontier(sup.result) == frontier(baseline)
+    # the supervisor registered its run_id before attempt 1
+    assert any(
+        rec.get("run_id") == sup.run_id for rec in load_registry(root)
+    )
+
+    index = FleetScanner(root).refresh()
+    rows = index["runs"]
+    assert len(rows) == 3
+    assert all(r["verdict"] == "healthy" for r in rows)
+    sup_row = next(r for r in rows if r["run_id"] == sup.run_id)
+    assert sup_row["resumed"]
+    assert [(a["attempt"], a["verdict"], a["resumable"])
+            for a in sup_row["attempts"]] == [
+        (1, "faulted", True), (2, "healthy", False),
+    ]
+    assert validate_exposition(
+        render_openmetrics(fleet_index=index)
+    ) == []
