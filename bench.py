@@ -1280,6 +1280,41 @@ def main(verbose=True):
             if verbose:
                 print(f"# host multichip capture failed: {e}",
                       file=sys.stderr)
+    # ---- numeric-containment census (ISSUE 15,
+    # docs/robustness_numeric.md): score the benchmark workload once on
+    # the CPU interpreter and count the trees whose loss the
+    # containment layer clamped to the inf sentinel
+    # (ops/losses.py::contain_nonfinite) — random GP trees over the
+    # Feynman data legitimately overflow/leave domains, and this
+    # fraction is the bench-side twin of the search telemetry's
+    # population_nonfinite_fraction gauge: a jump between rounds means
+    # an operator or containment regression, not a slower kernel. ----
+    containment = None
+    try:
+        from symbolicregression_jl_tpu.models.fitness import (
+            eval_loss_trees,
+        )
+
+        _nt = min(n_trees, 2048)
+        _trees_c = _build_workload(jax, jnp, options, _nt, 1)
+        _Xc, _yc = _feynman_data()
+        with jax.default_device(jax.devices("cpu")[0]):
+            _loss_c = eval_loss_trees(
+                _trees_c, jnp.asarray(_Xc), jnp.asarray(_yc), None,
+                options.operators, options.elementwise_loss,
+                backend="jnp",
+            )
+            _nonfin = int(jnp.sum(~jnp.isfinite(_loss_c)))
+        containment = {
+            "trees": int(_nt),
+            "nonfinite_trees": _nonfin,
+            "nonfinite_frac": round(_nonfin / _nt, 4),
+        }
+    except Exception as e:  # pragma: no cover - defensive
+        if verbose:
+            print(f"# containment census unavailable: {e}",
+                  file=sys.stderr)
+
     # ---- round-over-round trajectory (scripts/bench_trajectory.py):
     # the checked-in BENCH_r*/MULTICHIP_* series + regression flags ride
     # along in the artifact, so a drop is visible the moment this JSON
@@ -1346,6 +1381,9 @@ def main(verbose=True):
         "multichip_skip_reason": multichip_skip_reason,
         # round-over-round series + regression flags (bench_trajectory)
         "trajectory": trajectory,
+        # non-finite/clamp census of the scored workload (ISSUE 15):
+        # the inf-sentinel fraction the containment layer produced
+        "containment": containment,
         "telemetry_event_log": sink.path if sink is not None else None,
     }
     if platform == "cpu":

@@ -525,6 +525,96 @@ def bench_resilience():
     }]
 
 
+def bench_hostile_data():
+    """Hostile-data hardening end to end (ISSUE 15): an adversarial
+    fixture corpus — NaN-riddled rows, an Inf target cell, a constant
+    target, 1e30-range features — must complete a real search under
+    every data policy that admits it, with a FINITE hall of fame (the
+    containment contract: non-finite never escapes a scoring epilogue),
+    populated DatasetDiagnostics in the result AND the telemetry
+    run_start event, and data_policy='reject' failing fast with the
+    structured report instead of burning a search on poisoned data."""
+    import symbolicregression_jl_tpu as sr
+    from symbolicregression_jl_tpu.models.dataset import (
+        HostileDatasetError,
+    )
+    from symbolicregression_jl_tpu.telemetry.analyze import (
+        analyze_run,
+        resolve_log,
+    )
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((3, 128)).astype(np.float32)
+    y = (2.0 * np.cos(X[2]) + X[0] ** 2 - 0.5).astype(np.float32)
+    kw = dict(
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        npopulations=4, npop=24, ncycles_per_iteration=30, maxsize=12,
+        niterations=2, seed=0, verbosity=0, progress=False,
+        runtests=False,
+    )
+
+    corpus = {}
+    Xn = X.copy(); Xn[0, :6] = np.nan; Xn[1, 40] = np.inf
+    yn = y.copy(); yn[100] = np.inf
+    corpus["nan_rows"] = (Xn, yn)
+    corpus["constant_y"] = (X, np.full_like(y, 3.25))
+    Xs = X.copy(); Xs[1] *= 1e30
+    corpus["huge_scale"] = (Xs, y)
+
+    out = []
+    t0 = time.perf_counter()
+    # reject fails fast on the non-finite corpus member, with the report
+    try:
+        sr.equation_search(*corpus["nan_rows"], data_policy="reject", **kw)
+        rejected, report_rows = False, 0
+    except HostileDatasetError as e:
+        rejected = True
+        report_rows = e.diagnostics.bad_rows
+    out.append({
+        "suite": "hostile_data",
+        "case": "reject_fails_fast",
+        "ok": rejected and report_rows == 8,
+        "rejected": rejected,
+        "bad_rows": report_rows,
+    })
+
+    for name, (Xc, yc) in sorted(corpus.items()):
+        for policy in ("mask", "repair"):
+            d = _suite_telemetry_dir(f"srtpu_suite_hostile_{name}_")
+            res = sr.equation_search(
+                Xc, yc, data_policy=policy, telemetry=True,
+                telemetry_dir=d, **kw,
+            )
+            losses = [float(c.loss) for c in res.frontier()]
+            diags = res.dataset_diagnostics or {}
+            report = analyze_run(resolve_log(d))
+            run_diags = (report.get("run") or {}).get(
+                "dataset_diagnostics"
+            ) or {}
+            out.append({
+                "suite": "hostile_data",
+                "case": f"{name}_{policy}",
+                "ok": (
+                    bool(losses)
+                    and all(np.isfinite(losses))
+                    and diags.get("policy") == policy
+                    and run_diags.get("policy") == policy
+                    and report["verdict"] in ("healthy", "stalled")
+                ),
+                "hof_size": len(losses),
+                "hof_finite": bool(losses) and all(np.isfinite(losses)),
+                "best_loss": min(losses) if losses else None,
+                "masked_rows": diags.get("masked_rows"),
+                "repaired_cells": diags.get("repaired_cells"),
+                "warnings": len(diags.get("warnings") or []),
+                "run_start_diagnostics": bool(run_diags),
+                "verdict": report["verdict"],
+                "nonfinite_fraction": report.get("nonfinite_fraction"),
+            })
+    out[-1]["seconds"] = time.perf_counter() - t0
+    return out
+
+
 def bench_fleet():
     """Fleet observability end to end (ISSUE 13): two real tiny
     searches write telemetry into one fleet root; the fleet scanner
@@ -1075,6 +1165,7 @@ _CASES = [
     (bench_run_doctor, 900),
     (bench_profile, 900),
     (bench_resilience, 900),
+    (bench_hostile_data, 900),
     (bench_fleet, 1200),
     (bench_search_iteration, 1200),
     (bench_fitness_cache, 1200),

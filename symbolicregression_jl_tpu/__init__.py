@@ -16,9 +16,13 @@ Layout:
 
 from .models.dataset import (
     Dataset,
+    DatasetDiagnostics,
+    HostileDatasetError,
     load_csv_dataset,
     make_dataset,
+    sanitize_dataset,
     update_baseline_loss,
+    validate_dataset,
 )
 from .models.options import (
     ComplexityMapping,
@@ -43,7 +47,7 @@ from .ops.interpreter import (
     eval_tree,
     eval_trees,
 )
-from .ops.losses import LOSS_REGISTRY
+from .ops.losses import LOSS_REGISTRY, contain_nonfinite, pairwise_sum
 from .utils.export import (
     from_sympy,
     sympy_simplify_tree,
@@ -140,6 +144,12 @@ EquationSearch = equation_search
 
 __all__ = [
     "Dataset",
+    "DatasetDiagnostics",
+    "HostileDatasetError",
+    "contain_nonfinite",
+    "pairwise_sum",
+    "sanitize_dataset",
+    "validate_dataset",
     "load_csv_dataset",
     "make_dataset",
     "update_baseline_loss",

@@ -64,6 +64,15 @@ _MATRIX: Tuple[Tuple[str, dict], ...] = (
     # replaces the flat lockstep scan with per-bucket bounded loops —
     # a distinct compiled surface whose aval contract must still hold
     ("bucketed", dict(eval_bucket_ladder=(0.5, 1.0))),
+    # row-sharded deterministic-reduction graphs (ISSUE 15,
+    # docs/robustness_numeric.md): row_shards > 1 swaps every scoring /
+    # constant-optimizer row reduction for the fixed-order pairwise
+    # tree (ops/losses.py::pairwise_sum) — a distinct compiled surface
+    # (row_shards is in _graph_key). The `sharded` config pins the
+    # mesh/collective side; this one pins the REDUCTION program itself
+    # (traced meshless — the graph is identical with or without the
+    # mesh, which is exactly the bit-identity contract).
+    ("rowsharded", dict(row_shards=2)),
 )
 
 #: config name for the phased (chunked-dispatch) closure set

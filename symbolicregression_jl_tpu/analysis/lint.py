@@ -613,6 +613,28 @@ class Linter:
     }
     _BLOWUP_FACTOR_FNS = {"jax.numpy.tile", "jax.numpy.repeat"}
     _BLOWUP_MIN_FACTOR = 8
+    # SR009: ops that manufacture NaN/Inf on part of the float domain.
+    # A jnp.where BRANCH calling one of these on an unclamped input is
+    # the select-on-poisoned-output pitfall (both branches evaluate).
+    _NAN_PRODUCING_FNS = {
+        "jax.numpy.log", "jax.numpy.log2", "jax.numpy.log10",
+        "jax.numpy.log1p", "jax.numpy.sqrt", "jax.numpy.power",
+        "jax.numpy.float_power", "jax.numpy.arcsin", "jax.numpy.arccos",
+        "jax.numpy.arccosh", "jax.numpy.arctanh", "jax.numpy.reciprocal",
+        "jax.lax.log", "jax.lax.log1p", "jax.lax.sqrt", "jax.lax.rsqrt",
+        "jax.lax.pow", "jax.lax.lgamma", "jax.lax.asin", "jax.lax.acos",
+        "jax.lax.acosh", "jax.lax.atanh",
+    }
+    # ...unless the producer's input is already clamped into its domain:
+    # an argument that IS a call to one of these (the safe_* pattern —
+    # jnp.log(jnp.where(x > 0, x, 1.0)), jnp.sqrt(jnp.maximum(x, 0)))
+    _DOMAIN_CLAMP_FNS = {
+        "jax.numpy.where", "jax.numpy.clip", "jax.numpy.maximum",
+        "jax.numpy.minimum", "jax.numpy.abs", "jax.numpy.absolute",
+        "jax.numpy.exp", "jax.lax.clamp", "jax.lax.max", "jax.lax.min",
+        "jax.lax.abs", "jax.lax.exp", "jax.lax.select",
+        "jax.nn.softplus", "jax.nn.sigmoid",
+    }
 
     def _scan_jit_function(self, mod: ModuleInfo, info: FuncInfo) -> None:
         scope = info.scope
@@ -730,6 +752,11 @@ class Linter:
                             f"materializes {fac}x the input bytes",
                             function=info.qualname,
                         )
+                elif (
+                    full in ("jax.numpy.where", "jax.lax.select")
+                    and len(node.args) >= 3
+                ):
+                    linter._check_where_nan_branch(mod, info, node, scope)
 
         def scan_stmts(stmts) -> None:
             for stmt in stmts:
@@ -817,6 +844,76 @@ class Linter:
             scan_expr(info.node.body)
         else:
             scan_stmts(info.node.body)
+
+    # SR009 ------------------------------------------------------------
+    def _is_domain_clamped(self, scope: Scope, arg) -> bool:
+        """True when `arg` is already forced into an op's domain: a call
+        to a clamping fn (the safe_* inner-where pattern), a literal, or
+        a unary +/- of either. Precision over recall: a Name or an
+        arithmetic expression is treated as UNclamped only at the
+        producer's direct argument position (names that were clamped
+        upstream are invisible to the AST — flag-and-pragma is the
+        documented escape)."""
+        if isinstance(arg, ast.Constant):
+            return True
+        if isinstance(arg, ast.UnaryOp):
+            return self._is_domain_clamped(scope, arg.operand)
+        if isinstance(arg, ast.Call):
+            full = self._canonical(scope, arg.func)
+            if full in self._DOMAIN_CLAMP_FNS:
+                return True
+            # method-form clamps: x.clip(...), jnp.abs via attr chains
+            if isinstance(arg.func, ast.Attribute) and arg.func.attr in (
+                "clip",
+            ):
+                return True
+        return False
+
+    def _check_where_nan_branch(
+        self, mod: ModuleInfo, info: FuncInfo, node: ast.Call, scope: Scope
+    ) -> None:
+        """SR009: a jnp.where/lax.select whose value branch applies a
+        NaN-producing op to an unclamped input. Both branches of a
+        select evaluate, so the out-of-domain lanes compute anyway —
+        the guard must clamp the INPUT, not select on the poisoned
+        output (rules.py SR009; fixtures fixture_sr009.py)."""
+        for branch in node.args[1:3]:
+            hit = None
+            if isinstance(branch, ast.Call):
+                bfull = self._canonical(scope, branch.func)
+                if bfull in self._NAN_PRODUCING_FNS and branch.args:
+                    if not self._is_domain_clamped(scope, branch.args[0]):
+                        hit = (
+                            f"{(bfull or '?').replace('jax.numpy.', 'jnp.')}"
+                            "(<unclamped>)"
+                        )
+            elif isinstance(branch, ast.BinOp) and isinstance(
+                branch.op, ast.Div
+            ):
+                if not self._is_domain_clamped(scope, branch.right):
+                    hit = "a division with an unclamped denominator"
+            elif isinstance(branch, ast.BinOp) and isinstance(
+                branch.op, ast.Pow
+            ):
+                exp = branch.right
+                frac_exp = isinstance(exp, ast.Constant) and isinstance(
+                    exp.value, float
+                ) and not float(exp.value).is_integer()
+                if frac_exp and not self._is_domain_clamped(
+                    scope, branch.left
+                ):
+                    hit = "a fractional power of an unclamped base"
+            if hit is not None:
+                self._add(
+                    mod, node, "SR009",
+                    f"jnp.where branch computes {hit} in jit-reachable "
+                    f"{info.qualname}(): both branches evaluate, so the "
+                    "untaken lanes still manufacture NaN/Inf (NaN grads "
+                    "through 0*NaN) — clamp the op's INPUT "
+                    "(jnp.where(ok, x, safe)/maximum/clip inside the "
+                    "call), don't select on the poisoned output",
+                    function=info.qualname,
+                )
 
     # SR008 (host-side functions only) ---------------------------------
     def _scan_host_roundtrip(self, mod: ModuleInfo, info: FuncInfo) -> None:

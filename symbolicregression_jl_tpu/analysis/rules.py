@@ -173,6 +173,29 @@ RULES: Dict[str, Rule] = {
                 "Array (jit accepts device arrays directly)."
             ),
         ),
+        Rule(
+            id="SR009",
+            name="where-after-nan-producing-op",
+            summary=(
+                "jnp.where branch applies a NaN-producing op (log/sqrt/"
+                "arcsin/power/division, ...) to an unclamped input in "
+                "jit-reachable code"
+            ),
+            rationale=(
+                "jnp.where evaluates BOTH branches: selecting on the "
+                "output of jnp.log(x) still computes log over the "
+                "out-of-domain lanes, so the untaken branch "
+                "manufactures NaN/Inf — harmless to the forward value "
+                "but poisonous to jax.grad (the cotangent through the "
+                "untaken branch multiplies 0 * NaN = NaN, the classic "
+                "where-grad pitfall) and to any isfinite-based "
+                "containment reading the intermediate. The guard must "
+                "clamp the INPUT (jnp.log(jnp.where(x > 0, x, 1.0)), "
+                "jnp.maximum, jnp.clip), not select on the poisoned "
+                "output — exactly how ops/operators.py's safe_* "
+                "operators are written (docs/robustness_numeric.md)."
+            ),
+        ),
     ]
 }
 

@@ -32,7 +32,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .models.dataset import Dataset, make_dataset, update_baseline_loss
+from .models.dataset import (
+    Dataset,
+    make_dataset,
+    sanitize_dataset,
+    update_baseline_loss,
+    validate_dataset,
+)
 from .models.evolve import (
     IslandState,
     expected_optimize_count,
@@ -107,6 +113,10 @@ class EquationSearchResult:
     # otherwise): {"totals": {scored, unique, memo_hits, evaluated,
     # hit_rate, unique_ratio}, "per_iteration": [...], "banks": [...]}
     cache_stats: Optional[dict] = None
+    # hostile-data front-door census (models/dataset.py
+    # DatasetDiagnostics.to_dict()): what validate_dataset found and
+    # what Options.data_policy did about it (docs/robustness_numeric.md)
+    dataset_diagnostics: Optional[dict] = None
 
     @property
     def multi_output(self) -> bool:
@@ -841,7 +851,42 @@ def _curmaxsize(
     return min(cur, options.maxsize)
 
 
-def equation_search(
+def equation_search(X, y, **kwargs) -> EquationSearchResult:
+    """Public entry — see :func:`_equation_search_impl` for the full
+    signature and docs (the module bottom forwards ``__wrapped__`` and
+    the impl docstring, so ``inspect.signature``/``help()`` surface the
+    full keyword signature under this public name).
+
+    This thin wrapper owns ONE concern: a ``row_shards > 1`` search runs
+    under ``jax_threefry_partitionable=True`` (restored afterwards; the
+    flag is part of jax's jit trace context, so cached programs cannot
+    serve the wrong lowering). The legacy threefry lowering generates
+    DIFFERENT random values depending on how XLA partitions the
+    requesting program — measured: `migrate`'s randint/bernoulli draws
+    diverged between the (islands, rows) mesh and the single-device run
+    of the same Options — which would defeat the deterministic pairwise
+    loss reduction's bit-identity contract (docs/robustness_numeric.md).
+    The partitionable implementation is partition-invariant by
+    construction. It draws a different (equally distributed) stream than
+    the legacy one, so it is scoped HERE, to row-sharded searches only:
+    row_shards=1 searches keep the exact seed streams every existing
+    baseline and golden value was recorded under."""
+    options = kwargs.get("options")
+    row_shards = (
+        options.row_shards if options is not None
+        else int(kwargs.get("row_shards", 1))
+    )
+    if row_shards <= 1:
+        return _equation_search_impl(X, y, **kwargs)
+    prev = jax.config.jax_threefry_partitionable
+    jax.config.update("jax_threefry_partitionable", True)
+    try:
+        return _equation_search_impl(X, y, **kwargs)
+    finally:
+        jax.config.update("jax_threefry_partitionable", prev)
+
+
+def _equation_search_impl(
     X,
     y,
     *,
@@ -922,8 +967,23 @@ def equation_search(
         )
         jax.config.update("jax_enable_x64", True)
     host_dtype = np.float64 if options.precision == "float64" else np.float32
-    X = np.asarray(X, host_dtype)
-    y = np.asarray(y, host_dtype)
+    # the precision cast can itself manufacture non-finites (a finite
+    # float64 1e40 is inf in float32): count those cells so the front
+    # door's diagnosis says "overflowed the precision cast — rescale or
+    # use float64" instead of misreporting clean data as containing
+    # NaN/Inf (docs/robustness_numeric.md)
+    X_raw, y_raw = np.asarray(X), np.asarray(y)
+    X = np.asarray(X_raw, host_dtype)
+    y = np.asarray(y_raw, host_dtype)
+    cast_overflow = 0
+    if X_raw.dtype != host_dtype or y_raw.dtype != host_dtype:
+        try:
+            cast_overflow = int(
+                (np.isfinite(X_raw) & ~np.isfinite(X)).sum()
+                + (np.isfinite(y_raw) & ~np.isfinite(y)).sum()
+            )
+        except TypeError:  # non-numeric input: asarray already raised
+            cast_overflow = 0
     if X.ndim != 2:
         raise ValueError("X must be (nfeatures, n)")
     multi = y.ndim == 2
@@ -934,6 +994,33 @@ def equation_search(
         )
     nfeatures = X.shape[0]
 
+    # ---- hostile-data front door (models/dataset.py,
+    # docs/robustness_numeric.md): validate BEFORE any jitted program
+    # sees the data, then apply Options.data_policy — fail fast with a
+    # structured report (reject), exclude bad rows through the weights
+    # path (mask), or impute bad cells (repair). A clean dataset passes
+    # through untouched under every policy (bit-identity). The census
+    # lands in the telemetry run_start event and on the result. ----
+    if weights is not None:
+        weights = np.asarray(weights, host_dtype)
+    data_diags = validate_dataset(X, ys, weights)
+    data_diags.cast_overflow_cells = cast_overflow
+    if cast_overflow:
+        data_diags.errors.append(
+            f"{cast_overflow} finite value(s) overflowed the "
+            f"precision='{options.precision}' cast (|value| beyond the "
+            "working dtype's range) — rescale the data or use "
+            "precision='float64'; these cells are counted in the "
+            "non-finite census above"
+        )
+    X, ys, weights, data_diags = sanitize_dataset(
+        X, ys, weights, options.data_policy, data_diags
+    )
+    X = np.asarray(X, host_dtype)
+    ys = np.asarray(ys, host_dtype)
+    if weights is not None:
+        weights = np.asarray(weights, host_dtype)
+
     # multi-host bring-up (no-op on a single host): the analog of the
     # reference's addprocs/worker-setup block
     # (src/SymbolicRegression.jl:500-528) — every host runs this same
@@ -941,6 +1028,10 @@ def equation_search(
     # MUST run before preflight: jax.distributed.initialize refuses to run
     # once any backend has executed a computation.
     initialize_multihost()
+
+    if data_diags.warnings and options.verbosity > 0 and is_primary_host():
+        for wmsg in data_diags.warnings:
+            print(f"dataset warning: {wmsg}", file=sys.stderr)
 
     if runtests:
         preflight_checks(options, X, ys, weights, pipeline=True)
@@ -1027,6 +1118,10 @@ def equation_search(
             # single-device): a degraded mesh choice (idle devices) is
             # part of the machine-readable record, not just a warning
             **describe_mesh(mesh),
+            # hostile-data front-door census + policy provenance
+            # (schema-additive; docs/robustness_numeric.md): what the
+            # validator found and what sanitize_dataset did about it
+            dataset_diagnostics=data_diags.to_dict(),
             # resilience provenance (schema-additive): the snapshot
             # cadence this run writes under, and — on a resumed run —
             # where its saved_state came from (null = fresh start)
@@ -1706,4 +1801,15 @@ def equation_search(
         num_evals=total_evals,
         search_time_s=search_time_s,
         cache_stats=cache_stats,
+        dataset_diagnostics=data_diags.to_dict(),
     )
+
+
+# introspection passthrough: help()/inspect.signature on the public
+# wrapper surface the impl's full keyword signature and doc
+equation_search.__wrapped__ = _equation_search_impl
+equation_search.__doc__ = (
+    (_equation_search_impl.__doc__ or "")
+    + "\n\n"
+    + (equation_search.__doc__ or "")
+)

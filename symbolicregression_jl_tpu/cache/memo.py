@@ -98,6 +98,7 @@ def dataset_fingerprint(X, y, weights, options) -> str:
         backend = "pallas" if resolve_eval_backend_pallas(
             "auto", options.dtype, rescore_batch,
             int(np.asarray(y).shape[-1]),
+            deterministic=options.row_shards > 1,
         ) else "jnp"
     # eval_rows_per_tile changes the jnp reduction order (tile-wise
     # partial sums — fitness._make_eval_loss_fn) so it is part of the

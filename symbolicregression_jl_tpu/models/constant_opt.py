@@ -26,7 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.interpreter import _eval_single
-from ..ops.losses import aggregate_loss
+from ..ops.losses import aggregate_loss, contain_nonfinite
 from .fitness import loss_to_score
 from .complexity import compute_complexity
 from .options import Options
@@ -48,18 +48,25 @@ def _member_loss_fn(
     """loss(cval) for one member over the full dataset
     (reference opt objective src/ConstantOptimization.jl:11-19). Dispatches
     to options.loss_function when set, like every other scoring path —
-    constants must be fitted to the same objective selection uses."""
+    constants must be fitted to the same objective selection uses.
+    Both forms contain non-finite objectives through the shared
+    `contain_nonfinite` epilogue, and with row_shards > 1 the row
+    reduction goes through the same fixed-order pairwise tree as the
+    scoring path (the optimizer's f_best is written into pop.losses, so
+    its reduction must be partition-invariant too or a row-sharded run
+    would diverge from the single-device one at the first write-back)."""
     if options.loss_function is not None:
 
         def f_custom(cval: Array) -> Array:
             loss = options.loss_function(
                 tree._replace(cval=cval), X, y, weights, options
             )
-            return jnp.where(jnp.isfinite(loss), loss, jnp.inf)
+            return contain_nonfinite(loss)
 
         return f_custom
 
     loss_fn = options.elementwise_loss
+    deterministic = options.row_shards > 1
 
     def f(cval: Array) -> Array:
         y_pred, ok = _eval_single(
@@ -67,8 +74,8 @@ def _member_loss_fn(
             options.operators,
         )
         elem = loss_fn(y_pred, y)
-        loss = aggregate_loss(elem, weights)
-        return jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
+        loss = aggregate_loss(elem, weights, deterministic=deterministic)
+        return contain_nonfinite(loss, ok)
 
     return f
 
@@ -98,14 +105,23 @@ def _bfgs_single(
         fs = jax.vmap(loss_f)(cand)
         k = jnp.argmin(fs)
         f_new = fs[k]
-        improved = f_new < f
+        # non-finite step rejection (the containment contract): the
+        # objective is inf-contained so f_new < f already excludes
+        # non-finite candidates whenever f is finite; the explicit
+        # isfinite makes the reject-step rule hold from a NON-finite
+        # initial point too (f0 = inf must never accept an inf step)
+        improved = (f_new < f) & jnp.isfinite(f_new)
         t = ts[k]
         x_new = jnp.where(improved, x + t * d, x)
         g_new = jnp.where(improved, masked_grad(x_new), g)
         s = x_new - x
         yv = g_new - g
         sy = jnp.dot(s, yv)
-        rho = jnp.where(jnp.abs(sy) > 1e-10, 1.0 / sy, 0.0)
+        # SR009 form: divide the clamped input, then select — 1/sy on
+        # the near-zero lanes would manufacture inf in the untaken
+        # branch (bit-identical: selected lanes see the true sy)
+        ok_sy = jnp.abs(sy) > 1e-10
+        rho = jnp.where(ok_sy, 1.0 / jnp.where(ok_sy, sy, 1.0), 0.0)
         I = jnp.eye(L, dtype=x.dtype)
         V = I - rho * jnp.outer(s, yv)
         H_new = V @ H @ V.T + rho * jnp.outer(s, s)
@@ -118,7 +134,11 @@ def _bfgs_single(
     g0 = masked_grad(x0)
     H0 = jnp.eye(L, dtype=x0.dtype)
     x, f, _, _ = jax.lax.fori_loop(0, n_iters, body, (x0, f0, g0, H0))
-    return x, f
+    # restored-constants fallback: an instance whose objective never
+    # reached a finite value hands back its ORIGINAL constants with the
+    # inf objective — the caller's write-back then restores the member
+    # untouched instead of adopting line-search wreckage
+    return jnp.where(jnp.isfinite(f), x, x0), f
 
 
 def _nelder_mead_single(
@@ -181,14 +201,19 @@ def _nelder_mead_single(
                 jnp.where(fc < f_worst, fc, fsh),
             ),
         )
-        accept = new_f < f_worst
+        # reject non-finite steps explicitly (containment contract):
+        # with an all-inf simplex (hostile data / poisoned x0) the inf
+        # candidates must never displace a vertex
+        accept = (new_f < f_worst) & jnp.isfinite(new_f)
         verts = verts.at[-1].set(jnp.where(accept, new_x, worst))
         fs = fs.at[-1].set(jnp.where(accept, new_f, f_worst))
         return verts, fs
 
     verts, fs = jax.lax.fori_loop(0, n_iters * 3, body, (verts, fs))
     k = jnp.argmin(fs)
-    return verts[k], fs[k]
+    # restored-constants fallback (see _bfgs_single): never hand back a
+    # vertex whose objective is non-finite
+    return jnp.where(jnp.isfinite(fs[k]), verts[k], x0), fs[k]
 
 
 def _newton_single(
@@ -218,12 +243,15 @@ def _newton_single(
         cand = x[None, :] - ts[:, None] * step[None, :]
         fs = jax.vmap(loss_f)(cand)
         k = jnp.argmin(fs)
-        improved = fs[k] < f
+        # non-finite step rejection, like _bfgs_single
+        improved = (fs[k] < f) & jnp.isfinite(fs[k])
         x = jnp.where(improved, cand[k], x)
         f = jnp.where(improved, fs[k], f)
         return x, f
 
-    return jax.lax.fori_loop(0, n_iters, body, (x0, loss_f(x0)))
+    x, f = jax.lax.fori_loop(0, n_iters, body, (x0, loss_f(x0)))
+    # restored-constants fallback (see _bfgs_single)
+    return jnp.where(jnp.isfinite(f), x, x0), f
 
 
 _FORCE_INTERPRET = False  # tests only: run the fused kernels in interpret
@@ -244,6 +272,15 @@ def _use_fused_kernels(options: Options, n_instances: int, X: Array) -> bool:
 
     backend = options.optimizer_backend
     if backend == "jnp":
+        return False
+    if options.row_shards > 1:
+        # deterministic (row-sharded) optimization must reduce rows
+        # with the same fixed-order pairwise tree as the scoring path —
+        # the fused kernel's row reduction is the kernel's own
+        # accumulation order, which would break the row-sharded
+        # bit-identity contract at the first f_best write-back
+        # (docs/robustness_numeric.md; Options rejects the explicit
+        # optimizer_backend='pallas' + row_shards>1 combo)
         return False
     if options.optimizer_algorithm != "BFGS" or (
         options.loss_function is not None
@@ -314,15 +351,15 @@ def _bfgs_batched(
 
     def loss_grad(x):
         loss, grad, ok = grad_fn(x)
-        f = jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
+        f = contain_nonfinite(loss, ok)
+        # the grad-side containment twin: a non-finite gradient
+        # component is zeroed (reject the direction, keep the instance)
         g = jnp.where(jnp.isfinite(grad), grad, 0.0) * cmask
         return f, g
 
     def loss_batch(xs):  # (M, _LS_STEPS, L) -> (M, _LS_STEPS)
         loss, _, ok = ls_fn(xs.reshape(M * _LS_STEPS, L))
-        return jnp.where(
-            ok & jnp.isfinite(loss), loss, jnp.inf
-        ).reshape(M, _LS_STEPS)
+        return contain_nonfinite(loss, ok).reshape(M, _LS_STEPS)
 
     I = jnp.eye(L, dtype=x0.dtype)
 
@@ -336,7 +373,8 @@ def _bfgs_batched(
         fs = loss_batch(cand)
         k = jnp.argmin(fs, axis=1)
         f_new = jnp.take_along_axis(fs, k[:, None], axis=1)[:, 0]
-        improved = f_new < f
+        # non-finite step rejection, like _bfgs_single
+        improved = (f_new < f) & jnp.isfinite(f_new)
         # select, don't scale: 0 * inf direction would poison x with NaN
         # (matching _bfgs_single's where-form)
         x_new = jnp.where(
@@ -347,7 +385,9 @@ def _bfgs_batched(
         s = x_new - x
         yv = g_new - g
         sy = jnp.einsum("mi,mi->m", s, yv)
-        rho = jnp.where(jnp.abs(sy) > 1e-10, 1.0 / sy, 0.0)
+        # SR009 form: clamp the divisor, then select (see _bfgs_single)
+        ok_sy = jnp.abs(sy) > 1e-10
+        rho = jnp.where(ok_sy, 1.0 / jnp.where(ok_sy, sy, 1.0), 0.0)
         V = I[None] - rho[:, None, None] * s[:, :, None] * yv[:, None, :]
         H_new = (
             jnp.einsum("mij,mjk,mlk->mil", V, H, V)
@@ -364,7 +404,8 @@ def _bfgs_batched(
     f0, g0 = loss_grad(x0)
     H0 = jnp.broadcast_to(I, (M, L, L))  # srlint: disable=SR007 -- fori_loop carry: per-instance Hessians must be materialized once
     x, f, _, _ = jax.lax.fori_loop(0, n_iters, body, (x0, f0, g0, H0))
-    return x, f
+    # restored-constants fallback (see _bfgs_single)
+    return jnp.where(jnp.isfinite(f)[:, None], x, x0), f
 
 
 # name -> (fn, evals_per_member(L, n_iters)) for num_evals accounting
@@ -510,7 +551,16 @@ def _write_back(pop, sel_idx, sub_trees, sub_losses, eligible, xs, fs,
     x_best = jnp.take_along_axis(xs, best_r[None, :, None], axis=0)[0]
     f_best = jnp.take_along_axis(fs, best_r[None, :], axis=0)[0]
 
-    improved = eligible & (f_best < sub_losses) & jnp.isfinite(f_best)
+    # containment contract: never write a non-finite constant back into
+    # the population, even behind a finite objective — exp(c) with
+    # c -> -inf evaluates finite, but an inf/NaN cval poisons every
+    # later mutation/perturbation and the export path. A member whose
+    # best restart carries a non-finite constant keeps its pre-opt
+    # constants (restored, not adopted).
+    improved = (
+        eligible & (f_best < sub_losses) & jnp.isfinite(f_best)
+        & jnp.all(jnp.isfinite(x_best), axis=-1)
+    )
     new_sub_cval = jnp.where(improved[:, None], x_best, sub_trees.cval)
     sub_complexity = compute_complexity(
         sub_trees._replace(cval=new_sub_cval), options

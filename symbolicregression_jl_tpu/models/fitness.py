@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.interpreter import eval_loss_trees_fused, eval_trees
-from ..ops.losses import aggregate_loss
+from ..ops.losses import aggregate_loss, contain_nonfinite
 from ..ops.operators import OperatorSet
 from .complexity import compute_complexity
 from .options import Options
@@ -101,7 +101,8 @@ def dispatch_eval(
 
 
 def resolve_eval_backend_pallas(
-    backend: str, dtype, n_trees: int, n_rows: int
+    backend: str, dtype, n_trees: int, n_rows: int,
+    deterministic: bool = False,
 ) -> bool:
     """THE kernel routing decision, in shape terms: True when evaluation
     runs the Pallas kernel. Single source of truth — dispatch_eval, the
@@ -109,11 +110,22 @@ def resolve_eval_backend_pallas(
     the memo bank's fingerprint resolution (cache/memo.py, which must
     predict the backend the rescore will use or a served loss could be
     ULP-wrong) all call this one predicate. All inputs are trace-time
-    constants, so the decision is host-static."""
+    constants, so the decision is host-static.
+
+    deterministic (row_shards > 1): the Pallas kernel's row reduction
+    is the kernel's own accumulation order, NOT the fixed-order
+    pairwise tree that makes row-sharded scoring partition-invariant —
+    so deterministic scoring NEVER routes to the kernel (Options
+    rejects the explicit eval_backend='pallas' + row_shards>1 combo at
+    construction; 'auto' quietly keeps the jnp pairwise graph). Without
+    this gate the bit-identity contract of docs/robustness_numeric.md
+    would silently not hold exactly on the TPU path it targets."""
     from ..ops.pallas_eval import pallas_available
 
     import jax.numpy as _jnp
 
+    if deterministic:
+        return False
     return backend == "pallas" or (
         backend == "auto"
         and pallas_available()
@@ -122,10 +134,13 @@ def resolve_eval_backend_pallas(
     )
 
 
-def _routes_to_pallas(trees: TreeBatch, X: Array, backend: str) -> bool:
+def _routes_to_pallas(
+    trees: TreeBatch, X: Array, backend: str, deterministic: bool = False
+) -> bool:
     """resolve_eval_backend_pallas on an actual (trees, X) call shape."""
     return resolve_eval_backend_pallas(
-        backend, X.dtype, int(np.prod(trees.length.shape)), X.shape[1]
+        backend, X.dtype, int(np.prod(trees.length.shape)), X.shape[1],
+        deterministic=deterministic,
     )
 
 
@@ -151,6 +166,7 @@ def eval_loss_trees_bucketed(
     ladder: Tuple[float, ...],
     rows_per_tile: int = 0,
     presorted: bool = False,
+    deterministic: bool = False,
 ) -> Array:
     """Length-bucketed jnp evaluation: per-tree aggregated loss,
     bit-identical to the flat interpreter path (with rows_per_tile=0).
@@ -197,6 +213,7 @@ def eval_loss_trees_bucketed(
             eval_loss_trees_fused(
                 bucket, X, y, weights, operators, loss_fn,
                 rows_per_tile=rows_per_tile, n_steps=n_steps,
+                deterministic=deterministic,
             )
         )
     if not losses:  # N == 0: every bucket zero-width, like the flat path
@@ -221,13 +238,20 @@ def _make_eval_loss_fn(
     bucket_ladder: Tuple[float, ...] = (),
     rows_per_tile: int = 0,
     length_sorted: bool = False,
+    deterministic: bool = False,
 ) -> Callable:
     """TreeBatch -> per-tree aggregated loss (Inf on NaN/Inf evals,
     reference src/LossFunctions.jl:36-39). The ONE definition of the
     scoring composition: both the plain and the deduped/memoized paths
     call this exact closure, which is what makes the cache subsystem's
     bit-identity guarantee a structural property instead of a
-    keep-two-copies-in-sync obligation.
+    keep-two-copies-in-sync obligation. The inf-sentinel fold is the
+    shared `contain_nonfinite` epilogue on every branch (the
+    containment contract, docs/robustness_numeric.md), and
+    deterministic=True (derived from Options.row_shards > 1 by the
+    options-level callers) selects the fixed-order pairwise row
+    reduction on every jnp branch so row-sharded scoring is
+    bit-identical to single-device scoring.
 
     Dispatch decision tree (docs/eval_pipeline.md): batches that route to
     the Pallas kernel keep the flat composition (the kernel already
@@ -239,23 +263,29 @@ def _make_eval_loss_fn(
     shared-sort hint (see eval_loss_trees_bucketed)."""
 
     def eval_fn(trees: TreeBatch) -> Array:
-        if not _routes_to_pallas(trees, X, backend):
+        if not _routes_to_pallas(trees, X, backend,
+                                 deterministic=deterministic):
             if bucket_ladder:
                 return eval_loss_trees_bucketed(
                     trees, X, y, weights, operators, loss_fn,
                     bucket_ladder, rows_per_tile=rows_per_tile,
-                    presorted=length_sorted,
+                    presorted=length_sorted, deterministic=deterministic,
                 )
-            if rows_per_tile > 0:
+            if rows_per_tile > 0 or deterministic:
+                # deterministic scoring always takes the fused graph:
+                # its pairwise row reduction is the partition-invariant
+                # one (the flat composition below reduces with
+                # jnp.mean, which reassociates under row sharding)
                 return eval_loss_trees_fused(
                     trees, X, y, weights, operators, loss_fn,
                     rows_per_tile=rows_per_tile,
+                    deterministic=deterministic,
                 )
         y_pred, ok = dispatch_eval(trees, X, operators, backend, program,
                                    leaf_skip)
         elem = loss_fn(y_pred, y)
         loss = aggregate_loss(elem, weights)
-        return jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
+        return contain_nonfinite(loss, ok)
 
     return eval_fn
 
@@ -273,21 +303,22 @@ def eval_loss_trees(
     leaf_skip: "str | bool" = "auto",
     bucket_ladder: Tuple[float, ...] = (),
     rows_per_tile: int = 0,
+    deterministic: bool = False,
 ) -> Array:
     """Per-tree aggregated loss over all rows (or the row_idx minibatch).
 
     Trees whose evaluation hit NaN/Inf get Inf loss
     (reference src/LossFunctions.jl:36-39). bucket_ladder / rows_per_tile
-    select the length-bucketed / row-tiled jnp graphs — see
-    _make_eval_loss_fn for the dispatch decision tree and exactness
-    guarantees per path."""
+    / deterministic select the length-bucketed / row-tiled /
+    fixed-order-reduction jnp graphs — see _make_eval_loss_fn for the
+    dispatch decision tree and exactness guarantees per path."""
     if row_idx is not None:
         X = X[:, row_idx]
         y = y[row_idx]
         weights = None if weights is None else weights[row_idx]
     return _make_eval_loss_fn(
         X, y, weights, operators, loss_fn, backend, program, leaf_skip,
-        bucket_ladder, rows_per_tile,
+        bucket_ladder, rows_per_tile, deterministic=deterministic,
     )(trees)
 
 
@@ -304,6 +335,7 @@ def eval_loss_trees_deduped(
     leaf_skip: "str | bool" = "auto",
     bucket_ladder: Tuple[float, ...] = (),
     rows_per_tile: int = 0,
+    deterministic: bool = False,
     memo=None,
 ):
     """eval_loss_trees through the cache subsystem: intra-batch dedup of
@@ -335,6 +367,7 @@ def eval_loss_trees_deduped(
     eval_fn = _make_eval_loss_fn(
         X, y, weights, operators, loss_fn, backend, program, leaf_skip,
         bucket_ladder, rows_per_tile, length_sorted=True,
+        deterministic=deterministic,
     )
     loss, stats = dedup_eval_losses(flat, eval_fn, memo)
     return loss.reshape(batch_shape), stats
@@ -372,11 +405,12 @@ def score_trees_cached(
         leaf_skip=options.kernel_leaf_skip,
         bucket_ladder=options.eval_bucket_ladder,
         rows_per_tile=options.eval_rows_per_tile,
+        deterministic=options.row_shards > 1,
         memo=memo,
     )
     complexity = compute_complexity(trees, options)
     score = loss_to_score(loss, baseline, complexity, options)
-    score = jnp.where(jnp.isfinite(loss), score, jnp.inf)
+    score = contain_nonfinite(score, ref=loss)
     return score, loss, stats
 
 
@@ -414,7 +448,7 @@ def _custom_loss_trees(
     )
     fn = lambda t: options.loss_function(t, X, y, weights, options)
     loss = jax.vmap(fn)(flat)
-    loss = jnp.where(jnp.isfinite(loss), loss, jnp.inf)
+    loss = contain_nonfinite(loss)
     return loss.reshape(batch_shape)
 
 
@@ -438,10 +472,11 @@ def score_trees(
             leaf_skip=options.kernel_leaf_skip,
             bucket_ladder=options.eval_bucket_ladder,
             rows_per_tile=options.eval_rows_per_tile,
+            deterministic=options.row_shards > 1,
         )
     complexity = compute_complexity(trees, options)
     score = loss_to_score(loss, baseline, complexity, options)
-    score = jnp.where(jnp.isfinite(loss), score, jnp.inf)
+    score = contain_nonfinite(score, ref=loss)
     return score, loss
 
 

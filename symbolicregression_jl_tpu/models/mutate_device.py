@@ -183,7 +183,7 @@ def mutate_constant(
     max_change = perturbation_factor * temperature + 1.1
     factor = max_change ** jax.random.uniform(k2)
     bigger = jax.random.bernoulli(k3)
-    factor = jnp.where(bigger, factor, 1.0 / factor)
+    factor = jnp.where(bigger, factor, 1.0 / factor)  # srlint: disable=SR009 -- factor = max_change**u with max_change >= 1.1, u in [0,1): strictly positive, division is total here
     negate = jax.random.bernoulli(k4, probability_negate)
     new_val = tree.cval[idx] * factor * jnp.where(negate, -1.0, 1.0)
     new_cval = tree.cval.at[idx].set(new_val.astype(tree.cval.dtype))

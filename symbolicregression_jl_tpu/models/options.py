@@ -359,9 +359,33 @@ class Options:
     max_cycles_per_dispatch: Optional[int] = None
     # Dataset-row sharding width of the device mesh: with row_shards=r the
     # mesh is (n_devices//r, r) (islands x rows) and X/y shard their row
-    # dim, loss reductions becoming cross-chip psums (the mesh analog of
-    # the reference's big-dataset batching advice, src/Configure.jl:63-70).
+    # dim. Since ISSUE 15, row_shards > 1 also switches every scoring /
+    # constant-optimization row reduction to the fixed-order pairwise
+    # tree (ops/losses.py::pairwise_sum), whose result is invariant to
+    # row partitioning — a row-sharded search is bit-identical to the
+    # single-device run of the same Options (docs/robustness_numeric.md;
+    # the pre-15 psum reassociation exclusion in docs/multichip.md is
+    # gone). Part of _graph_key: the two reduction graphs compile as
+    # distinct programs.
     row_shards: int = 1
+    # --- hostile-data front door (models/dataset.py, ISSUE 15) ---
+    # What equation_search does with a dataset that fails validation
+    # (NaN/Inf cells, constant y, degenerate feature columns, scale
+    # hazards — docs/robustness_numeric.md):
+    #   "reject" (default) — fail fast with a structured
+    #     DatasetDiagnostics report (hard errors only; warnings like a
+    #     constant target are reported, never fatal);
+    #   "mask"   — rows with any non-finite cell are excluded from the
+    #     loss through the existing weights path (weight 0) and their
+    #     cells replaced by finite placeholders so the lockstep
+    #     evaluation stays finite; a no-op on clean data (bit-identical
+    #     to "reject");
+    #   "repair" — non-finite X cells are imputed with their column's
+    #     finite mean (the row stays live); non-finite y/weight rows
+    #     fall back to masking (targets are never invented).
+    # Orchestration-only: the policy transforms the data BEFORE any
+    # jitted program sees it, so it is absent from _graph_key.
+    data_policy: str = "reject"
     # Working dtype for X/y/constants/losses (the reference's Float16/32/64
     # type parameter T). "float64" flips on jax_enable_x64 at search start;
     # "bfloat16" is the TPU-native half precision — large bf16 batches on
@@ -451,6 +475,37 @@ class Options:
             raise ValueError("eval_rows_per_tile must be >= 0")
         if self.row_shards < 1:
             raise ValueError("row_shards must be >= 1")
+        if self.row_shards > 1 and self.eval_backend == "pallas":
+            raise ValueError(
+                "eval_backend='pallas' is incompatible with row_shards > 1:"
+                " the kernel's row reduction is not the fixed-order "
+                "pairwise tree the row-sharded bit-identity contract "
+                "requires (docs/robustness_numeric.md) — use "
+                "eval_backend='auto' or 'jnp'"
+            )
+        if self.row_shards > 1 and self.optimizer_backend == "pallas":
+            raise ValueError(
+                "optimizer_backend='pallas' is incompatible with "
+                "row_shards > 1 (the fused grad kernel's row reduction "
+                "is not partition-invariant; docs/robustness_numeric.md)"
+                " — use optimizer_backend='auto' or 'jnp'"
+            )
+        if self.row_shards > 1 and self.loss_function is not None:
+            raise ValueError(
+                "a custom loss_function is incompatible with "
+                "row_shards > 1: its internal row reductions (jnp.sum/"
+                "jnp.mean over the sharded rows) reassociate under the "
+                "row mesh, so the row-sharded bit-identity contract "
+                "(docs/robustness_numeric.md) cannot be guaranteed for "
+                "an arbitrary callable — use row_shards=1, or express "
+                "the objective as an elementwise `loss` (whose "
+                "aggregation the engine makes partition-invariant)"
+            )
+        if self.data_policy not in ("reject", "mask", "repair"):
+            raise ValueError(
+                "data_policy must be one of reject/mask/repair, got "
+                f"{self.data_policy!r}"
+            )
         if (
             self.max_cycles_per_dispatch is not None
             and self.max_cycles_per_dispatch < 1
@@ -559,8 +614,13 @@ class Options:
             self.independent_island_batches,
             self.n_parallel_tournaments, self.eval_backend,
             self.kernel_program, self.kernel_leaf_skip, self.precision,
-            # bucketed / row-tiled eval graphs are compiled in
+            # bucketed / row-tiled eval graphs are compiled in; so is
+            # the row_shards>1 deterministic pairwise reduction (two
+            # Options differing only in row_shards trace DIFFERENT
+            # scoring graphs — the lru-cached factories must not share
+            # a closure across them)
             self.eval_bucket_ladder, self.eval_rows_per_tile,
+            self.row_shards,
             self.constraints, self.nested_constraints,
             self.complexity_of_operators, self.complexity_of_constants,
             self.complexity_of_variables, self.mutation_weights.as_tuple(),

@@ -64,7 +64,14 @@ def move_window(stats: RunningSearchStatistics) -> RunningSearchStatistics:
     uses an iterative per-bin shave, src/AdaptiveParsimony.jl:57-89; the
     fixed point of both is the same proportional cap)."""
     tot = jnp.sum(stats.frequencies)
-    scale = jnp.where(tot > stats.window_size, stats.window_size / tot, 1.0)
+    # SR009 form: clamp the divisor — an empty stats table (tot = 0)
+    # would compute 0/0 = NaN in the untaken branch. Bit-identical:
+    # the selected lanes require tot > window_size >= the clamp floor.
+    scale = jnp.where(
+        tot > stats.window_size,
+        stats.window_size / jnp.maximum(tot, 1e-9),
+        1.0,
+    )
     return stats._replace(frequencies=stats.frequencies * scale)
 
 

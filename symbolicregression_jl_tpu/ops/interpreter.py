@@ -211,28 +211,39 @@ def _eval_loss_single(
     loss_fn,
     n_steps,
     rows_per_tile: int,
+    deterministic: bool = False,
 ) -> Array:
     """One tree -> aggregated loss scalar (Inf on NaN/Inf evals), never
     materializing the prediction row vector past the reduction.
 
     rows_per_tile == 0 (exact mode): evaluate all rows at once and apply
     literally the flat scoring composition — loss_fn, aggregate_loss,
-    inf-on-incomplete — so the result is bit-identical to the unfused
-    path. rows_per_tile > 0: stream the rows through a lax.scan of
-    fixed-width tiles, accumulating per-tree sufficient statistics
-    (weighted loss sum, weight sum, poison flag); the tile-wise partial
-    sums reduce in a different order than the flat row reduction, so this
-    mode is NOT bit-identical (documented opt-in for large datasets —
-    peak memory per tree drops from O(nrows) to O(rows_per_tile))."""
-    from .losses import aggregate_loss
+    inf-on-incomplete via the shared `contain_nonfinite` epilogue — so
+    the result is bit-identical to the unfused path. rows_per_tile > 0:
+    stream the rows through a lax.scan of fixed-width tiles,
+    accumulating per-tree sufficient statistics (weighted loss sum,
+    weight sum, poison flag); the tile-wise partial sums reduce in a
+    different order than the flat row reduction, so this mode is NOT
+    bit-identical to rows_per_tile=0 (documented opt-in for large
+    datasets — peak memory per tree drops from O(nrows) to
+    O(rows_per_tile)).
+
+    deterministic=True swaps every row reduction for the fixed-order
+    pairwise tree (ops/losses.py::pairwise_sum), making the loss
+    invariant to row-axis sharding — the row_shards>1 graphs
+    (docs/robustness_numeric.md). In tiled mode the within-tile sums go
+    pairwise and the cross-tile fold is the scan's fixed sequential
+    order, so the tiled loss is partition-invariant too (while staying
+    a different order than the untiled one)."""
+    from .losses import aggregate_loss, contain_nonfinite
 
     nrows = X.shape[1]
     if rows_per_tile <= 0 or rows_per_tile >= nrows:
         y_pred, bad = _eval_rows(kind, op, feat, cval, X, operators, n_steps)
         ok = ~jnp.any(bad) & (length > 0)
         elem = loss_fn(y_pred, y)
-        loss = aggregate_loss(elem, weights)
-        return jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
+        loss = aggregate_loss(elem, weights, deterministic=deterministic)
+        return contain_nonfinite(loss, ok)
 
     tile = int(rows_per_tile)
     n_tiles = -(-nrows // tile)
@@ -252,6 +263,10 @@ def _eval_loss_single(
          else wp.reshape(n_tiles, tile)),
     )
 
+    from .losses import pairwise_sum
+
+    _rowsum = pairwise_sum if deterministic else jnp.sum
+
     def tile_step(carry, xt):
         num, den, bad_any = carry
         Xt, yt, mt, wt = xt
@@ -261,8 +276,8 @@ def _eval_loss_single(
         w_eff = mt.astype(elem.dtype) if weights is None else jnp.where(
             mt, wt, jnp.zeros((), elem.dtype)
         )
-        num = num + jnp.sum(elem * w_eff)
-        den = den + jnp.sum(w_eff)
+        num = num + _rowsum(elem * w_eff)
+        den = den + _rowsum(w_eff)
         bad_any = bad_any | jnp.any(bad & mt)
         return (num, den, bad_any), None
 
@@ -273,7 +288,7 @@ def _eval_loss_single(
     (num, den, bad_any), _ = jax.lax.scan(tile_step, init, xs)
     loss = num / den
     ok = ~bad_any & (length > 0)
-    return jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)
+    return contain_nonfinite(loss, ok)
 
 
 def eval_loss_trees_fused(
@@ -285,10 +300,13 @@ def eval_loss_trees_fused(
     loss_fn,
     rows_per_tile: int = 0,
     n_steps=None,
+    deterministic: bool = False,
 ) -> Array:
     """Fused evaluate+reduce: per-tree aggregated loss (Inf on NaN/Inf
     evals) with NO (batch, nrows) prediction intermediate — the
     elementwise loss reduces to a scalar inside the vmapped evaluator.
+    deterministic=True selects the fixed-order pairwise row reduction
+    (sharding-invariant; see _eval_loss_single / ops/losses.py).
 
     trees batch shape (...,); X (nfeat, nrows); y (nrows,); returns loss
     (...,). With rows_per_tile=0 (default) the result is bit-identical to
@@ -311,7 +329,7 @@ def eval_loss_trees_fused(
     f = jax.vmap(
         lambda k, o, ft, c, n: _eval_loss_single(
             k, o, ft, c, n, X, y, weights, operators, loss_fn, n_steps,
-            rows_per_tile,
+            rows_per_tile, deterministic,
         )
     )
     loss = f(flat.kind, flat.op, flat.feat, flat.cval, flat.length)

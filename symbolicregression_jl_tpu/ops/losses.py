@@ -197,10 +197,92 @@ def resolve_loss(loss) -> Callable[[Array, Array], Array]:
     raise ValueError(f"Unknown loss {loss!r}")
 
 
+def contain_nonfinite(value: Array, ok=None, ref: Optional[Array] = None):
+    """THE numeric containment primitive (docs/robustness_numeric.md):
+    clamp ``value`` to the ``+inf`` sentinel wherever the evaluation left
+    the finite domain — ``ok`` is the evaluator's per-tree completeness
+    flag (the reference's ``complete=false`` from ``eval_tree_array``,
+    src/LossFunctions.jl:36-39) and ``ref`` is the array whose
+    finiteness is judged (defaults to ``value`` itself; scores pass
+    their underlying loss so a finite score built on a poisoned loss is
+    still contained).
+
+    One definition on purpose: every scoring path — the flat and fused
+    interpreter compositions, the Pallas batch epilogue, the custom
+    loss_function path, and the BFGS/NelderMead constant-optimizer
+    objectives — routes its inf-sentinel fold through this exact
+    expression, so "non-finite never escapes a scoring epilogue" is a
+    structural property instead of four ad-hoc ``jnp.where`` sites kept
+    in sync by review. The expression is bit-identical to the historic
+    inline form ``jnp.where(ok & jnp.isfinite(loss), loss, jnp.inf)``.
+    """
+    ref = value if ref is None else ref
+    fin = jnp.isfinite(ref)
+    if ok is not None:
+        fin = ok & fin
+    return jnp.where(fin, value, jnp.inf)
+
+
+def pairwise_sum(x: Array, axis: int = -1) -> Array:
+    """Fixed-order pairwise-tree sum along ``axis``: adjacent pairs are
+    added, then adjacent pair-sums, ... log2(n) levels of explicit
+    elementwise adds (zero-padded to the next power of two; ``x + 0``
+    is exact in IEEE arithmetic).
+
+    The reduction ORDER is pinned by the graph structure — every add is
+    its own HLO op — so the result is invariant to how XLA partitions
+    the array: a row-sharded pairwise sum equals the single-device one
+    bit for bit (each level's adds stay shard-local until the array is
+    down to the shard count), which is what re-admits ``row_shards>1``
+    into the search's bit-identity contract (docs/multichip.md). A
+    ``jnp.sum`` by contrast lowers to a reassociable reduce whose
+    partitioned form (per-shard partials + psum) is ULP-different.
+
+    Accuracy: pairwise summation's error grows O(log n) vs the naive
+    left fold's O(n) — deterministic mode is also (slightly) more
+    accurate, never less."""
+    x = jnp.moveaxis(x, axis, -1)
+    n = x.shape[-1]
+    if n == 0:
+        return jnp.zeros(x.shape[:-1], x.dtype)
+    size = 1
+    while size < n:
+        size *= 2
+    if size != n:
+        pad = [(0, 0)] * (x.ndim - 1) + [(0, size - n)]
+        x = jnp.pad(x, pad)
+    while size > 1:
+        x = x.reshape(x.shape[:-1] + (size // 2, 2))
+        x = x[..., 0] + x[..., 1]
+        size //= 2
+    return x[..., 0]
+
+
 def aggregate_loss(
-    elem: Array, weights: Optional[Array] = None, axis=-1
+    elem: Array,
+    weights: Optional[Array] = None,
+    axis=-1,
+    deterministic: bool = False,
 ) -> Array:
-    """Mean / weighted-mean aggregation (reference: src/LossFunctions.jl:11-31)."""
+    """Mean / weighted-mean aggregation (reference: src/LossFunctions.jl:11-31).
+
+    ``deterministic=True`` replaces the reassociable ``jnp.sum``/
+    ``jnp.mean`` row reduction with the fixed-order :func:`pairwise_sum`
+    tree, making the aggregate invariant to row-axis sharding (the
+    ``row_shards>1`` bit-identity contract — see pairwise_sum). The two
+    modes are numerically different reduction orders, so the flag is
+    part of the compiled graph (derived from ``Options.row_shards`` in
+    models/fitness.py, which is in ``_graph_key``)."""
+    if deterministic:
+        if weights is None:
+            n = jnp.asarray(
+                elem.shape[axis if axis >= 0 else elem.ndim + axis],
+                elem.dtype,
+            )
+            return pairwise_sum(elem, axis=axis) / n
+        return pairwise_sum(elem * weights, axis=axis) / pairwise_sum(
+            weights, axis=axis
+        )
     if weights is None:
         return jnp.mean(elem, axis=axis)
     return jnp.sum(elem * weights, axis=axis) / jnp.sum(weights, axis=axis)

@@ -137,6 +137,40 @@ def trajectory_best_throughput(trajectory: Optional[dict]) -> Dict[str, float]:
     return best
 
 
+def _numerically_degenerate(row, ctx):
+    """The containment layer is clamping most of the run's population
+    to the inf sentinel (ISSUE 15): either the tenant's dataset is
+    hostile past what the data policy absorbed, or the opset/scale
+    combination overflows on most trees. Threshold overridable via
+    ctx['nonfinite_threshold'] (default: the run doctor's
+    NONFINITE_DEGENERATE, carried on the row via the doctor flag)."""
+    frac = row.get("nonfinite_fraction")
+    thr = ctx.get("nonfinite_threshold")
+    if thr is not None:
+        if frac is not None and frac > float(thr):
+            return {
+                "message": (
+                    f"{frac:.0%} of population losses carry the inf "
+                    f"sentinel (> {float(thr):.0%}): evaluation is "
+                    "discarding most trees — check the run's "
+                    "dataset_diagnostics"
+                ),
+                "value": frac,
+                "threshold": float(thr),
+            }
+        return None
+    if row.get("numerically_degenerate"):
+        return {
+            "message": (
+                f"run doctor flagged numerically-degenerate "
+                f"({(frac or 0.0):.0%} inf-sentinel population losses)"
+                " — hostile data or overflow-heavy opset"
+            ),
+            "value": frac,
+        }
+    return None
+
+
 def _throughput_regression(row, ctx):
     best = trajectory_best_throughput(ctx.get("trajectory"))
     plat = row.get("backend")
@@ -176,6 +210,13 @@ DEFAULT_ALERT_RULES: Sequence[AlertRule] = (
         "stale_run", "warning",
         "in-flight run with no events for stale_after_s seconds",
         _stale,
+    ),
+    AlertRule(
+        "numerically_degenerate", "warning",
+        "most population losses clamped to the inf sentinel "
+        "(containment layer discarding the search's work — hostile "
+        "data or overflow-heavy opset)",
+        _numerically_degenerate,
     ),
     AlertRule(
         "compile_bound", "info",

@@ -70,6 +70,12 @@ VERDICTS = (
 STALL_WINDOW = 5      # trailing metric snapshots the plateau must span
 STALL_TOL = 1e-3      # relative best-loss improvement below this = flat
 DIVERSITY_FLOOR = 0.2  # unique-tree fraction at/below this = collapsed
+#: population non-finite (inf-sentinel) fraction above which the run is
+#: flagged ``numerically-degenerate`` (a reason, not a verdict — like
+#: compile_bound): most of the population is being clamped by the
+#: containment layer, so the search is burning evals on poisoned trees
+#: without (yet) meeting the `diverging` verdict's 0.9 collapse bar.
+NONFINITE_DEGENERATE = 0.5
 
 
 def load_events(
@@ -194,6 +200,8 @@ def analyze_run(
             # resilience provenance (ISSUE 11): snapshot cadence and,
             # on a resumed run, where its saved_state came from
             "snapshot", "resume_from",
+            # hostile-data front-door census (ISSUE 15)
+            "dataset_diagnostics",
         )
         if start.get(k) is not None
     }
@@ -318,9 +326,13 @@ def analyze_run(
         best_j = _series(ms, "best_loss")
         div_j = _series(ms, "population_diversity")
         frac_j = _series(ms, "population_finite_frac")
+        nonfin_j = _series(ms, "population_nonfinite_fraction")
         gauges_j = ((ms[-1].get("snapshot") or {}).get("gauges") or {})
         s = _summary(best_j) or {}
         per_out[j] = {
+            "last_nonfinite_frac": next(
+                (v for v in reversed(nonfin_j) if v is not None), None
+            ),
             "flat": _stall(best_j, stall_window, stall_tol),
             "last_diversity": next(
                 (v for v in reversed(div_j) if v is not None), None
@@ -438,6 +450,25 @@ def analyze_run(
         reasons.append(
             f"compile-bound: {report['compile_share']:.0%} of "
             "measured wall time went to first-dispatch compilation"
+        )
+    # numeric-containment flag (ISSUE 15): like compile_bound, a reason
+    # riding any verdict — the containment layer is clamping most of
+    # the population to the inf sentinel (hostile data, overflow-heavy
+    # opset, or scale hazards; see run_start.dataset_diagnostics)
+    nonfins = [p["last_nonfinite_frac"] for p in vals
+               if p.get("last_nonfinite_frac") is not None]
+    worst_nonfin = max(nonfins) if nonfins else None
+    report["nonfinite_fraction"] = worst_nonfin
+    report["numerically_degenerate"] = bool(
+        worst_nonfin is not None and worst_nonfin > NONFINITE_DEGENERATE
+    )
+    if report["numerically_degenerate"]:
+        reasons.append(
+            f"numerically-degenerate: {worst_nonfin:.0%} of population "
+            f"losses carry the inf sentinel (> {NONFINITE_DEGENERATE:.0%}"
+            " threshold) — evaluation is clamping most trees; check "
+            "run_start.dataset_diagnostics for scale hazards or "
+            "non-finite cells"
         )
     report["verdict"] = verdict
     return report

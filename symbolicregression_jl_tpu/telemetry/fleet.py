@@ -383,6 +383,13 @@ def _build_row(summaries: List[dict], now: float) -> dict:
         "stage_shares": stage_shares,
         "compile_share": report.get("compile_share"),
         "compile_bound": bool(report.get("compile_bound")),
+        # numeric-containment health (ISSUE 15): latest population
+        # inf-sentinel fraction + the doctor's degenerate flag — the
+        # numerically_degenerate alert rule's inputs
+        "nonfinite_fraction": report.get("nonfinite_fraction"),
+        "numerically_degenerate": bool(
+            report.get("numerically_degenerate")
+        ),
         "roofline_modeled": latest["roofline_modeled"],
         "t_first": t_first,
         "t_last": t_last,

@@ -267,6 +267,11 @@ class SearchMetrics:
             big = jnp.asarray(jnp.finfo(jnp.float32).max, losses.dtype)
             best = jnp.min(jnp.where(finite, losses, big), axis=1)
             n_fin = jnp.sum(finite, axis=1)
+            # numeric-containment census (ISSUE 15): population slots
+            # carrying the inf sentinel — every non-finite evaluation
+            # is clamped to +inf by ops/losses.py::contain_nonfinite,
+            # so this count IS the per-island clamp counter
+            nonfinite = losses.shape[1] - n_fin
             mean = jnp.sum(
                 jnp.where(finite, losses, 0.0), axis=1
             ) / jnp.maximum(n_fin, 1)
@@ -293,6 +298,7 @@ class SearchMetrics:
                 "island_best_loss": best,
                 "island_mean_loss": mean,
                 "island_finite_frac": n_fin / losses.shape[1],
+                "island_nonfinite": nonfinite,
                 "island_diversity": diversity,
                 "length_counts": len_counts,
                 "mean_length": mean_len,
@@ -348,6 +354,23 @@ class SearchMetrics:
             "population_finite_frac",
             "fraction of members with finite loss",
         ).set(float(np.mean(vals["island_finite_frac"])))
+        # containment counters (ISSUE 15, docs/robustness_numeric.md):
+        # the non-finite fraction is the run doctor's
+        # numerically-degenerate signal and the fleet alert input; the
+        # counter accumulates clamped (inf-sentinel) slots observed
+        # across snapshots — a monotone "how much work is evaluation
+        # throwing away" figure
+        nonfinite_total = int(np.sum(vals["island_nonfinite"]))
+        reg.gauge(
+            "population_nonfinite_fraction",
+            "fraction of population losses clamped to the inf sentinel "
+            "(contain_nonfinite)",
+        ).set(1.0 - float(np.mean(vals["island_finite_frac"])))
+        reg.counter(
+            "contained_losses_total",
+            "cumulative inf-sentinel (clamped) population slots "
+            "observed over metric snapshots",
+        ).inc(nonfinite_total)
         reg.gauge("mean_tree_length", "mean program length (slots)").set(
             float(vals["mean_length"])
         )
@@ -471,6 +494,13 @@ class SearchMetrics:
                     "diversity": [
                         float(v) for v in np.asarray(
                             vals["island_diversity"], np.float64
+                        )
+                    ],
+                    # additive (ISSUE 15): inf-sentinel slot count per
+                    # island — the containment clamp census
+                    "nonfinite": [
+                        int(v) for v in np.asarray(
+                            vals["island_nonfinite"], np.int64
                         )
                     ],
                 },

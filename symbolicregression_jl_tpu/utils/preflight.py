@@ -34,13 +34,23 @@ def test_entire_pipeline(options: Options, X, ys, weights=None) -> None:
 
     try:
         probe = make_probe_options(options)
-        n = min(20, X.shape[1])
-        Xp = jnp.asarray(np.asarray(X)[:, :n], jnp.float32)
-        yp = jnp.asarray(np.asarray(ys)[0, :n], jnp.float32)
+        # probe the first up-to-20 USABLE rows: with a weights vector,
+        # rows carrying zero weight are excluded from the loss (the
+        # data_policy="mask" front door parks bad rows there —
+        # docs/robustness_numeric.md), and a probe slice of only
+        # zero-weight rows would aggregate 0/0 -> all-inf scores and
+        # fail a perfectly healthy configuration
+        X_h, ys_h = np.asarray(X), np.asarray(ys)
+        w_h = None if weights is None else np.asarray(weights)
+        if w_h is not None and np.any(w_h > 0):
+            idx = np.where(w_h > 0)[0][:20]
+        else:
+            idx = np.arange(min(20, X_h.shape[1]))
+        Xp = jnp.asarray(X_h[:, idx], jnp.float32)
+        yp = jnp.asarray(ys_h[0, idx], jnp.float32)
         wp = (
-            None
-            if weights is None
-            else jnp.asarray(np.asarray(weights)[:n], jnp.float32)
+            None if w_h is None
+            else jnp.asarray(w_h[idx], jnp.float32)
         )
         st = init_island_state(
             jax.random.PRNGKey(0), probe, X.shape[0], Xp, yp, wp, 1.0

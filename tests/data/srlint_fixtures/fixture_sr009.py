@@ -1,0 +1,70 @@
+"""SR009 fixture: jnp.where-after-NaN-producing-op (select on the
+poisoned output instead of clamping the input). Parsed by the linter,
+never imported."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_log_branch(x):
+    # VIOLATION SR009: log evaluates over x <= 0 lanes anyway
+    return jnp.where(x > 0, jnp.log(x), 0.0)
+
+
+@jax.jit
+def bad_sqrt_branch(x):
+    # VIOLATION SR009: sqrt of unclamped negative lanes
+    return jnp.where(x >= 0, jnp.sqrt(x), x)
+
+
+@jax.jit
+def bad_division_branch(x, y):
+    # VIOLATION SR009: x / y computes over y == 0 lanes
+    return jnp.where(y != 0, x / y, 0.0)
+
+
+@jax.jit
+def bad_fractional_power(x):
+    # VIOLATION SR009: x ** 0.5 is sqrt of an unclamped base
+    return jnp.where(x > 0, x ** 0.5, 0.0)
+
+
+@jax.jit
+def good_clamped_log(x):
+    # OK: the input is clamped into the domain (the safe_* pattern)
+    return jnp.where(x > 0, jnp.log(jnp.where(x > 0, x, 1.0)), 0.0)
+
+
+@jax.jit
+def good_clamped_sqrt(x):
+    # OK: maximum clamps the input
+    return jnp.sqrt(jnp.maximum(x, 0.0))
+
+
+@jax.jit
+def good_clamped_division(x, y):
+    # OK: the denominator is clamped
+    return jnp.where(y != 0, x / jnp.where(y != 0, y, 1.0), 0.0)
+
+
+@jax.jit
+def good_integer_power(x):
+    # OK: integer powers are total on floats
+    return jnp.where(x > 1, x ** 2, x)
+
+
+@jax.jit
+def good_plain_select(x, y):
+    # OK: no NaN-producing op in either branch
+    return jnp.where(x > y, x, y)
+
+
+@jax.jit
+def pragma_suppressed(x):
+    return jnp.where(x > 1, jnp.log(x), 0.0)  # srlint: disable=SR009 -- x > 1 proven by the caller's contract
+
+
+def host_only_where(x):
+    # not jit-reachable: SR009 does not apply
+    return jnp.where(x > 0, jnp.log(x), 0.0)

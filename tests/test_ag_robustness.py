@@ -1,0 +1,626 @@
+"""Hostile-data hardening (ISSUE 15): the dataset front door
+(validate/sanitize + Options.data_policy), the shared numeric
+containment primitive, the fixed-order pairwise row reduction, and the
+new telemetry fields (docs/robustness_numeric.md).
+
+Search-level tests share ONE Options graph (same shapes, same knobs) so
+the whole file pays a single compile; the heavyweight combinations live
+under `slow` per the tier-1 dot-budget policy.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import symbolicregression_jl_tpu as sr
+from symbolicregression_jl_tpu.models.dataset import (
+    SCALE_HAZARD_ABS,
+    DatasetDiagnostics,
+    HostileDatasetError,
+    sanitize_dataset,
+    validate_dataset,
+)
+from symbolicregression_jl_tpu.models.options import make_options
+from symbolicregression_jl_tpu.ops.losses import (
+    aggregate_loss,
+    contain_nonfinite,
+    pairwise_sum,
+)
+
+KW = dict(
+    binary_operators=["+", "-", "*"],
+    unary_operators=["cos"],
+    npop=16,
+    npopulations=2,
+    ncycles_per_iteration=10,
+    maxsize=8,
+    should_optimize_constants=False,
+    verbosity=0,
+    progress=False,
+    runtests=False,
+    niterations=1,
+)
+
+
+def make_data(seed=0, n=64):
+    rng = np.random.default_rng(seed)
+    X = rng.standard_normal((3, n)).astype(np.float32)
+    y = (X[0] * X[0] + np.cos(X[2])).astype(np.float32)
+    return X, y
+
+
+def frontier(r):
+    return [
+        (c.complexity, c.equation, float(c.loss), float(c.score))
+        for c in r.frontier()
+    ]
+
+
+# ---------------------------------------------------------------------------
+# contain_nonfinite — THE containment primitive
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_contain_nonfinite_semantics():
+    v = jnp.asarray([1.0, np.nan, np.inf, -np.inf, 2.0])
+    out = np.asarray(contain_nonfinite(v))
+    np.testing.assert_array_equal(out, [1.0, np.inf, np.inf, np.inf, 2.0])
+    # ok flag folds in
+    ok = jnp.asarray([True, True, True, True, False])
+    out = np.asarray(contain_nonfinite(v, ok))
+    np.testing.assert_array_equal(
+        out, [1.0, np.inf, np.inf, np.inf, np.inf]
+    )
+    # ref: judge another array's finiteness (score contained on loss)
+    score = jnp.asarray([0.1, 0.2, 0.3])
+    loss = jnp.asarray([1.0, np.nan, 2.0])
+    np.testing.assert_array_equal(
+        np.asarray(contain_nonfinite(score, ref=loss)),
+        np.asarray([0.1, np.inf, 0.3], np.float32),
+    )
+    # bit-identical to the historic inline form
+    ref = jnp.where(ok & jnp.isfinite(v), v, jnp.inf)
+    np.testing.assert_array_equal(
+        np.asarray(contain_nonfinite(v, ok)), np.asarray(ref)
+    )
+
+
+# ---------------------------------------------------------------------------
+# pairwise_sum / deterministic aggregation
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_pairwise_sum_matches_sum():
+    rng = np.random.default_rng(0)
+    for n in (1, 2, 3, 7, 64, 100, 1000):
+        x = rng.standard_normal(n).astype(np.float32)
+        got = float(pairwise_sum(jnp.asarray(x)))
+        want = float(np.sum(x.astype(np.float64)))
+        assert abs(got - want) < 1e-3 * max(1.0, abs(want)), (n, got, want)
+    # batched, non-last axis
+    xb = rng.standard_normal((5, 33)).astype(np.float32)
+    got = np.asarray(pairwise_sum(jnp.asarray(xb.T), axis=0))
+    np.testing.assert_allclose(
+        got, xb.astype(np.float64).sum(1), rtol=1e-5
+    )
+    # empty axis sums to zero
+    assert float(pairwise_sum(jnp.zeros((0,), jnp.float32))) == 0.0
+
+
+@pytest.mark.fast
+def test_aggregate_loss_deterministic_forms():
+    rng = np.random.default_rng(1)
+    elem = rng.standard_normal(257).astype(np.float32)
+    w = np.abs(rng.standard_normal(257)).astype(np.float32)
+    for weights in (None, w):
+        a = float(aggregate_loss(jnp.asarray(elem), None if weights is
+                                 None else jnp.asarray(weights)))
+        b = float(aggregate_loss(
+            jnp.asarray(elem), None if weights is None
+            else jnp.asarray(weights), deterministic=True,
+        ))
+        assert abs(a - b) < 1e-4 * max(1.0, abs(a))
+    # NaN poison propagates through the pairwise tree like the flat sum
+    elem_bad = elem.copy()
+    elem_bad[13] = np.nan
+    assert not np.isfinite(
+        float(aggregate_loss(jnp.asarray(elem_bad), deterministic=True))
+    )
+
+
+@pytest.mark.fast
+def test_deterministic_loss_matches_flat_closely_and_exactly_repeats():
+    from symbolicregression_jl_tpu.models.fitness import eval_loss_trees
+    from symbolicregression_jl_tpu.models.mutate_device import (
+        gen_random_tree_fixed_size,
+    )
+
+    opts = make_options(**{k: v for k, v in KW.items()
+                           if k not in ("verbosity", "progress",
+                                        "runtests", "niterations")})
+    X, y = make_data(n=100)
+    keys = jax.random.split(jax.random.PRNGKey(0), 32)
+    sizes = jax.random.randint(jax.random.PRNGKey(1), (32,), 3, 8)
+    trees = jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(
+            k, s, 3, opts.operators, opts.max_len
+        )
+    )(keys, sizes)
+    args = (trees, jnp.asarray(X), jnp.asarray(y), None, opts.operators,
+            opts.elementwise_loss)
+    flat = np.asarray(eval_loss_trees(*args, backend="jnp"))
+    det1 = np.asarray(
+        eval_loss_trees(*args, backend="jnp", deterministic=True)
+    )
+    det2 = np.asarray(
+        eval_loss_trees(*args, backend="jnp", deterministic=True)
+    )
+    np.testing.assert_array_equal(det1, det2)
+    fin = np.isfinite(flat)
+    np.testing.assert_array_equal(fin, np.isfinite(det1))
+    np.testing.assert_allclose(det1[fin], flat[fin], rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# validate_dataset — the census
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_validate_clean_dataset():
+    X, y = make_data()
+    d = validate_dataset(X, y)
+    assert d.ok and not d.warnings
+    assert d.n_rows == 64 and d.n_features == 3 and d.n_outputs == 1
+    assert d.bad_rows == 0 and d.to_dict()["bad_rows"] == 0
+
+
+@pytest.mark.fast
+def test_validate_nonfinite_census():
+    X, y = make_data()
+    X[0, 3] = np.nan
+    X[1, 3] = np.inf  # same row: counted once in bad_rows
+    y[10] = np.nan
+    w = np.ones(64, np.float32)
+    w[20] = np.inf
+    d = validate_dataset(X, y, w)
+    assert d.nonfinite_x_cells == 2
+    assert d.nonfinite_y_cells == 1
+    assert d.nonfinite_weight_cells == 1
+    assert d.bad_rows == 3
+    assert not d.ok and len(d.errors) == 3
+
+
+@pytest.mark.fast
+def test_validate_warnings_never_errors():
+    X, y = make_data()
+    X[2, :] = 7.0                      # degenerate (constant) feature
+    X[0, 0] = SCALE_HAZARD_ABS * 10    # scale hazard
+    yc = np.full_like(y, 1.5)          # constant target
+    d = validate_dataset(X, yc)
+    assert d.ok
+    assert d.constant_y_outputs == [0]
+    assert 2 in d.degenerate_features
+    assert d.scale_hazard_features == [0]
+    assert len(d.warnings) == 3
+    # negative weights are an error (undefined weighted mean)
+    w = np.ones(64, np.float32)
+    w[0] = -1.0
+    d = validate_dataset(X, y, w)
+    assert not d.ok and d.nonpositive_weights == 1
+
+
+@pytest.mark.fast
+def test_validate_multi_output():
+    X, y = make_data()
+    ys = np.stack([y, np.full_like(y, 2.0)])
+    ys[0, 5] = np.nan
+    d = validate_dataset(X, ys)
+    assert d.n_outputs == 2
+    assert d.constant_y_outputs == [1]
+    assert d.bad_rows == 1
+
+
+# ---------------------------------------------------------------------------
+# sanitize_dataset — the three policies
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_sanitize_clean_passthrough_identity():
+    X, y = make_data()
+    w = np.ones(64, np.float32)
+    for pol in ("reject", "mask", "repair"):
+        X2, y2, w2, d = sanitize_dataset(X, y, w, pol)
+        assert X2 is X and y2 is y and w2 is w, pol
+        assert d.policy == pol and d.masked_rows == 0
+
+
+@pytest.mark.fast
+def test_sanitize_reject_raises_structured():
+    X, y = make_data()
+    X[0, 0] = np.nan
+    with pytest.raises(HostileDatasetError) as ei:
+        sanitize_dataset(X, y, None, "reject")
+    assert isinstance(ei.value, ValueError)  # stays a ValueError
+    assert isinstance(ei.value.diagnostics, DatasetDiagnostics)
+    assert ei.value.diagnostics.bad_rows == 1
+    assert "mask" in str(ei.value)  # names the way out
+
+
+@pytest.mark.fast
+def test_sanitize_mask_zeroes_and_placeholders():
+    X, y = make_data()
+    X[0, 3] = np.nan
+    y[10] = np.inf
+    Xm, ym, wm, d = sanitize_dataset(X, y, None, "mask")
+    assert np.isfinite(Xm).all() and np.isfinite(ym).all()
+    assert wm is not None and wm[3] == 0 and wm[10] == 0
+    assert wm.sum() == 62 and d.masked_rows == 2
+    # untouched rows keep their exact values
+    keep = np.ones(64, bool)
+    keep[[3, 10]] = False
+    np.testing.assert_array_equal(Xm[:, keep], X[:, keep])
+    np.testing.assert_array_equal(ym[keep], y[keep])
+
+
+@pytest.mark.fast
+def test_sanitize_repair_imputes_cells_keeps_rows_live():
+    X, y = make_data()
+    X[0, 3] = np.nan
+    X[0, 4] = np.inf
+    y[10] = np.nan
+    Xr, yr, wr, d = sanitize_dataset(X, y, None, "repair")
+    assert d.repaired_cells == 2 and d.masked_rows == 1
+    # imputed with the column's finite mean
+    col_mean = X[0][np.isfinite(X[0])].mean()
+    assert abs(Xr[0, 3] - col_mean) < 1e-6
+    # repaired rows keep full weight; only the bad-target row is masked
+    assert wr[3] == 1 and wr[4] == 1 and wr[10] == 0
+
+
+@pytest.mark.fast
+def test_sanitize_unusable_raises_under_every_policy():
+    # every column all-NaN: repair has nothing to impute FROM (imputing
+    # would invent data wholesale), so every policy rejects
+    X = np.full((2, 6), np.nan, np.float32)
+    y = np.ones(6, np.float32)
+    for pol in ("reject", "mask", "repair"):
+        with pytest.raises(HostileDatasetError):
+            sanitize_dataset(X, y, None, pol)
+    # zero rows
+    for pol in ("reject", "mask", "repair"):
+        with pytest.raises(HostileDatasetError):
+            sanitize_dataset(
+                np.zeros((2, 0), np.float32), np.zeros(0, np.float32),
+                None, pol,
+            )
+
+
+@pytest.mark.fast
+def test_repair_recovers_every_row_bad_dataset():
+    """Review regression: a dataset where EVERY row has one bad cell but
+    every column still has finite values to impute from is fully
+    repairable — 'no usable rows' must not be structural-fatal under
+    repair (it is under mask: masking every row leaves nothing)."""
+    X, y = make_data(n=12)
+    for j in range(12):
+        X[j % 3, j] = np.nan  # one bad cell per row, spread over columns
+    Xr, yr, wr, d = sanitize_dataset(X, y, None, "repair")
+    assert np.isfinite(Xr).all()
+    assert d.repaired_cells == 12 and d.masked_rows == 0
+    assert wr is None  # no row needed masking: weights untouched
+    with pytest.raises(HostileDatasetError):
+        sanitize_dataset(X, y, None, "mask")  # every row masked = unusable
+
+
+@pytest.mark.fast
+def test_wrong_shape_weights_structured_error():
+    """Review regression: a wrong-length weights vector must come back
+    as a structured HostileDatasetError, not a raw numpy broadcast
+    ValueError from inside the census."""
+    X, y = make_data(n=16)
+    w = np.ones(5, np.float32)
+    d = validate_dataset(X, y, w)
+    assert not d.ok and any("weights shape" in e for e in d.errors)
+    for pol in ("reject", "mask", "repair"):
+        with pytest.raises(HostileDatasetError):
+            sanitize_dataset(X, y, w, pol)
+
+
+@pytest.mark.fast
+def test_loss_function_incompatible_with_row_shards():
+    with pytest.raises(ValueError, match="loss_function.*row_shards"):
+        make_options(
+            binary_operators=["+"], row_shards=2,
+            loss_function=lambda t, X, y, w, o: 0.0,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Options plumbing
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_data_policy_option_validation():
+    with pytest.raises(ValueError):
+        make_options(binary_operators=["+"], data_policy="explode")
+    for pol in ("reject", "mask", "repair"):
+        assert make_options(
+            binary_operators=["+"], data_policy=pol
+        ).data_policy == pol
+
+
+@pytest.mark.fast
+def test_row_shards_rejects_pallas_backends():
+    """The Pallas kernel's row reduction is not the pairwise tree, so
+    the explicit kernel backends are unconstructible with row_shards>1
+    (and 'auto' routing consults deterministic — the review fix that
+    keeps the bit-identity contract true on TPU, not just on CPU)."""
+    from symbolicregression_jl_tpu.models.fitness import (
+        resolve_eval_backend_pallas,
+    )
+
+    with pytest.raises(ValueError, match="pallas.*row_shards|row_shards"):
+        make_options(
+            binary_operators=["+"], eval_backend="pallas", row_shards=2
+        )
+    with pytest.raises(ValueError, match="row_shards"):
+        make_options(
+            binary_operators=["+"], optimizer_backend="pallas",
+            row_shards=2,
+        )
+    # the routing predicate itself: deterministic never routes to the
+    # kernel, whatever the shape
+    assert resolve_eval_backend_pallas(
+        "auto", jnp.float32, 10**6, 10**6, deterministic=True
+    ) is False
+
+
+@pytest.mark.fast
+def test_cast_overflow_diagnosed_not_misreported():
+    """float64 data with finite values beyond float32 range must be
+    diagnosed as a precision-cast overflow (rescale / use float64),
+    never as phantom NaN/Inf in the caller's data."""
+    X, y = make_data()
+    X64 = X.astype(np.float64)
+    X64[0, 0] = 1e40  # finite in f64, inf in f32
+    with pytest.raises(HostileDatasetError) as ei:
+        sr.equation_search(X64, y.astype(np.float64), seed=0, **KW)
+    d = ei.value.diagnostics
+    assert d.cast_overflow_cells == 1
+    assert any("overflowed" in e for e in d.errors)
+    # the same data under precision='float64' is clean (validated on
+    # the lossless cast) — no search needed: validate directly
+    d64 = validate_dataset(X64, y.astype(np.float64))
+    assert d64.ok and d64.scale_hazard_features == [0]
+
+
+@pytest.mark.fast
+def test_row_shards_in_graph_key_data_policy_not():
+    base = make_options(binary_operators=["+"])
+    sharded = make_options(binary_operators=["+"], row_shards=2)
+    masked = make_options(binary_operators=["+"], data_policy="mask")
+    # row_shards selects a different scoring graph -> different key
+    assert base != sharded and hash(base) != hash(sharded)
+    # data_policy transforms data before any trace -> same key
+    assert base == masked and hash(base) == hash(masked)
+
+
+# ---------------------------------------------------------------------------
+# search-level: policies on clean and hostile data
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_clean_data_bit_identical_across_policies():
+    """Acceptance: data_policy='reject' (the default) is seed behavior on
+    clean data, and 'mask' is bit-identical to it — the front door is a
+    no-op when there is nothing to sanitize. Full searches: slow tier
+    (the 870s tier-1 dot budget; the pass-through identity that makes
+    this hold is asserted fast in
+    test_sanitize_clean_passthrough_identity)."""
+    X, y = make_data()
+    rs = {
+        p: sr.equation_search(X, y, seed=0, data_policy=p, **KW)
+        for p in ("reject", "mask", "repair")
+    }
+    assert frontier(rs["reject"]) == frontier(rs["mask"])
+    assert frontier(rs["reject"]) == frontier(rs["repair"])
+    d = rs["mask"].dataset_diagnostics
+    assert d is not None and d["policy"] == "mask" and d["masked_rows"] == 0
+
+
+@pytest.mark.fast
+def test_preflight_probe_skips_zero_weight_rows():
+    """Regression (found by the verify drive): the pipeline probe used
+    to slice the FIRST 20 rows blindly — under data_policy='mask' a
+    leading block of bad rows becomes 20 zero-weight placeholder rows,
+    the probe's weighted loss aggregates 0/0, every score is contained
+    to inf, and a perfectly healthy configuration failed preflight. The
+    probe must select usable (positively weighted) rows."""
+    from symbolicregression_jl_tpu.models.options import make_options
+    from symbolicregression_jl_tpu.utils.preflight import (
+        test_entire_pipeline,
+    )
+
+    X, y = make_data(n=64)
+    w = np.ones(64, np.float32)
+    w[:30] = 0.0  # leading block excluded from the loss
+    opts = make_options(
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        npop=16, npopulations=2, maxsize=8,
+    )
+    test_entire_pipeline(opts, X, y[None, :], w)  # must not raise
+
+
+def test_reject_is_default_and_raises_on_hostile():
+    X, y = make_data()
+    X[1, 7] = np.nan
+    with pytest.raises(HostileDatasetError):
+        sr.equation_search(X, y, seed=0, **KW)
+
+
+@pytest.mark.slow
+def test_hostile_injection_never_crashes_never_nonfinite_hof():
+    """Property test (acceptance): random NaN/Inf injection over 3 seeds
+    — the search completes under mask AND repair and the hall of fame
+    is finite every time. One Options graph serves all runs (same
+    shapes), so this is 6 searches on one compile."""
+    for seed in (0, 1, 2):
+        rng = np.random.default_rng(100 + seed)
+        X, y = make_data(seed=seed)
+        # poison ~10% of X cells and a few targets, mixing NaN/Inf
+        cells = rng.integers(0, X.size, size=X.size // 10)
+        flat = X.reshape(-1)
+        flat[cells] = np.where(
+            rng.random(cells.size) < 0.5, np.nan, np.inf
+        )
+        y[rng.integers(0, y.size, size=3)] = -np.inf
+        for pol in ("mask", "repair"):
+            r = sr.equation_search(
+                X, y, seed=seed, data_policy=pol, **KW
+            )
+            losses = [c.loss for c in r.frontier()]
+            assert losses, (seed, pol)
+            assert all(np.isfinite(l) for l in losses), (seed, pol)
+            d = r.dataset_diagnostics
+            assert d["policy"] == pol and (
+                d["masked_rows"] > 0 or d["repaired_cells"] > 0
+            )
+
+
+@pytest.mark.slow
+def test_hostile_search_populates_run_start_diagnostics(tmp_path):
+    from symbolicregression_jl_tpu.telemetry.analyze import (
+        analyze_run,
+        resolve_log,
+    )
+
+    X, y = make_data()
+    X[0, :4] = np.inf
+    r = sr.equation_search(
+        X, y, seed=0, data_policy="mask", telemetry=True,
+        telemetry_dir=str(tmp_path), **KW
+    )
+    report = analyze_run(resolve_log(str(tmp_path)))
+    diags = (report.get("run") or {}).get("dataset_diagnostics")
+    assert diags is not None
+    assert diags["policy"] == "mask" and diags["masked_rows"] == 4
+    assert diags == r.dataset_diagnostics
+    # the new containment gauges rode the fused reduction into the log
+    assert report.get("nonfinite_fraction") is not None
+
+
+# ---------------------------------------------------------------------------
+# telemetry: schema evolution for the new fields + doctor/alert logic
+# ---------------------------------------------------------------------------
+
+
+def _envelope(**fields):
+    return {"v": 1, "t": 0.0, "run": "r", **fields}
+
+
+@pytest.mark.fast
+def test_schema_accepts_new_run_start_and_metrics_fields():
+    from symbolicregression_jl_tpu.telemetry.events import validate_event
+
+    rs = _envelope(
+        type="run_start", config_fingerprint="f", backend="cpu",
+        devices=["cpu:0"], nout=1,
+        dataset_diagnostics={
+            "n_rows": 10, "n_features": 2, "bad_rows": 1,
+            "policy": "mask", "masked_rows": 1, "repaired_cells": 0,
+            "errors": [], "warnings": ["w"],
+        },
+    )
+    assert validate_event(rs) == []
+    # null diagnostics allowed (older writers)
+    rs["dataset_diagnostics"] = None
+    assert validate_event(rs) == []
+    # wrong type rejected
+    rs["dataset_diagnostics"] = "nope"
+    assert validate_event(rs) != []
+
+    m = _envelope(
+        type="metrics",
+        snapshot={
+            "counters": {"contained_losses_total": 3.0},
+            "gauges": {"population_nonfinite_fraction": 0.25},
+            "histograms": {},
+        },
+        per_island={"best_loss": [1.0], "nonfinite": [4]},
+    )
+    assert validate_event(m) == []
+
+
+@pytest.mark.fast
+def test_run_doctor_numerically_degenerate_reason():
+    from symbolicregression_jl_tpu.telemetry.analyze import analyze_run
+
+    def metrics_event(nonfinite_frac, best):
+        return _envelope(
+            type="metrics", output=0, iteration=0,
+            snapshot={
+                "counters": {},
+                "gauges": {
+                    "best_loss": best,
+                    "population_finite_frac": 1.0 - nonfinite_frac,
+                    "population_nonfinite_fraction": nonfinite_frac,
+                },
+                "histograms": {},
+            },
+        )
+
+    base = [
+        _envelope(type="run_start", config_fingerprint="f",
+                  backend="cpu", devices=["cpu:0"], nout=1),
+        metrics_event(0.8, 1.0),
+        _envelope(type="run_end", num_evals=1.0, search_time_s=1.0),
+    ]
+    report = analyze_run(base)
+    assert report["numerically_degenerate"] is True
+    assert report["nonfinite_fraction"] == pytest.approx(0.8)
+    assert any("numerically-degenerate" in r for r in report["reasons"])
+    # below the threshold: no flag
+    ok = [base[0], metrics_event(0.1, 1.0), base[2]]
+    report = analyze_run(ok)
+    assert report["numerically_degenerate"] is False
+    assert not any("numerically-degenerate" in r
+                   for r in report["reasons"])
+
+
+@pytest.mark.fast
+def test_fleet_alert_numerically_degenerate():
+    from symbolicregression_jl_tpu.telemetry.alerts import evaluate_alerts
+
+    row = {
+        "run_id": "r1", "verdict": "healthy", "faults": 0,
+        "attempts": [], "resumed": False,
+        "nonfinite_fraction": 0.7, "numerically_degenerate": True,
+    }
+    alerts = evaluate_alerts([row], {})
+    hits = [a for a in alerts if a["rule"] == "numerically_degenerate"]
+    assert len(hits) == 1 and hits[0]["severity"] == "warning"
+    # ctx threshold override
+    assert not [
+        a for a in evaluate_alerts(
+            [dict(row, numerically_degenerate=False,
+                  nonfinite_fraction=0.2)],
+            {"nonfinite_threshold": 0.5},
+        )
+        if a["rule"] == "numerically_degenerate"
+    ]
+    hits = [
+        a for a in evaluate_alerts(
+            [dict(row, numerically_degenerate=False,
+                  nonfinite_fraction=0.6)],
+            {"nonfinite_threshold": 0.5},
+        )
+        if a["rule"] == "numerically_degenerate"
+    ]
+    assert len(hits) == 1 and hits[0]["threshold"] == 0.5

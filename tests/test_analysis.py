@@ -131,6 +131,27 @@ def test_sr008_host_roundtrip_detected():
 
 
 @pytest.mark.fast
+def test_sr009_where_after_nan_producing_op_detected():
+    vs = _lint_fixture("fixture_sr009.py")
+    hits = _active(vs, "SR009")
+    # log branch, sqrt branch, unclamped division, fractional power
+    assert len(hits) == 4, [v.to_dict() for v in vs]
+    functions = {v.function for v in hits}
+    assert functions == {
+        "bad_log_branch", "bad_sqrt_branch", "bad_division_branch",
+        "bad_fractional_power",
+    }
+    # clamped inputs (the safe_* pattern), integer powers, plain selects
+    # and host-only code stay clean; the pragma suppresses
+    assert not any(
+        v.function and v.function.startswith(("good_", "host_only"))
+        for v in hits
+    )
+    sup = [v for v in vs if v.suppressed and v.rule_id == "SR009"]
+    assert len(sup) == 1 and sup[0].function == "pragma_suppressed"
+
+
+@pytest.mark.fast
 def test_clean_fixture_produces_zero_findings():
     vs = _lint_fixture("fixture_clean.py")
     assert vs == [], [v.to_dict() for v in vs]
@@ -318,8 +339,8 @@ def test_checked_in_baseline_exists_and_well_formed():
         payload = json.load(f)
     assert payload["schema_version"] == 1
     assert set(payload["configs"]) == {
-        "base", "cache", "islands4", "pop32", "bucketed", "chunked",
-        "sharded",
+        "base", "cache", "islands4", "pop32", "bucketed", "rowsharded",
+        "chunked", "sharded",
     }
     for entry in payload["configs"].values():
         assert entry["total_primitives"] == sum(
@@ -472,7 +493,8 @@ def test_checked_in_memory_baseline_exists_and_well_formed():
         payload = json.load(f)
     assert payload["schema_version"] == 1
     assert set(payload["configs"]) == {
-        "base", "cache", "islands4", "pop32", "bucketed", "sharded",
+        "base", "cache", "islands4", "pop32", "bucketed", "rowsharded",
+        "sharded",
     }
     for entry in payload["configs"].values():
         assert entry["peak_modeled_bytes"] > 0

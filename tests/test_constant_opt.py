@@ -466,3 +466,99 @@ def test_chunked_portable_path_matches_unchunked(rng):
     )
     np.testing.assert_array_equal(np.asarray(fs_fast), np.asarray(fs_chunk))
     np.testing.assert_array_equal(np.asarray(xs_fast), np.asarray(xs_chunk))
+
+
+# ---------------------------------------------------------------------------
+# containment contract (ISSUE 15): a non-finite objective at the initial
+# point must end in the restored-constants fallback, never in adopted
+# line-search wreckage or a non-finite constant written into the carry
+# ---------------------------------------------------------------------------
+
+
+def _overflow_member(opt):
+    """c0 * x0 + c1 with c0 so large the f32 objective overflows: the
+    squared-error loss at the initial point is inf for every row."""
+    plus = opt.operators.binary_index("+")
+    mult = opt.operators.binary_index("*")
+    return encode_tree(
+        Expr.binary(
+            plus,
+            Expr.binary(mult, Expr.const(1e30), Expr.var(0)),
+            Expr.const(1e30),
+        ),
+        opt.max_len,
+    )
+
+
+def test_nonfinite_initial_objective_restores_constants(rng):
+    """Regression (ISSUE 15 satellite): a member whose objective is
+    non-finite AT THE INITIAL POINT used to flow through the line
+    search unguarded; the contract now is reject-step + restore — the
+    population comes back with the ORIGINAL constants bit-for-bit and
+    its stored losses untouched, for BFGS, NelderMead and Newton."""
+    X = rng.standard_normal((1, 40)).astype(np.float32)
+    y = (2.0 * X[0] + 0.5).astype(np.float32)
+    Xj, yj = jnp.asarray(X), jnp.asarray(y)
+    from symbolicregression_jl_tpu.models.fitness import score_trees
+
+    for algo in ("BFGS", "NelderMead", "Newton"):
+        opt = make_options(
+            binary_operators=["+", "*"], maxsize=10,
+            optimizer_probability=1.0, optimizer_iterations=4,
+            optimizer_nrestarts=0, optimizer_algorithm=algo,
+        )
+        trees = stack_trees([_overflow_member(opt)])
+        scores, losses = score_trees(trees, Xj, yj, None, 1.0, opt)
+        assert not np.isfinite(np.asarray(losses)).any()  # inf-contained
+        pop = Population(
+            trees=trees, scores=scores, losses=losses,
+            birth=jnp.zeros(1, jnp.int32),
+        )
+        pop2, _, _ = optimize_constants_population(
+            jax.random.PRNGKey(0), pop, Xj, yj, None, 1.0, opt
+        )
+        np.testing.assert_array_equal(
+            np.asarray(pop.trees.cval), np.asarray(pop2.trees.cval),
+            err_msg=f"{algo}: constants not restored",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(pop.losses), np.asarray(pop2.losses),
+            err_msg=f"{algo}: losses overwritten from an inf objective",
+        )
+        assert np.isfinite(np.asarray(pop2.trees.cval)).all()
+
+
+def test_optimizer_never_writes_nonfinite_constants(rng):
+    """The write-back guard: even when an objective reaches a finite
+    value through a non-finite constant (exp(c) with c -> -inf is
+    finite), the population never adopts a non-finite cval."""
+    opt = make_options(
+        binary_operators=["+", "*"], unary_operators=["exp"],
+        maxsize=10, optimizer_probability=1.0, optimizer_iterations=8,
+        optimizer_nrestarts=1,
+    )
+    plus = opt.operators.binary_index("+")
+    exp_i = opt.operators.unary_index("exp")
+    # exp(c0) + c1 fit to y ~ 0.5: a huge negative c0 drive is plausible
+    tree = encode_tree(
+        Expr.binary(
+            plus, Expr.unary(exp_i, Expr.const(-2.0)), Expr.const(0.0)
+        ),
+        opt.max_len,
+    )
+    X = rng.standard_normal((1, 30)).astype(np.float32)
+    y = np.full(30, 0.5, np.float32)
+    Xj, yj = jnp.asarray(X), jnp.asarray(y)
+    from symbolicregression_jl_tpu.models.fitness import score_trees
+
+    trees = stack_trees([tree])
+    scores, losses = score_trees(trees, Xj, yj, None, 1.0, opt)
+    pop = Population(
+        trees=trees, scores=scores, losses=losses,
+        birth=jnp.zeros(1, jnp.int32),
+    )
+    pop2, _, _ = optimize_constants_population(
+        jax.random.PRNGKey(0), pop, Xj, yj, None, 1.0, opt
+    )
+    assert np.isfinite(np.asarray(pop2.trees.cval)).all()
+    assert np.isfinite(np.asarray(pop2.losses)).all()
