@@ -1315,6 +1315,47 @@ def main(verbose=True):
             print(f"# containment census unavailable: {e}",
                   file=sys.stderr)
 
+    # ---- serving throughput (ISSUE 16, docs/serving.md): four tiny
+    # same-shape jobs through the srserve JobServer at max_tenants=2 —
+    # two dispatches of one warm-compiled bucket. jobs/s is the
+    # number multi-tenant batching is supposed to move (N jobs on one
+    # compile instead of N compiles); warm_hit_rate > 0 is the
+    # warm-path evidence. A report, never a gate. ----
+    serving_throughput = None
+    try:
+        from symbolicregression_jl_tpu.serving import JobServer
+
+        _srv = JobServer(
+            niterations=1, max_tenants=2, flush_timeout_s=600.0,
+            binary_operators=["+", "-", "*"], unary_operators=["cos"],
+            npop=24, npopulations=2, ncycles_per_iteration=20,
+            maxsize=10, seed=0, verbosity=0, progress=False,
+        )
+        _rng = np.random.default_rng(0)
+        for _i in range(4):
+            _Xs = _rng.standard_normal((2, 100)).astype(np.float32)
+            _ys = _Xs[0] * _Xs[0] + np.cos(_Xs[1])
+            _srv.submit(_Xs, _ys, job_id=f"bench-{_i}", seed=_i)
+        _t0 = time.perf_counter()
+        _done = _srv.drain()
+        _wall = time.perf_counter() - _t0
+        _stats = _srv.stats()
+        serving_throughput = {
+            "jobs": len(_done),
+            "jobs_per_s": round(len(_done) / _wall, 3) if _wall else None,
+            "max_tenants": 2,
+            "dispatches": _stats["dispatches"],
+            "warm_hit_rate": round(_stats["warm_hit_rate"], 3),
+            "all_complete": all(
+                bool(j.result.frontier()) for j in _done
+            ),
+            "wall_s": round(_wall, 2),
+        }
+    except Exception as e:  # pragma: no cover - defensive
+        if verbose:
+            print(f"# serving throughput unavailable: {e}",
+                  file=sys.stderr)
+
     # ---- round-over-round trajectory (scripts/bench_trajectory.py):
     # the checked-in BENCH_r*/MULTICHIP_* series + regression flags ride
     # along in the artifact, so a drop is visible the moment this JSON
@@ -1384,6 +1425,9 @@ def main(verbose=True):
         # non-finite/clamp census of the scored workload (ISSUE 15):
         # the inf-sentinel fraction the containment layer produced
         "containment": containment,
+        # multi-tenant job-server throughput (ISSUE 16): jobs/s through
+        # the warm-compiled srserve bucket path
+        "serving_throughput": serving_throughput,
         "telemetry_event_log": sink.path if sink is not None else None,
     }
     if platform == "cpu":

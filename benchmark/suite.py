@@ -710,6 +710,82 @@ def bench_fleet():
     }]
 
 
+def bench_serving():
+    """srserve end to end (ISSUE 16): four same-shape jobs through the
+    JobServer at max_tenants=2 — two dispatches of one bucket, the
+    second a warm compile hit. Every job must complete with a
+    finite-loss frontier, the warm-hit rate must be positive after the
+    first bucket, the per-job run ids must land in the fleet registry,
+    and the srtpu_serve_* exposition must pass the validator. Reports
+    jobs/s against the solo per-job wall — the number batching is
+    supposed to move."""
+    import tempfile
+
+    from symbolicregression_jl_tpu.serving import JobServer
+    from symbolicregression_jl_tpu.telemetry.export import (
+        render_openmetrics,
+        validate_exposition,
+    )
+    from symbolicregression_jl_tpu.telemetry.fleet import load_registry
+    from symbolicregression_jl_tpu.telemetry.metrics import (
+        MetricsRegistry,
+    )
+
+    root = tempfile.mkdtemp(prefix="srtpu_suite_serving_")
+    registry = MetricsRegistry()
+    server = JobServer(
+        niterations=2, max_tenants=2, flush_timeout_s=600.0,
+        fleet_root=root, registry=registry,
+        binary_operators=["+", "-", "*"], unary_operators=["cos"],
+        npop=24, npopulations=2, ncycles_per_iteration=30,
+        maxsize=12, seed=0, verbosity=0, progress=False,
+    )
+    rng = np.random.default_rng(0)
+    n_jobs = 4
+    for i in range(n_jobs):
+        X = rng.standard_normal((2, 100)).astype(np.float32)
+        y = X[0] * X[0] + (i + 1) * np.cos(X[1])
+        server.submit(X, y, job_id=f"suite-{i}", seed=i)
+
+    t0 = time.perf_counter()
+    done = server.drain()
+    wall_s = time.perf_counter() - t0
+
+    finite = [
+        bool(j.result.frontier())
+        and np.isfinite(min(c.loss for c in j.result.frontier()))
+        for j in done
+    ]
+    stats = server.stats()
+    text = render_openmetrics(registry=registry)
+    problems = validate_exposition(text)
+    registered = sorted(
+        r.get("run_id") for r in load_registry(root)
+    )
+    ok = (
+        len(done) == n_jobs
+        and all(finite)
+        and stats["warm_hit_rate"] > 0
+        and registered == sorted(f"suite-{i}" for i in range(n_jobs))
+        and not problems
+    )
+    return [{
+        "suite": "serving",
+        "case": "warm_bucket_4_jobs",
+        "ok": ok,
+        "jobs": len(done),
+        "jobs_per_s": len(done) / wall_s if wall_s > 0 else None,
+        "dispatches": stats["dispatches"],
+        "warm_hit_rate": stats["warm_hit_rate"],
+        "all_finite": all(finite),
+        "registered_runs": len(registered),
+        "exposition_ok": not problems,
+        "exposition_problems": problems[:3],
+        "wall_s": wall_s,
+        "fleet_root": root,
+    }]
+
+
 def bench_multichip():
     """Multi-chip island sharding (ISSUE 9): the REAL production
     `equation_search` sharded over an 8-virtual-device (islands, rows)
@@ -1167,6 +1243,7 @@ _CASES = [
     (bench_resilience, 900),
     (bench_hostile_data, 900),
     (bench_fleet, 1200),
+    (bench_serving, 1200),
     (bench_search_iteration, 1200),
     (bench_fitness_cache, 1200),
     (bench_precision_ratio, 1200),

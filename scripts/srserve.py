@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""srserve — the multi-tenant SR job server CLI (docs/serving.md).
+
+Feeds jobs into :class:`symbolicregression_jl_tpu.serving.JobServer`:
+each job is admitted through the hostile-data front door, quantized
+onto the pad ladder, bucketed by (padded shape, opset, Options graph
+key), batched up to ``--max-tenants`` per bucket and dispatched as ONE
+tenant-batched program — so N small jobs cost one warm compile per
+bucket, not N compiles.
+
+Job sources (combine freely):
+
+* positional ``.npz`` paths — each file holds ``X`` (nfeatures, n),
+  ``y`` (n,) and optionally ``weights`` (n,); one job per file;
+* ``--demo N`` — N synthetic jobs over a few ladder shapes (the smoke
+  mode: exercises bucketing and the warm-compile path with no data on
+  hand).
+
+Serving knobs: ``--max-tenants`` (bucket fill that triggers dispatch),
+``--flush-timeout`` (seconds a partial bucket may sit before it
+flushes anyway), ``--niterations`` per job, and search Options via
+``--binary-operators``/``--unary-operators``/``--npop``/
+``--npopulations``/``--maxsize``/``--seed``.
+
+Observability: ``--fleet-root DIR`` registers every job's run id in
+the fleet index (srfleet reads it) and lands dispatch event logs
+under DIR; ``--metrics-port P`` serves the OpenMetrics exposition
+(``srtpu_serve_queue_depth``, ``srtpu_serve_bucket_fill``,
+``srtpu_serve_warm_hit_rate``, ``srtpu_serve_job_latency_seconds``)
+on ``http://127.0.0.1:P/metrics`` while the server drains.
+
+Exit status: 0 iff every submitted job completed with a non-empty
+frontier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="multi-tenant SR job server (see docs/serving.md)"
+    )
+    p.add_argument("jobs", nargs="*", help=".npz job files (X, y[, weights])")
+    p.add_argument("--demo", type=int, default=0, metavar="N",
+                   help="generate N synthetic jobs")
+    p.add_argument("--max-tenants", type=int, default=4)
+    p.add_argument("--flush-timeout", type=float, default=2.0)
+    p.add_argument("--niterations", type=int, default=10)
+    p.add_argument("--fleet-root", default=None)
+    p.add_argument("--metrics-port", type=int, default=None)
+    p.add_argument("--binary-operators", default="+,-,*")
+    p.add_argument("--unary-operators", default="cos")
+    p.add_argument("--npop", type=int, default=24)
+    p.add_argument("--npopulations", type=int, default=2)
+    p.add_argument("--maxsize", type=int, default=12)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--json", action="store_true",
+                   help="print one JSON line per completed job")
+    return p.parse_args(argv)
+
+
+def _demo_jobs(n, rng):
+    """Synthetic jobs over two ladder shapes: enough variety to prove
+    bucketing, enough repetition to prove the warm-compile path."""
+    shapes = [(2, 48), (2, 48), (3, 100)]
+    for i in range(n):
+        nfeat, rows = shapes[i % len(shapes)]
+        X = rng.standard_normal((nfeat, rows)).astype("float32")
+        y = X[0] * X[0] + (X[1] if nfeat > 1 else 0.0)
+        yield f"demo-{i:03d}", X, y, None
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    import numpy as np
+
+    import symbolicregression_jl_tpu as sr
+    from symbolicregression_jl_tpu.serving import JobServer
+    from symbolicregression_jl_tpu.telemetry.export import (
+        render_openmetrics,
+        serve_metrics,
+    )
+    from symbolicregression_jl_tpu.telemetry.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
+    server = JobServer(
+        niterations=args.niterations,
+        max_tenants=args.max_tenants,
+        flush_timeout_s=args.flush_timeout,
+        fleet_root=args.fleet_root,
+        registry=registry,
+        binary_operators=args.binary_operators.split(","),
+        unary_operators=(
+            args.unary_operators.split(",") if args.unary_operators else []
+        ),
+        npop=args.npop,
+        npopulations=args.npopulations,
+        maxsize=args.maxsize,
+        seed=args.seed,
+        verbosity=0,
+        progress=False,
+    )
+
+    httpd = None
+    if args.metrics_port is not None:
+        httpd = serve_metrics(
+            lambda: render_openmetrics(registry=registry),
+            port=args.metrics_port,
+        )
+        print(
+            f"metrics: http://127.0.0.1:{httpd.server_address[1]}/metrics",
+            file=sys.stderr,
+        )
+
+    submitted = 0
+    for path in args.jobs:
+        data = np.load(path)
+        server.submit(
+            data["X"], data["y"],
+            data["weights"] if "weights" in data else None,
+            job_id=os.path.splitext(os.path.basename(path))[0],
+            seed=args.seed + submitted,
+        )
+        submitted += 1
+    rng = np.random.default_rng(args.seed)
+    for job_id, X, y, w in _demo_jobs(args.demo, rng):
+        server.submit(X, y, w, job_id=job_id, seed=args.seed + submitted)
+        submitted += 1
+
+    if not submitted:
+        print("no jobs (pass .npz files or --demo N)", file=sys.stderr)
+        return 2
+
+    done = server.drain()
+    ok = True
+    for jr in done:
+        front = jr.result.frontier()
+        ok = ok and bool(front)
+        best = min((c.loss for c in front), default=float("nan"))
+        if args.json:
+            print(json.dumps({
+                "job_id": jr.job_id,
+                "tenants": jr.tenants,
+                "warm": jr.warm,
+                "latency_s": round(jr.latency_s, 3),
+                "best_loss": float(best),
+                "frontier": len(front),
+            }))
+        else:
+            print(
+                f"{jr.job_id}: best_loss={best:.4g} "
+                f"frontier={len(front)} tenants={jr.tenants} "
+                f"warm={'yes' if jr.warm else 'no'} "
+                f"latency={jr.latency_s:.2f}s"
+            )
+    stats = server.stats()
+    print(
+        f"done: {stats['completed']} job(s), "
+        f"{stats['dispatches']} dispatch(es), "
+        f"warm_hit_rate={stats['warm_hit_rate']:.0%}",
+        file=sys.stderr,
+    )
+    if httpd is not None:
+        httpd.shutdown()
+    return 0 if ok and len(done) == submitted else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -110,6 +110,13 @@ def __getattr__(name):
         from . import telemetry
 
         return getattr(telemetry, name)
+    # multi-tenant serving tier (serving/, docs/serving.md): lazy so
+    # `import symbolicregression_jl_tpu` stays light for solo users
+    if name in ("batched_equation_search", "JobServer", "JobResult",
+                "pad_to_ladder"):
+        from . import serving
+
+        return getattr(serving, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}"
     )
@@ -226,4 +233,8 @@ __all__ = [
     "serve_metrics",
     "validate_exposition",
     "write_textfile",
+    "batched_equation_search",
+    "JobServer",
+    "JobResult",
+    "pad_to_ladder",
 ]

@@ -73,6 +73,16 @@ _MATRIX: Tuple[Tuple[str, dict], ...] = (
     # (traced meshless — the graph is identical with or without the
     # mesh, which is exactly the bit-identity contract).
     ("rowsharded", dict(row_shards=2)),
+    # tenant-batched serving surface (ISSUE 16, docs/serving.md):
+    # tenants > 1 vmaps the whole per-tenant iteration body over a
+    # leading tenants axis — the distinct compiled program every
+    # srserve bucket reuses warm. Same aval-stability bar as solo:
+    # the (T, I, ...) carry must round-trip exactly, and the merged
+    # HoF is the per-island HoF minus the ISLAND axis only (the
+    # tenants axis survives the merge). Traced meshless, like
+    # rowsharded: the serving (tenants, islands) mesh pins layout,
+    # never the graph.
+    ("tenants2", dict(tenants=2)),
 )
 
 #: config name for the phased (chunked-dispatch) closure set
@@ -226,20 +236,38 @@ def _aval_mismatches(tag: str, got, want) -> List[str]:
 
 def _abstract_inputs(options, I: int):
     """Aval-only inputs for one iteration: (states, key, cm, X, y, bl,
-    scalars, memo-or-None)."""
+    scalars, memo-or-None). With ``options.tenants > 1`` every
+    per-tenant aval gains the leading tenants axis (keys ``(T, I, 2)``,
+    data ``(T, ...)``, per-iteration key ``(T, 2)``) — the shapes
+    serving/batched.py feeds the vmapped factories."""
     import jax
     import jax.numpy as jnp
 
     from ..api import _make_init_fn
 
-    X = jax.ShapeDtypeStruct((_NFEAT, _NROWS), jnp.float32)
-    y = jax.ShapeDtypeStruct((_NROWS,), jnp.float32)
-    bl = jax.ShapeDtypeStruct((), jnp.float32)
+    T = options.tenants
+    if T > 1:
+        X = jax.ShapeDtypeStruct((T, _NFEAT, _NROWS), jnp.float32)
+        y = jax.ShapeDtypeStruct((T, _NROWS), jnp.float32)
+        bl = jax.ShapeDtypeStruct((T,), jnp.float32)
+        key = jax.eval_shape(
+            lambda: jnp.stack(
+                [jax.random.PRNGKey(t) for t in range(T)]
+            )
+        )
+        keys = jax.eval_shape(
+            lambda k: jax.vmap(lambda kk: jax.random.split(kk, I))(k),
+            key,
+        )
+    else:
+        X = jax.ShapeDtypeStruct((_NFEAT, _NROWS), jnp.float32)
+        y = jax.ShapeDtypeStruct((_NROWS,), jnp.float32)
+        bl = jax.ShapeDtypeStruct((), jnp.float32)
+        key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        keys = jax.eval_shape(
+            lambda k: jax.random.split(k, I), jax.random.PRNGKey(0)
+        )
     cm = jax.ShapeDtypeStruct((), jnp.int32)
-    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
-    keys = jax.eval_shape(
-        lambda k: jax.random.split(k, I), jax.random.PRNGKey(0)
-    )
     scalars = options.traced_scalars()
     init_fn = _make_init_fn(options, _NFEAT, False)
     states = jax.eval_shape(init_fn, keys, X, y, bl, scalars)
@@ -252,6 +280,13 @@ def _abstract_inputs(options, I: int):
                 options.cache_device_slots, options.dtype
             )
         )
+        if T > 1:
+            memo = jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(
+                    (T,) + l.shape, l.dtype
+                ),
+                memo,
+            )
     return states, key, cm, X, y, bl, scalars, memo, keys
 
 
@@ -282,9 +317,16 @@ def _check_iteration_config(
     outs = jax.eval_shape(it_fn, *args)
     out_states, ghof = outs[0], outs[1]
     problems += _aval_mismatches(f"{name}: IslandState", out_states, states)
-    # merged HoF contract: per-island hof minus the leading island axis
+    # merged HoF contract: per-island hof minus the ISLAND axis — the
+    # leading axis solo, axis 1 when a tenants axis rides in front
+    # (tenant t's merged HoF survives per tenant; serving bit-identity)
+    _drop_island = (
+        (lambda s: s[:1] + s[2:]) if options.tenants > 1
+        else (lambda s: s[1:])
+    )
     want_ghof = jax.tree_util.tree_map(
-        lambda l: jax.ShapeDtypeStruct(l.shape[1:], l.dtype), states.hof
+        lambda l: jax.ShapeDtypeStruct(_drop_island(l.shape), l.dtype),
+        states.hof,
     )
     problems += _aval_mismatches(f"{name}: merged HoF", ghof, want_ghof)
 

@@ -190,7 +190,15 @@ def build_stage_programs(
     expensive halves (mutate = tree surgery, eval = the fused scoring
     call over all islands' children) so blowups attribute to the half
     that owns them. scripts/tpu_mem_analysis.py AOT-compiles exactly
-    these against the TPU target."""
+    these against the TPU target.
+
+    ``options.tenants > 1`` attributes PER-TENANT: the tenant-batched
+    iteration is the vmap of the per-tenant body, so each stage's
+    footprint is the solo stage's times the tenant count — the stage
+    decomposition traces the solo body and the whole-program number in
+    ``_analyze_config`` carries the tenants axis."""
+    import dataclasses
+
     import jax
     import jax.numpy as jnp
 
@@ -198,6 +206,8 @@ def build_stage_programs(
     from ..models.fitness import score_trees
     from ..parallel.migration import merge_hofs_across_islands, migrate
 
+    if options.tenants > 1:
+        options = dataclasses.replace(options, tenants=1)
     I = options.npopulations
     states, key, cm, X, y, bl, scalars, memo, keys = _abstract_inputs(
         options, I

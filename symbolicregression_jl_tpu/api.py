@@ -228,18 +228,26 @@ def _iteration_shard_kw(options: Options, mesh, has_weights: bool):
     candidate extraction and migrate()'s HoF sampling both want every
     device holding it whole), recorder events island-sharded on dim 1
     (the cycle scan stacks its axis in front). None mesh -> {} (plain
-    jit; the single-device graphs stay byte-identical)."""
+    jit; the single-device graphs stay byte-identical).
+
+    The vocabulary is written once for both mesh modes: per-tenant
+    leaves (iteration key, baseline, merged HoF, memo snapshot) use
+    the ``tenant`` spec, which search_shardings aliases to
+    ``replicated`` on a solo (islands, rows) mesh — the solo compiled
+    contract is unchanged — and to ``P(tenants)`` on a
+    (tenants, islands) serving mesh, where ``island`` composes as
+    ``P('tenants', 'islands')`` over the (T, I, ...) state leaves."""
     if mesh is None:
         return {}
     sh = search_shardings(mesh, options)
-    isl, repl = sh["island"], sh["replicated"]
-    in_sh = [isl, repl, repl, sh["x"], sh["rows"]]
+    isl, ten, repl = sh["island"], sh["tenant"], sh["replicated"]
+    in_sh = [isl, ten, repl, sh["x"], sh["rows"]]
     if has_weights:
         in_sh.append(sh["rows"])
-    in_sh += [repl, repl]
+    in_sh += [ten, repl]
     if options.cache_fitness:
-        in_sh.append(repl)
-    out_sh = [isl, repl]
+        in_sh.append(ten)
+    out_sh = [isl, ten]
     if options.recorder:
         out_sh.append(sh["events"])
     if options.cache_fitness:
@@ -312,6 +320,15 @@ def _make_iteration_fn(options: Options, has_weights: bool,
 
 @functools.lru_cache(maxsize=32)
 def _make_iteration_fn_cached(options, has_weights, donate, mesh=None):
+    # tenant-batched mode (options.tenants > 1, serving/batched.py): the
+    # per-tenant body below is vmapped over the leading tenants axis, so
+    # merge/migrate must NOT apply with_sharding_constraint inside the
+    # vmap (the constraint names a dim the vmapped body cannot see);
+    # tenant placement is expressed entirely through the jit in/out
+    # shardings (_iteration_shard_kw). Constraints only ever pin layout,
+    # never change values, so dropping them inside the batched body
+    # keeps the per-tenant math bit-identical to the solo program.
+    inner_mesh = None if options.tenants > 1 else mesh
 
     def one_iteration(
         states: IslandState,
@@ -368,14 +385,29 @@ def _make_iteration_fn_cached(options, has_weights, donate, mesh=None):
                 okeys2, states, X, y, weights, baseline, options_,
                 probability=p_sel, count_optimize_telemetry=True,
             )
-        ghof = merge_hofs_across_islands(states.hof, mesh=mesh)
-        states = migrate(k_mig, states, ghof, options_, mesh=mesh)
+        ghof = merge_hofs_across_islands(states.hof, mesh=inner_mesh)
+        states = migrate(k_mig, states, ghof, options_, mesh=inner_mesh)
         outs = (states, ghof)
         if options.recorder:
             outs = outs + (events,)
         if options.cache_fitness:
             outs = outs + (absorb_snap,)
         return outs
+
+    if options.tenants > 1:
+        # ONE program over the whole tenant batch: states (T, I, ...),
+        # per-tenant iteration keys (T, 2), stacked data (T, nfeat, n) /
+        # (T, n), per-tenant baselines (T,) and memo snapshots; the
+        # curmaxsize curriculum scalar and traced-scalar knobs are
+        # shared (same Options for every tenant — the serving bucket
+        # contract). vmap of the unchanged per-tenant body: threefry is
+        # elementwise in the key, so every tenant's draws — and
+        # therefore its HoF — are bit-identical to running that job
+        # alone (the serving bit-identity contract, docs/serving.md).
+        axes = (0, 0, None, 0, 0, 0 if has_weights else None, 0, None)
+        if options.cache_fitness:
+            axes = axes + (0,)
+        one_iteration = jax.vmap(one_iteration, in_axes=axes)
 
     # the IslandState carry is argument 0 in every signature variant; the
     # non-donating default keeps functional semantics for direct callers
@@ -432,6 +464,11 @@ def _make_phase_fns(options: Options, has_weights: bool,
 
 @functools.lru_cache(maxsize=32)
 def _make_phase_fns_cached(options, has_weights, donate, mesh=None):
+    # tenant-batched mode: same discipline as _make_iteration_fn — the
+    # per-tenant phase bodies are vmapped over the leading tenants axis,
+    # merge/migrate drop their in-vmap sharding constraints, and tenant
+    # placement rides the per-phase jit in/out shardings
+    inner_mesh = None if options.tenants > 1 else mesh
 
     def _bind(scalars):
         return options.bind_scalars(scalars)
@@ -479,9 +516,32 @@ def _make_phase_fns_cached(options, has_weights, donate, mesh=None):
         )
 
     def merge_migrate(k_mig, states, scalars):
-        ghof = merge_hofs_across_islands(states.hof, mesh=mesh)
-        states = migrate(k_mig, states, ghof, _bind(scalars), mesh=mesh)
+        ghof = merge_hofs_across_islands(states.hof, mesh=inner_mesh)
+        states = migrate(
+            k_mig, states, ghof, _bind(scalars), mesh=inner_mesh
+        )
         return states, ghof
+
+    if options.tenants > 1:
+        # vmap every phase over the tenants axis (chunk temperatures,
+        # curmaxsize and the scalar knobs shared; is_last stays an
+        # unmapped python bool for the jit static argnum below)
+        w_ax = 0 if has_weights else None
+        m_ax = 0 if options.cache_fitness else None
+        cycle_chunk = jax.vmap(
+            cycle_chunk,
+            in_axes=(0, None, 0, 0, w_ax, 0, None, None, None),
+        )
+        simplify = jax.vmap(
+            simplify, in_axes=(0, None, 0, 0, w_ax, 0, None, m_ax)
+        )
+        optimize = jax.vmap(
+            optimize, in_axes=(0, 0, 0, 0, w_ax, 0, None)
+        )
+        optimize_mut = jax.vmap(
+            optimize_mut, in_axes=(0, 0, 0, 0, w_ax, 0, None)
+        )
+        merge_migrate = jax.vmap(merge_migrate, in_axes=(0, 0, None))
 
     # donate the IslandState carry of every phase (the driver threads one
     # states pytree through the chain and never reuses a consumed one);
@@ -493,7 +553,10 @@ def _make_phase_fns_cached(options, has_weights, donate, mesh=None):
     # per-phase sharding contract (mesh=None -> plain jit): the states
     # carry and per-island key batches island-sharded in AND out, data
     # row-sharded, scalars/keys/memo replicated; the chunked driver then
-    # never leaves the mesh between phase dispatches
+    # never leaves the mesh between phase dispatches. Per-tenant leaves
+    # (baseline, iteration keys, memo, merged HoF) use the "tenant"
+    # spec — an alias of "replicated" on a solo mesh, P(tenants) on a
+    # serving mesh (see _iteration_shard_kw)
     if mesh is None:
         _sk = lambda in_sh, out_sh: {}
     else:
@@ -519,29 +582,29 @@ def _make_phase_fns_cached(options, has_weights, donate, mesh=None):
         "cycle": jax.jit(
             cycle_chunk, static_argnums=(8,), **_dk(0),
             **_sk(("island", "replicated") + _data
-                  + ("replicated", "replicated", "replicated"),
+                  + ("tenant", "replicated", "replicated"),
                   _cycle_out),
         ),
         "simplify": jax.jit(
             simplify, **_dk(0),
             **_sk(("island", "replicated") + _data
-                  + ("replicated", "replicated", "replicated"),
+                  + ("tenant", "replicated", "tenant"),
                   "island"),
         ),
         "optimize": jax.jit(
             optimize, **_dk(1),
             **_sk(("island", "island") + _data
-                  + ("replicated", "replicated"), "island"),
+                  + ("tenant", "replicated"), "island"),
         ),
         "optimize_mut": jax.jit(
             optimize_mut, **_dk(1),
             **_sk(("island", "island") + _data
-                  + ("replicated", "replicated"), "island"),
+                  + ("tenant", "replicated"), "island"),
         ),
         "merge_migrate": jax.jit(
             merge_migrate, **_dk(1),
-            **_sk(("replicated", "island", "replicated"),
-                  ("island", "replicated")),
+            **_sk(("tenant", "island", "replicated"),
+                  ("island", "tenant")),
         ),
     }
 
@@ -620,6 +683,12 @@ def _make_iteration_driver(options: Options, has_weights: bool,
         )
         return out
 
+    # tenant-batched chunked driver: the host-side key splits replicate
+    # what the fused form's vmapped body computes — threefry is
+    # elementwise in the key, so the vmapped split of the (T, 2) key
+    # batch yields each tenant's solo-search splits bit-for-bit
+    _tb = options.tenants > 1
+
     def driver(states, key, curmaxsize, X, y, *rest):
         rest = list(rest)
         memo = rest.pop() if options.cache_fitness else None
@@ -628,7 +697,11 @@ def _make_iteration_driver(options: Options, has_weights: bool,
         else:
             (baseline, scalars), weights = rest, None
 
-        k_mig, k_opt, k_opt_mut = jax.random.split(key, 3)
+        if _tb:
+            ks = jax.vmap(lambda k: jax.random.split(k, 3))(key)
+            k_mig, k_opt, k_opt_mut = ks[:, 0], ks[:, 1], ks[:, 2]
+        else:
+            k_mig, k_opt, k_opt_mut = jax.random.split(key, 3)
         events_chunks = []
         with spans.span("cycle", chunks=len(_chunks),
                         ncycles=ncycles) as sp:
@@ -666,21 +739,28 @@ def _make_iteration_driver(options: Options, has_weights: bool,
             absorb_snap = jax.tree_util.tree_map(
                 lambda a: jnp.array(a, copy=True), absorb_snap
             )
-        I = states.birth_counter.shape[0]
+        # last dim: I both solo (I,) and tenant-batched (T, I)
+        I = states.birth_counter.shape[-1]
+        if _tb:
+            _okeys = lambda k: jax.vmap(
+                lambda kk: jax.random.split(kk, I)
+            )(k)
+        else:
+            _okeys = lambda k: jax.random.split(k, I)
         with spans.span("optimize") as sp:
             passes = 0
             if (options.should_optimize_constants
                     and options.optimizer_probability > 0):
                 states = _call(
                     "optimize",
-                    jax.random.split(k_opt, I), states, X, y, weights,
+                    _okeys(k_opt), states, X, y, weights,
                     baseline, scalars,
                 )
                 passes += 1
             if expected_optimize_count(options) > 0:
                 states = _call(
                     "optimize_mut",
-                    jax.random.split(k_opt_mut, I), states, X, y,
+                    _okeys(k_opt_mut), states, X, y,
                     weights, baseline, scalars,
                 )
                 passes += 1
@@ -724,12 +804,24 @@ def _make_init_fn_cached(options, nfeatures, has_weights, donate,
 
     def init(keys, X, y, weights, baseline, scalars):
         options_ = options.bind_scalars(scalars)
-        return jax.vmap(
-            lambda k: init_island_state(
-                k, options_, nfeatures, X, y, weights, baseline,
-                dtype=options.dtype,
-            )
-        )(keys)
+
+        def one_tenant(k, Xt, yt, wt, blt):
+            return jax.vmap(
+                lambda kk: init_island_state(
+                    kk, options_, nfeatures, Xt, yt, wt, blt,
+                    dtype=options.dtype,
+                )
+            )(k)
+
+        if options.tenants > 1:
+            # (T, I, 2) key batch over (T, ...) stacked data: each
+            # tenant's islands initialize exactly as its solo search
+            # would (vmap of the unchanged per-tenant init)
+            return jax.vmap(
+                one_tenant,
+                in_axes=(0, 0, 0, 0 if has_weights else None, 0),
+            )(keys, X, y, weights, baseline)
+        return one_tenant(keys, X, y, weights, baseline)
 
     donate_kw = dict(donate_argnums=(0,)) if donate else {}
     if mesh is not None:
@@ -737,7 +829,7 @@ def _make_init_fn_cached(options, nfeatures, has_weights, donate,
         in_sh = [sh["island"], sh["x"], sh["rows"]]
         if has_weights:
             in_sh.append(sh["rows"])
-        in_sh += [sh["replicated"], sh["replicated"]]
+        in_sh += [sh["tenant"], sh["replicated"]]
         donate_kw.update(
             in_shardings=tuple(in_sh), out_shardings=sh["island"]
         )
@@ -956,6 +1048,15 @@ def _equation_search_impl(
         options = make_options(**option_kwargs)
     elif option_kwargs:
         raise ValueError("Pass either options= or option kwargs, not both")
+
+    if options.tenants > 1:
+        raise ValueError(
+            "equation_search is the solo front door (one dataset); "
+            "Options.tenants > 1 runs many same-shape jobs as ONE "
+            "batched program — use "
+            "serving.batched_equation_search(datasets, options=...) "
+            "or the srserve job queue (serving.jobs)"
+        )
 
     if options.precision == "float64" and not jax.config.jax_enable_x64:
         # The reference's Float64 mode. jax_enable_x64 is process-global and

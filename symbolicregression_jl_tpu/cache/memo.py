@@ -109,6 +109,12 @@ def dataset_fingerprint(X, y, weights, options) -> str:
         f"{options.kernel_leaf_skip}:{options.row_shards}:"
         f"{options.eval_rows_per_tile}".encode()
     )
+    # tenant-batched searches (serving/batched.py) rescore under vmap —
+    # per-tenant values are bit-identical to the solo program's by the
+    # serving contract, but the contexts are kept separate on principle:
+    # a bank must never be shared between programs whose equality is a
+    # TESTED invariant rather than a structural one
+    h.update(f"tenants:{options.tenants}".encode())
     return h.hexdigest()
 
 

@@ -104,6 +104,30 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+class TenantIsolationError(ValueError):
+    """Options combination that cannot keep tenants isolated in a
+    tenant-batched search (``Options.tenants > 1``, serving/batched.py).
+
+    A tenant-batched search runs many independent jobs through ONE
+    compiled program; any knob that funnels per-run host-side output
+    into a single shared location (a snapshot file, a hall-of-fame CSV,
+    the lineage recorder's one JSON document) would silently interleave
+    tenants. The error is structured: ``.fields`` names the conflicting
+    Options fields and ``.conflicts`` maps each to its reason, so a job
+    server can report exactly which knobs to fix per rejected job."""
+
+    def __init__(self, conflicts):
+        self.conflicts = dict(conflicts)
+        self.fields = tuple(self.conflicts)
+        detail = "; ".join(
+            f"{name}: {reason}" for name, reason in conflicts
+        )
+        super().__init__(
+            f"tenants > 1 conflicts with field(s) "
+            f"{', '.join(self.fields)} — {detail}"
+        )
+
+
 # Scalar knobs that shape the search but NOT the traced graph: they are
 # excluded from Options._graph_key and enter jitted functions as traced
 # arguments (Options.traced_scalars / bind_scalars), so sweeping them
@@ -394,6 +418,17 @@ class Options:
     precision: str = "float32"
     island_axis: str = "islands"
     row_axis: str = "rows"
+    # --- multi-tenant batched serving (serving/batched.py) ---
+    # tenants > 1 marks this Options as the per-tenant configuration of a
+    # tenant-batched search: the serving engine stacks that many
+    # same-shape datasets along a leading tenants axis and vmaps the
+    # iteration programs over it. Part of _graph_key (the vmapped
+    # program is a different compiled graph), and validated in
+    # __post_init__ against knobs that break per-tenant isolation
+    # (TenantIsolationError). The solo equation_search front door
+    # rejects tenants > 1 — use serving.batched_equation_search.
+    tenants: int = 1
+    tenant_axis: str = "tenants"
     max_len: int = 0  # 0 => round_up(maxsize + 2, 8)
 
     # ------------------------------------------------------------------
@@ -530,6 +565,49 @@ class Options:
             )
         if self.cache_device_slots < 0:
             raise ValueError("cache_device_slots must be >= 0")
+        if self.tenants < 1:
+            raise ValueError("tenants must be >= 1")
+        if self.tenants > 1:
+            if self.row_shards > 1:
+                raise ValueError(
+                    "tenants > 1 is incompatible with row_shards > 1: "
+                    "the device mesh is (tenants, islands) in batched "
+                    "serving — shard rows in solo searches only"
+                )
+            # per-tenant isolation contract (docs/serving.md): every
+            # host-side output channel must either be off or carry a
+            # "{tenant}" placeholder the engine expands per tenant —
+            # a shared file would interleave independent jobs
+            conflicts = []
+            if self.recorder:
+                conflicts.append((
+                    "recorder",
+                    "the lineage recorder materializes ONE run's "
+                    "populations into one JSON document; there is no "
+                    "per-tenant recorder — run the job solo",
+                ))
+            if (
+                self.snapshot_path is not None
+                and "{tenant}" not in str(self.snapshot_path)
+            ):
+                conflicts.append((
+                    "snapshot_path",
+                    "a shared snapshot file would interleave tenants; "
+                    "use a per-tenant template such as "
+                    "'snaps/tenant{tenant}.npz'",
+                ))
+            if (
+                self.output_file is not None
+                and "{tenant}" not in str(self.output_file)
+            ):
+                conflicts.append((
+                    "output_file",
+                    "a shared hall-of-fame CSV would interleave "
+                    "tenants; use a per-tenant template such as "
+                    "'hof_tenant{tenant}.csv'",
+                ))
+            if conflicts:
+                raise TenantIsolationError(conflicts)
         # build and cache derived structures
         object.__setattr__(self, "_operators", make_operator_set(
             self.binary_operators, self.unary_operators))
@@ -641,6 +719,9 @@ class Options:
             # dispatch chunking changes which compiled programs exist
             # (fused single call vs phased sub-programs)
             self.max_cycles_per_dispatch,
+            # the tenant-batched (vmapped) iteration is a different
+            # compiled graph from the solo one (serving/batched.py)
+            self.tenants,
         )
 
     def traced_scalars(self) -> Tuple:

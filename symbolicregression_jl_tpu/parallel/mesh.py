@@ -32,6 +32,7 @@ def make_mesh(
     n_islands: int,
     devices=None,
     row_shards: int = 1,
+    tenants: int = 1,
 ) -> Optional[Mesh]:
     """Build a (islands, rows) mesh from available devices.
 
@@ -41,11 +42,38 @@ def make_mesh(
     devices to sit idle (e.g. 8 devices, 6 islands -> a 6x1 mesh), the
     choice is loud: a warning names the mesh and the idle devices, so a
     quietly-degraded production run is visible in the log (and in the
-    telemetry ``run_start`` event via :func:`describe_mesh`)."""
+    telemetry ``run_start`` event via :func:`describe_mesh`).
+
+    tenants > 1 (serving/batched.py) builds a ``(tenants, islands)``
+    mesh instead — the tenant batch dim composes with island
+    parallelism as ``P('tenants', 'islands')`` on every state leaf.
+    Row sharding is mutually exclusive with tenant batching (Options
+    rejects the combination), so the rows axis never appears here."""
     devices = devices if devices is not None else jax.devices()
     n_dev = len(devices)
     if n_dev <= 1:
         return None
+    if tenants > 1:
+        t_shards = min(tenants, n_dev)
+        while t_shards > 1 and tenants % t_shards != 0:
+            t_shards -= 1
+        island_shards = n_dev // t_shards
+        while island_shards > 1 and n_islands % island_shards != 0:
+            island_shards -= 1
+        use = t_shards * island_shards
+        if use < n_dev:
+            warnings.warn(
+                f"make_mesh: tenants={tenants} x npopulations="
+                f"{n_islands} does not tile {n_dev} devices — using a "
+                f"({t_shards}, {island_shards}) ({options.tenant_axis}, "
+                f"{options.island_axis}) mesh on {use} device(s) and "
+                f"leaving {n_dev - use} idle. Pick tenants/npopulations "
+                f"whose product's divisors tile {n_dev} to use every "
+                "device.",
+                stacklevel=2,
+            )
+        dev_array = np.array(devices[:use]).reshape(t_shards, island_shards)
+        return Mesh(dev_array, (options.tenant_axis, options.island_axis))
     row_shards = max(1, min(row_shards, n_dev))
     island_shards = n_dev // row_shards
     while island_shards > 1 and n_islands % island_shards != 0:
@@ -141,13 +169,37 @@ def search_shardings(mesh: Optional[Mesh], options: Options):
     - ``x`` / ``rows``: dataset sharding over the rows axis (features
       replicated);
     - ``events``: recorder MutationEvents — cycle-scan outputs stack the
-      scan axis in front, so the island axis is dim 1.
+      scan axis in front, so the island axis is dim 1;
+    - ``tenant``: per-tenant leaves (keys, baselines, merged HoFs). On a
+      solo (islands, rows) mesh this aliases ``replicated`` so the
+      factories can thread ONE vocabulary through both modes without
+      changing the solo compiled contract.
+
+    On a tenant mesh (``make_mesh(..., tenants>1)`` — axis names
+    (tenants, islands)) the vocabulary composes with the leading tenant
+    batch dim instead: ``island`` becomes ``P('tenants', 'islands')``
+    (every IslandState leaf is (T, I, ...)), ``tenant`` is
+    ``P('tenants')``, and the dataset specs shard the leading tenants
+    dim of the stacked (T, nfeat, n) / (T, n) arrays (rows are never
+    sharded in tenant mode — Options rejects tenants x row_shards).
 
     None mesh -> None (plain jit, no sharding arguments)."""
     if mesh is None:
         return None
+    if options.tenant_axis in mesh.axis_names:
+        ten = NamedSharding(mesh, P(options.tenant_axis))
+        return {
+            "island": NamedSharding(
+                mesh, P(options.tenant_axis, options.island_axis)
+            ),
+            "tenant": ten,
+            "replicated": NamedSharding(mesh, P()),
+            "x": NamedSharding(mesh, P(options.tenant_axis, None, None)),
+            "rows": ten,
+        }
     return {
         "island": NamedSharding(mesh, P(options.island_axis)),
+        "tenant": NamedSharding(mesh, P()),
         "replicated": NamedSharding(mesh, P()),
         "x": NamedSharding(mesh, P(None, options.row_axis)),
         "rows": NamedSharding(mesh, P(options.row_axis)),
@@ -174,15 +226,27 @@ def put_global(x, sharding):
 def shard_island_states(states, mesh: Optional[Mesh], options: Options):
     if mesh is None:
         return states
-    sh = island_sharding(mesh, options)
+    if options.tenant_axis in mesh.axis_names:
+        sh = NamedSharding(
+            mesh, P(options.tenant_axis, options.island_axis)
+        )
+    else:
+        sh = island_sharding(mesh, options)
     return jax.tree_util.tree_map(lambda x: put_global(x, sh), states)
 
 
 def shard_dataset(X, y, weights, mesh: Optional[Mesh], options: Options):
+    """Place the dataset on the mesh. Solo mesh: rows over the rows
+    axis. Tenant mesh: the stacked (T, nfeat, n) / (T, n) arrays shard
+    their leading tenants dim (rows replicated within a tenant)."""
     if mesh is None:
         return X, y, weights
-    xsh = data_sharding(mesh, options, rows_dim=1)
-    vsh = NamedSharding(mesh, P(options.row_axis))
+    if options.tenant_axis in mesh.axis_names:
+        xsh = NamedSharding(mesh, P(options.tenant_axis, None, None))
+        vsh = NamedSharding(mesh, P(options.tenant_axis))
+    else:
+        xsh = data_sharding(mesh, options, rows_dim=1)
+        vsh = NamedSharding(mesh, P(options.row_axis))
     X = put_global(X, xsh)
     y = put_global(y, vsh)
     if weights is not None:

@@ -1,0 +1,40 @@
+"""srserve — the multi-tenant serving tier (docs/serving.md).
+
+Two layers over the solo search engine:
+
+* :mod:`.batched` — ``batched_equation_search(datasets, options=...)``:
+  stacks same-shape ``(X, y, weights)`` problems along a leading
+  ``tenants`` axis and runs ONE jitted search over all of them (the
+  api.py jit factories vmap their per-tenant bodies when
+  ``Options.tenants > 1``; the device mesh becomes
+  ``(tenants, islands)``). Each tenant's hall of fame is bit-identical
+  to running its job alone under the same Options and seed — the
+  serving bit-identity contract, pinned by tests/test_serving.py.
+* :mod:`.jobs` — :class:`~.jobs.JobServer`: a queue that admits jobs
+  through the hostile-data front door, quantizes shapes onto a pad
+  ladder, buckets by ``(padded shape, opset, Options graph key)`` so
+  one warm compile serves a whole bucket, flushes batches by fill or
+  timeout through the batched engine, and returns per-job results with
+  per-job run ids registered in the fleet index. ``scripts/srserve.py``
+  is the CLI front end; queue depth / bucket fill / warm-hit rate /
+  job latency export through the OpenMetrics endpoint as
+  ``srtpu_serve_*``.
+"""
+
+from .batched import batched_equation_search
+from .jobs import (
+    DEFAULT_FEATURE_LADDER,
+    DEFAULT_ROW_LADDER,
+    JobResult,
+    JobServer,
+    pad_to_ladder,
+)
+
+__all__ = [
+    "batched_equation_search",
+    "JobServer",
+    "JobResult",
+    "pad_to_ladder",
+    "DEFAULT_ROW_LADDER",
+    "DEFAULT_FEATURE_LADDER",
+]

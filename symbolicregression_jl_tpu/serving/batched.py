@@ -1,0 +1,434 @@
+"""Tenant-batched search engine: many same-shape jobs, ONE program.
+
+``batched_equation_search`` stacks T independent ``(X, y, weights)``
+problems along a leading tenants axis and drives the SAME iteration
+programs the solo search uses — ``Options.tenants > 1`` makes the
+api.py jit factories vmap their per-tenant bodies, and
+``parallel/mesh.py`` builds a ``(tenants, islands)`` mesh whose state
+sharding composes as ``P('tenants', 'islands')``. The vmapped pattern
+is the one Kozax demonstrates for many small GP searches in JAX: the
+per-program fixed cost (dispatch, compile, host loop) is paid once for
+the whole batch instead of once per job.
+
+The contract that makes this a serving tier rather than an
+approximation (docs/serving.md):
+
+* **Bit-identity** — tenant t's hall of fame equals the solo
+  ``equation_search`` run of the same Options (``tenants=1``) with
+  ``seed=seeds[t]``, bit for bit, fused and chunked drivers alike.
+  Threefry is elementwise in the key, so vmapping the unchanged
+  per-tenant body over a batch of per-tenant key chains reproduces
+  each tenant's solo draws exactly; migration/merge sharding
+  constraints are dropped inside the vmapped body (constraints pin
+  layout, never values) and tenant placement rides the jit in/out
+  shardings.
+* **Per-tenant PRNG chains** — tenant t's master key is
+  ``PRNGKey(seeds[t])``, split per iteration exactly as the solo host
+  loop splits its per-output key.
+* **Per-tenant memo banks** — fingerprints carry ``options.tenants``
+  (cache/memo.py), so batched banks never serve values into solo
+  searches; each tenant absorbs only its own scoring-path snapshot.
+* **Per-tenant telemetry** — one fused reduction per observed
+  iteration yields every tenant's best loss and eval count; gauges are
+  tenant-indexed (``serve_tenant_best_loss_<t>``) and the event log
+  carries the arrays.
+
+Same-Options only: a batch shares one compiled program, so every
+tenant runs the same graph-shaping Options; per-job knobs that are
+traced scalars would silently apply tenant 0's values to everyone,
+which is why the job server keys buckets on the traced scalars too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.dataset import (
+    make_dataset,
+    sanitize_dataset,
+    update_baseline_loss,
+    validate_dataset,
+)
+from ..models.options import Options, make_options
+from ..parallel.mesh import (
+    describe_mesh,
+    make_mesh,
+    shard_dataset,
+    shard_island_states,
+)
+from ..parallel.migration import merge_hofs_across_islands
+from ..utils.output import hof_to_candidates
+
+Array = jax.Array
+
+
+def _normalize_datasets(datasets) -> List[Tuple[Any, Any, Any]]:
+    out = []
+    for d in datasets:
+        if isinstance(d, dict):
+            out.append((d["X"], d["y"], d.get("weights")))
+        elif len(d) == 3:
+            out.append(tuple(d))
+        elif len(d) == 2:
+            out.append((d[0], d[1], None))
+        else:
+            raise ValueError(
+                "each dataset must be (X, y), (X, y, weights), or a "
+                "dict with keys X/y[/weights]"
+            )
+    if not out:
+        raise ValueError("batched_equation_search needs >= 1 dataset")
+    return out
+
+
+def _slice_tree(tree, t: int):
+    return jax.tree_util.tree_map(lambda a: a[t], tree)
+
+
+@jax.jit
+def _tenant_summary(states, ghof):
+    """ONE fused reduction over the whole batch: per-tenant best HoF
+    loss (inf when no slot exists yet) and per-tenant eval counts —
+    the telemetry fan-out reads these two (T,) vectors, never the full
+    state."""
+    best = jnp.min(
+        jnp.where(ghof.exists, ghof.losses, jnp.inf), axis=-1
+    )
+    evals = jnp.sum(states.num_evals, axis=-1)
+    return best, evals
+
+
+def batched_equation_search(
+    datasets: Sequence,
+    *,
+    options: Optional[Options] = None,
+    seeds: Optional[Sequence[int]] = None,
+    niterations: int = 10,
+    variable_names: Optional[Sequence[str]] = None,
+    registry=None,
+    telemetry_dir: Optional[str] = None,
+    return_state: bool = False,
+    runtests: bool = False,
+    **option_kwargs,
+) -> List["Any"]:
+    """Run T same-shape symbolic-regression jobs as one batched search.
+
+    datasets: sequence of ``(X, y)`` / ``(X, y, weights)`` tuples (or
+    dicts) — every X must share one (nfeatures, n) shape, every y one
+    (n,), and weights are all-or-none (mixing would silently change
+    the unweighted tenants' loss reduction; the job server pads with
+    explicit weights for exactly this reason). seeds: per-tenant seeds
+    (default ``options.seed + t``); tenant t is bit-identical to the
+    solo search of ``seed=seeds[t]``. registry: a
+    telemetry.metrics.MetricsRegistry for tenant-indexed gauges;
+    telemetry_dir: event-log directory (one ``serve_run`` log for the
+    whole batch, per-tenant arrays on each event).
+
+    Returns one ``EquationSearchResult`` per tenant, in input order.
+    """
+    from ..api import (  # local: api imports nothing from serving
+        EquationSearchResult,
+        SearchState,
+        _curmaxsize,
+        _donation_enabled,
+        _make_init_fn,
+        _make_iteration_driver,
+        equation_search,
+    )
+
+    jobs = _normalize_datasets(datasets)
+    T = len(jobs)
+    if options is None:
+        option_kwargs.setdefault("tenants", max(T, 1))
+        options = make_options(**option_kwargs)
+    elif option_kwargs:
+        raise ValueError("Pass either options= or option kwargs, not both")
+    if options.tenants != T:
+        options = dataclasses.replace(options, tenants=max(T, 1))
+    if seeds is None:
+        seeds = [options.seed + t for t in range(T)]
+    if len(seeds) != T:
+        raise ValueError(f"seeds has {len(seeds)} entries for {T} datasets")
+
+    if T == 1:
+        # one tenant IS a solo search — route through the front door so
+        # the single-job path carries every solo feature (and the warm
+        # jit cache of tenants=1 programs)
+        solo = dataclasses.replace(options, tenants=1, seed=int(seeds[0]))
+        X0, y0, w0 = jobs[0]
+        res = equation_search(
+            X0, y0, weights=w0, options=solo, niterations=niterations,
+            variable_names=variable_names, return_state=return_state,
+            runtests=runtests,
+        )
+        return [res]
+
+    # ---- admission: every tenant through the hostile-data front door
+    # (validate -> Options.data_policy), then the shape contract ----
+    host_dtype = (
+        np.float64 if options.precision == "float64" else np.float32
+    )
+    Xs, ys_, ws, diags = [], [], [], []
+    for t, (X, y, w) in enumerate(jobs):
+        X = np.asarray(X, host_dtype)
+        y = np.asarray(y, host_dtype)
+        if y.ndim != 1:
+            raise ValueError(
+                f"dataset {t}: serving jobs are single-output (y must "
+                f"be 1-D, got shape {y.shape})"
+            )
+        if w is not None:
+            w = np.asarray(w, host_dtype)
+        d = validate_dataset(X, y[None, :], w)
+        X, y2, w, d = sanitize_dataset(
+            X, y[None, :], w, options.data_policy, d
+        )
+        Xs.append(np.asarray(X, host_dtype))
+        ys_.append(np.asarray(y2[0], host_dtype))
+        ws.append(None if w is None else np.asarray(w, host_dtype))
+        diags.append(d)
+    shape0 = Xs[0].shape
+    for t, X in enumerate(Xs):
+        if X.shape != shape0:
+            raise ValueError(
+                f"dataset {t} has X shape {X.shape}, tenant 0 has "
+                f"{shape0}: a batch shares ONE padded shape — use the "
+                "job server's pad ladder (serving.jobs) to quantize"
+            )
+    has_w = [w is not None for w in ws]
+    if any(has_w) and not all(has_w):
+        raise ValueError(
+            "weights must be all-or-none across a batch: an unweighted "
+            "tenant's loss reduction (jnp.mean) differs bitwise from "
+            "ones-weights — pad with explicit weights (serving.jobs "
+            "does) or drop them everywhere"
+        )
+    has_weights = all(has_w)
+    nfeatures = shape0[0]
+    I = options.npopulations
+
+    # ---- per-tenant baselines + the stacked device-ready batch ----
+    bls = []
+    for t in range(T):
+        ds = make_dataset(
+            Xs[t], ys_[t], ws[t], variable_names, dtype=options.dtype
+        )
+        ds = update_baseline_loss(ds, options)
+        bls.append(float(ds.baseline_loss))
+    Xb = np.stack(Xs)                       # (T, nfeat, n)
+    yb = np.stack(ys_)                      # (T, n)
+    wb = np.stack(ws) if has_weights else None
+    bl = jnp.asarray(np.asarray(bls, host_dtype), options.dtype)
+
+    mesh = make_mesh(options, I, tenants=T)
+    Xb, yb, wb = shard_dataset(Xb, yb, wb, mesh, options)
+    donate = _donation_enabled()
+    scalars = options.traced_scalars()
+    t_start = time.time()
+
+    sink = None
+    if telemetry_dir is not None:
+        from ..telemetry.events import open_event_log
+
+        sink = open_event_log(telemetry_dir)
+        sink.emit(
+            "run_start",
+            run_id=options.telemetry_run_id or sink.run_id,
+            backend=jax.default_backend(),
+            tenants=T,
+            seeds=[int(s) for s in seeds],
+            niterations=niterations,
+            x_shape=[int(s) for s in shape0],
+            **describe_mesh(mesh),
+            dataset_diagnostics=[d.to_dict() for d in diags],
+        )
+
+    # ---- per-tenant PRNG chains: tenant t's master key is exactly the
+    # solo search's PRNGKey(seed_t); the vmapped split below computes
+    # each tenant's solo splits bit-for-bit (threefry is elementwise in
+    # the key) ----
+    masters = jnp.stack([jax.random.PRNGKey(int(s)) for s in seeds])
+    ks = jax.vmap(lambda k: jax.random.split(k))(masters)   # (T, 2, 2)
+    k_init, keys = ks[:, 0], ks[:, 1]
+    init_keys = jax.vmap(lambda k: jax.random.split(k, I))(k_init)
+
+    init_fn = _make_init_fn(options, nfeatures, has_weights, donate, mesh)
+    if has_weights:
+        states = init_fn(init_keys, Xb, yb, wb, bl, scalars)
+    else:
+        states = init_fn(init_keys, Xb, yb, bl, scalars)
+    states = shard_island_states(states, mesh, options)
+    ghof = jax.vmap(merge_hofs_across_islands)(states.hof)
+
+    iteration_fn = _make_iteration_driver(
+        options, has_weights, donate, spans=None, mesh=mesh
+    )
+
+    # ---- per-tenant memo banks (options.cache_fitness) ----
+    use_cache = (
+        options.cache_fitness
+        and jax.process_count() == 1
+        and options.loss_function is None
+    )
+    banks: List[Optional[object]] = []
+    if use_cache:
+        from ..cache.memo import dataset_fingerprint, get_memo_bank
+
+        for t in range(T):
+            banks.append(
+                get_memo_bank(
+                    dataset_fingerprint(Xs[t], ys_[t], ws[t], options),
+                    options.cache_capacity,
+                )
+            )
+
+    early_stop = options.early_stop_fn()
+    it_done = 0
+    for it in range(niterations):
+        cm = jnp.int32(_curmaxsize(options, it, max(niterations, 1)))
+        ks = jax.vmap(lambda k: jax.random.split(k))(keys)
+        keys, k_it = ks[:, 0], ks[:, 1]
+        if use_cache:
+            memo_snaps = [
+                b.device_snapshot(
+                    options.cache_device_slots, options.dtype
+                )
+                for b in banks
+            ]
+            memo_args = (
+                jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *memo_snaps
+                ),
+            )
+        else:
+            memo_args = ()
+        if has_weights:
+            out = iteration_fn(
+                states, k_it, cm, Xb, yb, wb, bl, scalars, *memo_args
+            )
+        else:
+            out = iteration_fn(
+                states, k_it, cm, Xb, yb, bl, scalars, *memo_args
+            )
+        if options.cache_fitness:
+            absorb_snap = out[-1]
+            out = out[:-1]
+        else:
+            absorb_snap = None
+        states, ghof = out
+        jax.block_until_ready(ghof.losses)
+        it_done = it + 1
+
+        if use_cache and absorb_snap is not None:
+            from ..cache.hashing import tree_hash_host
+
+            snap_trees, snap_losses = absorb_snap
+            snap_trees = jax.tree_util.tree_map(np.asarray, snap_trees)
+            snap_losses = np.asarray(snap_losses)
+            for t in range(T):
+                banks[t].absorb(
+                    tree_hash_host(
+                        _slice_tree(snap_trees, t)
+                    ).ravel(),
+                    snap_losses[t].ravel(),
+                )
+
+        observe = (
+            (sink is not None or registry is not None)
+            and it % max(options.telemetry_every, 1) == 0
+        )
+        if observe:
+            best, evals = _tenant_summary(states, ghof)
+            best = np.asarray(best, np.float64)
+            evals = np.asarray(evals, np.float64)
+            if registry is not None:
+                for t in range(T):
+                    registry.gauge(
+                        f"serve_tenant_best_loss_{t}",
+                        help="best HoF loss of tenant t in the "
+                             "current batched search",
+                    ).set(float(best[t]))
+                registry.gauge(
+                    "serve_tenants",
+                    help="tenant count of the current batched search",
+                ).set(T)
+            if sink is not None:
+                sink.emit(
+                    "serve_metrics",
+                    iteration=it,
+                    best_loss=[
+                        float(b) if np.isfinite(b) else None
+                        for b in best
+                    ],
+                    num_evals=[float(e) for e in evals],
+                )
+
+        if early_stop is not None:
+            done = True
+            for t in range(T):
+                cands_t = hof_to_candidates(
+                    _slice_tree(ghof, t), options, variable_names
+                )
+                if not any(
+                    early_stop(c.loss, c.complexity) for c in cands_t
+                ):
+                    done = False
+                    break
+            if done:
+                break
+
+    # ---- per-tenant result assembly ----
+    search_time_s = time.time() - t_start
+    results: List[EquationSearchResult] = []
+    evals_host = np.asarray(jnp.sum(states.num_evals, axis=-1))
+    keys_host = np.asarray(keys)
+    for t in range(T):
+        ghof_t = _slice_tree(ghof, t)
+        cands = hof_to_candidates(ghof_t, options, variable_names)
+        state = None
+        if return_state:
+            state = [
+                SearchState(
+                    island_states=_slice_tree(states, t),
+                    global_hof=ghof_t,
+                    iteration=it_done,
+                    rng_key=jnp.asarray(keys_host[t]),
+                )
+            ]
+        results.append(
+            EquationSearchResult(
+                candidates=[cands],
+                options=options,
+                variable_names=variable_names,
+                state=state,
+                num_evals=float(evals_host[t]),
+                search_time_s=search_time_s,
+                cache_stats=(
+                    {"banks": [banks[t].stats]} if use_cache else None
+                ),
+                dataset_diagnostics=diags[t].to_dict(),
+            )
+        )
+
+    if sink is not None:
+        sink.emit(
+            "run_end",
+            tenants=T,
+            iterations=it_done,
+            search_time_s=search_time_s,
+            num_evals=[float(e) for e in evals_host],
+            best_loss=[
+                (lambda ls: float(min(ls)) if ls else None)(
+                    [float(c.loss) for c in r.frontier()]
+                )
+                for r in results
+            ],
+        )
+        sink.close()
+    return results

@@ -1,0 +1,416 @@
+"""Warm-compile job server: admit -> pad -> bucket -> batch -> dispatch.
+
+The front door of the serving tier (docs/serving.md). Jobs enter
+through :meth:`JobServer.submit`, which runs the hostile-data front
+door (``validate_dataset`` + ``sanitize_dataset`` under the server
+Options' data_policy), quantizes the dataset onto a small pad ladder
+(rows padded with explicit ZERO-WEIGHT rows — the weighted loss
+normalizes by ``sum(weights)``, so zero-weight padding is exact;
+features padded with zero rows), and files the job into a bucket keyed
+by::
+
+    (padded rows, padded features, opset, Options graph key,
+     traced scalars)
+
+Everything in the key shapes or parameterizes the compiled program:
+two jobs sharing a bucket are served by ONE warm compile (the api.py
+jit factories are lru-cached on exactly the Options graph key + mesh),
+and the traced scalars are in the key because a batch shares one
+scalar vector — without them, job 0's parsimony would silently apply
+to everyone in the bucket.
+
+:meth:`JobServer.flush` drains every bucket that has reached
+``max_tenants`` fill, and (on timeout or ``force=True``) partially
+filled buckets too; each batch dispatches through
+:func:`..batched.batched_equation_search` (a 1-job batch routes
+through the solo front door). Per-job results come back as
+:class:`JobResult` with the job's own run id registered in the fleet
+index (telemetry/fleet.py), and the queue exports
+``srtpu_serve_queue_depth`` / ``srtpu_serve_bucket_fill`` /
+``srtpu_serve_warm_hit_rate`` / ``srtpu_serve_job_latency_seconds``
+through the OpenMetrics endpoint. :meth:`JobServer.alert_row` feeds
+the ``queue_stalled`` rule (telemetry/alerts.py) a row describing the
+oldest unbatched job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models.dataset import sanitize_dataset, validate_dataset
+from ..models.options import (
+    TRACED_SCALAR_FIELDS,
+    Options,
+    make_options,
+)
+from .batched import batched_equation_search
+
+# pad ladders: small enough that real traffic actually buckets, big
+# enough that padding waste stays bounded (< 2x rows, < 2x features)
+DEFAULT_ROW_LADDER: Tuple[int, ...] = (
+    32, 64, 128, 256, 512, 1024, 2048, 4096, 8192,
+)
+DEFAULT_FEATURE_LADDER: Tuple[int, ...] = (1, 2, 4, 8, 16, 32)
+
+_LATENCY_EDGES = (0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
+
+
+def pad_to_ladder(n: int, ladder: Sequence[int]) -> int:
+    """Smallest ladder rung >= n; past the last rung, the next power of
+    two (quantization must never reject a job, only stop deduplicating
+    compiles for outliers)."""
+    if n <= 0:
+        raise ValueError(f"size must be positive, got {n}")
+    for rung in ladder:
+        if n <= rung:
+            return int(rung)
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclasses.dataclass
+class _QueuedJob:
+    job_id: str
+    X: np.ndarray          # padded (f_pad, n_pad)
+    y: np.ndarray          # padded (n_pad,)
+    weights: np.ndarray    # padded (n_pad,), zeros on pad rows
+    seed: int
+    options: Options
+    bucket: tuple
+    submitted_at: float
+    rows: int              # pre-pad
+    features: int          # pre-pad
+    diagnostics: dict
+
+
+@dataclasses.dataclass
+class JobResult:
+    """One completed job: the solo-equivalent search result plus the
+    serving provenance (bucket, batch fill, warm-compile flag, queue
+    wait and end-to-end latency)."""
+
+    job_id: str
+    result: Any            # api.EquationSearchResult
+    bucket: tuple
+    tenants: int           # batch fill this job dispatched with
+    warm: bool             # served by an already-warm compile
+    queue_wait_s: float
+    latency_s: float       # submit -> result
+
+
+class JobServer:
+    """Multi-tenant SR job queue over the batched engine.
+
+    options: the server's per-tenant search Options (jobs may override
+    via ``submit(..., options=)`` — different graph keys land in
+    different buckets). niterations: iterations per job.
+    max_tenants: bucket fill that triggers an immediate dispatch.
+    flush_timeout_s: age at which a partially-filled bucket flushes.
+    fleet_root: fleet directory — every job's run id is registered
+    there (telemetry/fleet.py) and dispatch event logs land under it.
+    registry: telemetry.metrics.MetricsRegistry for the
+    ``srtpu_serve_*`` exposition. clock: injectable monotonic clock
+    (tests drive timeout flushes without sleeping).
+    """
+
+    def __init__(
+        self,
+        options: Optional[Options] = None,
+        *,
+        niterations: int = 10,
+        max_tenants: int = 4,
+        flush_timeout_s: float = 2.0,
+        row_ladder: Sequence[int] = DEFAULT_ROW_LADDER,
+        feature_ladder: Sequence[int] = DEFAULT_FEATURE_LADDER,
+        fleet_root: Optional[str] = None,
+        registry=None,
+        clock=time.monotonic,
+        **option_kwargs,
+    ):
+        if options is None:
+            options = make_options(**option_kwargs)
+        elif option_kwargs:
+            raise ValueError(
+                "Pass either options= or option kwargs, not both"
+            )
+        if max_tenants < 1:
+            raise ValueError("max_tenants must be >= 1")
+        self.options = options
+        self.niterations = int(niterations)
+        self.max_tenants = int(max_tenants)
+        self.flush_timeout_s = float(flush_timeout_s)
+        self.row_ladder = tuple(row_ladder)
+        self.feature_ladder = tuple(feature_ladder)
+        self.fleet_root = fleet_root
+        self.registry = registry
+        self.clock = clock
+        self._queue: Dict[tuple, List[_QueuedJob]] = {}
+        self._ids = itertools.count()
+        self._seen: set = set()      # (bucket, tenants) already compiled
+        self._dispatches = 0
+        self._warm_hits = 0
+        self._completed: List[JobResult] = []
+        if registry is not None:
+            self._g_depth = registry.gauge(
+                "serve_queue_depth",
+                help="jobs admitted and not yet dispatched",
+            )
+            self._g_fill = registry.gauge(
+                "serve_bucket_fill",
+                help="fill ratio (tenants/max_tenants) of the last "
+                     "dispatched batch",
+            )
+            self._g_warm = registry.gauge(
+                "serve_warm_hit_rate",
+                help="fraction of dispatches served by an "
+                     "already-warm compile",
+            )
+            self._h_latency = registry.histogram(
+                "serve_job_latency_seconds",
+                list(_LATENCY_EDGES),
+                help="submit-to-result latency per job",
+            )
+            self._g_depth.set(0)
+
+    # ------------------------------------------------------------------
+    def submit(
+        self,
+        X,
+        y,
+        weights=None,
+        *,
+        seed: Optional[int] = None,
+        job_id: Optional[str] = None,
+        options: Optional[Options] = None,
+    ) -> str:
+        """Admit one job; returns its job id (also its fleet run id).
+
+        The dataset passes the hostile-data front door under the
+        job Options' data_policy, then pads onto the ladder: rows with
+        zero-weight rows (exact under the weighted loss), features
+        with zero feature rows (a caveat, not exact: the mutation
+        feature sampler sees the padded feature count —
+        docs/serving.md)."""
+        opts = options if options is not None else self.options
+        host_dtype = (
+            np.float64 if opts.precision == "float64" else np.float32
+        )
+        X = np.asarray(X, host_dtype)
+        y = np.asarray(y, host_dtype)
+        if X.ndim != 2:
+            raise ValueError("X must be (nfeatures, n)")
+        if y.ndim != 1:
+            raise ValueError(
+                "serving jobs are single-output: y must be (n,)"
+            )
+        if weights is not None:
+            weights = np.asarray(weights, host_dtype)
+        diags = validate_dataset(X, y[None, :], weights)
+        X, ys, weights, diags = sanitize_dataset(
+            X, y[None, :], weights, opts.data_policy, diags
+        )
+        X = np.asarray(X, host_dtype)
+        y = np.asarray(ys[0], host_dtype)
+        nfeat, n = X.shape
+
+        # ---- shape quantization onto the pad ladder ----
+        f_pad = pad_to_ladder(nfeat, self.feature_ladder)
+        n_pad = pad_to_ladder(n, self.row_ladder)
+        w = (
+            weights if weights is not None
+            else np.ones(n, host_dtype)
+        )
+        Xp = np.zeros((f_pad, n_pad), host_dtype)
+        Xp[:nfeat, :n] = X
+        yp = np.zeros(n_pad, host_dtype)
+        yp[:n] = y
+        wp = np.zeros(n_pad, host_dtype)
+        wp[:n] = w
+
+        opset = (
+            tuple(opts.binary_operators), tuple(opts.unary_operators)
+        )
+        # traced scalars (parsimony etc.) don't shape the graph, but a
+        # batch shares ONE scalar vector — jobs differing in any of
+        # them must land in different buckets (host floats: the jnp
+        # leaves traced_scalars() returns are unhashable)
+        scalar_key = tuple(
+            float(getattr(opts, f)) for f in TRACED_SCALAR_FIELDS
+        )
+        bucket = (
+            n_pad, f_pad, opset, opts._graph_key(), scalar_key,
+        )
+        if job_id is None:
+            job_id = f"job-{next(self._ids):06d}"
+        job = _QueuedJob(
+            job_id=job_id,
+            X=Xp, y=yp, weights=wp,
+            seed=int(seed if seed is not None else opts.seed),
+            options=opts,
+            bucket=bucket,
+            submitted_at=self.clock(),
+            rows=n, features=nfeat,
+            diagnostics=diags.to_dict(),
+        )
+        self._queue.setdefault(bucket, []).append(job)
+        self._set_queue_gauges()
+        return job_id
+
+    # ------------------------------------------------------------------
+    def pending(self) -> int:
+        return sum(len(v) for v in self._queue.values())
+
+    def oldest_wait_s(self) -> Optional[float]:
+        """Age of the oldest unbatched job (the queue_stalled signal)."""
+        now = self.clock()
+        ages = [
+            now - j.submitted_at
+            for jobs in self._queue.values() for j in jobs
+        ]
+        return max(ages) if ages else None
+
+    @property
+    def warm_hit_rate(self) -> float:
+        return (
+            self._warm_hits / self._dispatches if self._dispatches
+            else 0.0
+        )
+
+    @property
+    def completed(self) -> List[JobResult]:
+        return list(self._completed)
+
+    def stats(self) -> dict:
+        return {
+            "queue_depth": self.pending(),
+            "oldest_wait_s": self.oldest_wait_s(),
+            "dispatches": self._dispatches,
+            "warm_hits": self._warm_hits,
+            "warm_hit_rate": self.warm_hit_rate,
+            "completed": len(self._completed),
+            "buckets": len(self._queue),
+        }
+
+    def alert_row(self) -> dict:
+        """One fleet-index-shaped row describing the queue, for
+        telemetry.alerts.evaluate_alerts — the ``queue_stalled`` rule
+        reads ``serve_queue_oldest_wait_s``."""
+        return {
+            "run_id": "srserve-queue",
+            "serve_queue_depth": self.pending(),
+            "serve_queue_oldest_wait_s": self.oldest_wait_s(),
+            "serve_flush_timeout_s": self.flush_timeout_s,
+        }
+
+    # ------------------------------------------------------------------
+    def flush(self, force: bool = False) -> List[JobResult]:
+        """Dispatch every full bucket, plus (timeout or force) the
+        partial ones; returns the newly completed jobs."""
+        out: List[JobResult] = []
+        now = self.clock()
+        for bucket in list(self._queue):
+            jobs = self._queue[bucket]
+            while len(jobs) >= self.max_tenants:
+                batch, self._queue[bucket] = (
+                    jobs[: self.max_tenants], jobs[self.max_tenants:]
+                )
+                jobs = self._queue[bucket]
+                out.extend(self._dispatch(bucket, batch))
+            if jobs and (
+                force
+                or now - jobs[0].submitted_at >= self.flush_timeout_s
+            ):
+                self._queue[bucket] = []
+                out.extend(self._dispatch(bucket, jobs))
+            if not self._queue.get(bucket):
+                self._queue.pop(bucket, None)
+        self._set_queue_gauges()
+        self._completed.extend(out)
+        return out
+
+    def drain(self) -> List[JobResult]:
+        """Force-flush until the queue is empty; returns everything
+        completed by this call."""
+        out: List[JobResult] = []
+        while self.pending():
+            out.extend(self.flush(force=True))
+        return out
+
+    # ------------------------------------------------------------------
+    def _dispatch(
+        self, bucket: tuple, batch: List[_QueuedJob]
+    ) -> List[JobResult]:
+        T = len(batch)
+        # a warm dispatch reuses a compiled program: the jit factories
+        # are lru-cached on (Options graph key incl. tenants, shapes),
+        # so the first (bucket, T) pays the compile and every later one
+        # is a cache hit — the whole point of bucketing
+        warm = (bucket, T) in self._seen
+        self._seen.add((bucket, T))
+        self._dispatches += 1
+        self._warm_hits += int(warm)
+        t0 = self.clock()
+        telemetry_dir = None
+        if self.fleet_root is not None:
+            import os
+
+            telemetry_dir = os.path.join(self.fleet_root, "srserve")
+        results = batched_equation_search(
+            [(j.X, j.y, j.weights) for j in batch],
+            options=batch[0].options,
+            seeds=[j.seed for j in batch],
+            niterations=self.niterations,
+            registry=self.registry,
+            telemetry_dir=telemetry_dir,
+        )
+        t1 = self.clock()
+        if self.registry is not None:
+            self._g_fill.set(T / self.max_tenants)
+            self._g_warm.set(self.warm_hit_rate)
+        out = []
+        for job, res in zip(batch, results):
+            wait = t0 - job.submitted_at
+            latency = t1 - job.submitted_at
+            if self.registry is not None:
+                self._h_latency.observe(latency)
+            if self.fleet_root is not None:
+                from ..telemetry.fleet import register_run
+
+                best = [float(c.loss) for c in res.frontier()]
+                register_run(
+                    self.fleet_root,
+                    source="srserve",
+                    run_id=job.job_id,
+                    telemetry_dir=telemetry_dir,
+                    tenants=T,
+                    bucket_rows=bucket[0],
+                    bucket_features=bucket[1],
+                    warm=warm,
+                    queue_wait_s=wait,
+                    latency_s=latency,
+                    best_loss=min(best) if best else None,
+                )
+            out.append(
+                JobResult(
+                    job_id=job.job_id,
+                    result=res,
+                    bucket=bucket,
+                    tenants=T,
+                    warm=warm,
+                    queue_wait_s=wait,
+                    latency_s=latency,
+                )
+            )
+        return out
+
+    # ------------------------------------------------------------------
+    def _set_queue_gauges(self):
+        if self.registry is not None:
+            self._g_depth.set(self.pending())

@@ -23,6 +23,10 @@ Rule vocabulary (:data:`DEFAULT_ALERT_RULES`, docs/observability.md
   ``ctx["stale_after_s"]`` (default 600 s): either the process is dead
   (killed without a fault event — the line-buffered log just stops) or
   it is wedged on a hung tunnel; both need a human or the supervisor;
+* ``queue_stalled`` — the srserve admission queue
+  (:meth:`..serving.jobs.JobServer.alert_row` rows) holds a job older
+  than ``ctx["queue_deadline_s"]`` (default 4x the server's flush
+  timeout): the batcher stopped dispatching;
 * ``compile_bound`` — the doctor's compile-share flag (> 50% of
   measured wall in first-dispatch compilation): warm the compilation
   cache before trusting any timing from this run. Severity ``info``, a
@@ -171,6 +175,36 @@ def _numerically_degenerate(row, ctx):
     return None
 
 
+def _queue_stalled(row, ctx):
+    """The srserve admission queue holds a job older than the flush
+    deadline (ISSUE 16): the batcher stopped dispatching — a wedged
+    in-flight batch, a dead worker loop, or a flush timer that never
+    fires. Evaluates only on rows that carry the queue fields
+    (:meth:`..serving.jobs.JobServer.alert_row`); the deadline comes
+    from ``ctx['queue_deadline_s']`` (default 4x the server's own
+    ``flush_timeout_s`` when the row carries it, else 30 s)."""
+    wait = row.get("serve_queue_oldest_wait_s")
+    if wait is None:
+        return None
+    limit = ctx.get("queue_deadline_s")
+    if limit is None:
+        ft = row.get("serve_flush_timeout_s")
+        limit = 4.0 * float(ft) if ft else 30.0
+    limit = float(limit)
+    if limit > 0 and float(wait) > limit:
+        depth = row.get("serve_queue_depth")
+        return {
+            "message": (
+                f"oldest queued job waiting {float(wait):.0f}s "
+                f"(> {limit:.0f}s) with {depth or 0} job(s) pending — "
+                "the batcher is not flushing"
+            ),
+            "value": float(wait),
+            "threshold": limit,
+        }
+    return None
+
+
 def _throughput_regression(row, ctx):
     best = trajectory_best_throughput(ctx.get("trajectory"))
     plat = row.get("backend")
@@ -217,6 +251,12 @@ DEFAULT_ALERT_RULES: Sequence[AlertRule] = (
         "(containment layer discarding the search's work — hostile "
         "data or overflow-heavy opset)",
         _numerically_degenerate,
+    ),
+    AlertRule(
+        "queue_stalled", "warning",
+        "srserve admission queue holds a job past the flush deadline "
+        "(the batcher stopped dispatching)",
+        _queue_stalled,
     ),
     AlertRule(
         "compile_bound", "info",

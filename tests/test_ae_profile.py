@@ -274,7 +274,7 @@ def test_checked_in_cost_baseline_well_formed():
     # the compile_surface matrix, stage-attributed on the shared
     # seven-stage vocabulary, every figure positive
     assert set(configs) == {"base", "cache", "islands4", "pop32",
-                            "bucketed", "rowsharded"}
+                            "bucketed", "rowsharded", "tenants2"}
     for entry in configs.values():
         assert entry["flops"] > 0 and entry["bytes"] > 0
         assert 0.0 < entry["padded_waste_fraction"] < 1.0

@@ -340,7 +340,7 @@ def test_checked_in_baseline_exists_and_well_formed():
     assert payload["schema_version"] == 1
     assert set(payload["configs"]) == {
         "base", "cache", "islands4", "pop32", "bucketed", "rowsharded",
-        "chunked", "sharded",
+        "chunked", "sharded", "tenants2",
     }
     for entry in payload["configs"].values():
         assert entry["total_primitives"] == sum(
@@ -494,7 +494,7 @@ def test_checked_in_memory_baseline_exists_and_well_formed():
     assert payload["schema_version"] == 1
     assert set(payload["configs"]) == {
         "base", "cache", "islands4", "pop32", "bucketed", "rowsharded",
-        "sharded",
+        "sharded", "tenants2",
     }
     for entry in payload["configs"].values():
         assert entry["peak_modeled_bytes"] > 0

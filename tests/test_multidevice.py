@@ -264,6 +264,63 @@ def test_make_mesh_warns_on_idle_devices():
 TINY8 = {**TINY, "npopulations": 8}
 
 
+def test_tenant_batched_state_sharded():
+    """ISSUE 16: on the (tenants, islands) serving mesh the carried
+    IslandState leaves are sharded over BOTH named axes —
+    P('tenants', 'islands') — after init and after an iteration, so a
+    4-tenant batch actually spreads over all 8 devices instead of
+    GSPMD collapsing the tenants axis onto one replica."""
+    from jax.sharding import NamedSharding
+
+    from symbolicregression_jl_tpu.api import (
+        _make_init_fn,
+        _make_iteration_driver,
+    )
+
+    T, I = 4, 2
+    tiny = {k: v for k, v in TINY.items() if k != "runtests"}
+    opts = make_options(seed=0, tenants=T, **{**tiny, "npopulations": I})
+    mesh = mesh_mod.make_mesh(opts, I, tenants=T)
+    assert mesh is not None and mesh.devices.shape == (T, I)
+    assert mesh.axis_names == (opts.tenant_axis, opts.island_axis)
+
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((T, 2, 32)).astype(np.float32))
+    y = X[:, 0] * X[:, 0]
+    bl = jnp.var(y, axis=-1)
+    scalars = opts.traced_scalars()
+    masters = jnp.stack([jax.random.PRNGKey(s) for s in range(T)])
+    ks = jax.vmap(lambda k: jax.random.split(k))(masters)
+    init_keys = jax.vmap(lambda k: jax.random.split(k, I))(ks[:, 0])
+
+    init_fn = _make_init_fn(opts, 2, False, False, mesh)
+    states = init_fn(init_keys, X, y, bl, scalars)
+
+    def _assert_tenant_island(tree):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            sh = getattr(leaf, "sharding", None)
+            assert isinstance(sh, NamedSharding), (
+                f"{jax.tree_util.keystr(path)}: {type(sh)}"
+            )
+            spec = tuple(sh.spec)
+            assert spec[:2] == (opts.tenant_axis, opts.island_axis), (
+                f"{jax.tree_util.keystr(path)}: {sh} is not "
+                "(tenants, islands)-sharded"
+            )
+
+    _assert_tenant_island(states)
+
+    it_fn = _make_iteration_driver(opts, False, donate=False, mesh=mesh)
+    states, ghof = it_fn(
+        states, ks[:, 1], jnp.int32(opts.maxsize), X, y, bl, scalars
+    )
+    _assert_tenant_island(states)
+    # the merged per-tenant HoF rides the tenants axis
+    gsh = ghof.losses.sharding
+    assert isinstance(gsh, NamedSharding)
+    assert tuple(gsh.spec)[:1] == (opts.tenant_axis,)
+
+
 @pytest.mark.slow
 def test_sharded_search_production_contract(monkeypatch):
     """ISSUE 9 acceptance, fused driver: on the 8-device mesh with

@@ -1,0 +1,403 @@
+"""srserve tests (ISSUE 16): the tenant-batched engine's bit-identity
+contract, the job server's bucketing/warm-compile/timeout mechanics,
+the tenant-isolation Options guards, and the serving observability
+surface (srtpu_serve_* exposition + the queue_stalled alert rule).
+
+The bit-identity tests are the serving contract: tenant t of a batched
+search must equal the SOLO equation_search of the same Options
+(tenants=1) with seed=seeds[t] — bit for bit, losses and scores
+included, fused and chunked drivers alike. conftest forces 8 virtual
+CPU devices, so the 4-tenant runs exercise the real (tenants, islands)
+mesh: 4 tenants x 2 islands tiles all 8.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import symbolicregression_jl_tpu as sr
+from symbolicregression_jl_tpu.models.options import (
+    TenantIsolationError,
+    make_options,
+)
+from symbolicregression_jl_tpu.serving import (
+    DEFAULT_FEATURE_LADDER,
+    DEFAULT_ROW_LADDER,
+    JobServer,
+    batched_equation_search,
+    pad_to_ladder,
+)
+from symbolicregression_jl_tpu.telemetry.alerts import evaluate_alerts
+from symbolicregression_jl_tpu.telemetry.export import (
+    render_openmetrics,
+    validate_exposition,
+)
+from symbolicregression_jl_tpu.telemetry.metrics import MetricsRegistry
+
+TINY = dict(
+    binary_operators=["+", "-", "*"],
+    unary_operators=["cos"],
+    npop=24,
+    npopulations=2,
+    ncycles_per_iteration=40,
+    maxsize=12,
+    should_optimize_constants=False,
+    verbosity=0,
+    progress=False,
+)
+
+
+def make_jobs(T=4, n=48, nfeat=2, weighted=True, seed=0):
+    rng = np.random.default_rng(seed)
+    jobs = []
+    for t in range(T):
+        X = (rng.standard_normal((nfeat, n)) * 2).astype(np.float32)
+        y = X[0] * X[0] + (t + 1) * np.cos(X[-1])
+        w = (
+            rng.uniform(0.5, 1.5, n).astype(np.float32)
+            if weighted else None
+        )
+        jobs.append((X, y, w))
+    return jobs
+
+
+def frontier(res):
+    return [
+        (c.complexity, c.equation, float(c.loss), float(c.score))
+        for c in res.frontier()
+    ]
+
+
+class _FakeResult:
+    """Engine stand-in for host-logic job-server tests."""
+
+    def frontier(self):
+        return []
+
+
+def _solo_frontiers(jobs, opts, seeds, niterations):
+    out = []
+    for (X, y, w), s in zip(jobs, seeds):
+        solo = dataclasses.replace(opts, tenants=1, seed=int(s))
+        out.append(frontier(sr.equation_search(
+            X, y, weights=w, options=solo, niterations=niterations,
+        )))
+    return out
+
+
+@pytest.mark.slow
+def test_batched_bit_identity_fused():
+    """ISSUE 16 acceptance (fused): each tenant of the 4-tenant batched
+    search equals its solo run bit for bit — same Options, per-tenant
+    seeds, weighted datasets, (4 tenants x 2 islands) mesh."""
+    jobs = make_jobs(T=4)
+    opts = make_options(seed=0, **TINY)
+    seeds = [10, 11, 12, 13]
+    batched = batched_equation_search(
+        jobs, options=opts, seeds=seeds, niterations=3,
+    )
+    solos = _solo_frontiers(jobs, opts, seeds, 3)
+    for t in range(4):
+        assert frontier(batched[t]) == solos[t], f"tenant {t}"
+
+
+@pytest.mark.slow
+def test_batched_bit_identity_chunked():
+    """ISSUE 16 acceptance (chunked): the phased driver carries the
+    same contract — chunked batched equals chunked solo bit for bit
+    (and, through the existing chunked==fused contract, the fused solo
+    too)."""
+    jobs = make_jobs(T=4)
+    opts = make_options(seed=0, max_cycles_per_dispatch=15, **TINY)
+    seeds = [20, 21, 22, 23]
+    batched = batched_equation_search(
+        jobs, options=opts, seeds=seeds, niterations=2,
+    )
+    solos = _solo_frontiers(jobs, opts, seeds, 2)
+    for t in range(4):
+        assert frontier(batched[t]) == solos[t], f"tenant {t}"
+
+
+@pytest.mark.slow
+def test_batched_two_tenants_bit_identity_quick():
+    """The small form of the contract: 2 unweighted tenants, 2
+    iterations, against solo runs — exercising the vmapped factories,
+    the tenant mesh, and the per-tenant PRNG chains end to end. Slow:
+    compiles both the batched and the solo program (~3 min on one
+    core); tier-1 covers the real dispatch path through
+    test_job_server_bucketing_warm_hits_and_exposition instead."""
+    jobs = make_jobs(T=2, n=32, weighted=False)
+    opts = make_options(seed=0, **{
+        **TINY, "ncycles_per_iteration": 20, "npop": 16,
+    })
+    seeds = [5, 6]
+    batched = batched_equation_search(
+        jobs, options=opts, seeds=seeds, niterations=2,
+    )
+    solos = _solo_frontiers(jobs, opts, seeds, 2)
+    assert frontier(batched[0]) == solos[0]
+    assert frontier(batched[1]) == solos[1]
+    # tenants with different data/seed genuinely diverge (the batch is
+    # not broadcasting tenant 0 everywhere)
+    assert frontier(batched[0]) != frontier(batched[1])
+
+
+def test_batched_single_tenant_routes_solo(monkeypatch):
+    """T=1 delegates to the solo front door (so a 1-job batch carries
+    every solo feature and its warm jit cache): the effective Options
+    has tenants=1 and the per-tenant seed, weights pass through. The
+    solo entry point is stubbed — the solo search itself is covered
+    everywhere else; this pins the routing."""
+    calls = {}
+
+    def fake_solo(X, y, *, weights=None, options=None, **kw):
+        calls.update(X=X, weights=weights, options=options, **kw)
+        return "solo-result"
+
+    monkeypatch.setattr(
+        "symbolicregression_jl_tpu.api.equation_search", fake_solo
+    )
+    (X, y, w), = make_jobs(T=1, n=32)
+    res = batched_equation_search(
+        [(X, y, w)], niterations=1, seed=4, **TINY
+    )
+    assert res == ["solo-result"]
+    assert calls["options"].tenants == 1
+    assert calls["options"].seed == 4
+    assert calls["weights"] is w
+    assert calls["niterations"] == 1
+
+
+def test_batched_input_contracts():
+    """Admission rejections fire before any compile: shape mismatch,
+    mixed weights, seed-count mismatch, empty batch."""
+    jobs = make_jobs(T=2, n=32, weighted=False)
+    opts = make_options(**TINY)
+    bad_shape = [jobs[0], (jobs[1][0][:, :16], jobs[1][1][:16], None)]
+    with pytest.raises(ValueError, match="pad ladder"):
+        batched_equation_search(bad_shape, options=opts)
+    mixed = [
+        jobs[0],
+        (jobs[1][0], jobs[1][1], np.ones(32, np.float32)),
+    ]
+    with pytest.raises(ValueError, match="all-or-none"):
+        batched_equation_search(mixed, options=opts)
+    with pytest.raises(ValueError, match="seeds"):
+        batched_equation_search(jobs, options=opts, seeds=[1, 2, 3])
+    with pytest.raises(ValueError, match=">= 1 dataset"):
+        batched_equation_search([], options=opts)
+
+
+def test_tenant_isolation_guards():
+    """Options combinations that cannot keep tenants isolated are
+    rejected up front (ISSUE 16 satellite): stateful recorder hooks and
+    shared output paths raise the structured TenantIsolationError,
+    row_shards conflicts with the (tenants, islands) mesh, and the solo
+    front door refuses tenants > 1 outright."""
+    with pytest.raises(TenantIsolationError) as ei:
+        make_options(
+            binary_operators=["+"], tenants=2,
+            snapshot_path="/tmp/one_file.pkl",
+        )
+    assert "snapshot_path" in ei.value.fields
+    with pytest.raises(ValueError, match="row_shards"):
+        make_options(binary_operators=["+"], tenants=2, row_shards=2)
+    # a per-tenant template is fine
+    make_options(
+        binary_operators=["+"], tenants=2,
+        snapshot_path="/tmp/snap_{tenant}.pkl",
+    )
+    X = np.ones((2, 16), np.float32)
+    y = np.ones(16, np.float32)
+    with pytest.raises(ValueError, match="batched_equation_search"):
+        sr.equation_search(
+            X, y, niterations=1, tenants=2, runtests=False, **TINY
+        )
+
+
+def test_pad_to_ladder():
+    assert pad_to_ladder(1, DEFAULT_ROW_LADDER) == 32
+    assert pad_to_ladder(32, DEFAULT_ROW_LADDER) == 32
+    assert pad_to_ladder(33, DEFAULT_ROW_LADDER) == 64
+    assert pad_to_ladder(8192, DEFAULT_ROW_LADDER) == 8192
+    # beyond the ladder: next power of two, never a crash
+    assert pad_to_ladder(9000, DEFAULT_ROW_LADDER) == 16384
+    assert pad_to_ladder(3, DEFAULT_FEATURE_LADDER) == 4
+    assert pad_to_ladder(32, DEFAULT_FEATURE_LADDER) == 32
+
+
+def test_job_server_bucketing_warm_hits_and_exposition(tmp_path):
+    """The bucketing/warm-compile path end to end: 4 same-shape jobs at
+    max_tenants=2 make 2 dispatches of the SAME (bucket, T) — the
+    second is a warm hit; every job completes with a finite-loss
+    frontier; run ids land in the fleet registry; the serve gauges
+    render as a valid OpenMetrics exposition."""
+    registry = MetricsRegistry()
+    fleet_root = str(tmp_path / "fleet")
+    server = JobServer(
+        niterations=1, max_tenants=2, flush_timeout_s=60.0,
+        fleet_root=fleet_root, registry=registry,
+        seed=0, **{**TINY, "npop": 16, "ncycles_per_iteration": 20},
+    )
+    # different ROW COUNTS, one padded bucket: 30 and 27 both quantize
+    # to the 32 rung
+    rng = np.random.default_rng(0)
+    for i, n in enumerate([30, 27, 30, 27]):
+        X = rng.standard_normal((2, n)).astype(np.float32)
+        y = X[0] * X[0]
+        server.submit(X, y, job_id=f"j{i}", seed=i)
+    assert server.pending() == 4
+    assert server.stats()["buckets"] == 1
+
+    done = server.drain()
+    assert sorted(j.job_id for j in done) == ["j0", "j1", "j2", "j3"]
+    assert server.pending() == 0
+    stats = server.stats()
+    assert stats["dispatches"] == 2
+    assert stats["warm_hits"] == 1
+    assert server.warm_hit_rate == pytest.approx(0.5)
+    warm_flags = {j.job_id: j.warm for j in done}
+    assert not warm_flags["j0"] and warm_flags["j2"]
+    for j in done:
+        assert j.tenants == 2
+        assert j.result.frontier()
+        assert np.isfinite(min(c.loss for c in j.result.frontier()))
+        assert j.latency_s >= j.queue_wait_s >= 0.0
+
+    from symbolicregression_jl_tpu.telemetry.fleet import load_registry
+
+    recs = load_registry(fleet_root)
+    assert sorted(r["run_id"] for r in recs) == ["j0", "j1", "j2", "j3"]
+    assert all(r["source"] == "srserve" for r in recs)
+
+    text = render_openmetrics(registry=registry)
+    assert validate_exposition(text) == []
+    for name in (
+        "srtpu_serve_queue_depth",
+        "srtpu_serve_bucket_fill",
+        "srtpu_serve_warm_hit_rate",
+        "srtpu_serve_job_latency_seconds",
+        "srtpu_serve_tenants",
+    ):
+        assert name in text, name
+
+
+def test_job_server_timeout_flush_with_fake_clock(monkeypatch):
+    """Partial buckets sit until the flush timeout, then dispatch (the
+    injectable clock makes the timing deterministic); distinct shapes
+    land in distinct buckets. The engine is stubbed — flush/bucket
+    mechanics are host-side; the real dispatch path is covered by
+    test_job_server_bucketing_warm_hits_and_exposition."""
+    dispatched = []
+
+    def fake_engine(datasets, *, seeds=None, **kw):
+        dispatched.append((len(datasets), list(seeds)))
+        return [_FakeResult() for _ in datasets]
+
+    monkeypatch.setattr(
+        "symbolicregression_jl_tpu.serving.jobs.batched_equation_search",
+        fake_engine,
+    )
+    now = [0.0]
+    server = JobServer(
+        niterations=1, max_tenants=4, flush_timeout_s=2.0,
+        clock=lambda: now[0],
+        seed=0, **{**TINY, "npop": 16, "ncycles_per_iteration": 20},
+    )
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((2, 20)).astype(np.float32)
+    server.submit(X, X[0] * X[0], job_id="small")
+    X2 = rng.standard_normal((3, 100)).astype(np.float32)
+    server.submit(X2, X2[0] + X2[1], job_id="big")
+    assert server.stats()["buckets"] == 2  # (32, 2) vs (128, 4) pads
+
+    assert server.flush() == []            # under the timeout: holds
+    assert server.pending() == 2
+    now[0] = 2.5
+    assert server.oldest_wait_s() == pytest.approx(2.5)
+    done = server.flush()                  # past the timeout: partial
+    assert sorted(j.job_id for j in done) == ["big", "small"]
+    assert all(j.tenants == 1 for j in done)
+    assert server.pending() == 0
+    # two single-job dispatches, never a cross-bucket batch
+    assert dispatched == [(1, [0]), (1, [0])]
+
+
+def test_queue_stalled_alert_rule():
+    """The queue_stalled rule fires on a JobServer.alert_row-shaped row
+    whose oldest wait exceeds the deadline — default 4x the server's
+    own flush timeout, overridable via ctx['queue_deadline_s'] — and
+    stays silent on fresh queues and non-queue rows."""
+    row = {
+        "run_id": "srserve-queue",
+        "serve_queue_depth": 3,
+        "serve_queue_oldest_wait_s": 9.0,
+        "serve_flush_timeout_s": 2.0,
+    }
+    fired = evaluate_alerts([row], {})
+    assert [a["rule"] for a in fired] == ["queue_stalled"]
+    assert fired[0]["severity"] == "warning"
+    assert fired[0]["value"] == 9.0 and fired[0]["threshold"] == 8.0
+
+    fresh = dict(row, serve_queue_oldest_wait_s=1.0)
+    assert evaluate_alerts([fresh], {}) == []
+    # explicit deadline wins over the flush-timeout default
+    assert evaluate_alerts([fresh], {"queue_deadline_s": 0.5}) != []
+    # rows without the queue fields never trip it
+    assert evaluate_alerts(
+        [{"run_id": "r0", "verdict": "completed"}], {}
+    ) == []
+
+    # the live server produces a row the rule can read
+    server = JobServer(
+        flush_timeout_s=2.0, clock=lambda: 0.0,
+        binary_operators=["+"], verbosity=0, progress=False,
+    )
+    r = server.alert_row()
+    assert r["serve_queue_oldest_wait_s"] is None
+    assert evaluate_alerts([r], {}) == []
+
+
+def test_batched_telemetry_and_registry(tmp_path):
+    """Per-tenant telemetry fan-out: the batched run writes run_start /
+    serve_metrics / run_end events carrying per-tenant arrays, and the
+    registry gains tenant-indexed best-loss gauges from ONE fused
+    reduction per observed iteration."""
+    import glob
+    import json
+
+    # weighted jobs + the bucketing test's Options: same graph key and
+    # shapes as its dispatches, so this rides that test's warm compile
+    # (telemetry_every is host cadence, not part of the graph key)
+    jobs = make_jobs(T=2, n=32)
+    registry = MetricsRegistry()
+    tdir = str(tmp_path / "events")
+    opts = make_options(
+        seed=0, telemetry_every=1,
+        **{**TINY, "npop": 16, "ncycles_per_iteration": 20},
+    )
+    batched_equation_search(
+        jobs, options=opts, seeds=[1, 2], niterations=1,
+        registry=registry, telemetry_dir=tdir,
+    )
+    gauges = registry.snapshot()["gauges"]
+    assert "serve_tenant_best_loss_0" in gauges
+    assert "serve_tenant_best_loss_1" in gauges
+    assert gauges["serve_tenants"] == 2
+
+    logs = glob.glob(tdir + "/events-*.jsonl")
+    assert logs
+    events = [
+        json.loads(line)
+        for line in open(logs[0])
+        if line.strip()
+    ]
+    kinds = [e.get("type") for e in events]
+    assert "run_start" in kinds and "run_end" in kinds
+    start = events[kinds.index("run_start")]
+    assert start["tenants"] == 2 and start["seeds"] == [1, 2]
+    sm = [e for e in kinds if e == "serve_metrics"]
+    assert sm, "no serve_metrics events"
+    end = events[kinds.index("run_end")]
+    assert len(end["best_loss"]) == 2 and len(end["num_evals"]) == 2
