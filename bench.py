@@ -1031,8 +1031,9 @@ def main(verbose=True):
     # length-bucketed eval dispatch (Options.eval_bucket_ladder) against
     # the flat interpreter on the SAME workload and device — measured on
     # the CPU backend, the interpreter's production home (on TPU the
-    # large-batch scoring path runs the Pallas kernel, which ignores the
-    # ladder). The flat reference reuses the rate already measured above
+    # large-batch scoring path runs the Pallas kernel, whose own bucket
+    # dispatch is A/B'd separately below as pallas_bucketed_vs_flat).
+    # The flat reference reuses the rate already measured above
     # (main run on CPU platform, the xla-cpu anchor otherwise).
     bucketed_rate, bucketed_ratio = None, None
     interp_flat_rate = value if platform == "cpu" else xla_cpu_rate
@@ -1196,6 +1197,79 @@ def main(verbose=True):
             skip_reason=roofline_skip_reason,
             trees_rows_per_s=value,
         )
+
+    # ---- Pallas bucketed-vs-flat kernel ratio (ISSUE 17): the
+    # bucket-laddered kernel dispatch (per-bucket t_block re-clamp over
+    # the shared length-major sort) against the flat kernel on the bench
+    # workload. On-chip only — interpret mode on CPU times the Pallas
+    # interpreter, not the Mosaic schedule, so CPU rounds carry a skip
+    # reason instead of a silent null (mirrors roofline_skip_reason;
+    # the CPU-portable bit-identity half lives in
+    # benchmark/suite.py::bench_pallas_bucketed). ----
+    pallas_bucketed_rate = None
+    pallas_bucketed_ratio = None
+    pallas_bucketed_skip = None
+    if platform == "cpu":
+        pallas_bucketed_skip = "cpu-only round"
+    elif not pallas_routed:
+        pallas_bucketed_skip = "pallas-not-routed"
+    else:
+        try:
+            _pb_trees = _build_workload(
+                jax, jnp, options, min(n_trees, CHUNK), 1
+            )
+            _pb_X = jnp.asarray(_feynman_data()[0])
+            _pb_over = _dispatch_overhead_s(jax, jnp, main_dev)
+            _pb_flat, _, _ = time_pallas_variant(
+                jax, jnp, _pb_trees, _pb_X, options.operators, _pb_over,
+                20,
+            )
+            pallas_bucketed_rate, _, _ = time_pallas_variant(
+                jax, jnp, _pb_trees, _pb_X, options.operators, _pb_over,
+                20, bucket_ladder=(0.25, 0.5, 0.75, 1.0),
+            )
+            pallas_bucketed_ratio = pallas_bucketed_rate / _pb_flat
+        except Exception as e:  # pragma: no cover - device-fault path
+            pallas_bucketed_skip = f"error: {type(e).__name__}"
+            if verbose:
+                print(f"# pallas bucketed A/B failed: {e}",
+                      file=sys.stderr)
+
+    # ---- kernel tune-cache provenance (ISSUE 17): whether THIS round's
+    # `auto` routing had a persistent tuned config to consult
+    # (symbolicregression_jl_tpu/tune), and what it resolved to — a
+    # miss with present=False is the byte-identical static-default
+    # regime, not an error. ----
+    kernel_tune_cache = None
+    try:
+        from symbolicregression_jl_tpu.tune import (
+            current_device_kind,
+            default_cache_path,
+            load_tune_cache,
+            lookup_kernel_config,
+            tuned_min_work,
+        )
+
+        _tc_path = (
+            os.environ.get("SRTPU_TUNE_CACHE") or default_cache_path()
+        )
+        _tc = load_tune_cache()
+        _tc_kind = current_device_kind()
+        _tc_cfg = lookup_kernel_config(
+            options.operators, options.max_len, "float32"
+        )
+        kernel_tune_cache = {
+            "present": _tc is not None,
+            "path": _tc_path,
+            "device_kind": _tc_kind,
+            "hit": _tc_cfg is not None,
+            "config": _tc_cfg,
+            "min_work": tuned_min_work(),
+        }
+    except Exception as e:  # pragma: no cover - defensive
+        if verbose:
+            print(f"# tune-cache provenance unavailable: {e}",
+                  file=sys.stderr)
 
     # ---- multi-chip real-search capture (benchmark/multichip.py):
     # the production equation_search sharded over an island mesh vs the
@@ -1416,6 +1490,20 @@ def main(verbose=True):
         "roofline_measured": roofline_measured,
         "roofline_modeled": roofline_modeled,
         "roofline_skip_reason": roofline_skip_reason,
+        # Pallas kernel with the bucket ladder vs flat, same workload,
+        # on-chip only (ISSUE 17; skip reason on CPU rounds)
+        "pallas_bucketed": (
+            round(pallas_bucketed_rate, 1)
+            if pallas_bucketed_rate is not None else None
+        ),
+        "pallas_bucketed_vs_flat": (
+            round(pallas_bucketed_ratio, 3)
+            if pallas_bucketed_ratio is not None else None
+        ),
+        "pallas_bucketed_skip_reason": pallas_bucketed_skip,
+        # persistent autotuner provenance (ISSUE 17): cache present/hit
+        # and the config the `auto` router resolved for this round
+        "kernel_tune_cache": kernel_tune_cache,
         # real-search island-sharding capture (benchmark/multichip.py);
         # the skip reason names why no ON-PLATFORM capture exists
         "multichip": multichip_rows,

@@ -27,6 +27,17 @@ waste actually costs in the in-search regime (feynman searches run at
 256 rows). A near-constant ms/iter across row counts = the waste is
 real (same vector work regardless of rows); trees-rows/s scaling
 linearly with rows = it is not.
+
+--autotune [--cache PATH] [--top K] [--min-work N] runs the persistent
+autotuner: the srcost model (analysis/cost.py::rank_kernel_configs)
+ranks the full (t_block, r_block, dispatch, tree_unroll, ladder)
+candidate grid, only the top K are measured on the device, and the
+winner is folded into the schema-versioned tune cache
+(symbolicregression_jl_tpu/tune/tune_cache.json by default, or --cache)
+under THIS device kind. On a host without a TPU the sweep falls back to
+Pallas interpret mode on a shrunken workload — those timings are marked
+interpret in the cache and can never be filed under a TPU device kind.
+See docs/kernel_tuning.md.
 """
 
 from __future__ import annotations
@@ -71,6 +82,29 @@ def main():
     args = [a for a in args if a != "--rows-sweep"]
     bucket_sweep = "--bucket-sweep" in args
     args = [a for a in args if a != "--bucket-sweep"]
+    autotune = "--autotune" in args
+    args = [a for a in args if a != "--autotune"]
+    cache_path = None
+    if "--cache" in args:
+        i = args.index("--cache")
+        if i + 1 >= len(args):
+            sys.exit("--cache requires a path")
+        cache_path = args[i + 1]
+        args = args[:i] + args[i + 2:]
+    top_k = 5
+    if "--top" in args:
+        i = args.index("--top")
+        if i + 1 >= len(args):
+            sys.exit("--top requires a value")
+        top_k = int(args[i + 1])
+        args = args[:i] + args[i + 2:]
+    min_work_flag = None
+    if "--min-work" in args:
+        i = args.index("--min-work")
+        if i + 1 >= len(args):
+            sys.exit("--min-work requires a value")
+        min_work_flag = int(args[i + 1])
+        args = args[:i] + args[i + 2:]
     rows_max = 2048
     if "--rows-max" in args:
         i = args.index("--rows-max")
@@ -100,6 +134,90 @@ def main():
         return time_pallas_variant(
             jax, jnp, trees, X, ops, overhead, n_inner, **kw
         )
+
+    if autotune:
+        from symbolicregression_jl_tpu.models.fitness import (
+            _PALLAS_MIN_WORK,
+        )
+        from symbolicregression_jl_tpu.ops.pallas_eval import (
+            pallas_available,
+        )
+        from symbolicregression_jl_tpu.tune import (
+            current_device_kind,
+            load_tune_cache,
+            model_ranked_sweep,
+            save_tune_cache,
+        )
+        from symbolicregression_jl_tpu.tune.tuner import sweep_to_cache
+
+        interpret = not pallas_available()
+        device_kind = current_device_kind()
+        if interpret:
+            # CPU fallback: interpret mode pays ~1000x per slot, so the
+            # measured workload shrinks to stay tractable. The relative
+            # ordering it produces is still a valid cache payload —
+            # entries are marked interpret and update_tune_cache refuses
+            # to file them under any TPU device kind.
+            at_trees, at_X, at_inner = trees[:256], X[:, :256], 1
+        else:
+            at_trees, at_X, at_inner = trees, X, n_inner
+        lengths = [
+            int(v) for v in np.asarray(jax.device_get(at_trees.length))
+        ]
+        print(
+            f"# autotune: device_kind={device_kind} interpret={interpret} "
+            f"workload={len(lengths)}x{at_X.shape[1]} top_k={top_k}",
+            file=sys.stderr, flush=True,
+        )
+
+        def measure(config):
+            kw = dict(
+                t_block=config["t_block"], r_block=config["r_block"],
+                dispatch=config["dispatch"],
+                tree_unroll=config["tree_unroll"],
+            )
+            if config.get("ladder"):
+                kw["bucket_ladder"] = tuple(
+                    float(f) for f in config["ladder"]
+                )
+            if interpret:
+                kw["interpret"] = True
+            rate, per_iter, compile_s = time_pallas_variant(
+                jax, jnp, at_trees, at_X, ops, overhead, at_inner, **kw
+            )
+            print(json.dumps({
+                "sweep": "autotune", "config": config,
+                "trees_rows_per_s": rate, "per_iter_s": per_iter,
+                "compile_s": compile_s, "interpret": interpret,
+                "device_kind": device_kind,
+            }), flush=True)
+            return rate
+
+        sweep = model_ranked_sweep(
+            ops, lengths, int(at_X.shape[1]), int(at_X.shape[0]),
+            measure, top_k=top_k,
+        )
+        # the entry is keyed by the PADDED slot count (options.max_len,
+        # what trees.kind.shape[-1] is at lookup time in
+        # fitness._tuned_kernel_kwargs), not the user-facing maxsize
+        cache = sweep_to_cache(
+            sweep, ops, options.max_len, dtype="float32",
+            interpret=interpret,
+            device_kind=device_kind,
+            min_work=(min_work_flag if min_work_flag is not None
+                      else _PALLAS_MIN_WORK),
+            cache=load_tune_cache(cache_path),
+        )
+        if not sweep.get("best") or cache is None:
+            sys.exit("autotune: no candidate measured successfully")
+        path = save_tune_cache(cache, cache_path)
+        print(
+            f"\nBEST: {sweep['best']['trees_rows_per_s']:.3e} "
+            f"trees-rows/s  {sweep['best']['config']}\n"
+            f"cache written: {path} (device_kind={device_kind}, "
+            f"interpret={interpret})"
+        )
+        return
 
     if bucket_sweep:
         # ladder A/B on the jnp interpreter path: flat reference first,

@@ -171,8 +171,8 @@ def bench_bucketed_eval():
     trees-rows/s rates, their ratio (the acceptance target is >=1.5x on
     CPU), and the bit-identity of the two loss vectors. eval_backend is
     pinned to 'jnp' so the case measures the interpreter on every
-    platform (the Pallas kernel path ignores the ladder — it already
-    prices trees by length)."""
+    platform (the Pallas kernel path has its own bucket dispatch —
+    bench_pallas_bucketed covers it)."""
     import jax
     import jax.numpy as jnp
 
@@ -1227,6 +1227,115 @@ def bench_static_analysis():
     ]
 
 
+def bench_pallas_bucketed():
+    """Bucket-laddered Pallas kernel correctness (ISSUE 17): the bucketed
+    kernel dispatch vs the flat kernel under Pallas interpret mode on
+    CPU, on a skewed-length batch — values, ok mask, AND poison
+    semantics (planted inf constants) must be bit-identical, plus the
+    fused loss epilogue vs its host-graph twin
+    (aggregate_loss(tile_rows=r_block) + contain_nonfinite, both sides
+    jitted). Interpret mode executes the same kernel program the TPU
+    runs, minus the Mosaic schedule, so this is the portable half of
+    the bucketed-vs-flat acceptance; the on-chip throughput half lives
+    in bench.py / kernel_tune.py. Small shapes: interpret mode pays
+    ~1000x per slot."""
+    import jax
+    import jax.numpy as jnp
+
+    from symbolicregression_jl_tpu.models.mutate_device import (
+        gen_random_tree_fixed_size,
+    )
+    from symbolicregression_jl_tpu.models.options import make_options
+    from symbolicregression_jl_tpu.ops.losses import (
+        aggregate_loss,
+        contain_nonfinite,
+    )
+    from symbolicregression_jl_tpu.ops.pallas_eval import (
+        eval_loss_trees_pallas,
+        eval_trees_pallas,
+    )
+
+    t0 = time.time()
+    options = make_options(
+        binary_operators=["+", "-", "*", "/"],
+        unary_operators=["cos", "exp"],
+        maxsize=20,
+    )
+    ops = options.operators
+    loss_fn = options.elementwise_loss
+    n_trees, n_rows = 48, 300
+    rng = np.random.default_rng(0)
+    u = rng.random(n_trees)
+    sizes = np.where(
+        u < 0.80, rng.integers(3, 7, n_trees),
+        np.where(u < 0.95, rng.integers(7, 13, n_trees),
+                 rng.integers(13, 21, n_trees)),
+    ).astype(np.int32)
+    trees = jax.vmap(
+        lambda k, s: gen_random_tree_fixed_size(
+            k, s, 3, ops, options.max_len
+        )
+    )(jax.random.split(jax.random.PRNGKey(0), n_trees), jnp.asarray(sizes))
+    X = jax.random.normal(jax.random.PRNGKey(2), (3, n_rows), jnp.float32)
+    y = 2.0 * jnp.cos(X[2]) + X[1] ** 2 - 2.0
+    ladder = (0.25, 0.5, 1.0)  # skewed 3-bucket ladder
+    kw = dict(t_block=8, r_block=128, interpret=True)
+
+    y_flat, ok_flat = eval_trees_pallas(trees, X, ops, **kw)
+    y_buck, ok_buck = eval_trees_pallas(
+        trees, X, ops, bucket_ladder=ladder, **kw
+    )
+    values_ok = bool(np.array_equal(
+        np.asarray(y_flat), np.asarray(y_buck), equal_nan=True
+    ))
+    mask_ok = bool(np.array_equal(np.asarray(ok_flat), np.asarray(ok_buck)))
+
+    # poison semantics: planted inf constants must poison the SAME trees
+    poisoned = trees._replace(cval=jnp.where(
+        jnp.arange(n_trees)[:, None] % 7 == 0, jnp.inf, trees.cval
+    ))
+    yp_flat, okp_flat = eval_trees_pallas(poisoned, X, ops, **kw)
+    yp_buck, okp_buck = eval_trees_pallas(
+        poisoned, X, ops, bucket_ladder=ladder, **kw
+    )
+    poison_ok = bool(
+        np.array_equal(np.asarray(yp_flat), np.asarray(yp_buck),
+                       equal_nan=True)
+        and np.array_equal(np.asarray(okp_flat), np.asarray(okp_buck))
+    )
+
+    # fused epilogue vs the host-graph twin, both sides jitted (the
+    # eager host graph compiles a true divide where jit folds the
+    # constant divisor to a reciprocal-multiply — the production
+    # composition is always jitted, so that is the contract surface)
+    @jax.jit
+    def host_twin(t):
+        yp, ok = eval_trees_pallas(t, X, ops, **kw)
+        elem = loss_fn(yp, y[None, :])
+        return contain_nonfinite(
+            aggregate_loss(elem, None, tile_rows=kw["r_block"]), ok
+        )
+
+    fused = eval_loss_trees_pallas(
+        trees, X, y, ops, loss_fn, bucket_ladder=ladder, **kw
+    )
+    fused_ok = bool(np.array_equal(
+        np.asarray(fused), np.asarray(host_twin(trees)), equal_nan=True
+    ))
+    return [
+        {
+            "suite": "pallas_bucketed",
+            "case": "summary",
+            "bit_identical_values": values_ok,
+            "bit_identical_ok": mask_ok,
+            "bit_identical_poison": poison_ok,
+            "fused_bit_identical": fused_ok,
+            "ladder": list(ladder),
+            "seconds": round(time.time() - t0, 1),
+        }
+    ]
+
+
 # (fn, per-case subprocess timeout). northstar LAST: it is the one case
 # with a device-fault history (r04/r03), and even in its own process it
 # is the longest.
@@ -1236,6 +1345,7 @@ _CASES = [
     (bench_single_eval_48_nodes, 600),
     (bench_population_scoring, 600),
     (bench_bucketed_eval, 900),
+    (bench_pallas_bucketed, 900),
     (bench_multichip, 1200),
     (bench_telemetry, 900),
     (bench_run_doctor, 900),

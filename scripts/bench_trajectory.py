@@ -61,11 +61,24 @@ METRICS = (
     "roofline_fraction",
     "roofline_modeled",
     "interp_bucketed_vs_flat",
+    "pallas_bucketed_vs_flat",
     "multichip_scaling_efficiency",
     "multichip_speedup",
 )
 
 DEFAULT_THRESHOLD = 0.10
+
+#: Pinned regression floors (ISSUE 17): a series whose checked-in
+#: history is all-null (the column landed after the last capture round)
+#: has no "best earlier round" to regress against, so its FIRST real
+#: capture could land arbitrarily low without a flag. A pin seeds the
+#: per-platform bar at the acceptance value the series shipped with
+#: (round label "pin"); any real round that beats the pin replaces it
+#: as the bar, exactly like a measured best. interp_bucketed_vs_flat's
+#: 1.5 is the ISSUE 5 CPU acceptance target the ladder was merged on.
+PINNED_FLOORS = {
+    "interp_bucketed_vs_flat": {"cpu": 1.5},
+}
 
 
 def _headline_from_tail(tail: str):
@@ -173,6 +186,9 @@ def load_bench_round(path: str):
         "interp_bucketed_vs_flat": _num(
             parsed.get("interp_bucketed_vs_flat")
         ),
+        "pallas_bucketed_vs_flat": _num(
+            parsed.get("pallas_bucketed_vs_flat")
+        ),
         "first_call_s": _num(parsed.get("first_call_s")),
     }
     mc = _multichip_summary(parsed.get("multichip"))
@@ -217,13 +233,19 @@ def load_multichip_record(path: str):
 
 
 def detect_regressions(points, metrics=METRICS,
-                       threshold: float = DEFAULT_THRESHOLD):
+                       threshold: float = DEFAULT_THRESHOLD,
+                       pins=PINNED_FLOORS):
     """Per metric: flag every point whose value sits more than
     `threshold` below the best EARLIER value on the same platform.
-    Null points neither regress nor set the bar."""
+    Null points neither regress nor set the bar. PINNED_FLOORS entries
+    pre-seed the bar (round 'pin') for series with no measured history
+    yet."""
     out = []
     for metric in metrics:
-        best_by_platform = {}
+        best_by_platform = {
+            plat: {"value": float(v), "round": "pin"}
+            for plat, v in (pins or {}).get(metric, {}).items()
+        }
         for p in points:
             v = _num(p.get(metric))
             plat = p.get("platform")
@@ -362,9 +384,9 @@ def render_markdown(traj) -> str:
         "report, not a gate.*",
         "",
         "| round | platform | tunnel | trees-rows/s | vs_baseline | "
-        "roofline | roofline (modeled) | bucketed/flat | mc scaling | "
-        "mc speedup |",
-        "|---|---|---|---|---|---|---|---|---|---|",
+        "roofline | roofline (modeled) | bucketed/flat | "
+        "pallas bucketed/flat | mc scaling | mc speedup |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
     ]
 
     def cell(v, spec=".3g"):
@@ -388,6 +410,7 @@ def render_markdown(traj) -> str:
             f"| {roof_cell} "
             f"| {cell(p.get('roofline_modeled'))} "
             f"| {cell(p.get('interp_bucketed_vs_flat'))} "
+            f"| {cell(p.get('pallas_bucketed_vs_flat'))} "
             f"| {cell(p.get('multichip_scaling_efficiency'))} "
             f"| {cell(p.get('multichip_speedup'))} |"
         )
@@ -395,7 +418,7 @@ def render_markdown(traj) -> str:
     for p in mc_latest:
         lines.append(
             f"| latest | {cell(p.get('platform'))} | — | — | — | — | — "
-            f"| — "
+            f"| — | — "
             f"| {cell(p.get('multichip_scaling_efficiency'))} "
             f"| {cell(p.get('multichip_speedup'))} |"
         )

@@ -152,6 +152,44 @@ def check_fleet_exposition() -> dict:
     return {"ok": not problems, "samples": samples, "detail": detail}
 
 
+def check_tune_cache() -> dict:
+    """Kernel tune-cache gate (ISSUE 17): a checked-in
+    symbolicregression_jl_tpu/tune/tune_cache.json (or one named by
+    SRTPU_TUNE_CACHE) must parse and satisfy the schema
+    (tune/cache.py::validate_tune_cache — schema version, config shapes,
+    interpret-under-TPU quarantine). An ABSENT cache is fine: that is
+    the byte-identical static-default regime. A present-but-invalid one
+    fails the gate — models/fitness.py would silently ignore it at
+    runtime (load warns and returns None), and a cache nobody can
+    consult must not sit in the tree looking authoritative."""
+    from symbolicregression_jl_tpu.tune import (
+        default_cache_path,
+        validate_tune_cache,
+    )
+
+    path = os.environ.get("SRTPU_TUNE_CACHE") or default_cache_path()
+    if not os.path.exists(path):
+        return {"ok": True, "present": False, "entries": 0, "detail": ""}
+    try:
+        with open(path) as f:
+            cache = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"ok": False, "present": True, "entries": 0,
+                "detail": f"unreadable: {e}"}
+    problems = validate_tune_cache(cache)
+    entries = sum(
+        len(dk.get("entries", {}))
+        for dk in cache.get("device_kinds", {}).values()
+        if isinstance(dk, dict)
+    ) if isinstance(cache, dict) else 0
+    return {
+        "ok": not problems,
+        "present": True,
+        "entries": entries,
+        "detail": problems[0] if problems else "",
+    }
+
+
 def check_docs() -> dict:
     """gen_api_reference.py --check in a subprocess (it imports the whole
     package and renders docstrings; isolation keeps this process's jax
@@ -213,6 +251,7 @@ def main(argv=None) -> int:
         None if (ns.skip_telemetry_schema or ns.only is not None)
         else check_fleet_exposition()
     )
+    tune_cache = None if ns.only is not None else check_tune_cache()
     # non-fatal: the bench trajectory is a report, never a gate
     trajectory = None if ns.only is not None else trajectory_report()
     ok = (
@@ -220,6 +259,7 @@ def main(argv=None) -> int:
         and (docs is None or docs["api_reference_current"])
         and (telemetry is None or telemetry["ok"])
         and (fleet is None or fleet["ok"])
+        and (tune_cache is None or tune_cache["ok"])
     )
 
     if ns.format == "json":
@@ -227,6 +267,7 @@ def main(argv=None) -> int:
         payload["docs"] = docs
         payload["telemetry_schema"] = telemetry
         payload["fleet_exposition"] = fleet
+        payload["tune_cache"] = tune_cache
         payload["trajectory"] = trajectory
         payload["ok"] = ok
         print(json.dumps(payload, indent=2, sort_keys=True))
@@ -251,6 +292,14 @@ def main(argv=None) -> int:
                 else f"INVALID ({fleet['detail']})"
             )
             print(f"fleet OpenMetrics exposition: {state}")
+        if tune_cache is not None:
+            state = (
+                ("absent (static defaults)" if not tune_cache["present"]
+                 else f"valid ({tune_cache['entries']} entries)")
+                if tune_cache["ok"]
+                else f"INVALID ({tune_cache['detail']})"
+            )
+            print(f"kernel tune cache: {state}")
         if trajectory is not None and "error" not in trajectory:
             n_reg = len(trajectory["regressions"])
             print(

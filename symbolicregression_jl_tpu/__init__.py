@@ -117,6 +117,17 @@ def __getattr__(name):
         from . import serving
 
         return getattr(serving, name)
+    # persistent kernel autotuner (tune/, docs/kernel_tuning.md): lazy —
+    # fitness.py consults the cache on its own; importing the package
+    # must not touch the tuner machinery
+    if name in ("current_device_kind", "default_cache_path",
+                "load_tune_cache", "lookup_kernel_config",
+                "model_ranked_sweep", "save_tune_cache", "sweep_to_cache",
+                "tuned_min_work", "update_tune_cache",
+                "validate_tune_cache"):
+        from . import tune
+
+        return getattr(tune, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}"
     )
@@ -237,4 +248,14 @@ __all__ = [
     "JobServer",
     "JobResult",
     "pad_to_ladder",
+    "current_device_kind",
+    "default_cache_path",
+    "load_tune_cache",
+    "lookup_kernel_config",
+    "model_ranked_sweep",
+    "save_tune_cache",
+    "sweep_to_cache",
+    "tuned_min_work",
+    "update_tune_cache",
+    "validate_tune_cache",
 ]

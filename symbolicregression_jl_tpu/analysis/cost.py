@@ -48,6 +48,7 @@ CLI: ``python -m symbolicregression_jl_tpu.analysis --only cost
 from __future__ import annotations
 
 import json
+import math
 import os
 from typing import Dict, List, Optional, Tuple
 
@@ -324,6 +325,194 @@ def iteration_cost(options) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# Pallas kernel config model (the autotuner's pre-measurement ranking)
+# ---------------------------------------------------------------------------
+
+#: element-op weight of one kernel slot's CANDIDATE computation per
+#: operator name — FLOP_WEIGHTS vocabulary keyed by the operator-set
+#: spelling instead of the lax primitive name.
+_OP_NAME_WEIGHTS: Dict[str, float] = {
+    "+": 1.0, "-": 1.0, "*": 1.0, "/": 4.0, "^": 12.0, "pow": 12.0,
+    "min": 1.0, "max": 1.0, "mod": 6.0, "atan2": 12.0,
+    "neg": 1.0, "abs": 1.0, "sign": 1.0, "inv": 4.0, "sqrt": 4.0,
+    "cbrt": 8.0, "square": 1.0, "cube": 2.0, "exp": 8.0, "log": 8.0,
+    "log2": 8.0, "log10": 8.0, "log1p": 9.0, "sin": 8.0, "cos": 8.0,
+    "tan": 10.0, "sinh": 10.0, "cosh": 10.0, "tanh": 9.0, "asin": 10.0,
+    "acos": 10.0, "atan": 10.0, "round": 1.0, "floor": 1.0,
+    "ceil": 1.0, "relu": 1.0, "logistic": 9.0, "erf": 10.0,
+    "gamma": 16.0,
+}
+
+
+def _pallas_slot_flops(operators, dispatch: str) -> float:
+    """Modeled vector element-ops of ONE kernel slot-visit per row lane.
+
+    The branchless kernel computes EVERY candidate on each slot (leaf
+    mux + all unary + all binary + domain masks) and selects the
+    opcode's result — "mux" pays a log2-deep select tree, "chain" a
+    serial per-candidate select chain (same op count, longer critical
+    path; modeled with a small serialization surcharge so the ranking
+    prefers mux at equal measure, matching the on-chip A/B)."""
+    names = list(operators.unary_names) + list(operators.binary_names)
+    cand = 2.0  # leaf candidates: const splat + X gather-select
+    cand += sum(_OP_NAME_WEIGHTS.get(n, 2.0) for n in names)
+    n_ops = 3 + len(names)  # PAD/CONST/VAR + operators
+    if dispatch == "chain":
+        sel = float(n_ops) * 1.25
+    else:
+        sel = float(max(1, math.ceil(math.log2(n_ops))))
+    mask = 2.0  # validity + poison lockstep masks per slot
+    return cand + sel + mask
+
+
+def pallas_config_cost(
+    lengths, config: dict, nrows: int, nfeat: int, operators
+) -> dict:
+    """Modeled flops/bytes/padded-waste of ONE Pallas kernel
+    configuration over a concrete length histogram — pure host
+    arithmetic (no tracing), shared by the autotuner's pre-measurement
+    ranking (tune/tuner.py) and the bucketed-kernel baseline entries.
+
+    Mirrors the wrapper's actual geometry (ops/pallas_eval.py): trees
+    sort length-major, `ladder` splits at the SAME positional
+    boundaries the bucketed drivers use, each bucket re-clamps t_block
+    and pads to its own grid, and every tree_unroll interleave group
+    runs ceil(group_max/4) dynamic 4-slot steps — so bucketing models
+    its REAL effect (smaller tail-bucket tree padding, unchanged
+    slot work) rather than an assumed slot-truncation win. `fused`
+    drops the (T, nrows) value write-back for per-tree scalars."""
+    from ..models.fitness import _bucket_bounds
+    from ..ops.pallas_eval import _SLOT_UNROLL, _round_up
+
+    t_block = int(config.get("t_block", 256))
+    r_block = int(config.get("r_block", 1024))
+    dispatch = config.get("dispatch", "mux")
+    tree_unroll = int(config.get("tree_unroll", 8))
+    ladder = tuple(config.get("ladder", ()) or ())
+    fused = bool(config.get("fused", False))
+
+    lens = sorted(int(x) for x in lengths)
+    T = len(lens)
+    max_len = max(lens) if lens else 0
+    L = _round_up(max(max_len, 1), _SLOT_UNROLL)
+    r_block = min(r_block, _round_up(max(nrows, 1), 128))
+    R_pad = _round_up(nrows, r_block)
+
+    bounds = _bucket_bounds(T, ladder) if ladder else (0, T)
+    executed = 0  # slot-visits actually advanced by the group loops
+    grid_i = 0  # tree-block grid steps across all buckets
+    T_pad_total = 0
+    table_bytes = 0.0
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        if hi <= lo:
+            continue
+        b_lens = lens[lo:hi]
+        Tb = len(b_lens)
+        tb = min(t_block, _round_up(max(Tb, 8), tree_unroll))
+        T_pad = _round_up(Tb, tb)
+        b_lens = b_lens + [0] * (T_pad - Tb)
+        for g in range(0, T_pad, tree_unroll):
+            gmax = max(b_lens[g:g + tree_unroll])
+            steps = -(-gmax // _SLOT_UNROLL)  # ceil
+            executed += steps * _SLOT_UNROLL * tree_unroll
+        grid_i += T_pad // tb
+        T_pad_total += T_pad
+        # 4 i32 scalar tables + cval, (L, T_pad) each, refetched per
+        # tree block (SMEM-resident across the row-tile sweep)
+        table_bytes += 5 * L * T_pad * 4
+
+    useful = sum(lens)
+    slot_flops = _pallas_slot_flops(operators, dispatch)
+    flops = float(executed) * slot_flops * float(R_pad)
+    # X refetched once per (tree block, row tile) grid cell
+    bytes_moved = table_bytes + grid_i * nfeat * R_pad * 4.0
+    if fused:
+        bytes_moved += T_pad_total * 8.0  # per-tree loss + poison
+        bytes_moved += grid_i * R_pad * 4.0  # y target per tree block
+        flops += float(T_pad_total) * R_pad * 3.0  # elem + mask + sum
+    else:
+        bytes_moved += float(T_pad_total) * R_pad * 4.0  # value out
+    lane_exec = float(executed) * R_pad
+    lane_useful = float(useful) * nrows
+    return {
+        "flops": flops,
+        "bytes": bytes_moved,
+        "padded_waste_fraction": (
+            round(1.0 - lane_useful / lane_exec, 6) if lane_exec else 0.0
+        ),
+        "executed_slots": executed,
+        "useful_slots": useful,
+    }
+
+
+def rank_kernel_configs(
+    configs, lengths, nrows: int, nfeat: int, operators
+) -> List[Tuple[dict, dict]]:
+    """Model-ranked [(config, cost), ...], best first — the autotuner's
+    pre-measurement ordering so the measured sweep only runs the top
+    candidates. Score = modeled element-ops + 8x bytes (the byte weight
+    approximates the VPU-issue-to-HBM balance point of the tabled TPU
+    peaks in benchmark/roofline.py; at this granularity only the
+    ORDERING matters). Ties break on padded-waste fraction, then on the
+    config's sorted repr so the ranking is deterministic."""
+    scored = [
+        (pallas_config_cost(lengths, c, nrows, nfeat, operators), c)
+        for c in configs
+    ]
+    scored.sort(key=lambda sc: (
+        sc[0]["flops"] + 8.0 * sc[0]["bytes"],
+        sc[0]["padded_waste_fraction"],
+        sorted(sc[1].items(), key=lambda kv: kv[0]),
+    ))
+    return [(c, s) for s, c in scored]
+
+
+#: deterministic skewed length histogram for the bucketed-kernel
+#: baseline entries: a GP-shaped population (short programs dominate —
+#: the TensorGP waste regime) with NO RNG so the baseline is stable.
+_KERNEL_COST_LENGTHS = (5,) * 6554 + (9,) * 1229 + (19,) * 409
+_KERNEL_COST_NROWS = 2048
+_KERNEL_COST_NFEAT = 3
+_KERNEL_COST_LADDER = (0.25, 0.5, 0.75, 1.0)
+
+
+def pallas_kernel_cost_entries() -> Dict[str, dict]:
+    """Baseline entries for the Pallas kernel configurations (additive
+    alongside the compile_surface Options matrix): the flat default,
+    the bucket-laddered grid, and the bucketed+fused-epilogue kernel,
+    all modeled on one deterministic skewed histogram. Gated like every
+    other config so a cost-model or wrapper-geometry change that moves
+    the kernel's modeled work shows up in CI."""
+    from ..ops.operators import make_operator_set
+
+    ops = make_operator_set(["+", "-", "*", "/"], ["cos", "exp"])
+    base = {"t_block": 256, "r_block": 1024, "dispatch": "mux",
+            "tree_unroll": 8}
+    variants = {
+        "pallas_postfix_flat": {**base, "ladder": ()},
+        "pallas_postfix_bucketed": {**base,
+                                    "ladder": _KERNEL_COST_LADDER},
+        "pallas_postfix_fused": {**base, "ladder": _KERNEL_COST_LADDER,
+                                 "fused": True},
+    }
+    out: Dict[str, dict] = {}
+    for name, cfg in variants.items():
+        est = pallas_config_cost(
+            _KERNEL_COST_LENGTHS, cfg, _KERNEL_COST_NROWS,
+            _KERNEL_COST_NFEAT, ops,
+        )
+        out[name] = {
+            "flops": est["flops"],
+            "bytes": est["bytes"],
+            "padded_waste_fraction": est["padded_waste_fraction"],
+            "by_primitive": {},
+            "while_loops": 0,
+            "stages": {},
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
 # baseline gate
 # ---------------------------------------------------------------------------
 
@@ -436,6 +625,10 @@ def check_cost(
                 "padded_waste_fraction": s_est["padded_waste_fraction"],
             }
         out_configs[name] = entry
+    if configs is None:
+        # bucketed-kernel config entries ride alongside the Options
+        # matrix (additive: the Options-config entries are untouched)
+        out_configs.update(pallas_kernel_cost_entries())
 
     baseline_checked = baseline_match = False
     if update_baseline:

@@ -39,8 +39,44 @@ _PALLAS_MIN_WORK = _PALLAS_MIN_BATCH * 1024
 
 def _pallas_work_gate(n_trees: int, n_rows: int) -> bool:
     """True when an (n_trees x n_rows) eval is big enough that the Pallas
-    kernel's tile padding is amortized (see _PALLAS_MIN_WORK)."""
-    return n_trees * n_rows >= _PALLAS_MIN_WORK
+    kernel's tile padding is amortized. The static _PALLAS_MIN_WORK
+    calibration is the default; a persistent kernel-tune cache entry for
+    this device kind (tune/cache.py, written by kernel_tune.py
+    --autotune) replaces it with the MEASURED crossover — and with no
+    cache present `tuned_min_work()` is None, so routing is
+    byte-identical to the static rule."""
+    from ..tune.cache import tuned_min_work
+
+    min_work = tuned_min_work()
+    if min_work is None:
+        min_work = _PALLAS_MIN_WORK
+    return n_trees * n_rows >= min_work
+
+
+def _tuned_kernel_kwargs(operators, max_len: int, dtype_name: str) -> dict:
+    """eval_trees_pallas/eval_loss_trees_pallas keyword overrides from
+    the persistent tune cache for (this device kind, opset, maxsize,
+    dtype) — {} when no cache or no matching entry, so untuned dispatch
+    reproduces the static defaults exactly. All values are host-static
+    (they select a compiled kernel variant, like the defaults they
+    replace)."""
+    from ..tune.cache import lookup_kernel_config
+
+    cfg = lookup_kernel_config(operators, max_len, dtype_name)
+    if not cfg:
+        return {}
+    kw: dict = {}
+    if isinstance(cfg.get("t_block"), int):
+        kw["t_block"] = cfg["t_block"]
+    if isinstance(cfg.get("r_block"), int):
+        kw["r_block"] = cfg["r_block"]
+    if cfg.get("dispatch") in ("mux", "chain"):
+        kw["dispatch"] = cfg["dispatch"]
+    if isinstance(cfg.get("tree_unroll"), int):
+        kw["tree_unroll"] = cfg["tree_unroll"]
+    if cfg.get("ladder"):
+        kw["bucket_ladder"] = tuple(float(x) for x in cfg["ladder"])
+    return kw
 
 # Kernel program shape used when kernel_program="auto": the best measured
 # variant on hardware (benchmark/kernel_tune.py A/B history in BASELINE.md).
@@ -90,9 +126,14 @@ def dispatch_eval(
         )
         if resolved_program != "postfix":
             resolved_skip = False  # instr programs have no leaf slots
+        tuned = _tuned_kernel_kwargs(
+            operators, trees.kind.shape[-1], compute_dtype
+        )
+        if resolved_program != "postfix":
+            tuned.pop("bucket_ladder", None)  # postfix-only parameter
         y, ok = eval_trees_pallas(
             trees, X, operators, compute_dtype=compute_dtype,
-            program=resolved_program, leaf_skip=resolved_skip,
+            program=resolved_program, leaf_skip=resolved_skip, **tuned,
         )
         # downstream scoring expects the working dtype; the kernel
         # accumulates/returns f32 (bf16-compute, f32-accumulate)
@@ -253,8 +294,12 @@ def _make_eval_loss_fn(
     reduction on every jnp branch so row-sharded scoring is
     bit-identical to single-device scoring.
 
-    Dispatch decision tree (docs/eval_pipeline.md): batches that route to
-    the Pallas kernel keep the flat composition (the kernel already
+    Dispatch decision tree (docs/eval_pipeline.md): batches that route
+    to the Pallas kernel take the KERNEL-FUSED epilogue when the fused
+    seam's restrictions hold (unweighted, float32, postfix program —
+    the loss reduction + containment runs inside the kernel via
+    eval_loss_trees_pallas, honoring `bucket_ladder` and any tuned
+    kernel config), else the flat composition (the kernel already
     prices trees by length — ops/pallas_eval.py design note 3b); jnp
     batches take the length-bucketed graph when `bucket_ladder` is
     non-empty (bit-identical), else the row-tiled fused reduction when
@@ -280,6 +325,27 @@ def _make_eval_loss_fn(
                     trees, X, y, weights, operators, loss_fn,
                     rows_per_tile=rows_per_tile,
                     deterministic=deterministic,
+                )
+        else:
+            resolved_program = (
+                _DEFAULT_PROGRAM if program == "auto" else program
+            )
+            if (weights is None and resolved_program == "postfix"
+                    and X.dtype == jnp.float32):
+                # kernel-fused loss epilogue: the (B, nrows) prediction
+                # matrix never reaches HBM. Weighted / bf16 / instr
+                # batches fall through to the unfused composition below
+                # (the PR 12 rules: deterministic never routes here at
+                # all — _routes_to_pallas gates it above).
+                from ..ops.pallas_eval import eval_loss_trees_pallas
+
+                tuned = _tuned_kernel_kwargs(
+                    operators, trees.kind.shape[-1], "float32"
+                )
+                tuned.setdefault("bucket_ladder", bucket_ladder)
+                return eval_loss_trees_pallas(
+                    trees, X, y, operators, loss_fn,
+                    presorted=length_sorted, **tuned,
                 )
         y_pred, ok = dispatch_eval(trees, X, operators, backend, program,
                                    leaf_skip)

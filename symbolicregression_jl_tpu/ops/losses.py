@@ -258,11 +258,45 @@ def pairwise_sum(x: Array, axis: int = -1) -> Array:
     return x[..., 0]
 
 
+def _tiled_row_sum(elem: Array, tile_rows: int) -> Array:
+    """Fixed-order tiled row sum along the LAST axis: zero-pad to a
+    multiple of ``tile_rows``, view the padded axis as (tile, sublane,
+    lane) = (n_tiles, tile_rows//128, 128) blocks, ``jnp.sum`` each block,
+    and left-fold the per-tile partials sequentially.
+
+    This is, op for op, the reduction order of the Pallas fused-loss
+    epilogue (ops/pallas_eval.eval_loss_trees_pallas): the kernel sums
+    each (r_sub, 128) elem tile with one ``jnp.sum`` and accumulates
+    across the row-tile grid sweep with ``accum_tile``'s sequential
+    adds. Zero padding is exact (x + 0), a batched block ``jnp.sum``
+    produces the same bits as the kernel's per-tile unbatched one (same
+    reduce extent; the batch axis cannot reassociate it), and the fold
+    here is the same chain of scalar adds — so kernel and host graph
+    agree bit for bit by construction, not by tolerance."""
+    n = elem.shape[-1]
+    padded = _round_up_rows(n, tile_rows)
+    if padded != n:
+        pad = [(0, 0)] * (elem.ndim - 1) + [(0, padded - n)]
+        elem = jnp.pad(elem, pad)
+    r_sub = tile_rows // 128
+    tiles = elem.reshape(elem.shape[:-1] + (padded // tile_rows, r_sub, 128))
+    partials = jnp.sum(tiles, axis=(-2, -1))  # (..., n_tiles)
+    acc = partials[..., 0]
+    for t in range(1, partials.shape[-1]):
+        acc = acc + partials[..., t]
+    return acc
+
+
+def _round_up_rows(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
 def aggregate_loss(
     elem: Array,
     weights: Optional[Array] = None,
     axis=-1,
     deterministic: bool = False,
+    tile_rows: int = 0,
 ) -> Array:
     """Mean / weighted-mean aggregation (reference: src/LossFunctions.jl:11-31).
 
@@ -272,7 +306,30 @@ def aggregate_loss(
     ``row_shards>1`` bit-identity contract — see pairwise_sum). The two
     modes are numerically different reduction orders, so the flag is
     part of the compiled graph (derived from ``Options.row_shards`` in
-    models/fitness.py, which is in ``_graph_key``)."""
+    models/fitness.py, which is in ``_graph_key``).
+
+    ``tile_rows > 0`` (unweighted, non-deterministic, ``axis=-1`` only)
+    selects the fixed-order TILED mean ``_tiled_row_sum(elem) / n`` —
+    the host-graph twin of the Pallas fused-loss epilogue's in-kernel
+    reduction at ``r_block = tile_rows``. Like ``deterministic``, it is
+    a pinned reduction order: the fused kernel's per-tree loss is
+    bit-identical to ``aggregate_loss(elem, tile_rows=r_block)`` on the
+    same elem bits (docs/eval_pipeline.md exactness table), while the
+    untiled ``jnp.mean`` default differs by reassociation ULPs."""
+    if tile_rows:
+        if weights is not None or deterministic or axis != -1:
+            raise ValueError(
+                "tile_rows applies to the unweighted non-deterministic "
+                "axis=-1 aggregation only (the Pallas fused epilogue's "
+                "contract); weighted/deterministic paths never fuse"
+            )
+        if tile_rows < 128 or tile_rows % 128:
+            raise ValueError(
+                f"tile_rows must be a positive multiple of 128, got "
+                f"{tile_rows}"
+            )
+        n = jnp.asarray(elem.shape[-1], elem.dtype)
+        return _tiled_row_sum(elem, tile_rows) / n
     if deterministic:
         if weights is None:
             n = jnp.asarray(

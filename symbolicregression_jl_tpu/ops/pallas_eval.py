@@ -60,6 +60,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.trees import BIN, CONST, PAD, UNA, VAR, TreeBatch
+from .losses import contain_nonfinite
 from .operators import OperatorSet, isfinite_
 
 Array = jax.Array
@@ -400,7 +401,21 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                  tree_unroll: int, compute_dtype=jnp.float32,
                  leaf_skip: "bool | str" = False,
                  scalar_pack: bool = False,
-                 top_carry: bool = False):
+                 top_carry: bool = False,
+                 fused_loss=None):
+    """fused_loss (elementwise (pred, target) -> elem, or None): when set,
+    the kernel fuses the loss epilogue — instead of writing each tree's
+    root-value row tile to a (T_pad, NR, 128) output, it computes
+    ``elem = fused_loss(root, y_tile)`` in-register, zeroes padded rows,
+    reduces the tile with one ``jnp.sum``, and accumulates the per-tree
+    scalar across the row-tile sweep exactly like the poison output
+    (``accum_tile``; the loss-sum block's index map ignores j). The call
+    then never materializes a ``(B, nrows)`` array on either side of the
+    kernel boundary. The reduction order — per-tile ``jnp.sum``, then a
+    sequential fold over row tiles — is the order
+    ``ops.losses.aggregate_loss(tile_rows=r_block)`` pins on the host
+    graph, which is what makes the fused epilogue bit-identical to that
+    composition rather than merely close to ``jnp.mean``."""
     from jax.experimental import pallas as pl  # noqa: PLC0415
 
     if slot_loop not in ("dynamic", "unrolled"):
@@ -433,19 +448,26 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
         def kernel(nrows_ref, *rest):
             tbl_refs = rest[:n_tbl_refs]
             length_ref, cval_ref = rest[n_tbl_refs:n_tbl_refs + 2]
-            X_ref, out_ref, bad_ref = rest[n_tbl_refs + 2:n_tbl_refs + 5]
-            val_refs = rest[n_tbl_refs + 5:]
+            if fused_loss is None:
+                X_ref, out_ref, bad_ref = rest[n_tbl_refs + 2:n_tbl_refs + 5]
+                ytgt_ref = None
+                val_refs = rest[n_tbl_refs + 5:]
+            else:
+                X_ref, ytgt_ref, out_ref, bad_ref = (
+                    rest[n_tbl_refs + 2:n_tbl_refs + 6]
+                )
+                val_refs = rest[n_tbl_refs + 6:]
             fetch = fetch_of_refs(tbl_refs)
             pid_j, valid_f = kernel_row_validity(nrows_ref, r_sub)
             run_postfix_body(
-                fetch, length_ref, cval_ref, X_ref, out_ref, bad_ref,
-                val_refs, pid_j, valid_f,
+                fetch, length_ref, cval_ref, X_ref, ytgt_ref, out_ref,
+                bad_ref, val_refs, pid_j, valid_f,
             )
 
         return kernel
 
-    def run_postfix_body(fetch, length_ref, cval_ref, X_ref, out_ref,
-                         bad_ref, val_refs, pid_j, valid_f):
+    def run_postfix_body(fetch, length_ref, cval_ref, X_ref, ytgt_ref,
+                         out_ref, bad_ref, val_refs, pid_j, valid_f):
         def slot_body(si, ti, bad, val_ref, v_prev):
             """One postfix slot: branchless dispatch over the operator set.
 
@@ -627,10 +649,25 @@ def _make_kernel(operators: OperatorSet, t_block: int, r_block: int,
                             si, tis[t], bads[t], val_refs[t], vprevs[t]
                         )
             for t in range(tree_unroll):
-                # output/accumulation stays float32 regardless of cdt
-                out_ref[tis[t]] = val_refs[t][
-                    jnp.maximum(ns[t] - 1, 0)
-                ].astype(jnp.float32)
+                if fused_loss is None:
+                    # output/accumulation stays float32 regardless of cdt
+                    out_ref[tis[t]] = val_refs[t][
+                        jnp.maximum(ns[t] - 1, 0)
+                    ].astype(jnp.float32)
+                else:
+                    # fused epilogue: elem on the root's row tile, padded
+                    # rows zeroed (a `where`, not a multiply: 0 * inf is
+                    # NaN and the pad region of y/X is garbage), one
+                    # per-tile jnp.sum, accum_tile across the j sweep —
+                    # the exact order aggregate_loss(tile_rows=r_block)
+                    # replays on the host graph
+                    root = val_refs[t][
+                        jnp.maximum(ns[t] - 1, 0)
+                    ].astype(jnp.float32)
+                    elem = jnp.where(
+                        valid_f > 0, fused_loss(root, ytgt_ref[...]), 0.0
+                    )
+                    accum_tile(out_ref, (0, tis[t]), pid_j, jnp.sum(elem))
                 accum_tile(bad_ref, (0, tis[t]), pid_j, jnp.sum(bads[t]))
             return 0
 
@@ -864,6 +901,133 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+def _ladder_bounds(n: int, ladder: Tuple[float, ...]):
+    """Host-static (lo, hi) bucket slices of a length-sorted batch of n
+    trees under a cumulative-fraction ladder — THE positional boundary
+    definition is models.fitness._bucket_bounds (shared with the jnp
+    interpreter's bucketed driver so both backends split one sorted
+    order at identical positions). Empty slices are dropped; an empty
+    ladder is the single flat bucket."""
+    if not ladder:
+        return [(0, n)] if n else []
+    from ..models.fitness import _bucket_bounds  # noqa: PLC0415
+
+    bounds = _bucket_bounds(n, ladder)
+    return [(lo, hi) for lo, hi in zip(bounds[:-1], bounds[1:]) if hi > lo]
+
+
+def _postfix_call(flat_b: TreeBatch, Xp: Array, ytgt, nrows_arr: Array,
+                  operators: OperatorSet, L: int, t_block: int,
+                  r_block: int, interpret: bool, slot_loop: str,
+                  dispatch: str, tree_unroll: int, cdt, leaf_skip,
+                  scalar_pack: bool, top_carry: bool, NR: int,
+                  nfeat: int, fused_loss=None):
+    """One postfix pallas_call over a contiguous slice of the (sorted)
+    flat batch — the per-bucket unit of the length-bucket ladder. The
+    tree-block size re-clamps to THIS slice, so a small tail bucket runs
+    a small grid instead of inheriting the full batch's t_block padding.
+
+    Returns (y (Tb, R_pad) float32, bad (Tb,)) in value mode, or
+    (loss_sum (Tb,), bad (Tb,)) when fused_loss is set (ytgt = the
+    (NR, 128)-tiled f32 target; see _make_kernel's fused_loss note)."""
+    from jax.experimental import pallas as pl  # noqa: PLC0415
+    from jax.experimental.pallas import tpu as pltpu  # noqa: PLC0415
+
+    T = flat_b.length.shape[0]
+    r_sub = r_block // 128
+    t_block = min(t_block, _round_up(max(T, 8), tree_unroll))
+    T_pad = _round_up(T, t_block)
+
+    # tables transposed to (L, T_pad) — see module docstring point 4
+    def padT(x, fill=0):
+        return jnp.pad(x, ((0, T_pad - T), (0, 0)),
+                       constant_values=fill).T
+
+    pcode = padT(fuse_opcodes(flat_b, operators))
+    feat = padT(flat_b.feat)
+    lidx, ridx = operand_schedule(flat_b.kind)
+    lidx, ridx = padT(lidx), padT(ridx)
+    length = jnp.pad(flat_b.length, (0, T_pad - T))[None, :]
+    cval = padT(flat_b.cval.astype(jnp.float32))
+
+    kernel = _make_kernel(operators, t_block, r_block, L, slot_loop,
+                          dispatch, tree_unroll, cdt, leaf_skip=leaf_skip,
+                          scalar_pack=scalar_pack, top_carry=top_carry,
+                          fused_loss=fused_loss)
+
+    # INVARIANT (accum_tile soundness): the row-tile index j MUST stay the
+    # trailing, sequentially-iterated grid dimension, and the scalar
+    # outputs' index maps must ignore j so their blocks stay resident
+    # across the j sweep (tile 0 initializes, later tiles accumulate).
+    # Reordering this grid or marking j parallel via dimension_semantics
+    # would silently corrupt poison/loss outputs.
+    grid = (T_pad // t_block, NR // r_sub)
+    smem_spec = lambda shape, imap: pl.BlockSpec(
+        shape, imap, memory_space=pltpu.SMEM
+    )
+    tree_tbl = lambda: smem_spec((L, t_block), lambda i, j: (0, i))
+    if scalar_pack:
+        n_codes = 3 + operators.n_unary + operators.n_binary
+        tbl_args = (
+            pack_postfix_scalars(pcode, feat, lidx, ridx, n_codes,
+                                 nfeat, L),
+        )
+    else:
+        tbl_args = (pcode, feat, lidx, ridx)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),  # nrows scalar
+        *[tree_tbl() for _ in tbl_args],  # scalar table(s)
+        smem_spec((1, t_block), lambda i, j: (0, i)),  # length
+        tree_tbl(),  # cval
+        pl.BlockSpec((nfeat, r_sub, 128), lambda i, j: (0, j, 0)),
+    ]
+    args = [nrows_arr, *tbl_args, length, cval, Xp]
+    # the poison row (and the fused loss-sum row) is accumulated across
+    # row tiles inside the kernel (the index map ignores j, so the block
+    # stays resident for the whole j sweep). A per-tile (1, t_block)
+    # block over a (grid_j, T_pad) array would be an ILLEGAL Mosaic
+    # block shape for grid_j > 1 (sublane dim must be a multiple of 8 or
+    # equal the array's), and a (grid_j, t_block) resident block would
+    # grow SMEM linearly with the row-tile count.
+    if fused_loss is not None:
+        in_specs.append(
+            pl.BlockSpec((r_sub, 128), lambda i, j: (j, 0))  # y target
+        )
+        args.append(ytgt)
+        out_specs = [
+            smem_spec((1, t_block), lambda i, j: (0, i)),  # loss sum
+            smem_spec((1, t_block), lambda i, j: (0, i)),  # poison
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((1, T_pad), jnp.float32),
+            jax.ShapeDtypeStruct((1, T_pad), jnp.float32),
+        ]
+    else:
+        out_specs = [
+            pl.BlockSpec((t_block, r_sub, 128), lambda i, j: (i, j, 0)),
+            smem_spec((1, t_block), lambda i, j: (0, i)),  # poison
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((T_pad, NR, 128), jnp.float32),
+            jax.ShapeDtypeStruct((1, T_pad), jnp.float32),
+        ]
+    out, bad = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((L, r_sub, 128), cdt)
+            for _ in range(tree_unroll)
+        ],
+        interpret=interpret,
+    )(*args)
+    if fused_loss is not None:
+        return out[0, :T], bad[0, :T]
+    return out.reshape(T_pad, NR * 128)[:T], bad[0, :T]
+
+
 def _check_r_block(r_block: int, nrows: int, interpret: bool):
     """Mosaic blocks over the row-tile axis must have a sublane count that
     is a multiple of 8 or covers the whole axis, and the row padding math
@@ -890,7 +1054,7 @@ def _check_r_block(r_block: int, nrows: int, interpret: bool):
     static_argnames=("operators", "t_block", "r_block", "interpret",
                      "slot_loop", "dispatch", "tree_unroll", "sort_trees",
                      "compute_dtype", "program", "leaf_skip",
-                     "scalar_pack", "top_carry"),
+                     "scalar_pack", "top_carry", "bucket_ladder"),
 )
 def eval_trees_pallas(
     trees: TreeBatch,
@@ -908,6 +1072,7 @@ def eval_trees_pallas(
     leaf_skip: "bool | str" = False,
     scalar_pack: bool = False,
     top_carry: bool = False,
+    bucket_ladder: Tuple[float, ...] = (),
 ) -> Tuple[Array, Array]:
     """Evaluate a flat batch of trees over X (nfeat, nrows).
 
@@ -965,10 +1130,19 @@ def eval_trees_pallas(
     one scalar table read per step and takes a scratch write->read
     round-trip off the tree's serial dependence chain — the latency
     chain that tree-interleaving exists to hide. Composable with
-    scalar_pack and leaf_skip."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+    scalar_pack and leaf_skip.
 
+    bucket_ladder (postfix only) is the PR-4 length-bucket ladder ported
+    to the kernel: the length-sorted batch is split at host-static
+    positional boundaries (models.fitness._bucket_bounds — THE same
+    boundary definition the jnp interpreter's bucketed driver uses, so
+    both backends share one sorted order) and each bucket runs its own
+    pallas_call whose slot axis and tree-block padding are clamped to
+    that bucket. Bit-identity with the flat call is structural: per-tree
+    results depend only on the tree's own tables/scratch (see the
+    cache/dedup note above), and slots beyond a bucket's max length are
+    PAD identities that a smaller L simply never executes. () = one
+    flat bucket (today's behavior)."""
     if program not in ("postfix", "instr", "instr_packed"):
         raise ValueError(
             "program must be 'postfix', 'instr' or 'instr_packed', "
@@ -992,6 +1166,11 @@ def eval_trees_pallas(
         raise ValueError(
             "top_carry applies to the postfix program only (the instr "
             "program's operands are not stack-adjacent)"
+        )
+    if bucket_ladder and program != "postfix":
+        raise ValueError(
+            "bucket_ladder applies to the postfix program only (the "
+            "instr programs have no per-bucket slot loop to truncate)"
         )
     batch_shape = trees.length.shape
     flat = jax.tree_util.tree_map(
@@ -1030,88 +1209,35 @@ def eval_trees_pallas(
     T = flat.length.shape[0]
     nfeat, nrows = X.shape
 
-    t_block = min(t_block, _round_up(max(T, 8), tree_unroll))
     r_block = min(r_block, _round_up(nrows, 128))
     _check_r_block(r_block, nrows, interpret)
-    r_sub = r_block // 128
-    T_pad = _round_up(T, t_block)
     R_pad = _round_up(nrows, r_block)
     NR = R_pad // 128  # row tiles of 128 lanes
 
-    # tables transposed to (L, T_pad) — see module docstring point 4
-    def padT(x, fill=0):
-        return jnp.pad(x, ((0, T_pad - T), (0, 0)),
-                       constant_values=fill).T
-
-    pcode = padT(fuse_opcodes(flat, operators))
-    feat = padT(flat.feat)
-    lidx, ridx = operand_schedule(flat.kind)
-    lidx, ridx = padT(lidx), padT(ridx)
-    length = jnp.pad(flat.length, (0, T_pad - T))[None, :]
     cdt = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[compute_dtype]
-    cval = padT(flat.cval.astype(jnp.float32))
     # rows folded to (..., NR, 128) tiles — see module docstring point 3
     Xp = jnp.pad(X.astype(cdt), ((0, 0), (0, R_pad - nrows)))
     Xp = Xp.reshape(nfeat, NR, 128)
     nrows_arr = jnp.asarray([nrows], jnp.int32)
 
-    kernel = _make_kernel(operators, t_block, r_block, L, slot_loop,
-                          dispatch, tree_unroll, cdt, leaf_skip=leaf_skip,
-                          scalar_pack=scalar_pack, top_carry=top_carry)
-
-    # INVARIANT (accum_tile soundness): the row-tile index j MUST stay the
-    # trailing, sequentially-iterated grid dimension, and the scalar
-    # outputs' index maps must ignore j so their blocks stay resident
-    # across the j sweep (tile 0 initializes, later tiles accumulate).
-    # Reordering this grid or marking j parallel via dimension_semantics
-    # would silently corrupt poison/loss outputs.
-    grid = (T_pad // t_block, NR // r_sub)
-    smem_spec = lambda shape, imap: pl.BlockSpec(
-        shape, imap, memory_space=pltpu.SMEM
-    )
-    tree_tbl = lambda: smem_spec((L, t_block), lambda i, j: (0, i))
-    if scalar_pack:
-        n_codes = 3 + operators.n_unary + operators.n_binary
-        tbl_args = (
-            pack_postfix_scalars(pcode, feat, lidx, ridx, n_codes,
-                                 nfeat, L),
+    outs = []
+    bads = []
+    for lo, hi in _ladder_bounds(T, bucket_ladder):
+        y_b, bad_b = _postfix_call(
+            flat[lo:hi], Xp, None, nrows_arr, operators, L, t_block,
+            r_block, interpret, slot_loop, dispatch, tree_unroll, cdt,
+            leaf_skip, scalar_pack, top_carry, NR, nfeat,
         )
+        outs.append(y_b)
+        bads.append(bad_b)
+    if not outs:
+        y = jnp.zeros((0, nrows), jnp.float32)
+        ok = jnp.zeros((0,), bool)
     else:
-        tbl_args = (pcode, feat, lidx, ridx)
-    y, bad = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),  # nrows scalar
-            *[tree_tbl() for _ in tbl_args],  # scalar table(s)
-            smem_spec((1, t_block), lambda i, j: (0, i)),  # length
-            tree_tbl(),  # cval
-            pl.BlockSpec((nfeat, r_sub, 128), lambda i, j: (0, j, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((t_block, r_sub, 128), lambda i, j: (i, j, 0)),
-            # single poison row, accumulated across row tiles inside the
-            # kernel (the index map ignores j, so the block stays resident
-            # for the whole j sweep). A per-tile (1, t_block) block over a
-            # (grid_j, T_pad) array would be an ILLEGAL Mosaic block shape
-            # for grid_j > 1 (sublane dim must be a multiple of 8 or equal
-            # the array's), and a (grid_j, t_block) resident block would
-            # grow SMEM linearly with the row-tile count.
-            smem_spec((1, t_block), lambda i, j: (0, i)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((T_pad, NR, 128), jnp.float32),
-            jax.ShapeDtypeStruct((1, T_pad), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((L, r_sub, 128), cdt)
-            for _ in range(tree_unroll)
-        ],
-        interpret=interpret,
-    )(nrows_arr, *tbl_args, length, cval, Xp)
-
-    y = y.reshape(T_pad, R_pad)[:T, :nrows]
-    ok = (bad[0, :T] == 0) & (flat.length > 0)
+        y = outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+        bad = bads[0] if len(bads) == 1 else jnp.concatenate(bads)
+        y = y[:, :nrows]
+        ok = (bad == 0) & (flat.length > 0)
     if inv_perm is not None:
         y = y[inv_perm]
         ok = ok[inv_perm]
@@ -1119,6 +1245,132 @@ def eval_trees_pallas(
         y.reshape(batch_shape + (nrows,)),
         ok.reshape(batch_shape),
     )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("operators", "loss_fn", "t_block", "r_block",
+                     "interpret", "slot_loop", "dispatch", "tree_unroll",
+                     "sort_trees", "presorted", "leaf_skip",
+                     "scalar_pack", "top_carry", "bucket_ladder"),
+)
+def eval_loss_trees_pallas(
+    trees: TreeBatch,
+    X: Array,
+    y: Array,
+    operators: OperatorSet,
+    loss_fn,
+    t_block: int = DEFAULT_T_BLOCK,
+    r_block: int = DEFAULT_R_BLOCK,
+    interpret: bool = False,
+    slot_loop: str = "dynamic",
+    dispatch: str = "mux",
+    tree_unroll: int = 8,
+    sort_trees: bool = True,
+    presorted: bool = False,
+    leaf_skip: "bool | str" = False,
+    scalar_pack: bool = False,
+    top_carry: bool = False,
+    bucket_ladder: Tuple[float, ...] = (),
+) -> Array:
+    """Fused per-tree aggregated loss through the Pallas kernel — the
+    kernel-side analog of the interpreter's `eval_loss_trees_fused`.
+
+    The loss epilogue runs inside the kernel via the `accum_tile` scalar
+    accumulator (`_make_kernel(fused_loss=...)`): each grid cell reduces
+    its (r_sub, 128) elementwise-loss tile with `jnp.sum` and folds the
+    partial into a per-tree SMEM scalar across the sequential row-tile
+    sweep, so the `(B, nrows)` prediction matrix is NEVER materialized
+    in HBM. The host side only divides by nrows and applies
+    `contain_nonfinite` — bit-identical BY CONSTRUCTION to the host
+    composition `contain_nonfinite(aggregate_loss(loss_fn(y_pred, y),
+    tile_rows=r_block), ok)`: `aggregate_loss(tile_rows=...)` performs
+    the identical pad → per-(r_sub, 128)-tile `jnp.sum` → sequential
+    fold → divide sequence on the host graph (see ops/losses.py). The
+    untiled `jnp.mean` composition differs from this by reduction order
+    only (documented ULP-level difference — docs/eval_pipeline.md
+    exactness table).
+
+    Fused-seam restrictions (callers fall back to the unfused
+    composition outside them, per the PR 12 determinism rules):
+    float32 X/y only, unweighted, non-deterministic reduction order
+    (`row_shards > 1` never routes to Pallas), postfix program only.
+
+    loss_fn is a static elementwise callable (y_pred, y_target) -> loss,
+    traced INTO the kernel per (tree, row-tile). Padded rows contribute
+    exactly 0.0 via a `where` on the row mask (multiplying by the mask
+    would turn inf·0 into NaN), matching the host graph's zero-padding.
+
+    presorted=True asserts `trees` is already length-major (the dedup
+    path's contract) and skips the sort; `bucket_ladder` as in
+    `eval_trees_pallas`. Returns loss with `trees`' batch shape:
+    finite per-tree mean loss, or +inf where the tree is empty/PAD or
+    produced any nonfinite row (same containment as the interpreter
+    path).
+    """
+    if X.dtype != jnp.float32 or y.dtype != jnp.float32:
+        raise ValueError(
+            "eval_loss_trees_pallas is float32-only (the fused epilogue "
+            f"accumulates f32 loss sums); got X {X.dtype}, y {y.dtype}"
+        )
+    batch_shape = trees.length.shape
+    flat = jax.tree_util.tree_map(
+        lambda x: x.reshape((-1,) + x.shape[len(batch_shape):]), trees
+    )
+    inv_perm = None
+    if sort_trees and not presorted and flat.length.shape[0] > 1:
+        perm = jnp.argsort(flat.length)
+        inv_perm = jnp.zeros_like(perm).at[perm].set(
+            jnp.arange(perm.shape[0], dtype=perm.dtype)
+        )
+        flat = jax.tree_util.tree_map(lambda x: x[perm], flat)
+    L = _round_up(trees.max_len, _SLOT_UNROLL)
+    if L != trees.max_len:
+        dl = L - trees.max_len
+        flat = TreeBatch(
+            kind=jnp.pad(flat.kind, ((0, 0), (0, dl))),
+            op=jnp.pad(flat.op, ((0, 0), (0, dl))),
+            feat=jnp.pad(flat.feat, ((0, 0), (0, dl))),
+            cval=jnp.pad(flat.cval, ((0, 0), (0, dl))),
+            length=flat.length,
+        )
+    T = flat.length.shape[0]
+    nfeat, nrows = X.shape
+
+    r_block = min(r_block, _round_up(nrows, 128))
+    _check_r_block(r_block, nrows, interpret)
+    R_pad = _round_up(nrows, r_block)
+    NR = R_pad // 128
+
+    Xp = jnp.pad(X, ((0, 0), (0, R_pad - nrows)))
+    Xp = Xp.reshape(nfeat, NR, 128)
+    # target rows tiled exactly like X rows; padded targets are dead
+    # lanes (the kernel's row mask zeroes their loss contribution)
+    yp = jnp.pad(y, (0, R_pad - nrows)).reshape(NR, 128)
+    nrows_arr = jnp.asarray([nrows], jnp.int32)
+
+    nums = []
+    bads = []
+    for lo, hi in _ladder_bounds(T, bucket_ladder):
+        num_b, bad_b = _postfix_call(
+            flat[lo:hi], Xp, yp, nrows_arr, operators, L, t_block,
+            r_block, interpret, slot_loop, dispatch, tree_unroll,
+            jnp.float32, leaf_skip, scalar_pack, top_carry, NR, nfeat,
+            fused_loss=loss_fn,
+        )
+        nums.append(num_b)
+        bads.append(bad_b)
+    if not nums:
+        loss = jnp.zeros((0,), jnp.float32)
+    else:
+        num = nums[0] if len(nums) == 1 else jnp.concatenate(nums)
+        bad = bads[0] if len(bads) == 1 else jnp.concatenate(bads)
+        ok = (bad == 0) & (flat.length > 0)
+        loss = num / jnp.asarray(nrows, jnp.float32)
+        loss = contain_nonfinite(loss, ok)
+    if inv_perm is not None:
+        loss = loss[inv_perm]
+    return loss.reshape(batch_shape)
 
 
 def prep_instr_tables(flat, operators, sort_trees):

@@ -1,0 +1,47 @@
+"""srtune — persistent per-device-kind kernel autotuner.
+
+The Pallas kernel's free parameters (t_block, r_block, dispatch,
+tree_unroll, bucket ladder) and the `auto` router's work-volume
+crossover were compile-time constants tuned by hand from kernel_tune.py
+sweeps. This package makes them data: `cache.py` holds a
+schema-versioned on-disk cache keyed by (device_kind, opset
+fingerprint, maxsize, dtype), `tuner.py` ranks candidate configurations
+with the srcost analytic model (analysis/cost.py) BEFORE measuring so a
+sweep only times the top few, and `models/fitness.py` consults the
+cache from the `auto` router — with every static default preserved
+bit-for-bit when no cache exists. See docs/kernel_tuning.md.
+"""
+
+from .cache import (
+    SCHEMA_VERSION,
+    current_device_kind,
+    default_cache_path,
+    entry_key,
+    load_tune_cache,
+    lookup_kernel_config,
+    opset_fingerprint,
+    reset_tune_cache_memo,
+    save_tune_cache,
+    tuned_min_work,
+    update_tune_cache,
+    validate_tune_cache,
+)
+from .tuner import candidate_grid, model_ranked_sweep, sweep_to_cache
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "candidate_grid",
+    "current_device_kind",
+    "default_cache_path",
+    "entry_key",
+    "load_tune_cache",
+    "lookup_kernel_config",
+    "model_ranked_sweep",
+    "opset_fingerprint",
+    "reset_tune_cache_memo",
+    "save_tune_cache",
+    "sweep_to_cache",
+    "tuned_min_work",
+    "update_tune_cache",
+    "validate_tune_cache",
+]

@@ -1,0 +1,373 @@
+"""Persistent kernel-tune cache: schema, load/save, and router lookups.
+
+One JSON file maps device kinds to tuned kernel configurations and the
+`auto` router's work-volume crossover:
+
+    {
+      "schema_version": 1,
+      "device_kinds": {
+        "TPU v5e": {
+          "interpret": false,
+          "min_work": 524288,
+          "entries": {
+            "bin:+,-,*,/|una:cos,exp|L24|float32": {
+              "config": {"t_block": 256, "r_block": 1024,
+                         "dispatch": "mux", "tree_unroll": 8,
+                         "ladder": [0.25, 0.5, 0.75, 1.0]},
+              "trees_rows_per_s": 1.01e9,
+              "source": "kernel_tune"
+            }
+          }
+        }
+      }
+    }
+
+Contracts (enforced by `validate_tune_cache`, gated by scripts/lint.py
+on any checked-in cache, and unit-tested in tests/test_ah_tune.py):
+
+- **Robust load.** A missing, corrupt, truncated, or wrong-schema file
+  NEVER crashes the router: `load_tune_cache` warns once and returns
+  None, and every lookup then falls back to the static defaults — so
+  routing without a cache is byte-identical to routing before this
+  module existed.
+- **Per-device-kind isolation.** Lookups key on the CURRENT process's
+  device kind (`current_device_kind`, which honors an active
+  `jax.default_device(...)` context exactly like
+  `ops.pallas_eval.pallas_available`). A cache written on one device
+  kind never leaks configs to another.
+- **Interpret-mode quarantine.** Entries measured under Pallas
+  interpret mode (the CPU fallback sweep) are stored under the CPU
+  device kind with ``interpret: true`` and MUST NOT appear under a TPU
+  device kind — interpret timings say nothing about Mosaic schedules,
+  and the validator rejects any cache that merges them.
+- **Sorted-key writer.** `save_tune_cache` goes through the shared
+  `analysis.report.write_baseline_json` writer, so cache refreshes
+  diff like every other checked-in baseline.
+
+`SRTPU_TUNE_CACHE` overrides the on-disk location (tests point it at
+tmp paths; fleets can share one tuned cache over NFS).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+from typing import Dict, List, Optional, Tuple
+
+SCHEMA_VERSION = 1
+
+_ENV_VAR = "SRTPU_TUNE_CACHE"
+
+_DEFAULT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "tune_cache.json"
+)
+
+#: ladder fractions must ascend and end at 1.0 (the Options validation
+#: rule, re-checked here because the cache bypasses Options).
+_DISPATCHES = ("mux", "chain")
+_TREE_UNROLLS = (1, 2, 4, 8, 16)
+
+_CONFIG_KEYS = ("t_block", "r_block", "dispatch", "tree_unroll",
+                "ladder")
+
+# (path, mtime) -> parsed cache; reset via reset_tune_cache_memo()
+_MEMO: Dict[Tuple[str, float], Optional[dict]] = {}
+
+
+def default_cache_path() -> str:
+    """Resolved cache location: $SRTPU_TUNE_CACHE or the in-package
+    tune_cache.json (the checked-in location the lint gate watches)."""
+    return os.environ.get(_ENV_VAR) or _DEFAULT_PATH
+
+
+def current_device_kind() -> str:
+    """The device kind lookups key on, honoring an active
+    `jax.default_device(...)` context like `pallas_available` does (a
+    CPU-anchor bench on a TPU host must consult CPU entries, if any,
+    not the chip's)."""
+    import jax
+
+    try:
+        dd = jax.config.jax_default_device
+        if dd is not None:
+            return str(getattr(dd, "device_kind", dd.platform))
+        return str(jax.devices()[0].device_kind)
+    except Exception:  # pragma: no cover - no devices at all
+        return "cpu"
+
+
+def opset_fingerprint(operators) -> str:
+    """Order-sensitive operator-set key: opcode assignment follows
+    tuple order (ops/pallas_eval.fuse_opcodes), so ("+", "-") and
+    ("-", "+") are genuinely different kernels."""
+    return ("bin:" + ",".join(operators.binary_names)
+            + "|una:" + ",".join(operators.unary_names))
+
+
+def entry_key(opset_fp: str, maxsize: int, dtype: str) -> str:
+    """(opset fingerprint, maxsize, dtype) -> entry key. maxsize is the
+    tree buffer's slot capacity (Options.maxsize): it fixes the kernel's
+    L axis, which the tile geometry depends on."""
+    return f"{opset_fp}|L{int(maxsize)}|{dtype}"
+
+
+def load_tune_cache(path: Optional[str] = None) -> Optional[dict]:
+    """Parse the cache file, or None when absent/unusable.
+
+    Never raises on bad content: corrupt JSON, a truncated write, a
+    non-dict payload, or a schema-version mismatch each warn once and
+    return None (the router then uses the static defaults). Memoized on
+    (path, mtime) so per-dispatch lookups cost a stat, not a parse."""
+    path = path or default_cache_path()
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    memo_key = (path, mtime)
+    if memo_key in _MEMO:
+        return _MEMO[memo_key]
+    cache: Optional[dict] = None
+    try:
+        with open(path) as f:
+            parsed = json.load(f)
+        if not isinstance(parsed, dict):
+            warnings.warn(
+                f"kernel-tune cache {path} is not a JSON object — "
+                "ignoring it (static kernel defaults stay in effect)",
+                stacklevel=2,
+            )
+        elif parsed.get("schema_version") != SCHEMA_VERSION:
+            warnings.warn(
+                f"kernel-tune cache {path} has schema_version "
+                f"{parsed.get('schema_version')!r}, this build reads "
+                f"{SCHEMA_VERSION} — ignoring it (static kernel "
+                "defaults stay in effect; re-run kernel_tune.py "
+                "--autotune to regenerate)",
+                stacklevel=2,
+            )
+        else:
+            cache = parsed
+    except (json.JSONDecodeError, OSError, UnicodeDecodeError) as e:
+        warnings.warn(
+            f"kernel-tune cache {path} is unreadable ({e.__class__.__name__}: "
+            f"{e}) — ignoring it (static kernel defaults stay in effect)",
+            stacklevel=2,
+        )
+    _MEMO.clear()  # keep exactly the newest (path, mtime) resident
+    _MEMO[memo_key] = cache
+    return cache
+
+
+def reset_tune_cache_memo() -> None:
+    """Drop the (path, mtime) memo — tests that rewrite the cache file
+    within one mtime granule call this between lookups."""
+    _MEMO.clear()
+
+
+def save_tune_cache(cache: dict, path: Optional[str] = None) -> str:
+    """Write through the shared sorted-key baseline writer; refuses an
+    invalid payload (the cache is a checked-in artifact — never let a
+    writer produce a file the lint gate would then fail)."""
+    from ..analysis.report import write_baseline_json
+
+    problems = validate_tune_cache(cache)
+    if problems:
+        raise ValueError(
+            "refusing to write an invalid kernel-tune cache:\n  "
+            + "\n  ".join(problems)
+        )
+    path = path or default_cache_path()
+    write_baseline_json(path, cache)
+    reset_tune_cache_memo()
+    return path
+
+
+def update_tune_cache(
+    cache: Optional[dict],
+    device_kind: str,
+    interpret: bool,
+    key: str,
+    config: dict,
+    trees_rows_per_s: Optional[float] = None,
+    min_work: Optional[int] = None,
+    source: str = "kernel_tune",
+) -> dict:
+    """Merge one tuned entry (and optionally a min_work crossover) into
+    a cache dict, creating structure as needed. Refuses to mark a TPU
+    device kind's entries as interpret-mode — the CPU fallback sweep
+    must never masquerade as on-chip data."""
+    if interpret and "tpu" in device_kind.lower():
+        raise ValueError(
+            f"interpret-mode timings cannot be merged into TPU device "
+            f"kind {device_kind!r} (they measure the interpreter, not "
+            "Mosaic schedules)"
+        )
+    cache = dict(cache) if cache else {"schema_version": SCHEMA_VERSION,
+                                       "device_kinds": {}}
+    kinds = dict(cache.get("device_kinds", {}))
+    kind = dict(kinds.get(device_kind, {"entries": {}}))
+    if bool(kind.get("interpret", interpret)) != interpret:
+        raise ValueError(
+            f"device kind {device_kind!r} already holds "
+            f"interpret={kind.get('interpret')} entries — refusing to "
+            "mix measurement modes under one device kind"
+        )
+    kind["interpret"] = bool(interpret)
+    if min_work is not None:
+        kind["min_work"] = int(min_work)
+    entries = dict(kind.get("entries", {}))
+    entry = {"config": _normalize_config(config), "source": source}
+    if trees_rows_per_s is not None:
+        entry["trees_rows_per_s"] = float(trees_rows_per_s)
+    entries[key] = entry
+    kind["entries"] = entries
+    kinds[device_kind] = kind
+    cache["device_kinds"] = kinds
+    cache["schema_version"] = SCHEMA_VERSION
+    return cache
+
+
+def _normalize_config(config: dict) -> dict:
+    out = {k: config[k] for k in _CONFIG_KEYS if k in config}
+    if "ladder" in out:
+        out["ladder"] = [float(x) for x in out["ladder"]]
+    return out
+
+
+def lookup_kernel_config(
+    operators, maxsize: int, dtype: str,
+    device_kind: Optional[str] = None,
+    path: Optional[str] = None,
+) -> Optional[dict]:
+    """The tuned kernel configuration for (this device kind, opset,
+    maxsize, dtype), or None — callers keep their static defaults on
+    None, so an absent/foreign-device cache changes nothing."""
+    cache = load_tune_cache(path)
+    if cache is None:
+        return None
+    device_kind = device_kind or current_device_kind()
+    kind = cache.get("device_kinds", {}).get(device_kind)
+    if not isinstance(kind, dict):
+        return None
+    entry = kind.get("entries", {}).get(
+        entry_key(opset_fingerprint(operators), maxsize, dtype)
+    )
+    if not isinstance(entry, dict):
+        return None
+    config = entry.get("config")
+    return dict(config) if isinstance(config, dict) else None
+
+
+def tuned_min_work(
+    device_kind: Optional[str] = None, path: Optional[str] = None
+) -> Optional[int]:
+    """The tuned `auto`-router crossover (trees x rows) for this device
+    kind, or None — the router keeps the static _PALLAS_MIN_WORK on
+    None, which is what makes no-cache routing byte-identical to the
+    pre-autotuner behavior."""
+    cache = load_tune_cache(path)
+    if cache is None:
+        return None
+    device_kind = device_kind or current_device_kind()
+    kind = cache.get("device_kinds", {}).get(device_kind)
+    if not isinstance(kind, dict):
+        return None
+    mw = kind.get("min_work")
+    return int(mw) if isinstance(mw, (int, float)) and mw > 0 else None
+
+
+def validate_tune_cache(cache) -> List[str]:
+    """Schema check for the lint gate (scripts/lint.py) and the writer.
+    Returns a list of problems; empty means valid."""
+    problems: List[str] = []
+    if not isinstance(cache, dict):
+        return ["cache payload is not a JSON object"]
+    if cache.get("schema_version") != SCHEMA_VERSION:
+        problems.append(
+            f"schema_version must be {SCHEMA_VERSION}, got "
+            f"{cache.get('schema_version')!r}"
+        )
+    kinds = cache.get("device_kinds")
+    if not isinstance(kinds, dict):
+        return problems + ["device_kinds must be an object"]
+    for kind_name, kind in kinds.items():
+        tag = f"device_kinds[{kind_name!r}]"
+        if not isinstance(kind, dict):
+            problems.append(f"{tag} must be an object")
+            continue
+        interpret = kind.get("interpret")
+        if not isinstance(interpret, bool):
+            problems.append(f"{tag}.interpret must be a boolean")
+        elif interpret and "tpu" in kind_name.lower():
+            problems.append(
+                f"{tag}: interpret-mode timings under a TPU device "
+                "kind — the CPU fallback sweep must never be merged "
+                "into an on-chip entry"
+            )
+        mw = kind.get("min_work")
+        if mw is not None and (
+            not isinstance(mw, int) or isinstance(mw, bool) or mw <= 0
+        ):
+            problems.append(f"{tag}.min_work must be a positive integer")
+        entries = kind.get("entries", {})
+        if not isinstance(entries, dict):
+            problems.append(f"{tag}.entries must be an object")
+            continue
+        for key, entry in entries.items():
+            etag = f"{tag}.entries[{key!r}]"
+            if not isinstance(entry, dict):
+                problems.append(f"{etag} must be an object")
+                continue
+            problems += _validate_config(
+                entry.get("config"), f"{etag}.config"
+            )
+    return problems
+
+
+def _validate_config(config, tag: str) -> List[str]:
+    problems: List[str] = []
+    if not isinstance(config, dict):
+        return [f"{tag} must be an object"]
+    tb = config.get("t_block")
+    ru = config.get("tree_unroll")
+    if not isinstance(tb, int) or isinstance(tb, bool) or tb <= 0:
+        problems.append(f"{tag}.t_block must be a positive integer")
+    if ru not in _TREE_UNROLLS:
+        problems.append(
+            f"{tag}.tree_unroll must be one of {_TREE_UNROLLS}"
+        )
+    elif isinstance(tb, int) and not isinstance(tb, bool) and tb > 0 \
+            and tb % ru:
+        problems.append(
+            f"{tag}.t_block ({tb}) must be a multiple of tree_unroll "
+            f"({ru}) — the kernel's interleave-group invariant"
+        )
+    rb = config.get("r_block")
+    if (not isinstance(rb, int) or isinstance(rb, bool) or rb <= 0
+            or rb % 128):
+        problems.append(
+            f"{tag}.r_block must be a positive multiple of 128 "
+            "(rows live on (r_sub, 128) vreg tiles)"
+        )
+    if config.get("dispatch") not in _DISPATCHES:
+        problems.append(f"{tag}.dispatch must be one of {_DISPATCHES}")
+    ladder = config.get("ladder", [])
+    if not isinstance(ladder, (list, tuple)):
+        problems.append(f"{tag}.ladder must be a list")
+    elif ladder:
+        fracs = list(ladder)
+        if not all(isinstance(x, (int, float)) and not isinstance(x, bool)
+                   and 0.0 < float(x) <= 1.0 for x in fracs):
+            problems.append(
+                f"{tag}.ladder fractions must be in (0, 1]"
+            )
+        elif sorted(fracs) != fracs or float(fracs[-1]) != 1.0:
+            problems.append(
+                f"{tag}.ladder must ascend and end at 1.0 (the "
+                "Options.eval_bucket_ladder rule)"
+            )
+    for k in config:
+        if k not in _CONFIG_KEYS:
+            problems.append(f"{tag} has unknown key {k!r}")
+    return problems

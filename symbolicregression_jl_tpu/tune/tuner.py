@@ -1,0 +1,140 @@
+"""Model-ranked kernel sweep: srcost orders candidates, hardware breaks
+ties.
+
+A blind grid over (t_block, r_block, dispatch, tree_unroll, ladder) is
+~70 Mosaic compiles per sweep — minutes of tunnel time each on a v5e.
+The srcost analytic model (analysis/cost.py::pallas_config_cost) prices
+every candidate's flops/bytes/padded-waste in microseconds on the host,
+so the measured sweep only runs the top few (`top_k`). The model's
+ABSOLUTE numbers drift from Mosaic reality; its ORDERING is what the
+ranking uses, and measurement always has the final word within the
+top-k set.
+
+`measure_fn` is injected (config dict -> trees-rows/s, or raises) so
+benchmark/kernel_tune.py plugs in its bench-methodology timer while
+tests plug in deterministic fakes — the sweep logic itself never
+touches a device.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .cache import (
+    SCHEMA_VERSION,
+    current_device_kind,
+    entry_key,
+    opset_fingerprint,
+    update_tune_cache,
+)
+
+#: the default ladder candidate — PR 4's measured interpreter winner
+#: (BASELINE.md bucket sweep); the kernel sweep re-judges it per device.
+DEFAULT_LADDER = (0.25, 0.5, 0.75, 1.0)
+
+
+def candidate_grid(include_bucketed: bool = True) -> List[dict]:
+    """The autotuner's candidate space over the kernel's tile/dispatch
+    parameters. Deliberately coarse: srcost ranks it, so breadth is
+    cheap; only the measured top-k costs compile time."""
+    grid: List[dict] = []
+    ladders = ([], list(DEFAULT_LADDER)) if include_bucketed else ([],)
+    for t_block in (128, 256, 512):
+        for r_block in (512, 1024):
+            for dispatch in ("mux", "chain"):
+                for tree_unroll in (4, 8, 16):
+                    for ladder in ladders:
+                        grid.append({
+                            "t_block": t_block,
+                            "r_block": r_block,
+                            "dispatch": dispatch,
+                            "tree_unroll": tree_unroll,
+                            "ladder": list(ladder),
+                        })
+    return grid
+
+
+def model_ranked_sweep(
+    operators,
+    lengths: Sequence[int],
+    nrows: int,
+    nfeat: int,
+    measure_fn: Callable[[dict], float],
+    candidates: Optional[Sequence[dict]] = None,
+    top_k: int = 5,
+) -> dict:
+    """Rank `candidates` with the srcost model, measure the top_k with
+    `measure_fn`, and return the sweep record:
+
+        {"ranked": [(config, modeled_cost), ...],   # best-modeled first
+         "measured": [{"config", "trees_rows_per_s"| "error"}, ...],
+         "best": {"config", "trees_rows_per_s"} | None}
+
+    A candidate whose measurement raises is recorded with its error and
+    skipped — one Mosaic lowering failure must not kill the sweep."""
+    from ..analysis.cost import rank_kernel_configs
+
+    if candidates is None:
+        candidates = candidate_grid()
+    ranked = rank_kernel_configs(
+        list(candidates), list(lengths), nrows, nfeat, operators
+    )
+    measured: List[dict] = []
+    best: Optional[dict] = None
+    for config, _cost in ranked[:max(1, int(top_k))]:
+        try:
+            rate = float(measure_fn(config))
+        except Exception as e:  # noqa: BLE001 - sweep must survive
+            measured.append({
+                "config": config,
+                "error": f"{type(e).__name__}: {e}",
+            })
+            continue
+        rec = {"config": config, "trees_rows_per_s": rate}
+        measured.append(rec)
+        if best is None or rate > best["trees_rows_per_s"]:
+            best = rec
+    return {
+        "ranked": [(c, s) for c, s in ranked],
+        "measured": measured,
+        "best": best,
+    }
+
+
+def sweep_to_cache(
+    sweep: dict,
+    operators,
+    maxsize: int,
+    dtype: str = "float32",
+    interpret: bool = False,
+    device_kind: Optional[str] = None,
+    min_work: Optional[int] = None,
+    cache: Optional[dict] = None,
+    source: str = "kernel_tune",
+) -> Optional[dict]:
+    """Fold a model_ranked_sweep result into a (new or existing) cache
+    dict under THIS device kind, or None when the sweep measured
+    nothing. interpret=True marks the CPU fallback sweep — update_
+    tune_cache refuses to file such entries under a TPU device kind."""
+    best = sweep.get("best")
+    if not best:
+        return cache
+    return update_tune_cache(
+        cache,
+        device_kind or current_device_kind(),
+        interpret,
+        entry_key(opset_fingerprint(operators), maxsize, dtype),
+        best["config"],
+        trees_rows_per_s=best["trees_rows_per_s"],
+        min_work=min_work,
+        source=source,
+    )
+
+
+__all__ = [
+    "DEFAULT_LADDER",
+    "SCHEMA_VERSION",
+    "candidate_grid",
+    "model_ranked_sweep",
+    "sweep_to_cache",
+]

@@ -1,0 +1,336 @@
+"""Persistent kernel-tune cache (ISSUE 17): schema round-trip, robust
+load (corrupt/truncated/wrong-schema files degrade to the static
+defaults, never crash), per-device-kind isolation, the `auto` router's
+consultation of the tuned crossover, and the srcost candidate ranking
+the autotuner's measured sweep order rides on. All CPU, no kernels."""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from symbolicregression_jl_tpu.analysis.cost import (
+    pallas_config_cost,
+    pallas_kernel_cost_entries,
+    rank_kernel_configs,
+)
+from symbolicregression_jl_tpu.ops.operators import make_operator_set
+from symbolicregression_jl_tpu.tune import (
+    SCHEMA_VERSION,
+    current_device_kind,
+    entry_key,
+    load_tune_cache,
+    lookup_kernel_config,
+    opset_fingerprint,
+    reset_tune_cache_memo,
+    save_tune_cache,
+    tuned_min_work,
+    update_tune_cache,
+    validate_tune_cache,
+)
+
+OPS = make_operator_set(["+", "-", "*", "/"], ["cos", "exp"])
+
+CONFIG = {
+    "t_block": 256,
+    "r_block": 1024,
+    "dispatch": "mux",
+    "tree_unroll": 8,
+    "ladder": [0.25, 0.5, 0.75, 1.0],
+}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_memo():
+    reset_tune_cache_memo()
+    yield
+    reset_tune_cache_memo()
+
+
+def _cache_with(device_kind, interpret=False, min_work=None,
+                config=CONFIG, maxsize=24):
+    return update_tune_cache(
+        None, device_kind, interpret,
+        entry_key(opset_fingerprint(OPS), maxsize, "float32"),
+        config, trees_rows_per_s=1.0e9, min_work=min_work,
+    )
+
+
+# ---------------------------------------------------------------------------
+# cache: round-trip, robust load, isolation
+# ---------------------------------------------------------------------------
+
+
+def test_round_trip(tmp_path, monkeypatch):
+    path = str(tmp_path / "tune_cache.json")
+    monkeypatch.setenv("SRTPU_TUNE_CACHE", path)
+    cache = _cache_with("TPU v5e", min_work=1 << 20)
+    assert save_tune_cache(cache) == path
+    assert load_tune_cache() == cache
+    cfg = lookup_kernel_config(OPS, 24, "float32", device_kind="TPU v5e")
+    assert cfg == CONFIG
+    assert tuned_min_work(device_kind="TPU v5e") == 1 << 20
+    # sorted-key writer: refreshes must diff like every other baseline
+    with open(path) as f:
+        text = f.read()
+    assert text == json.dumps(cache, indent=2, sort_keys=True) + "\n"
+
+
+def test_missing_file_is_none(tmp_path, monkeypatch):
+    monkeypatch.setenv("SRTPU_TUNE_CACHE", str(tmp_path / "absent.json"))
+    assert load_tune_cache() is None
+    assert lookup_kernel_config(OPS, 24, "float32") is None
+    assert tuned_min_work() is None
+
+
+@pytest.mark.parametrize("payload", [
+    "{not json at all",
+    json.dumps({"schema_version": SCHEMA_VERSION})[: 20],  # truncated
+    "[1, 2, 3]",  # parses, but not an object
+])
+def test_corrupt_cache_warns_and_defaults(tmp_path, monkeypatch, payload):
+    path = tmp_path / "tune_cache.json"
+    path.write_text(payload)
+    monkeypatch.setenv("SRTPU_TUNE_CACHE", str(path))
+    with pytest.warns(UserWarning, match="static kernel defaults"):
+        assert load_tune_cache() is None
+    # memoized verdict: lookups keep returning the defaults, no crash
+    assert lookup_kernel_config(OPS, 24, "float32") is None
+    assert tuned_min_work() is None
+
+
+def test_schema_version_mismatch_ignored_with_warning(tmp_path,
+                                                      monkeypatch):
+    cache = _cache_with("cpu", interpret=True, min_work=4096)
+    cache["schema_version"] = SCHEMA_VERSION + 1
+    path = tmp_path / "tune_cache.json"
+    path.write_text(json.dumps(cache))
+    monkeypatch.setenv("SRTPU_TUNE_CACHE", str(path))
+    with pytest.warns(UserWarning, match="schema_version"):
+        assert load_tune_cache() is None
+    assert tuned_min_work(device_kind="cpu") is None
+
+
+def test_device_kind_isolation(tmp_path, monkeypatch):
+    path = str(tmp_path / "tune_cache.json")
+    monkeypatch.setenv("SRTPU_TUNE_CACHE", path)
+    save_tune_cache(_cache_with("TPU v5e", min_work=2048))
+    # a TPU-tuned cache must change NOTHING for another device kind
+    assert lookup_kernel_config(OPS, 24, "float32",
+                                device_kind="cpu") is None
+    assert tuned_min_work(device_kind="cpu") is None
+    assert lookup_kernel_config(OPS, 24, "float32",
+                                device_kind="TPU v5e") == CONFIG
+    # ... and entry keys isolate on (opset, maxsize, dtype) too
+    other_ops = make_operator_set(["+", "-"], ["cos"])
+    assert lookup_kernel_config(other_ops, 24, "float32",
+                                device_kind="TPU v5e") is None
+    assert lookup_kernel_config(OPS, 32, "float32",
+                                device_kind="TPU v5e") is None
+
+
+def test_interpret_quarantine():
+    # the CPU fallback sweep must never masquerade as on-chip data
+    with pytest.raises(ValueError, match="interpret"):
+        _cache_with("TPU v5e", interpret=True)
+    # and a hand-merged cache that violates it fails validation
+    bad = _cache_with("TPU v5e")
+    bad["device_kinds"]["TPU v5e"]["interpret"] = True
+    assert any("interpret" in p for p in validate_tune_cache(bad))
+    # mixing measurement modes under one device kind is refused as well
+    cache = _cache_with("cpu", interpret=True)
+    with pytest.raises(ValueError, match="mix"):
+        update_tune_cache(
+            cache, "cpu", False,
+            entry_key(opset_fingerprint(OPS), 32, "float32"), CONFIG,
+        )
+
+
+def test_validate_rejects_malformed_configs():
+    def bad_config(**kw):
+        cache = _cache_with("cpu", interpret=True, config={**CONFIG, **kw})
+        return validate_tune_cache(cache)
+
+    assert bad_config(dispatch="vliw")
+    assert bad_config(tree_unroll=3)
+    assert bad_config(t_block=260)  # not a multiple of tree_unroll 8
+    assert bad_config(r_block=200)  # not a multiple of 128
+    assert bad_config(ladder=[0.5, 0.25, 1.0])  # not ascending
+    assert bad_config(ladder=[0.25, 0.5])  # does not end at 1.0
+    assert validate_tune_cache(_cache_with("cpu", interpret=True)) == []
+    # the writer refuses an invalid payload outright
+    with pytest.raises(ValueError, match="invalid"):
+        save_tune_cache(_cache_with("cpu", interpret=True,
+                                    config={**CONFIG, "dispatch": "x"}),
+                        path="/dev/null")
+
+
+# ---------------------------------------------------------------------------
+# router consultation
+# ---------------------------------------------------------------------------
+
+
+def test_auto_router_consults_tuned_crossover(tmp_path, monkeypatch):
+    import symbolicregression_jl_tpu.ops.pallas_eval as pe
+    from symbolicregression_jl_tpu.models.fitness import (
+        _PALLAS_MIN_WORK,
+        resolve_eval_backend_pallas,
+    )
+
+    monkeypatch.setattr(pe, "pallas_available", lambda: True)
+    monkeypatch.setenv("SRTPU_TUNE_CACHE",
+                       str(tmp_path / "tune_cache.json"))
+    # no cache on disk: the static crossover, byte-identical to the
+    # pre-autotuner rule
+    below = int(_PALLAS_MIN_WORK ** 0.5) // 2
+    assert not resolve_eval_backend_pallas(
+        "auto", jnp.float32, below, below
+    )
+    assert resolve_eval_backend_pallas("auto", jnp.float32, 1024, 1024)
+    # a tuned crossover for THIS device kind replaces the static rule
+    kind = current_device_kind()
+    save_tune_cache(_cache_with(kind, interpret="tpu" not in kind.lower(),
+                                min_work=5000))
+    assert resolve_eval_backend_pallas("auto", jnp.float32, 100, 100)
+    assert not resolve_eval_backend_pallas("auto", jnp.float32, 50, 50)
+    # a foreign device kind's crossover changes nothing
+    save_tune_cache(_cache_with("TPU imaginary-v9", min_work=5000))
+    assert not resolve_eval_backend_pallas(
+        "auto", jnp.float32, 100, 100
+    )
+
+
+def test_tuned_kernel_kwargs(tmp_path, monkeypatch):
+    from symbolicregression_jl_tpu.models.fitness import (
+        _tuned_kernel_kwargs,
+    )
+
+    monkeypatch.setenv("SRTPU_TUNE_CACHE",
+                       str(tmp_path / "tune_cache.json"))
+    # no cache: {} — untuned dispatch keeps the static defaults exactly
+    assert _tuned_kernel_kwargs(OPS, 24, "float32") == {}
+    kind = current_device_kind()
+    save_tune_cache(_cache_with(kind,
+                                interpret="tpu" not in kind.lower()))
+    kw = _tuned_kernel_kwargs(OPS, 24, "float32")
+    assert kw == {
+        "t_block": 256, "r_block": 1024, "dispatch": "mux",
+        "tree_unroll": 8, "bucket_ladder": (0.25, 0.5, 0.75, 1.0),
+    }
+    # a different maxsize misses -> static defaults again
+    assert _tuned_kernel_kwargs(OPS, 40, "float32") == {}
+
+
+# ---------------------------------------------------------------------------
+# srcost candidate ranking
+# ---------------------------------------------------------------------------
+
+BASE = {"t_block": 256, "r_block": 1024, "dispatch": "mux",
+        "tree_unroll": 8, "ladder": []}
+
+
+def test_ranking_prefers_mux_over_chain():
+    # chain's serial select surcharge (n_ops * 1.25 vs ceil(log2 n_ops))
+    # makes it strictly more modeled flops at identical geometry
+    lengths = [5] * 40 + [19] * 8
+    chain = {**BASE, "dispatch": "chain"}
+    c_mux = pallas_config_cost(lengths, BASE, 256, 3, OPS)
+    c_chain = pallas_config_cost(lengths, chain, 256, 3, OPS)
+    assert c_chain["flops"] > c_mux["flops"]
+    ranked = rank_kernel_configs([chain, BASE], lengths, 256, 3, OPS)
+    assert ranked[0][0] == BASE
+
+
+def test_ranking_prefers_less_row_padding():
+    # nrows=1500: r_block 512 pads to 1536 rows, 1024 pads to 2048 —
+    # strictly more dead lanes at identical slot work
+    lengths = [9] * 256
+    small = {**BASE, "r_block": 512}
+    ranked = rank_kernel_configs([BASE, small], lengths, 1500, 3, OPS)
+    assert ranked[0][0] == small
+
+
+def test_ranking_prefers_less_tree_padding():
+    # T=300 with t_block 256 pads the tree axis to 512; t_block 128
+    # pads to 384 — same executed slots (padded trees are length 0),
+    # smaller tables and waste
+    lengths = [9] * 300
+    small = {**BASE, "t_block": 128}
+    c_big = pallas_config_cost(lengths, BASE, 256, 3, OPS)
+    c_small = pallas_config_cost(lengths, small, 256, 3, OPS)
+    assert c_small["bytes"] < c_big["bytes"]
+    assert c_small["flops"] == c_big["flops"]  # padded trees run 0 steps
+    ranked = rank_kernel_configs([BASE, small], lengths, 256, 3, OPS)
+    assert ranked[0][0] == small
+
+
+def test_ranking_penalizes_mixed_length_groups():
+    # hand-computed: lengths [3]*60 + [19]*4, _SLOT_UNROLL=4.
+    # unroll 4: 15 all-short groups (1 step each) + 1 long group
+    #   (5 steps) -> executed = 15*1*4*4 + 5*4*4 = 320 slot-visits.
+    # unroll 16: groups 0-2 all short (3*1*4*16=192), group 3 mixes 12
+    #   short with the 4 long trees -> gmax 19 -> 5*4*16 = 320;
+    #   total 512. The narrower interleave must rank first.
+    lengths = [3] * 60 + [19] * 4
+    narrow = {**BASE, "tree_unroll": 4}
+    wide = {**BASE, "tree_unroll": 16}
+    c_narrow = pallas_config_cost(lengths, narrow, 256, 3, OPS)
+    c_wide = pallas_config_cost(lengths, wide, 256, 3, OPS)
+    assert c_narrow["executed_slots"] == 320
+    assert c_wide["executed_slots"] == 512
+    assert c_wide["flops"] / c_narrow["flops"] == pytest.approx(512 / 320)
+    ranked = rank_kernel_configs([wide, narrow], lengths, 256, 3, OPS)
+    assert ranked[0][0] == narrow
+
+
+def test_kernel_cost_baseline_entries_are_honest():
+    entries = pallas_kernel_cost_entries()
+    assert set(entries) == {
+        "pallas_postfix_flat", "pallas_postfix_bucketed",
+        "pallas_postfix_fused",
+    }
+    # the model must NOT invent a bucketed slot-work win: on the clean
+    # skewed histogram the ladder only re-tiles, it cannot truncate
+    flat, buck = (entries["pallas_postfix_flat"],
+                  entries["pallas_postfix_bucketed"])
+    assert buck["flops"] == flat["flops"]
+    # the fused epilogue's whole point: the (T, nrows) value write-back
+    # never reaches HBM, so modeled bytes collapse
+    assert entries["pallas_postfix_fused"]["bytes"] < 0.25 * buck["bytes"]
+
+
+def test_model_ranked_sweep_measures_top_k_and_survives_errors():
+    from symbolicregression_jl_tpu.tune import (
+        model_ranked_sweep,
+        sweep_to_cache,
+    )
+
+    lengths = [5] * 40 + [19] * 8
+    calls = []
+
+    def measure(config):
+        calls.append(config)
+        if config["dispatch"] == "chain":
+            raise RuntimeError("lowering exploded")
+        return 100.0 + config["t_block"]
+
+    candidates = [
+        {**BASE, "t_block": tb, "dispatch": d}
+        for tb in (128, 256) for d in ("mux", "chain")
+    ]
+    sweep = model_ranked_sweep(OPS, lengths, 256, 3, measure,
+                               candidates=candidates, top_k=3)
+    assert len(calls) == 3
+    assert len(sweep["measured"]) == 3
+    errors = [m for m in sweep["measured"] if "error" in m]
+    assert all(m["config"]["dispatch"] == "chain" for m in errors)
+    best = sweep["best"]
+    assert best["config"]["dispatch"] == "mux"
+    assert best["trees_rows_per_s"] == max(
+        m["trees_rows_per_s"] for m in sweep["measured"]
+        if "trees_rows_per_s" in m
+    )
+    cache = sweep_to_cache(sweep, OPS, 24, interpret=True,
+                           device_kind="cpu", min_work=4096)
+    assert validate_tune_cache(cache) == []
+    assert cache["device_kinds"]["cpu"]["min_work"] == 4096

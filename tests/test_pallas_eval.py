@@ -563,3 +563,210 @@ def test_top_carry_matches_jnp(rng, kw):
     np.testing.assert_allclose(
         np.asarray(y)[m], np.asarray(y_ref)[m], rtol=1e-5, atol=1e-5
     )
+
+
+# ---------------------------------------------------------------------------
+# bucket-laddered kernel dispatch + fused loss epilogue (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+def _skewed_batch(rng, n=32):
+    """80/15/5 short/mid/long — the skew the ladder exists for."""
+    sizes = np.where(
+        rng.random(n) < 0.80, rng.integers(2, 7, n),
+        np.where(rng.random(n) < 0.75, rng.integers(7, 13, n),
+                 rng.integers(13, 22, n)),
+    )
+    return stack_trees([
+        encode_tree(
+            random_expr_fixed_size(rng, OPS, NFEAT, int(s)), L
+        )
+        for s in sizes
+    ])
+
+
+@pytest.mark.parametrize("ladder", [
+    (0.25, 0.5, 1.0),
+    (1.0,),  # one rung: still must be the identity
+])
+def test_bucketed_bit_identical_to_flat(rng, ladder):
+    """The bucket ladder is a DISPATCH decomposition, not a numeric
+    mode: values, ok mask, and inverse-permutation scatter must be
+    bit-identical to the flat kernel on a skewed batch."""
+    trees = _skewed_batch(rng)
+    X = jnp.asarray(
+        (rng.standard_normal((NFEAT, 140)) * 2).astype(np.float32)
+    )
+    y_flat, ok_flat = eval_trees_pallas(
+        trees, X, OPS, t_block=8, r_block=128, interpret=True
+    )
+    y_buck, ok_buck = eval_trees_pallas(
+        trees, X, OPS, t_block=8, r_block=128, interpret=True,
+        bucket_ladder=ladder,
+    )
+    assert np.array_equal(
+        np.asarray(y_flat), np.asarray(y_buck), equal_nan=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ok_flat), np.asarray(ok_buck)
+    )
+
+
+def test_bucketed_poison_bit_identical_to_flat(rng):
+    """Poison semantics cross bucket boundaries unchanged: planted inf
+    constants must poison the SAME trees under the ladder."""
+    trees = _skewed_batch(rng)
+    n = trees.length.shape[0]
+    trees = trees._replace(cval=jnp.where(
+        (jnp.arange(n) % 5 == 0)[:, None], jnp.inf, trees.cval
+    ))
+    X = jnp.asarray(
+        (rng.standard_normal((NFEAT, 140)) * 2).astype(np.float32)
+    )
+    y_flat, ok_flat = eval_trees_pallas(
+        trees, X, OPS, t_block=8, r_block=128, interpret=True
+    )
+    y_buck, ok_buck = eval_trees_pallas(
+        trees, X, OPS, t_block=8, r_block=128, interpret=True,
+        bucket_ladder=(0.25, 0.5, 1.0),
+    )
+    assert not bool(np.all(np.asarray(ok_flat)))  # poison took effect
+    np.testing.assert_array_equal(
+        np.asarray(ok_flat), np.asarray(ok_buck)
+    )
+    assert np.array_equal(
+        np.asarray(y_flat), np.asarray(y_buck), equal_nan=True
+    )
+
+
+def test_bucketed_requires_postfix():
+    trees = stack_trees([encode_tree(
+        random_expr_fixed_size(np.random.default_rng(0), OPS, NFEAT, 5),
+        L,
+    )])
+    X = jnp.zeros((NFEAT, 8), jnp.float32)
+    with pytest.raises(ValueError, match="bucket_ladder"):
+        eval_trees_pallas(
+            trees, X, OPS, interpret=True, program="instr",
+            bucket_ladder=(0.5, 1.0),
+        )
+
+
+@pytest.mark.parametrize("r_block,bucket_ladder", [
+    (128, (0.25, 0.5, 1.0)),  # 2 row tiles: exercises accum_tile j>0
+    (256, ()),  # single row tile, flat dispatch
+])
+def test_fused_epilogue_bit_identical_to_host_twin(rng, r_block,
+                                                   bucket_ladder):
+    """The kernel-fused loss epilogue vs the host composition it
+    replaces — contain_nonfinite(aggregate_loss(elem,
+    tile_rows=r_block), ok) — must agree BITWISE, with both sides
+    jitted (the production regime; under jit XLA folds the constant
+    row-count divisor to a reciprocal-multiply on both sides alike,
+    where an eager host graph would divide — a 1-ULP seam this contract
+    deliberately excludes by jitting both)."""
+    from symbolicregression_jl_tpu.ops.losses import (
+        aggregate_loss,
+        contain_nonfinite,
+        l2_dist_loss,
+    )
+    from symbolicregression_jl_tpu.ops.pallas_eval import (
+        eval_loss_trees_pallas,
+    )
+
+    trees = _skewed_batch(rng)
+    n_rows = 140
+    X = jnp.asarray(
+        (rng.standard_normal((NFEAT, n_rows)) * 2).astype(np.float32)
+    )
+    y = (2.0 * jnp.cos(X[2]) + X[1] ** 2).astype(jnp.float32)
+
+    @jax.jit
+    def host_twin(t):
+        yp, ok = eval_trees_pallas(
+            t, X, OPS, t_block=8, r_block=r_block, interpret=True,
+            bucket_ladder=bucket_ladder,
+        )
+        elem = l2_dist_loss(yp, y[None, :])
+        return contain_nonfinite(
+            aggregate_loss(elem, None, tile_rows=r_block), ok
+        )
+
+    fused = eval_loss_trees_pallas(
+        trees, X, y, OPS, l2_dist_loss, t_block=8, r_block=r_block,
+        interpret=True, bucket_ladder=bucket_ladder,
+    )
+    assert np.array_equal(
+        np.asarray(fused), np.asarray(host_twin(trees)), equal_nan=True
+    )
+    # and with poison planted: the inf sentinel must land identically
+    n = trees.length.shape[0]
+    poisoned = trees._replace(cval=jnp.where(
+        (jnp.arange(n) % 7 == 0)[:, None], jnp.inf, trees.cval
+    ))
+    fused_p = eval_loss_trees_pallas(
+        poisoned, X, y, OPS, l2_dist_loss, t_block=8, r_block=r_block,
+        interpret=True, bucket_ladder=bucket_ladder,
+    )
+    ref_p = np.asarray(host_twin(poisoned))
+    assert np.isinf(ref_p).any()
+    assert np.array_equal(np.asarray(fused_p), ref_p, equal_nan=True)
+
+
+def test_fused_loss_builder_routes_to_kernel(rng, monkeypatch):
+    """_make_eval_loss_fn's Pallas branch must take the KERNEL-FUSED
+    epilogue for unweighted float32 postfix batches, honoring the
+    Options ladder — asserted by substituting an interpret-mode
+    recording wrapper for the compiled entry point."""
+    import symbolicregression_jl_tpu.ops.pallas_eval as pe
+    from symbolicregression_jl_tpu.models.fitness import eval_loss_trees
+    from symbolicregression_jl_tpu.ops.losses import l2_dist_loss
+
+    trees = _skewed_batch(rng, n=16)
+    X = jnp.asarray(
+        (rng.standard_normal((NFEAT, 130)) * 2).astype(np.float32)
+    )
+    y = (X[0] + 1.0).astype(jnp.float32)
+    real_loss = pe.eval_loss_trees_pallas
+    real_value = pe.eval_trees_pallas
+    calls = []
+
+    def recording(t, Xa, ya, operators, loss_fn, **kw):
+        calls.append(kw)
+        kw.update(interpret=True, t_block=8, r_block=128)
+        return real_loss(t, Xa, ya, operators, loss_fn, **kw)
+
+    def value_interpret(t, Xa, operators, **kw):
+        kw.update(interpret=True, t_block=8, r_block=128)
+        return real_value(t, Xa, operators, **kw)
+
+    monkeypatch.setattr(pe, "pallas_available", lambda: True)
+    monkeypatch.setattr(pe, "eval_loss_trees_pallas", recording)
+    # the weighted fall-through exercises dispatch_eval's VALUE kernel,
+    # which on CPU must also run under interpret
+    monkeypatch.setattr(pe, "eval_trees_pallas", value_interpret)
+    ladder = (0.5, 1.0)
+    loss = eval_loss_trees(
+        trees, X, y, None, OPS, l2_dist_loss, backend="pallas",
+        bucket_ladder=ladder,
+    )
+    assert len(calls) == 1
+    assert calls[0].get("bucket_ladder") == ladder
+    # weighted batches must fall through to the unfused composition
+    w = jnp.ones_like(y)
+    eval_loss_trees(
+        trees, X, y, w, OPS, l2_dist_loss, backend="pallas",
+        bucket_ladder=ladder,
+    )
+    assert len(calls) == 1
+    # correctness of the routed loss vs the jnp interpreter graph
+    ref = eval_loss_trees(
+        trees, X, y, None, OPS, l2_dist_loss, backend="jnp"
+    )
+    m = np.isfinite(np.asarray(ref))
+    np.testing.assert_allclose(
+        np.asarray(loss)[m], np.asarray(ref)[m], rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.isfinite(np.asarray(loss)), m
+    )
