@@ -1112,7 +1112,8 @@ def bench_fitness_cache():
 def bench_static_analysis():
     """Static-analysis gate as a suite case (ISSUEs 3+4): srlint
     violation count, compile-surface baseline status, the srmem
-    HBM-footprint gate, and docs/api_reference.md drift, via
+    HBM-footprint gate, the srkey Options-contract gate, and
+    docs/api_reference.md drift, via
     scripts/lint.py --format json in its own subprocess (the gate pins
     CPU for itself; this case never needs the device)."""
     import subprocess
@@ -1125,12 +1126,12 @@ def bench_static_analysis():
     try:
         proc = subprocess.run(
             [sys.executable, script, "--format", "json"],
-            capture_output=True, text=True, timeout=900,
+            capture_output=True, text=True, timeout=1100,
         )
     except subprocess.TimeoutExpired:
         return [{
             "suite": "static_analysis",
-            "error": "lint.py timed out after 900s",
+            "error": "lint.py timed out after 1100s",
             "seconds": round(time.time() - t0, 1),
         }]
     seconds = round(time.time() - t0, 1)
@@ -1147,6 +1148,7 @@ def bench_static_analysis():
     surface = payload.get("surface") or {}
     memory = payload.get("memory") or {}
     cost = payload.get("cost") or {}
+    keys = payload.get("keys") or {}
     docs = payload.get("docs") or {}
     tele = payload.get("telemetry_schema") or {}
     mem_configs = memory.get("configs", {})
@@ -1195,6 +1197,19 @@ def bench_static_analysis():
             "base_padded_waste": (
                 cost_configs.get("base") or {}
             ).get("padded_waste_fraction"),
+        },
+        {
+            "suite": "static_analysis",
+            "case": "srkey",
+            "ok": keys.get("ok", False),
+            "fields": sum((keys.get("fields") or {}).values()),
+            "problems": len(keys.get("problems", [])),
+            # both trace configs orchestration-invariant = the warm-
+            # compile sharing contract the serving tier relies on holds
+            "orchestration_invariant": all(
+                e.get("orchestration_invariant", False)
+                for e in (keys.get("configs") or {}).values()
+            ) if keys.get("traced") else None,
         },
         {
             "suite": "static_analysis",

@@ -1,11 +1,12 @@
 #!/usr/bin/env python
 """Repo-wide static-analysis gate: srlint + compile-surface + srmem HBM
-gate + srcost analytic-cost gate + doc drift.
+gate + srcost analytic-cost gate + srkey Options-contract gate + doc
+drift.
 
 The one command CI (and benchmark/suite.py's `static_analysis` case) runs:
 
     python scripts/lint.py [--format text|json]
-        [--only lint|surface|memory|cost]
+        [--only lint|surface|memory|cost|keys[,...]]
         [--update-baseline] [--hbm-budget-gb G] [--xla-memory] [--skip-docs]
 
 Wraps `python -m symbolicregression_jl_tpu.analysis` and adds the
@@ -234,10 +235,11 @@ def main(argv=None) -> int:
 
     pin_platform()
     report = run_analysis(
-        lint=ns.only in (None, "lint"),
-        surface=ns.only in (None, "surface"),
-        memory=ns.only in (None, "memory"),
-        cost=ns.only in (None, "cost"),
+        lint=ns.only is None or "lint" in ns.only,
+        surface=ns.only is None or "surface" in ns.only,
+        memory=ns.only is None or "memory" in ns.only,
+        cost=ns.only is None or "cost" in ns.only,
+        keys=ns.only is None or "keys" in ns.only,
         update_baseline=ns.update_baseline,
         hbm_budget_gb=ns.hbm_budget_gb,
         xla_memory=ns.xla_memory,

@@ -25,9 +25,13 @@ from .models.dataset import (
     validate_dataset,
 )
 from .models.options import (
+    GRAPH_FIELDS,
+    ORCHESTRATION_FIELDS,
+    TRACED_SCALAR_FIELDS,
     ComplexityMapping,
     MutationWeights,
     Options,
+    callable_token,
     make_options,
 )
 from .models.trees import (
@@ -175,6 +179,10 @@ __all__ = [
     "make_options",
     "MutationWeights",
     "ComplexityMapping",
+    "GRAPH_FIELDS",
+    "TRACED_SCALAR_FIELDS",
+    "ORCHESTRATION_FIELDS",
+    "callable_token",
     "Expr",
     "TreeBatch",
     "encode_tree",

@@ -1,7 +1,8 @@
 """Static analysis for the TPU hot path: srlint + compile-surface checker
-+ srmem HBM-footprint analyzer.
++ srmem HBM-footprint analyzer + srcost cost model + srkey contract
+checker.
 
-Three engines, one CLI (``python -m symbolicregression_jl_tpu.analysis``):
+Five engines, one CLI (``python -m symbolicregression_jl_tpu.analysis``):
 
 - **srlint** (lint.py / rules.py): a JAX-aware AST linter that builds a
   call graph rooted at the package's ``jax.jit`` entry points and flags
@@ -23,10 +24,17 @@ Three engines, one CLI (``python -m symbolicregression_jl_tpu.analysis``):
   attributed per search stage, diffed against the checked-in
   ``cost_baseline.json`` (>10% regressions fail) — the modeled half of
   the srprof roofline join (telemetry/profile.py).
+- **srkey** (keys.py): the Options compile-identity contract checker —
+  verifies the GRAPH/TRACED_SCALAR/ORCHESTRATION field classification in
+  models/options.py is complete, that ``_graph_key`` covers exactly the
+  graph fields, and (by differential tracing of the production programs)
+  that orchestration fields never leak into jitted graphs while traced
+  scalars re-bind without recompiling.
 
 See docs/static_analysis.md for the rule catalog and workflows.
 """
 
+import argparse
 from typing import Optional
 
 from .lint import Linter, lint_package, lint_paths
@@ -35,6 +43,7 @@ from .rules import RULES, Rule, Violation
 
 __all__ = [
     "AnalysisReport",
+    "ENGINES",
     "Linter",
     "RULES",
     "Rule",
@@ -45,6 +54,21 @@ __all__ = [
     "pin_platform",
     "run_analysis",
 ]
+
+#: The engine names ``--only`` accepts (comma-separated subsets).
+ENGINES = ("lint", "surface", "memory", "cost", "keys")
+
+
+def _parse_only(text: str):
+    """argparse type for ``--only``: 'lint' or 'lint,keys' -> frozenset."""
+    names = tuple(s.strip() for s in text.split(",") if s.strip())
+    bad = sorted(set(names) - set(ENGINES))
+    if bad or not names:
+        raise argparse.ArgumentTypeError(
+            f"unknown engine(s) {bad or [text]} — choose from "
+            + ", ".join(ENGINES)
+        )
+    return frozenset(names)
 
 
 def pin_platform() -> None:
@@ -81,9 +105,10 @@ def add_engine_args(parser) -> None:
         help="report format (default: text)",
     )
     parser.add_argument(
-        "--only", choices=("lint", "surface", "memory", "cost"),
-        default=None,
-        help="run a single engine (default: all four)",
+        "--only", type=_parse_only, default=None,
+        metavar="ENGINE[,ENGINE...]",
+        help="run a subset of engines, comma-separated (choices: "
+        + ", ".join(ENGINES) + "; default: all five)",
     )
     parser.add_argument(
         "--update-baseline", action="store_true",
@@ -109,15 +134,16 @@ def run_analysis(
     surface: bool = True,
     memory: bool = True,
     cost: bool = True,
+    keys: bool = True,
     update_baseline: bool = False,
     hbm_budget_gb: Optional[float] = None,
     xla_memory: bool = False,
 ) -> AnalysisReport:
-    """Run srlint / the compile-surface checker / srmem / srcost on this
-    repo.
+    """Run srlint / the compile-surface checker / srmem / srcost / srkey
+    on this repo.
 
-    Importing compile_surface, memory, or cost pulls in jax; callers
-    that only lint stay AST-only (no backend initialization)."""
+    Importing compile_surface, memory, cost, or keys pulls in jax;
+    callers that only lint stay AST-only (no backend initialization)."""
     report = AnalysisReport()
     if lint:
         report.violations = lint_package()
@@ -140,4 +166,8 @@ def run_analysis(
         from .cost import check_cost
 
         report.cost = check_cost(update_baseline=update_baseline)
+    if keys:
+        from .keys import check_keys
+
+        report.keys = check_keys()
     return report

@@ -1,13 +1,14 @@
 """CLI for the static-analysis subsystem.
 
     python -m symbolicregression_jl_tpu.analysis [--format text|json]
-        [--only lint|surface|memory|cost] [--update-baseline]
+        [--only lint|surface|memory|cost|keys[,...]] [--update-baseline]
         [--hbm-budget-gb G] [--xla-memory]
 
+``--only`` accepts a comma-separated subset (``--only lint,keys``).
 Exit status: 0 when clean, 1 on violations / surface problems / HBM
-budget, cost, or baseline regressions (CI contract — benchmark/suite.py
-and scripts/lint.py both rely on it). Platform handling: see
-`analysis.pin_platform`.
+budget, cost, key-contract, or baseline regressions (CI contract —
+benchmark/suite.py and scripts/lint.py both rely on it). Platform
+handling: see `analysis.pin_platform`.
 """
 
 from __future__ import annotations
@@ -22,18 +23,19 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m symbolicregression_jl_tpu.analysis",
         description="srlint + compile-surface checker + srmem "
-        "HBM-footprint gate + srcost analytic cost gate "
-        "(docs/static_analysis.md)",
+        "HBM-footprint gate + srcost analytic cost gate + srkey "
+        "Options-contract checker (docs/static_analysis.md)",
     )
     add_engine_args(ap)
     ns = ap.parse_args(argv)
 
     pin_platform()
     report = run_analysis(
-        lint=ns.only in (None, "lint"),
-        surface=ns.only in (None, "surface"),
-        memory=ns.only in (None, "memory"),
-        cost=ns.only in (None, "cost"),
+        lint=ns.only is None or "lint" in ns.only,
+        surface=ns.only is None or "surface" in ns.only,
+        memory=ns.only is None or "memory" in ns.only,
+        cost=ns.only is None or "cost" in ns.only,
+        keys=ns.only is None or "keys" in ns.only,
         update_baseline=ns.update_baseline,
         hbm_budget_gb=ns.hbm_budget_gb,
         xla_memory=ns.xla_memory,

@@ -1,0 +1,612 @@
+"""srkey — the Options compile-identity contract checker (fifth engine).
+
+Everything the serving tier trusts hangs off ``Options._graph_key()``
+(models/options.py): it decides which jobs share a warm compile in
+``serving.JobServer`` buckets, which lru-cached jit factory closures are
+reused, and — with ``cache.memo.dataset_fingerprint`` — which memo-bank
+entries may be served across runs. srkey machine-checks that contract
+instead of trusting a comment convention:
+
+1. **Registry completeness** — every ``Options`` field is declared in
+   exactly one of ``GRAPH_FIELDS`` / ``TRACED_SCALAR_FIELDS`` /
+   ``ORCHESTRATION_FIELDS`` (models/options.py). An unclassified or
+   doubly-classified field fails immediately (and skips the rest: the
+   later checks are meaningless against a broken registry), so every
+   future PR that adds a knob is forced to state its compile contract.
+2. **Key coverage (AST)** — ``_graph_key``'s source reads ``self.<f>``
+   for every graph field and for NO orchestration/scalar field.
+3. **Per-field key semantics** — perturbing a graph field changes the
+   key; perturbing an orchestration field leaves key AND traced scalars
+   unchanged; perturbing a traced scalar leaves the key unchanged while
+   ``traced_scalars()`` differs. Every field must have a perturbation
+   spec in ``ALT_SPECS`` (a missing spec is itself a finding).
+4. **Fingerprint coverage** — every result-affecting eval-context field
+   perturbs ``dataset_fingerprint`` (and so does the dataset itself),
+   so a shared memo bank can never serve stale fitness; an
+   all-orchestration perturbation leaves the fingerprint unchanged.
+5. **Differential verification by tracing** — over the compile-surface
+   base kwargs (solo + tenant-batched): perturb ALL orchestration
+   fields at once and assert the jaxprs of the production programs
+   (``memory.build_stage_programs`` + the fused iteration) are
+   byte-identical to the unperturbed trace; same for all traced
+   scalars (their VALUES enter jit as f32 avals, never as constants).
+   On a mismatch the perturbation set is bisected by group halving, so
+   the report names the leaking field(s), not just "something leaked".
+
+The factory lru_caches (api.py) key on Options hash/eq — which IS the
+graph key — so a perturbed-orchestration Options would hit the cache
+entry whose closure closes over the BASE options and mask any leak.
+Every trace set therefore clears those caches first; that also means a
+green srkey run proves the caches may legitimately share closures
+across orchestration perturbations.
+
+Runs entirely on CPU (tracing is platform-independent) and executes
+nothing; srkey adds zero primitives to any jitted program.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import inspect
+import textwrap
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .compile_surface import _BASE_KWARGS, _NFEAT, _NROWS
+
+#: Differential-tracing configs: the solo base surface and the
+#: tenant-batched (vmapped) serving surface — the two program families
+#: warm-compile buckets actually serve (compile_surface._MATRIX rows).
+DEFAULT_TRACE_CONFIGS: Tuple[Tuple[str, dict], ...] = (
+    ("base", {}),
+    ("tenants2", dict(tenants=2)),
+)
+
+
+def _alt_loss_fn(tree, X, y, weights, options):  # pragma: no cover
+    """Module-level custom-objective stand-in for the loss_function
+    perturbation (never traced by srkey — key semantics only)."""
+    return 0.0
+
+
+#: Per-field perturbation specs: kwargs overlays on the compile-surface
+#: base config, each changing that field to a DIFFERENT valid value.
+#: Every Options field must have one — srkey reports a missing spec, so
+#: a new knob cannot land without stating how to perturb it.
+ALT_SPECS: Dict[str, dict] = {
+    # --- graph fields -------------------------------------------------
+    "binary_operators": dict(binary_operators=("+", "-")),
+    "unary_operators": dict(unary_operators=("sin",)),
+    "npopulations": dict(npopulations=3),
+    "npop": dict(npop=16),
+    "ncycles_per_iteration": dict(ncycles_per_iteration=3),
+    "tournament_selection_n": dict(tournament_selection_n=6),
+    "topn": dict(topn=6),
+    "maxsize": dict(maxsize=10),
+    "maxdepth": dict(maxdepth=6),
+    "max_len": dict(max_len=24),
+    "loss": dict(loss="L1DistLoss"),
+    "loss_function": dict(loss_function=_alt_loss_fn),
+    "annealing": dict(annealing=True),
+    "use_frequency": dict(use_frequency=False),
+    "use_frequency_in_tournament": dict(use_frequency_in_tournament=False),
+    "mutation_weights": dict(mutation_weights=dict(mutate_constant=1.0)),
+    "crossover_probability": dict(crossover_probability=0.1),
+    "migration": dict(migration=False),
+    "hof_migration": dict(hof_migration=False),
+    "should_optimize_constants": dict(should_optimize_constants=False),
+    "optimizer_algorithm": dict(optimizer_algorithm="NelderMead"),
+    "optimizer_probability": dict(optimizer_probability=0.5),
+    "optimizer_nrestarts": dict(optimizer_nrestarts=1),
+    "optimizer_iterations": dict(optimizer_iterations=4),
+    "optimizer_backend": dict(optimizer_backend="jnp"),
+    "batching": dict(batching=True),
+    "batch_size": dict(batch_size=32),
+    "independent_island_batches": dict(independent_island_batches=True),
+    "constraints": dict(constraints={"*": (3, 3)}),
+    "nested_constraints": dict(nested_constraints={"cos": {"cos": 0}}),
+    "complexity_of_operators": dict(complexity_of_operators={"+": 2}),
+    "complexity_of_constants": dict(complexity_of_constants=2),
+    "complexity_of_variables": dict(complexity_of_variables=2),
+    "recorder": dict(recorder=True),
+    "cache_fitness": dict(cache_fitness=True),
+    "cache_device_slots": dict(cache_device_slots=16),
+    "n_parallel_tournaments": dict(n_parallel_tournaments=2),
+    "eval_backend": dict(eval_backend="jnp"),
+    "kernel_program": dict(kernel_program="postfix"),
+    "kernel_leaf_skip": dict(kernel_leaf_skip=True),
+    "eval_bucket_ladder": dict(eval_bucket_ladder=(0.5, 1.0)),
+    "eval_rows_per_tile": dict(eval_rows_per_tile=16),
+    "max_cycles_per_dispatch": dict(max_cycles_per_dispatch=1),
+    "row_shards": dict(row_shards=2),
+    "precision": dict(precision="bfloat16"),
+    "tenants": dict(tenants=2),
+    # --- traced scalars ----------------------------------------------
+    "parsimony": dict(parsimony=0.01),
+    "alpha": dict(alpha=0.2),
+    "perturbation_factor": dict(perturbation_factor=0.1),
+    "probability_negate_constant": dict(probability_negate_constant=0.02),
+    "adaptive_parsimony_scaling": dict(adaptive_parsimony_scaling=10.0),
+    "tournament_selection_p": dict(tournament_selection_p=0.9),
+    "fraction_replaced": dict(fraction_replaced=0.01),
+    "fraction_replaced_hof": dict(fraction_replaced_hof=0.05),
+    # --- orchestration ------------------------------------------------
+    "skip_mutation_failures": dict(skip_mutation_failures=False),
+    "fast_cycle": dict(fast_cycle=True),
+    "warmup_maxsize_by": dict(warmup_maxsize_by=0.5),
+    "early_stop_condition": dict(early_stop_condition=1e-8),
+    "timeout_in_seconds": dict(timeout_in_seconds=60.0),
+    "max_evals": dict(max_evals=1000),
+    "seed": dict(seed=7),
+    "deterministic": dict(deterministic=False),
+    "verbosity": dict(verbosity=1),
+    "progress": dict(progress=True),
+    # {tenant} templates keep the specs valid under the tenant-batched
+    # trace config too (TenantIsolationError otherwise)
+    "output_file": dict(output_file="hof_{tenant}.csv"),
+    "save_to_file": dict(save_to_file=False),
+    "terminal_width": dict(terminal_width=80),
+    "define_helper_functions": dict(define_helper_functions=False),
+    "recorder_file": dict(recorder_file="other_recorder.json"),
+    "telemetry": dict(telemetry=True),
+    "telemetry_dir": dict(telemetry_dir="tmp_srkey_tel"),
+    "telemetry_every": dict(telemetry_every=2),
+    "telemetry_run_id": dict(telemetry_run_id="srkey-run"),
+    "telemetry_attempt": dict(telemetry_attempt=2),
+    "profile_trace_dir": dict(profile_trace_dir="tmp_srkey_trace"),
+    "snapshot_path": dict(snapshot_path="snap_{tenant}.npz"),
+    # companion kwarg required by __post_init__ validation; both
+    # fields are orchestration-classified, so the class invariants
+    # (key + scalars unchanged) still hold for the pair
+    "snapshot_every_dispatches": dict(
+        snapshot_every_dispatches=3, snapshot_path="snap_{tenant}.npz"
+    ),
+    "cache_capacity": dict(cache_capacity=128),
+    "data_policy": dict(data_policy="mask"),
+    "island_axis": dict(island_axis="isl"),
+    "row_axis": dict(row_axis="r"),
+    "tenant_axis": dict(tenant_axis="t"),
+}
+
+#: Eval-context fields whose perturbation must change the memo
+#: fingerprint — anything that can move a full-data loss VALUE (even in
+#: ULPs) or reinterpret program bytes. ``eval_backend`` uses "pallas"
+#: here, not ALT_SPECS' "jnp": the fingerprint RESOLVES "auto" (which
+#: lands on "jnp" for the small CPU rescore batch), so only the literal
+#: non-auto alternative actually exercises the coverage.
+FINGERPRINT_FIELDS: Tuple[Tuple[str, dict], ...] = (
+    ("binary_operators", ALT_SPECS["binary_operators"]),
+    ("unary_operators", ALT_SPECS["unary_operators"]),
+    ("loss", ALT_SPECS["loss"]),
+    ("loss_function", ALT_SPECS["loss_function"]),
+    ("precision", ALT_SPECS["precision"]),
+    ("eval_backend", dict(eval_backend="pallas")),
+    ("kernel_program", ALT_SPECS["kernel_program"]),
+    ("kernel_leaf_skip", ALT_SPECS["kernel_leaf_skip"]),
+    ("row_shards", ALT_SPECS["row_shards"]),
+    ("eval_rows_per_tile", ALT_SPECS["eval_rows_per_tile"]),
+    ("tenants", ALT_SPECS["tenants"]),
+)
+
+
+# ---------------------------------------------------------------------------
+# registry + AST coverage
+# ---------------------------------------------------------------------------
+
+
+def _registry(_override=None) -> Tuple[Tuple[str, ...], ...]:
+    """(graph, scalars, orchestration) — the declared classification.
+    ``_override`` substitutes an injected registry for the tests that
+    prove srkey fails on a broken one."""
+    if _override is not None:
+        return tuple(tuple(t) for t in _override)
+    from ..models.options import (
+        GRAPH_FIELDS,
+        ORCHESTRATION_FIELDS,
+        TRACED_SCALAR_FIELDS,
+    )
+
+    return GRAPH_FIELDS, TRACED_SCALAR_FIELDS, ORCHESTRATION_FIELDS
+
+
+def _registry_problems(graph, scalars, orch) -> List[str]:
+    from ..models.options import Options
+
+    problems: List[str] = []
+    declared: Dict[str, List[str]] = {}
+    for cls, fields in (
+        ("GRAPH_FIELDS", graph),
+        ("TRACED_SCALAR_FIELDS", scalars),
+        ("ORCHESTRATION_FIELDS", orch),
+    ):
+        for f in fields:
+            declared.setdefault(f, []).append(cls)
+    actual = {f.name for f in dataclasses.fields(Options)}
+    for f in sorted(actual - set(declared)):
+        problems.append(
+            f"field {f!r} is UNCLASSIFIED — declare it in exactly one "
+            "of GRAPH_FIELDS / TRACED_SCALAR_FIELDS / "
+            "ORCHESTRATION_FIELDS (models/options.py)"
+        )
+    for f, classes in sorted(declared.items()):
+        if f not in actual:
+            problems.append(
+                f"registry declares {f!r} ({', '.join(classes)}) but "
+                "Options has no such field"
+            )
+        elif len(classes) > 1:
+            problems.append(
+                f"field {f!r} is doubly classified: {', '.join(classes)}"
+            )
+    return problems
+
+
+def _graph_key_reads() -> List[str]:
+    """Every ``self.<attr>`` read in Options._graph_key, via AST."""
+    from ..models.options import Options
+
+    src = textwrap.dedent(inspect.getsource(Options._graph_key))
+    reads: List[str] = []
+    for node in ast.walk(ast.parse(src)):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        ):
+            reads.append(node.attr)
+    return reads
+
+
+def _coverage_problems(graph, scalars, orch) -> Tuple[List[str], dict]:
+    reads = set(_graph_key_reads())
+    problems: List[str] = []
+    missing = sorted(set(graph) - reads)
+    for f in missing:
+        problems.append(
+            f"graph field {f!r} is ABSENT from _graph_key — two Options "
+            "differing only in it would share a warm-compile bucket "
+            "compiled for the other's value"
+        )
+    foreign = sorted(reads & (set(scalars) | set(orch)))
+    for f in foreign:
+        cls = (
+            "traced-scalar" if f in set(scalars) else "orchestration"
+        )
+        problems.append(
+            f"{cls} field {f!r} is read in _graph_key — sweeping it "
+            "would recompile (scalars) or fragment warm-cache buckets "
+            "for a host-only knob (orchestration)"
+        )
+    detail = {
+        "reads": sorted(reads),
+        "missing_from_key": missing,
+        "foreign_in_key": foreign,
+    }
+    return problems, detail
+
+
+# ---------------------------------------------------------------------------
+# per-field key / scalar semantics
+# ---------------------------------------------------------------------------
+
+
+def _scalar_values(options) -> Tuple[float, ...]:
+    return tuple(float(v) for v in options.traced_scalars())
+
+
+def _semantics_problems(graph, scalars, orch) -> Tuple[List[str], dict]:
+    from ..models.options import make_options
+
+    problems: List[str] = []
+    base = make_options(**_BASE_KWARGS)
+    base_key = base._graph_key()
+    base_scalars = _scalar_values(base)
+    missing_specs: List[str] = []
+    checked = 0
+    for field in sorted(set(graph) | set(scalars) | set(orch)):
+        spec = ALT_SPECS.get(field)
+        if spec is None:
+            missing_specs.append(field)
+            problems.append(
+                f"no perturbation spec for field {field!r} in "
+                "analysis/keys.py ALT_SPECS — srkey cannot verify its "
+                "class"
+            )
+            continue
+        try:
+            alt = make_options(**{**_BASE_KWARGS, **spec})
+        except Exception as e:
+            problems.append(
+                f"perturbation spec for {field!r} failed to construct: "
+                f"{type(e).__name__}: {e}"
+            )
+            continue
+        if getattr(alt, field) == getattr(base, field):
+            problems.append(
+                f"perturbation spec for {field!r} does not change the "
+                f"field (still {getattr(base, field)!r})"
+            )
+            continue
+        checked += 1
+        key_changed = alt._graph_key() != base_key
+        scalars_changed = _scalar_values(alt) != base_scalars
+        if field in set(graph) and not key_changed:
+            problems.append(
+                f"graph field {field!r}: perturbation does NOT change "
+                "_graph_key — a warm bucket would serve a program "
+                "compiled for the other value"
+            )
+        elif field in set(scalars):
+            if key_changed:
+                problems.append(
+                    f"traced scalar {field!r}: perturbation changes "
+                    "_graph_key — sweeping it would recompile instead "
+                    "of re-binding the traced argument"
+                )
+            if not scalars_changed:
+                problems.append(
+                    f"traced scalar {field!r}: perturbation does not "
+                    "change traced_scalars() — the jitted program would "
+                    "never see the new value"
+                )
+        elif field in set(orch):
+            if key_changed:
+                problems.append(
+                    f"orchestration field {field!r}: perturbation "
+                    "changes _graph_key — a host-only knob is "
+                    "fragmenting warm-compile buckets"
+                )
+            if scalars_changed:
+                problems.append(
+                    f"orchestration field {field!r}: perturbation "
+                    "changes traced_scalars()"
+                )
+    detail = {"checked": checked, "missing_specs": missing_specs}
+    return problems, detail
+
+
+# ---------------------------------------------------------------------------
+# memo-fingerprint coverage
+# ---------------------------------------------------------------------------
+
+
+def _fingerprint_problems() -> Tuple[List[str], dict]:
+    import numpy as np
+
+    from ..cache.memo import dataset_fingerprint
+    from ..models.options import make_options
+
+    problems: List[str] = []
+    X = (
+        np.arange(_NFEAT * _NROWS, dtype=np.float32).reshape(
+            _NFEAT, _NROWS
+        )
+        / 7.0
+    )
+    y = np.arange(_NROWS, dtype=np.float32) / 3.0
+    base = make_options(**_BASE_KWARGS)
+    base_fp = dataset_fingerprint(X, y, None, base)
+    covered: List[str] = []
+    for field, spec in FINGERPRINT_FIELDS:
+        alt = make_options(**{**_BASE_KWARGS, **spec})
+        if dataset_fingerprint(X, y, None, alt) == base_fp:
+            problems.append(
+                f"eval-context field {field!r}: perturbation does NOT "
+                "change dataset_fingerprint — a shared memo bank could "
+                "serve losses computed under the other value"
+            )
+        else:
+            covered.append(field)
+    # the dataset itself is the other half of the fingerprint
+    y2 = y.copy()
+    y2[0] += 1.0
+    if dataset_fingerprint(X, y2, None, base) == base_fp:
+        problems.append(
+            "dataset bytes do NOT change dataset_fingerprint — two "
+            "different datasets would share a memo bank"
+        )
+    # ...and a pure-orchestration perturbation must NOT split banks
+    orch_spec: Dict[str, object] = {}
+    for f in _registry()[2]:
+        orch_spec.update(ALT_SPECS.get(f, {}))
+    alt = make_options(**{**_BASE_KWARGS, **orch_spec})
+    if dataset_fingerprint(X, y, None, alt) != base_fp:
+        problems.append(
+            "an all-orchestration perturbation changed "
+            "dataset_fingerprint — host-only knobs are fragmenting "
+            "memo banks"
+        )
+    detail = {"covered": covered, "dataset_bytes": True}
+    return problems, detail
+
+
+# ---------------------------------------------------------------------------
+# differential verification by tracing
+# ---------------------------------------------------------------------------
+
+
+def _clear_factory_caches() -> None:
+    """The api.py jit-factory lru_caches key on Options hash/eq — the
+    graph key — so a perturbed-orchestration Options HITS the base
+    entry, whose closure closes over the base options; a leak would be
+    invisible. Cleared before every trace set so each trace closes over
+    exactly its own Options."""
+    from .. import api
+
+    api._make_init_fn_cached.cache_clear()
+    api._make_iteration_fn_cached.cache_clear()
+    api._make_phase_fns_cached.cache_clear()
+
+
+def trace_programs(options) -> Dict[str, str]:
+    """Byte-comparable jaxpr text of every production program for one
+    Options: the per-stage decomposition (memory.build_stage_programs)
+    plus the fused whole-iteration jit."""
+    import jax
+
+    from ..api import _make_iteration_fn
+    from .compile_surface import _abstract_inputs
+    from .memory import build_stage_programs
+
+    _clear_factory_caches()
+    progs: Dict[str, str] = {}
+    for stage, (fn, args) in build_stage_programs(options).items():
+        progs[stage] = str(jax.make_jaxpr(fn)(*args))
+    I = options.npopulations
+    states, key, cm, X, y, bl, scalars, memo, _ = _abstract_inputs(
+        options, I
+    )
+    it_fn = _make_iteration_fn(options, False)
+    args = (states, key, cm, X, y, bl, scalars) + (
+        (memo,) if memo is not None else ()
+    )
+    progs["iteration"] = str(jax.make_jaxpr(it_fn)(*args))
+    return progs
+
+
+def _diff_stages(base: Dict[str, str], got: Dict[str, str]) -> List[str]:
+    return sorted(
+        s for s in base if got.get(s) != base[s]
+    ) + sorted(s for s in got if s not in base)
+
+
+def _merged_spec(fields: Sequence[str]) -> dict:
+    spec: Dict[str, object] = {}
+    for f in fields:
+        spec.update(ALT_SPECS.get(f, {}))
+    return spec
+
+
+def _bisect_culprits(
+    cfg_kwargs: dict, base_progs: Dict[str, str], fields: List[str]
+) -> List[str]:
+    """Group-halving search for the field(s) whose perturbation changes
+    a traced program — O(c·log n) trace sets for c culprits, run only
+    after the all-at-once set mismatched."""
+    from ..models.options import make_options
+
+    culprits: List[str] = []
+
+    def rec(group: List[str]) -> None:
+        if not group:
+            return
+        progs = trace_programs(
+            make_options(**{**cfg_kwargs, **_merged_spec(group)})
+        )
+        if not _diff_stages(base_progs, progs):
+            return
+        if len(group) == 1:
+            culprits.append(group[0])
+            return
+        mid = len(group) // 2
+        rec(group[:mid])
+        rec(group[mid:])
+
+    rec(list(fields))
+    return sorted(culprits)
+
+
+def _differential_problems(
+    configs: Tuple[Tuple[str, dict], ...], scalars, orch
+) -> Tuple[List[str], dict]:
+    from ..models.options import make_options
+
+    problems: List[str] = []
+    detail: Dict[str, dict] = {}
+    for name, extra in configs:
+        cfg_kwargs = {**_BASE_KWARGS, **extra}
+        base_progs = trace_programs(make_options(**cfg_kwargs))
+        entry = {
+            "stages": sorted(base_progs),
+            "orchestration_invariant": True,
+            "scalar_invariant": True,
+            "culprits": [],
+        }
+        # all orchestration knobs at once: one extra trace set on the
+        # green path; bisect to name culprits only on a mismatch
+        for cls_name, fields, flag in (
+            ("orchestration", [f for f in orch], "orchestration_invariant"),
+            ("traced-scalar", [f for f in scalars], "scalar_invariant"),
+        ):
+            alt = make_options(
+                **{**cfg_kwargs, **_merged_spec(fields)}
+            )
+            diff = _diff_stages(base_progs, trace_programs(alt))
+            if diff:
+                entry[flag] = False
+                culprits = _bisect_culprits(
+                    cfg_kwargs, base_progs, fields
+                )
+                entry["culprits"] += culprits
+                problems.append(
+                    f"{name}: {cls_name} perturbation changed traced "
+                    f"program(s) {diff} — leaking field(s): "
+                    f"{culprits or ['<interaction of several fields>']} "
+                    "(a warm-compile bucket would serve a graph "
+                    "compiled for another config's value)"
+                )
+        detail[name] = entry
+    return problems, detail
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+def check_keys(
+    configs: Optional[Tuple[Tuple[str, dict], ...]] = None,
+    trace: bool = True,
+    _override=None,
+) -> dict:
+    """Run the full srkey check; returns the report dict rendered by
+    report.render_keys_text (docs/static_analysis.md "srkey")."""
+    graph, scalars, orch = _registry(_override)
+    notes: List[str] = []
+    problems = _registry_problems(graph, scalars, orch)
+    result = {
+        "ok": False,
+        "problems": problems,
+        "notes": notes,
+        "fields": {
+            "graph": len(graph),
+            "traced_scalar": len(scalars),
+            "orchestration": len(orch),
+        },
+        "traced": False,
+    }
+    if problems:
+        # fail fast: coverage/semantics/differential against a broken
+        # registry would only repeat the same finding noisily
+        notes.append(
+            "registry is incomplete/inconsistent — key coverage, "
+            "semantics, fingerprint, and differential checks skipped"
+        )
+        return result
+
+    cov_problems, cov_detail = _coverage_problems(graph, scalars, orch)
+    problems += cov_problems
+    result["key_coverage"] = cov_detail
+
+    sem_problems, sem_detail = _semantics_problems(graph, scalars, orch)
+    problems += sem_problems
+    result["semantics"] = sem_detail
+
+    fp_problems, fp_detail = _fingerprint_problems()
+    problems += fp_problems
+    result["fingerprint"] = fp_detail
+
+    if trace:
+        diff_problems, diff_detail = _differential_problems(
+            tuple(configs if configs is not None else
+                  DEFAULT_TRACE_CONFIGS),
+            scalars, orch,
+        )
+        problems += diff_problems
+        result["configs"] = diff_detail
+        result["traced"] = True
+    else:
+        notes.append("differential tracing skipped (trace=False)")
+
+    result["ok"] = not problems
+    return result

@@ -210,6 +210,12 @@ class Linter:
         self.modules: List[ModuleInfo] = []
         self.violations: List[Violation] = []
         self._func_by_id: Dict[int, FuncInfo] = {}
+        # SR010: orchestration-classified Options field names, AST-
+        # extracted from the scanned modules' own top-level
+        # `ORCHESTRATION_FIELDS = (...)` tuple (models/options.py in the
+        # package scan; fixtures declare their own) — lint stays pure
+        # AST, nothing is imported
+        self.orchestration_fields: Set[str] = set()
 
     # -- loading --------------------------------------------------------
     def load(self, files: Optional[Sequence[str]] = None) -> "Linter":
@@ -241,6 +247,9 @@ class Linter:
             self.modules.append(mod)
             for info in mod.functions.values():
                 self._func_by_id[id(info)] = info
+            self.orchestration_fields |= _declared_orchestration_fields(
+                tree
+            )
         return self
 
     # -- resolution -----------------------------------------------------
@@ -527,11 +536,15 @@ class Linter:
             for info in mod.functions.values():
                 if id(info) in self.jit_reachable:
                     self._scan_jit_function(mod, info)
+                    self._scan_orchestration_reads(mod, info)
                 else:
                     # SR008 is about HOST code feeding synced values back
                     # into jitted entries; jit-reachable bodies are
                     # already covered by SR001
                     self._scan_host_roundtrip(mod, info)
+                # SR011 applies everywhere: key/fingerprint computations
+                # are host-side code by construction
+                self._scan_id_in_key(mod, info)
         self.violations.sort(key=lambda v: (v.path, v.line, v.rule_id))
         return self.violations
 
@@ -1014,6 +1027,91 @@ class Linter:
                 function=info.qualname,
             )
 
+    # SR010 ------------------------------------------------------------
+    #: receiver names treated as "an Options instance" for SR010 —
+    #: precision over recall: `options.seed` and `opts.verbosity` are
+    #: flagged, `args.seed` on some argparse namespace is not
+    _OPTIONS_RECEIVERS = {"options", "opts", "opt", "o"}
+
+    def _scan_orchestration_reads(
+        self, mod: ModuleInfo, info: FuncInfo
+    ) -> None:
+        """SR010: a read of an orchestration-classified options.<field>
+        inside jit-reachable code. Orchestration fields are absent from
+        Options._graph_key BY CONTRACT, so a traced read bakes the first
+        caller's value into a compiled graph that hash-equal Options
+        with a different value will share (rules.py SR010; the srkey
+        engine catches the same leak end-to-end by differential
+        tracing)."""
+        if not self.orchestration_fields:
+            return
+        for node in _own_body_nodes(info.node):
+            if not (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and node.attr in self.orchestration_fields
+            ):
+                continue
+            base = node.value
+            recv = (
+                base.id if isinstance(base, ast.Name)
+                else base.attr if isinstance(base, ast.Attribute)
+                else None
+            )
+            if recv is None or not (
+                recv in self._OPTIONS_RECEIVERS
+                or recv.lower().endswith("options")
+            ):
+                continue
+            self._add(
+                mod, node, "SR010",
+                f"{recv}.{node.attr} read in jit-reachable "
+                f"{info.qualname}(): {node.attr!r} is orchestration-"
+                "classified (absent from Options._graph_key), so the "
+                "first caller's value is baked into a compiled graph "
+                "that hash-equal Options with a different value will "
+                "share — hoist the read to the host loop, or reclassify "
+                "the field in models/options.py",
+                function=info.qualname,
+            )
+
+    # SR011 ------------------------------------------------------------
+    #: a function whose qualname mentions one of these is (heuristically)
+    #: computing an identity that may outlive its inputs
+    _KEYISH_NAME_PARTS = ("key", "hash", "fingerprint", "memo")
+
+    def _scan_id_in_key(self, mod: ModuleInfo, info: FuncInfo) -> None:
+        """SR011: builtin id() inside a hash/key/fingerprint/memo
+        computation. id() is only unique among live objects — once the
+        callable is collected the id is reused, so a key derived from it
+        can alias two distinct callables (rules.py SR011; fix with
+        models/options.py::callable_token)."""
+        low = info.qualname.lower()
+        if not any(p in low for p in self._KEYISH_NAME_PARTS):
+            return
+        for node in _own_body_nodes(info.node):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "id"
+                and len(node.args) == 1
+                and not node.keywords
+            ):
+                continue
+            func, full = self._resolve_target(info.scope, "id")
+            if func is not None or full != "id":
+                continue  # shadowed by a local def or an import
+            self._add(
+                mod, node, "SR011",
+                f"id(...) inside {info.qualname}(): ids are reused "
+                "after garbage collection, so an identity key derived "
+                "from id() can alias two distinct callables over the "
+                "process lifetime — use models/options.py::"
+                "callable_token (monotonic, pinned by a strong "
+                "reference) instead",
+                function=info.qualname,
+            )
+
 
 def _rebuilt_returned_params(info: FuncInfo) -> List[str]:
     """Parameters that are reassigned in the body AND reachable from a
@@ -1103,6 +1201,41 @@ def _literal_int_factor(node: ast.Call) -> Optional[int]:
             prod *= elt.value
         return prod
     return None
+
+
+def _own_body_nodes(node):
+    """Every AST node of a function, EXCLUDING nested def/lambda/class
+    subtrees (those are separate FuncInfos and scanned on their own)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        if isinstance(
+            n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                ast.ClassDef)
+        ):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _declared_orchestration_fields(tree: ast.Module) -> Set[str]:
+    """String elements of a top-level ``ORCHESTRATION_FIELDS = (...)``
+    assignment (SR010's vocabulary — models/options.py declares the real
+    one; lint fixtures declare their own)."""
+    out: Set[str] = set()
+    for stmt in tree.body:
+        if not (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and stmt.targets[0].id == "ORCHESTRATION_FIELDS"
+            and isinstance(stmt.value, (ast.Tuple, ast.List))
+        ):
+            continue
+        for elt in stmt.value.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.add(elt.value)
+    return out
 
 
 def _literal_str_seq(node) -> Optional[List[str]]:

@@ -15,7 +15,8 @@ JSON schema (schema_version 1):
       "violations": [Violation.to_dict(), ...],
       "surface": {...} | null,        # compile-surface section, if run
       "memory": {...} | null,         # srmem section, if run
-      "cost": {...} | null            # srcost section, if run
+      "cost": {...} | null,           # srcost section, if run
+      "keys": {...} | null            # srkey section, if run
     }
 """
 
@@ -35,6 +36,7 @@ class AnalysisReport:
     surface: Optional[dict] = None  # compile_surface.check_surface() output
     memory: Optional[dict] = None  # memory.check_memory() output
     cost: Optional[dict] = None  # cost.check_cost() output
+    keys: Optional[dict] = None  # keys.check_keys() output
 
     @property
     def active(self) -> List[Violation]:
@@ -49,6 +51,8 @@ class AnalysisReport:
         if self.memory is not None and not self.memory.get("ok", True):
             return False
         if self.cost is not None and not self.cost.get("ok", True):
+            return False
+        if self.keys is not None and not self.keys.get("ok", True):
             return False
         return True
 
@@ -69,6 +73,7 @@ class AnalysisReport:
             "surface": self.surface,
             "memory": self.memory,
             "cost": self.cost,
+            "keys": self.keys,
         }
 
     def to_json(self) -> str:
@@ -104,6 +109,8 @@ class AnalysisReport:
             lines.append(render_memory_text(self.memory))
         if self.cost is not None:
             lines.append(render_cost_text(self.cost))
+        if self.keys is not None:
+            lines.append(render_keys_text(self.keys))
         return "\n".join(lines)
 
 
@@ -217,6 +224,43 @@ def render_cost_text(cost: dict) -> str:
             if cost.get("baseline_match") else
             (" (baseline MISMATCH)" if cost.get("baseline_checked")
              else " (no baseline check)")
+        )
+    )
+    return "\n".join(lines)
+
+
+def render_keys_text(keys: dict) -> str:
+    lines: List[str] = []
+    for problem in keys.get("problems", []):
+        lines.append(f"srkey: {problem}")
+    for note in keys.get("notes", []):
+        lines.append(f"srkey: note: {note}")
+    configs = keys.get("configs", {})
+    for name in sorted(configs):
+        entry = configs[name]
+        verdicts = []
+        for label, flag in (
+            ("orchestration", "orchestration_invariant"),
+            ("scalar", "scalar_invariant"),
+        ):
+            verdicts.append(
+                f"{label} invariant" if entry.get(flag)
+                else f"{label} LEAKS"
+            )
+        culprits = entry.get("culprits") or []
+        lines.append(
+            f"srkey: {name}: {', '.join(verdicts)}"
+            + (f" (culprits: {', '.join(culprits)})" if culprits else "")
+        )
+    f = keys.get("fields", {})
+    status = "ok" if keys.get("ok", False) else "FAIL"
+    lines.append(
+        f"srkey: {status} — {f.get('graph', 0)} graph + "
+        f"{f.get('traced_scalar', 0)} traced-scalar + "
+        f"{f.get('orchestration', 0)} orchestration field(s)"
+        + (
+            f", differentially traced over {len(configs)} config(s)"
+            if keys.get("traced") else ", differential tracing skipped"
         )
     )
     return "\n".join(lines)

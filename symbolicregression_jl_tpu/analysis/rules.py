@@ -196,6 +196,46 @@ RULES: Dict[str, Rule] = {
                 "operators are written (docs/robustness_numeric.md)."
             ),
         ),
+        Rule(
+            id="SR010",
+            name="orchestration-field-in-jit",
+            summary=(
+                "read of an orchestration-classified options.<field> "
+                "(models/options.py ORCHESTRATION_FIELDS) in "
+                "jit-reachable code"
+            ),
+            rationale=(
+                "Orchestration fields are host-side by contract: they "
+                "are deliberately ABSENT from Options._graph_key, so "
+                "two Options differing only in one share a warm-compile "
+                "bucket and one lru-cached factory closure. A "
+                "jit-reachable read bakes the FIRST caller's value into "
+                "the shared compiled graph — every later config served "
+                "from that bucket silently runs with the wrong value "
+                "(the exact failure srkey's differential trace detects "
+                "end-to-end). Either the read belongs on the host loop, "
+                "or the field is misclassified and must move to "
+                "GRAPH_FIELDS / TRACED_SCALAR_FIELDS."
+            ),
+        ),
+        Rule(
+            id="SR011",
+            name="callable-id-in-key",
+            summary=(
+                "id() of a (possibly-callable) value used inside a "
+                "hash/key/fingerprint/memo computation"
+            ),
+            rationale=(
+                "CPython reuses id() after garbage collection: a key "
+                "derived from id(fn) can alias two DISTINCT callables "
+                "observed at different times — a warm-compile bucket or "
+                "memo fingerprint keyed that way serves results "
+                "compiled for a different custom loss. Key callables "
+                "with models/options.py::callable_token (a "
+                "process-lifetime monotonic token pinned by a strong "
+                "reference) instead."
+            ),
+        ),
     ]
 }
 

@@ -78,11 +78,29 @@ def dataset_fingerprint(X, y, weights, options) -> str:
         h.update(loss.encode())
     else:
         # a callable's name is not its identity (every lambda is
-        # '<lambda>'): key the live object. id() reuse after GC is the
-        # residual risk — acceptable for a cache scoped to one process,
-        # wrong for anything persisted.
-        h.update(f"callable:{getattr(loss, '__name__', '')}:{id(loss)}"
-                 .encode())
+        # '<lambda>'): key the live object via its process-lifetime
+        # token (models/options.py::callable_token). Raw id() would be
+        # reused after GC, letting a later distinct loss inherit a dead
+        # one's fingerprint (srlint SR011). Tokens are process-local —
+        # still wrong for anything persisted.
+        from ..models.options import callable_token
+
+        h.update(
+            f"callable:{getattr(loss, '__name__', '')}:"
+            f"{callable_token(loss)}".encode()
+        )
+    # a custom objective REPLACES the named loss at evaluation time but
+    # lives in its own field — it must split banks too (srkey
+    # fingerprint coverage caught this as a gap: two searches differing
+    # only in loss_function would otherwise share a bank)
+    if options.loss_function is not None:
+        from ..models.options import callable_token
+
+        h.update(
+            f"loss_function:"
+            f"{getattr(options.loss_function, '__name__', '')}:"
+            f"{callable_token(options.loss_function)}".encode()
+        )
     h.update(options.precision.encode())
     # different eval backends/kernel shapes may differ in reduction order
     # (interpreter vs Pallas, postfix vs instr): ULP-distinct contexts.

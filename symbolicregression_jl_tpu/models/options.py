@@ -146,6 +146,132 @@ TRACED_SCALAR_FIELDS = (
     "fraction_replaced_hof",
 )
 
+# --- the compile-identity contract (analysis/keys.py — srkey) ---------
+# Every Options field is declared in EXACTLY ONE of GRAPH_FIELDS /
+# TRACED_SCALAR_FIELDS / ORCHESTRATION_FIELDS; srkey fails the build on
+# any unclassified or doubly-classified field, and differentially
+# verifies each class against the traced programs:
+#
+#   GRAPH_FIELDS          compiled into the jitted search graph — part
+#                         of _graph_key (and hash/eq), so perturbing one
+#                         MUST change the key (new warm-compile bucket,
+#                         new lru-cached factory closure).
+#   TRACED_SCALAR_FIELDS  enter jit as traced f32 arguments
+#                         (traced_scalars/bind_scalars) — absent from
+#                         the key, absent from the traced graph.
+#   ORCHESTRATION_FIELDS  host-side only — perturbing one must leave
+#                         every traced program byte-identical AND the
+#                         key unchanged (jit-reachable code must never
+#                         read them: srlint SR010).
+GRAPH_FIELDS = (
+    "binary_operators",
+    "unary_operators",
+    "npopulations",
+    "npop",
+    "ncycles_per_iteration",
+    "tournament_selection_n",
+    "topn",
+    "maxsize",
+    "maxdepth",
+    "max_len",
+    "loss",
+    "loss_function",
+    "annealing",
+    "use_frequency",
+    "use_frequency_in_tournament",
+    "mutation_weights",
+    "crossover_probability",
+    "migration",
+    "hof_migration",
+    "should_optimize_constants",
+    "optimizer_algorithm",
+    "optimizer_probability",
+    "optimizer_nrestarts",
+    "optimizer_iterations",
+    "optimizer_backend",
+    "batching",
+    "batch_size",
+    "independent_island_batches",
+    "constraints",
+    "nested_constraints",
+    "complexity_of_operators",
+    "complexity_of_constants",
+    "complexity_of_variables",
+    "recorder",
+    "cache_fitness",
+    "cache_device_slots",
+    "n_parallel_tournaments",
+    "eval_backend",
+    "kernel_program",
+    "kernel_leaf_skip",
+    "eval_bucket_ladder",
+    "eval_rows_per_tile",
+    "max_cycles_per_dispatch",
+    "row_shards",
+    "precision",
+    "tenants",
+)
+
+ORCHESTRATION_FIELDS = (
+    "skip_mutation_failures",
+    "fast_cycle",
+    "warmup_maxsize_by",
+    "early_stop_condition",
+    "timeout_in_seconds",
+    "max_evals",
+    "seed",
+    "deterministic",
+    "verbosity",
+    "progress",
+    "output_file",
+    "save_to_file",
+    "terminal_width",
+    "define_helper_functions",
+    "recorder_file",
+    "telemetry",
+    "telemetry_dir",
+    "telemetry_every",
+    "telemetry_run_id",
+    "telemetry_attempt",
+    "profile_trace_dir",
+    "snapshot_path",
+    "snapshot_every_dispatches",
+    "cache_capacity",
+    "data_policy",
+    "island_axis",
+    "row_axis",
+    "tenant_axis",
+)
+
+
+# --- process-lifetime identity tokens for callable config values ------
+# `id()` is only unique among LIVE objects: after a callable is
+# garbage-collected its id is reused, so two DISTINCT custom losses
+# observed at different times could alias one warm-compile bucket or
+# one memo-bank fingerprint (srlint SR011). The registry hands each
+# callable a monotonically increasing token and keeps a STRONG
+# reference, so the id that keys the lookup can never be reused within
+# the process. Tokens are process-local, exactly like the ids they
+# replace — never persist them.
+_CALLABLE_TOKENS: Dict[int, int] = {}
+_CALLABLE_REFS: list = []
+
+
+def callable_token(fn: Callable) -> int:
+    """Stable process-lifetime identity token for a callable config
+    value (custom ``loss`` / ``loss_function``) — used by
+    ``Options._graph_key`` and ``cache.memo.dataset_fingerprint``
+    instead of ``id()``. The registered callable is pinned for the
+    process lifetime (a handful of user losses, not a leak vector)."""
+    tok = _CALLABLE_TOKENS.get(id(fn))
+    if tok is None:
+        tok = len(_CALLABLE_REFS)
+        _CALLABLE_TOKENS[id(fn)] = tok
+        # pin: if fn were collected, a new callable could reuse its id
+        # and inherit its token
+        _CALLABLE_REFS.append(fn)
+    return tok
+
 
 @dataclasses.dataclass(frozen=True, eq=False)
 class Options:
@@ -709,8 +835,13 @@ class Options:
             self.optimizer_probability, self.optimizer_nrestarts,
             self.optimizer_iterations, self.optimizer_algorithm,
             self.optimizer_backend,
-            str(self.loss) if not callable(self.loss) else id(self.loss),
-            None if self.loss_function is None else id(self.loss_function),
+            # callables are keyed by process-lifetime token, not id():
+            # ids are reused after GC, so two distinct custom losses
+            # could otherwise alias one warm-compile bucket (SR011)
+            str(self.loss) if not callable(self.loss)
+            else callable_token(self.loss),
+            None if self.loss_function is None
+            else callable_token(self.loss_function),
             # recorder mode adds the event-collection outputs to the graph
             self.recorder,
             # the dedup/memo scoring path and the device memo table shape

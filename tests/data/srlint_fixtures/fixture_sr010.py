@@ -1,0 +1,62 @@
+"""SR010 fixture: orchestration-classified options fields read inside
+jit-reachable code. Parsed by the linter, never imported — the fixture
+declares its own ORCHESTRATION_FIELDS vocabulary, exactly like
+models/options.py declares the real one."""
+
+import jax
+import jax.numpy as jnp
+
+ORCHESTRATION_FIELDS = (
+    "seed",
+    "verbosity",
+    "snapshot_path",
+)
+
+
+@jax.jit
+def bad_seed_read(x, options):
+    # VIOLATION SR010: a host-side knob read inside a traced body —
+    # the first caller's seed is baked into the shared compiled graph
+    return x + options.seed
+
+
+def _inner(x, opts):
+    # VIOLATION SR010 (reachable through traced_caller below); the
+    # `opts` receiver spelling is covered too
+    return x * opts.verbosity
+
+
+@jax.jit
+def traced_caller(x, opts):
+    return _inner(x, opts)
+
+
+@jax.jit
+def bad_attr_receiver(x, state):
+    # VIOLATION SR010: receiver resolved through an attribute chain
+    # ending in an options-ish name
+    return x + state.run_options.seed
+
+
+@jax.jit
+def good_graph_read(x, options):
+    # OK: maxsize is not orchestration-classified
+    return x[: options.maxsize]
+
+
+@jax.jit
+def good_other_receiver(x, args):
+    # OK: `args.seed` is some other object, not an Options
+    return x + args.seed
+
+
+@jax.jit
+def pragma_suppressed(x, options):
+    return x + options.seed  # srlint: disable=SR010 -- fixture pragma
+
+
+def host_only(x, options):
+    # OK: not jit-reachable — the host loop is where these belong
+    if options.verbosity > 0:
+        print("host", options.snapshot_path)
+    return jnp.asarray(x)

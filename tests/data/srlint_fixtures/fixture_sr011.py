@@ -1,0 +1,49 @@
+"""SR011 fixture: id() of a callable inside hash/key/fingerprint/memo
+computations. Parsed by the linter, never imported. SR011 applies to
+HOST code too (keys are computed on the host) — none of these functions
+needs to be jit-reachable to be flagged."""
+
+
+def graph_key(options):
+    # VIOLATION SR011: id() is reused after GC — two distinct losses
+    # can alias one warm-compile bucket
+    return (options.maxsize, id(options.loss))
+
+
+def dataset_fingerprint(loss):
+    # VIOLATION SR011: fingerprint keyed on a reusable id
+    return f"callable:{id(loss)}"
+
+
+class Bank:
+    def _memo_slot(self, fn):
+        # VIOLATION SR011: method form, "memo" in the qualname
+        return id(fn) % 1024
+
+
+def cache_hash(fn):
+    # VIOLATION SR011: "hash" in the qualname
+    return hash((id(fn), 7))
+
+
+def good_token_key(options, callable_token):
+    # OK: the process-lifetime token registry, not id()
+    return (options.maxsize, callable_token(options.loss))
+
+
+def ordinary_helper(fn):
+    # OK: id() outside any key/hash/fingerprint/memo computation
+    # (object-graph bookkeeping like lint.py's own FuncInfo index)
+    return id(fn)
+
+
+def shadowed_key(values):
+    # OK: `id` here is a local variable, not the builtin
+    def id(v):
+        return v
+
+    return id(values)
+
+
+def pragma_key(fn):
+    return id(fn)  # srlint: disable=SR011 -- fixture pragma

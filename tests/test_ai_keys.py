@@ -1,0 +1,416 @@
+"""srkey — the Options compile-identity contract checker (ISSUE 18).
+
+Covers: classification-registry completeness (and failure on injected
+holes), _graph_key AST coverage, per-field key/scalar semantics,
+memo-fingerprint coverage, the callable-token fix for the id()-reuse
+aliasing hazard (SR011), the SR010/SR011 lint rules on their fixtures,
+and the CLI wiring (`--only keys`, comma-separated engine subsets).
+
+The differential-tracing runs (every production program traced three
+times per config) are slow-marked; everything else is registry/AST/
+constructor work on CPU."""
+
+import gc
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from symbolicregression_jl_tpu.analysis import lint_paths
+from symbolicregression_jl_tpu.analysis.keys import (
+    ALT_SPECS,
+    _graph_key_reads,
+    check_keys,
+)
+from symbolicregression_jl_tpu.models.options import (
+    GRAPH_FIELDS,
+    ORCHESTRATION_FIELDS,
+    TRACED_SCALAR_FIELDS,
+    Options,
+    callable_token,
+    make_options,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "data", "srlint_fixtures")
+
+
+def _lint_fixture(name):
+    return lint_paths(
+        FIXTURES, files=[os.path.join(FIXTURES, name)], repo_root=REPO
+    )
+
+
+def _active(violations, rule=None):
+    return [
+        v for v in violations
+        if not v.suppressed and (rule is None or v.rule_id == rule)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# classification registry
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_registry_complete_and_disjoint():
+    import dataclasses
+
+    actual = {f.name for f in dataclasses.fields(Options)}
+    declared = (
+        set(GRAPH_FIELDS) | set(TRACED_SCALAR_FIELDS)
+        | set(ORCHESTRATION_FIELDS)
+    )
+    assert declared == actual
+    assert not set(GRAPH_FIELDS) & set(TRACED_SCALAR_FIELDS)
+    assert not set(GRAPH_FIELDS) & set(ORCHESTRATION_FIELDS)
+    assert not set(TRACED_SCALAR_FIELDS) & set(ORCHESTRATION_FIELDS)
+    # traced_scalars()' tuple IS the scalar registry, in order
+    assert len(TRACED_SCALAR_FIELDS) == len(
+        make_options(verbosity=0).traced_scalars()
+    )
+
+
+@pytest.mark.fast
+def test_injected_unclassified_field_fails_fast():
+    r = check_keys(
+        trace=False,
+        _override=(
+            tuple(f for f in GRAPH_FIELDS if f != "maxsize"),
+            TRACED_SCALAR_FIELDS,
+            ORCHESTRATION_FIELDS,
+        ),
+    )
+    assert not r["ok"]
+    assert any("UNCLASSIFIED" in p and "maxsize" in p for p in r["problems"])
+    # fail-fast: a broken registry skips the downstream checks
+    assert "semantics" not in r and r["traced"] is False
+
+
+@pytest.mark.fast
+def test_injected_double_classification_fails():
+    r = check_keys(
+        trace=False,
+        _override=(
+            GRAPH_FIELDS,
+            TRACED_SCALAR_FIELDS,
+            ORCHESTRATION_FIELDS + ("maxsize",),
+        ),
+    )
+    assert not r["ok"]
+    assert any("doubly classified" in p for p in r["problems"])
+
+
+@pytest.mark.fast
+def test_injected_unknown_field_fails():
+    r = check_keys(
+        trace=False,
+        _override=(
+            GRAPH_FIELDS + ("no_such_knob",),
+            TRACED_SCALAR_FIELDS,
+            ORCHESTRATION_FIELDS,
+        ),
+    )
+    assert not r["ok"]
+    assert any("no such field" in p for p in r["problems"])
+
+
+# ---------------------------------------------------------------------------
+# _graph_key coverage + per-field semantics (no tracing)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_graph_key_covers_exactly_the_graph_fields():
+    reads = set(_graph_key_reads())
+    assert set(GRAPH_FIELDS) <= reads
+    assert not reads & set(ORCHESTRATION_FIELDS)
+    assert not reads & set(TRACED_SCALAR_FIELDS)
+
+
+def test_check_keys_semantics_green_without_tracing():
+    r = check_keys(trace=False)
+    assert r["ok"], r["problems"]
+    assert r["semantics"]["missing_specs"] == []
+    # every classified field was perturbed and behaved per its class
+    assert r["semantics"]["checked"] == len(GRAPH_FIELDS) + len(
+        TRACED_SCALAR_FIELDS
+    ) + len(ORCHESTRATION_FIELDS)
+    # memo-fingerprint coverage ran too
+    assert "eval_backend" in r["fingerprint"]["covered"]
+    assert any("tracing skipped" in n for n in r["notes"])
+
+
+@pytest.mark.fast
+def test_every_field_has_a_perturbation_spec():
+    for field in (
+        GRAPH_FIELDS + TRACED_SCALAR_FIELDS + ORCHESTRATION_FIELDS
+    ):
+        assert field in ALT_SPECS, field
+
+
+@pytest.mark.fast
+def test_misclassified_orchestration_field_is_flagged():
+    # 'annealing' pretends to be orchestration: it is read in _graph_key
+    # (coverage) and its perturbation changes the key (semantics)
+    r = check_keys(
+        trace=False,
+        _override=(
+            tuple(f for f in GRAPH_FIELDS if f != "annealing"),
+            TRACED_SCALAR_FIELDS,
+            ORCHESTRATION_FIELDS + ("annealing",),
+        ),
+    )
+    assert not r["ok"]
+    assert any(
+        "annealing" in p and "_graph_key" in p for p in r["problems"]
+    )
+
+
+@pytest.mark.fast
+def test_misclassified_graph_field_is_flagged():
+    # 'seed' pretends to be graph: absent from the key AND its
+    # perturbation does not change the key
+    r = check_keys(
+        trace=False,
+        _override=(
+            GRAPH_FIELDS + ("seed",),
+            TRACED_SCALAR_FIELDS,
+            tuple(f for f in ORCHESTRATION_FIELDS if f != "seed"),
+        ),
+    )
+    assert not r["ok"]
+    assert any("seed" in p and "ABSENT" in p for p in r["problems"])
+    assert any(
+        "seed" in p and "does NOT change" in p for p in r["problems"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# callable_token: the SR011 fix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_callable_token_stable_and_distinct():
+    f = lambda x: x  # noqa: E731
+    g = lambda x: -x  # noqa: E731
+    assert callable_token(f) == callable_token(f)
+    assert callable_token(f) != callable_token(g)
+
+
+@pytest.mark.fast
+def test_callable_token_never_aliases_after_gc():
+    # the id()-reuse hazard: delete the first callable, allocate more —
+    # CPython may hand a new lambda the dead one's id(); the token
+    # registry pins a strong reference, so tokens never collide
+    tok1 = callable_token(lambda x: x + 1)
+    gc.collect()
+    tokens = {tok1}
+    for i in range(64):
+        t = callable_token(lambda x, i=i: x * i)
+        assert t not in tokens
+        tokens.add(t)
+        gc.collect()
+
+
+@pytest.mark.fast
+def test_graph_key_distinguishes_distinct_custom_losses():
+    f = lambda tree, X, y, w, o: 0.0  # noqa: E731
+    a = make_options(loss_function=f, verbosity=0)
+    del f
+    gc.collect()
+    g = lambda tree, X, y, w, o: 1.0  # noqa: E731
+    b = make_options(loss_function=g, verbosity=0)
+    assert a._graph_key() != b._graph_key()
+    # non-callable configs: same kwargs -> byte-identical keys
+    assert (
+        make_options(loss="L1DistLoss", verbosity=0)._graph_key()
+        == make_options(loss="L1DistLoss", verbosity=0)._graph_key()
+    )
+
+
+@pytest.mark.fast
+def test_memo_fingerprint_distinguishes_distinct_losses():
+    import numpy as np
+
+    from symbolicregression_jl_tpu.cache.memo import dataset_fingerprint
+
+    X = np.ones((2, 16), dtype=np.float32)
+    y = np.ones(16, dtype=np.float32)
+    f = lambda tree, X, y, w, o: 0.0  # noqa: E731
+    a = make_options(loss_function=f, verbosity=0)
+    fp_a = dataset_fingerprint(X, y, None, a)
+    assert fp_a == dataset_fingerprint(X, y, None, a)  # stable
+    del f
+    gc.collect()
+    g = lambda tree, X, y, w, o: 1.0  # noqa: E731
+    b = make_options(loss_function=g, verbosity=0)
+    assert fp_a != dataset_fingerprint(X, y, None, b)
+
+
+# ---------------------------------------------------------------------------
+# SR010 / SR011 lint rules
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_sr010_orchestration_read_in_jit_detected():
+    vs = _lint_fixture("fixture_sr010.py")
+    hits = _active(vs, "SR010")
+    assert len(hits) == 3, [v.to_dict() for v in vs]
+    assert {v.line for v in hits} == {20, 26, 38}
+    # reachable through the call graph, attribute receivers covered
+    assert any(v.function == "_inner" for v in hits)
+    assert not any(
+        v.function in ("good_graph_read", "good_other_receiver",
+                       "host_only")
+        for v in hits
+    )
+    sup = [v for v in vs if v.suppressed and v.rule_id == "SR010"]
+    assert len(sup) == 1 and sup[0].line == 55
+
+
+@pytest.mark.fast
+def test_sr011_callable_id_in_key_detected():
+    vs = _lint_fixture("fixture_sr011.py")
+    hits = _active(vs, "SR011")
+    assert len(hits) == 4, [v.to_dict() for v in vs]
+    assert {v.line for v in hits} == {10, 15, 21, 26}
+    # host code is NOT exempt, but non-keyish names and shadowed id are
+    assert not any(
+        v.function in ("ordinary_helper", "shadowed_key",
+                       "good_token_key")
+        for v in hits
+    )
+    sup = [v for v in vs if v.suppressed and v.rule_id == "SR011"]
+    assert len(sup) == 1 and sup[0].line == 49
+
+
+@pytest.mark.fast
+def test_package_clean_under_sr010_sr011():
+    from symbolicregression_jl_tpu.analysis import lint_package
+
+    vs = lint_package()
+    assert not _active(vs, "SR010"), [v.to_dict() for v in vs]
+    assert not _active(vs, "SR011"), [v.to_dict() for v in vs]
+
+
+# ---------------------------------------------------------------------------
+# report + CLI wiring
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_parse_only_accepts_comma_subsets():
+    import argparse
+
+    from symbolicregression_jl_tpu.analysis import _parse_only
+
+    assert _parse_only("keys") == frozenset({"keys"})
+    assert _parse_only("lint,keys") == frozenset({"lint", "keys"})
+    with pytest.raises(argparse.ArgumentTypeError):
+        _parse_only("bogus")
+    with pytest.raises(argparse.ArgumentTypeError):
+        _parse_only(",")
+
+
+@pytest.mark.fast
+def test_report_gates_on_keys_section():
+    from symbolicregression_jl_tpu.analysis import AnalysisReport
+
+    bad = AnalysisReport(keys={"ok": False, "problems": ["x"]})
+    assert bad.ok is False
+    good = AnalysisReport(keys={"ok": True, "problems": []})
+    assert good.ok is True
+    payload = json.loads(good.to_json())
+    assert payload["keys"] == {"ok": True, "problems": []}
+    text = AnalysisReport(keys={
+        "ok": True, "problems": [], "notes": [],
+        "fields": {"graph": 46, "traced_scalar": 8, "orchestration": 28},
+        "traced": True,
+        "configs": {"base": {
+            "orchestration_invariant": True, "scalar_invariant": True,
+            "culprits": [],
+        }},
+    }).to_text()
+    assert "srkey: ok" in text and "orchestration invariant" in text
+
+
+@pytest.mark.fast
+def test_cli_engine_subset_selection(monkeypatch, capsys):
+    import symbolicregression_jl_tpu.analysis as A
+    from symbolicregression_jl_tpu.analysis.__main__ import main
+
+    calls = {}
+
+    def fake_run(**kw):
+        calls.update(kw)
+        return A.AnalysisReport()
+
+    monkeypatch.setattr(A, "run_analysis", fake_run)
+    assert main(["--only", "lint,keys", "--format", "json"]) == 0
+    capsys.readouterr()
+    assert calls["lint"] and calls["keys"]
+    assert not (calls["surface"] or calls["memory"] or calls["cost"])
+
+
+# ---------------------------------------------------------------------------
+# differential tracing (slow: traces every production program 3x/config)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_check_keys_green_with_differential_tracing():
+    r = check_keys()
+    assert r["ok"], r["problems"]
+    assert r["traced"]
+    for name in ("base", "tenants2"):
+        entry = r["configs"][name]
+        assert entry["orchestration_invariant"], name
+        assert entry["scalar_invariant"], name
+        assert entry["culprits"] == []
+        # the fused iteration traces alongside every phased stage
+        assert "iteration" in entry["stages"]
+
+
+@pytest.mark.slow
+def test_differential_tracing_catches_injected_leak():
+    # misclassify 'annealing' as orchestration: the combined-orch trace
+    # must mismatch and the bisection must name exactly that field
+    r = check_keys(
+        configs=(("base", {}),),
+        _override=(
+            tuple(f for f in GRAPH_FIELDS if f != "annealing"),
+            TRACED_SCALAR_FIELDS,
+            ORCHESTRATION_FIELDS + ("annealing",),
+        ),
+    )
+    assert not r["ok"]
+    entry = r["configs"]["base"]
+    assert entry["orchestration_invariant"] is False
+    assert entry["culprits"] == ["annealing"]
+    assert any(
+        "changed traced program" in p and "annealing" in p
+        for p in r["problems"]
+    )
+
+
+@pytest.mark.slow
+def test_cli_only_keys_green():
+    """Acceptance: `python -m symbolicregression_jl_tpu.analysis --only
+    keys` exits 0 on the repo and reports the srkey JSON section."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "symbolicregression_jl_tpu.analysis",
+         "--only", "keys", "--format", "json"],
+        capture_output=True, text=True, cwd=REPO, timeout=870,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+    payload = json.loads(proc.stdout)
+    assert payload["keys"]["ok"] is True
+    assert payload["keys"]["traced"] is True
+    assert payload["surface"] is None and payload["memory"] is None
