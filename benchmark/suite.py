@@ -1112,8 +1112,8 @@ def bench_fitness_cache():
 def bench_static_analysis():
     """Static-analysis gate as a suite case (ISSUEs 3+4): srlint
     violation count, compile-surface baseline status, the srmem
-    HBM-footprint gate, the srkey Options-contract gate, and
-    docs/api_reference.md drift, via
+    HBM-footprint gate, the srkey Options-contract gate, the srshard
+    sharding-contract gate, and docs/api_reference.md drift, via
     scripts/lint.py --format json in its own subprocess (the gate pins
     CPU for itself; this case never needs the device)."""
     import subprocess
@@ -1126,12 +1126,12 @@ def bench_static_analysis():
     try:
         proc = subprocess.run(
             [sys.executable, script, "--format", "json"],
-            capture_output=True, text=True, timeout=1100,
+            capture_output=True, text=True, timeout=2700,
         )
     except subprocess.TimeoutExpired:
         return [{
             "suite": "static_analysis",
-            "error": "lint.py timed out after 1100s",
+            "error": "lint.py timed out after 2700s",
             "seconds": round(time.time() - t0, 1),
         }]
     seconds = round(time.time() - t0, 1)
@@ -1149,6 +1149,7 @@ def bench_static_analysis():
     memory = payload.get("memory") or {}
     cost = payload.get("cost") or {}
     keys = payload.get("keys") or {}
+    shard = payload.get("shard") or {}
     docs = payload.get("docs") or {}
     tele = payload.get("telemetry_schema") or {}
     mem_configs = memory.get("configs", {})
@@ -1210,6 +1211,25 @@ def bench_static_analysis():
                 e.get("orchestration_invariant", False)
                 for e in (keys.get("configs") or {}).values()
             ) if keys.get("traced") else None,
+        },
+        {
+            "suite": "static_analysis",
+            "case": "srshard",
+            "ok": shard.get("ok", False),
+            "configs": len(shard.get("configs", {})),
+            "baseline_match": shard.get("baseline_match", False),
+            "problems": len(shard.get("problems", [])),
+            # the three headline invariants the sharding contract gates
+            # on: no collective crosses a tenant boundary, no carry leaf
+            # silently replicates, and the modeled comms share of the
+            # worst stage stays a fraction (not the bottleneck)
+            "cross_tenant_collectives": shard.get(
+                "cross_tenant_collectives"
+            ),
+            "max_replication_factor": shard.get(
+                "max_replication_factor"
+            ),
+            "comms_fraction": shard.get("comms_fraction"),
         },
         {
             "suite": "static_analysis",
@@ -1355,7 +1375,7 @@ def bench_pallas_bucketed():
 # with a device-fault history (r04/r03), and even in its own process it
 # is the longest.
 _CASES = [
-    (bench_static_analysis, 1200),
+    (bench_static_analysis, 2900),
     (bench_eval_fixed_tree, 600),
     (bench_single_eval_48_nodes, 600),
     (bench_population_scoring, 600),

@@ -1,13 +1,18 @@
 #!/usr/bin/env python
 """Repo-wide static-analysis gate: srlint + compile-surface + srmem HBM
-gate + srcost analytic-cost gate + srkey Options-contract gate + doc
-drift.
+gate + srcost analytic-cost gate + srkey Options-contract gate + srshard
+sharding-contract gate + doc drift.
 
 The one command CI (and benchmark/suite.py's `static_analysis` case) runs:
 
     python scripts/lint.py [--format text|json]
-        [--only lint|surface|memory|cost|keys[,...]]
+        [--only lint|surface|memory|cost|keys|shard[,...]]
         [--update-baseline] [--hbm-budget-gb G] [--xla-memory] [--skip-docs]
+
+srshard (like compile-surface's `sharded` config) is skip-aware: on a
+host without 8 devices every mesh config reports `skipped`, the run
+stays green against the checked-in shard_baseline.json, and a refresh
+never writes skipped entries (skipped != missing).
 
 Wraps `python -m symbolicregression_jl_tpu.analysis` and adds the
 doc-drift check: docs/api_reference.md must be exactly what
@@ -240,6 +245,7 @@ def main(argv=None) -> int:
         memory=ns.only is None or "memory" in ns.only,
         cost=ns.only is None or "cost" in ns.only,
         keys=ns.only is None or "keys" in ns.only,
+        shard=ns.only is None or "shard" in ns.only,
         update_baseline=ns.update_baseline,
         hbm_budget_gb=ns.hbm_budget_gb,
         xla_memory=ns.xla_memory,

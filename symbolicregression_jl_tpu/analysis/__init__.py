@@ -1,8 +1,8 @@
 """Static analysis for the TPU hot path: srlint + compile-surface checker
 + srmem HBM-footprint analyzer + srcost cost model + srkey contract
-checker.
+checker + srshard sharding-contract checker.
 
-Five engines, one CLI (``python -m symbolicregression_jl_tpu.analysis``):
+Six engines, one CLI (``python -m symbolicregression_jl_tpu.analysis``):
 
 - **srlint** (lint.py / rules.py): a JAX-aware AST linter that builds a
   call graph rooted at the package's ``jax.jit`` entry points and flags
@@ -30,6 +30,16 @@ Five engines, one CLI (``python -m symbolicregression_jl_tpu.analysis``):
   graph fields, and (by differential tracing of the production programs)
   that orchestration fields never leak into jitted graphs while traced
   scalars re-bind without recompiling.
+- **srshard** (shard.py): the SPMD sharding-contract checker — AOT-lowers
+  the production stage programs and the fused iteration over a matrix of
+  8-device meshes (1x8 / 2x4 / 4x2 islands x rows, plus a 2x4
+  tenants x islands serving mesh), walks the compiled shardings to
+  assert the island/tenant contract end-to-end, flags replication
+  blowups by leaf name, proves the tenant axis stays collective-free
+  (bisecting any leak to the culprit leaf), and prices every collective
+  with a ring model over tabled ICI bandwidths — gated against the
+  checked-in ``shard_baseline.json`` (census drift or >10% comm-byte
+  growth fails).
 
 See docs/static_analysis.md for the rule catalog and workflows.
 """
@@ -56,7 +66,7 @@ __all__ = [
 ]
 
 #: The engine names ``--only`` accepts (comma-separated subsets).
-ENGINES = ("lint", "surface", "memory", "cost", "keys")
+ENGINES = ("lint", "surface", "memory", "cost", "keys", "shard")
 
 
 def _parse_only(text: str):
@@ -108,13 +118,13 @@ def add_engine_args(parser) -> None:
         "--only", type=_parse_only, default=None,
         metavar="ENGINE[,ENGINE...]",
         help="run a subset of engines, comma-separated (choices: "
-        + ", ".join(ENGINES) + "; default: all five)",
+        + ", ".join(ENGINES) + "; default: all six)",
     )
     parser.add_argument(
         "--update-baseline", action="store_true",
         help="rewrite the checked-in baselines (compile_baseline.json / "
-        "memory_baseline.json / cost_baseline.json) for the engines "
-        "being run, instead of diffing against them",
+        "memory_baseline.json / cost_baseline.json / shard_baseline.json"
+        ") for the engines being run, instead of diffing against them",
     )
     parser.add_argument(
         "--hbm-budget-gb", type=float, default=None, metavar="G",
@@ -135,15 +145,17 @@ def run_analysis(
     memory: bool = True,
     cost: bool = True,
     keys: bool = True,
+    shard: bool = True,
     update_baseline: bool = False,
     hbm_budget_gb: Optional[float] = None,
     xla_memory: bool = False,
 ) -> AnalysisReport:
     """Run srlint / the compile-surface checker / srmem / srcost / srkey
-    on this repo.
+    / srshard on this repo.
 
-    Importing compile_surface, memory, cost, or keys pulls in jax;
-    callers that only lint stay AST-only (no backend initialization)."""
+    Importing compile_surface, memory, cost, keys, or shard pulls in
+    jax; callers that only lint stay AST-only (no backend
+    initialization)."""
     report = AnalysisReport()
     if lint:
         report.violations = lint_package()
@@ -170,4 +182,8 @@ def run_analysis(
         from .keys import check_keys
 
         report.keys = check_keys()
+    if shard:
+        from .shard import check_shard
+
+        report.shard = check_shard(update_baseline=update_baseline)
     return report

@@ -1,8 +1,8 @@
 """CLI for the static-analysis subsystem.
 
     python -m symbolicregression_jl_tpu.analysis [--format text|json]
-        [--only lint|surface|memory|cost|keys[,...]] [--update-baseline]
-        [--hbm-budget-gb G] [--xla-memory]
+        [--only lint|surface|memory|cost|keys|shard[,...]]
+        [--update-baseline] [--hbm-budget-gb G] [--xla-memory]
 
 ``--only`` accepts a comma-separated subset (``--only lint,keys``).
 Exit status: 0 when clean, 1 on violations / surface problems / HBM
@@ -24,7 +24,8 @@ def main(argv=None) -> int:
         prog="python -m symbolicregression_jl_tpu.analysis",
         description="srlint + compile-surface checker + srmem "
         "HBM-footprint gate + srcost analytic cost gate + srkey "
-        "Options-contract checker (docs/static_analysis.md)",
+        "Options-contract checker + srshard sharding-contract gate "
+        "(docs/static_analysis.md)",
     )
     add_engine_args(ap)
     ns = ap.parse_args(argv)
@@ -36,6 +37,7 @@ def main(argv=None) -> int:
         memory=ns.only is None or "memory" in ns.only,
         cost=ns.only is None or "cost" in ns.only,
         keys=ns.only is None or "keys" in ns.only,
+        shard=ns.only is None or "shard" in ns.only,
         update_baseline=ns.update_baseline,
         hbm_budget_gb=ns.hbm_budget_gb,
         xla_memory=ns.xla_memory,

@@ -55,6 +55,7 @@ class FuncInfo:
     has_var_kwargs: bool
     scope: "Scope"
     is_jit_root: bool = False
+    is_batched_body: bool = False  # passed to jax.vmap / lax.scan / lax.map
     callees: Set[int] = dataclasses.field(default_factory=set)  # id(FuncInfo)
 
     @property
@@ -288,14 +289,26 @@ class Linter:
     # -- jit roots + call edges ----------------------------------------
     _JIT_NAMES = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
     _PARTIAL_NAMES = {"functools.partial", "partial"}
+    # transforms whose first function argument becomes a BATCHED body
+    # (SR012: sharding constraints inside reference dims the batched
+    # trace cannot see)
+    _BATCH_NAMES = {"jax.vmap", "jax.lax.scan", "jax.lax.map"}
 
     def build_graph(self) -> None:
         for mod in self.modules:
             self._walk_calls(mod)
         # BFS over callee edges from jit roots
-        frontier = [
+        self.jit_reachable: Set[int] = self._reach(
             f for f in self._func_by_id.values() if f.is_jit_root
-        ]
+        )
+        # SR012: everything reachable from a vmap/scan/map body runs
+        # under the batching transform
+        self.batched_reachable: Set[int] = self._reach(
+            f for f in self._func_by_id.values() if f.is_batched_body
+        )
+
+    def _reach(self, roots) -> Set[int]:
+        frontier = list(roots)
         reachable: Set[int] = set(id(f) for f in frontier)
         while frontier:
             f = frontier.pop()
@@ -303,7 +316,7 @@ class Linter:
                 if cid not in reachable:
                     reachable.add(cid)
                     frontier.append(self._func_by_id[cid])
-        self.jit_reachable: Set[int] = reachable
+        return reachable
 
     def _walk_calls(self, mod: ModuleInfo) -> None:
         linter = self
@@ -416,6 +429,11 @@ class Linter:
                 wrapped.is_jit_root = True
                 self._check_static_argnames(mod, node, wrapped)
                 self._check_donation(mod, node, wrapped, node)
+        # vmap(f)/scan(body, ...)/map(f, ...): f becomes a batched body
+        if full in self._BATCH_NAMES and node.args:
+            body = self._funcinfo_of_expr(scope, mod, node.args[0])
+            if body is not None:
+                body.is_batched_body = True
         # function-valued arguments (vmap/scan/tree_map/closures)
         for arg in list(node.args) + [kw.value for kw in node.keywords]:
             f = self._funcinfo_of_expr(scope, mod, arg)
@@ -545,6 +563,8 @@ class Linter:
                 # SR011 applies everywhere: key/fingerprint computations
                 # are host-side code by construction
                 self._scan_id_in_key(mod, info)
+                if id(info) in self.batched_reachable:
+                    self._scan_sharding_in_batched(mod, info)
         self.violations.sort(key=lambda v: (v.path, v.line, v.rule_id))
         return self.violations
 
@@ -1109,6 +1129,75 @@ class Linter:
                 "process lifetime — use models/options.py::"
                 "callable_token (monotonic, pinned by a strong "
                 "reference) instead",
+                function=info.qualname,
+            )
+
+    # SR012 ------------------------------------------------------------
+    _SHARDING_CALLS = {
+        "jax.lax.with_sharding_constraint":
+            "jax.lax.with_sharding_constraint",
+        "jax.experimental.pjit.with_sharding_constraint":
+            "with_sharding_constraint",
+        "jax.sharding.NamedSharding": "NamedSharding",
+        "jax.NamedSharding": "NamedSharding",
+    }
+
+    def _scan_sharding_in_batched(
+        self, mod: ModuleInfo, info: FuncInfo
+    ) -> None:
+        """SR012: with_sharding_constraint / NamedSharding inside a
+        vmapped/scanned body whose mesh comes from OUTSIDE the function
+        (a free variable, not a parameter or local): the constraint
+        names axes against dims the batched trace cannot see (rules.py
+        SR012 — the static form of api.py's inner_mesh=None rule).
+        Mesh-as-parameter is exempt: the caller decides whether a mesh
+        exists (migration.py's pin_replicated pattern)."""
+        local_stores = {
+            n.id for n in _own_body_nodes(info.node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+        }
+        calls = [
+            (n, self._SHARDING_CALLS.get(
+                self._canonical(info.scope, n.func) or ""
+            ))
+            for n in _own_body_nodes(info.node)
+            if isinstance(n, ast.Call)
+        ]
+        # a NamedSharding nested inside a with_sharding_constraint call
+        # is the same finding — report the constraint once
+        inside_constraint = {
+            id(sub)
+            for n, short in calls if short and short != "NamedSharding"
+            for sub in ast.walk(n) if sub is not n
+        }
+        for node, short in calls:
+            if short is None:
+                continue
+            if short == "NamedSharding" and id(node) in inside_constraint:
+                continue
+            free_meshes = sorted({
+                n.id
+                for arg in list(node.args)
+                + [kw.value for kw in node.keywords]
+                for n in ast.walk(arg)
+                if isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and "mesh" in n.id.lower()
+                and n.id not in info.params
+                and n.id not in local_stores
+            })
+            if not free_meshes:
+                continue
+            self._add(
+                mod, node, "SR012",
+                f"{short}(...) referencing outer mesh "
+                f"{', '.join(free_meshes)} inside batched body "
+                f"{info.qualname}() (reachable from jax.vmap/lax.scan/"
+                "lax.map): the constraint names mesh axes against dims "
+                "the batched trace cannot see — hoist placement to the "
+                "enclosing jit's in/out shardings, or pass the mesh as "
+                "a parameter so the caller can thread None under vmap "
+                "(api.py's inner_mesh rule)",
                 function=info.qualname,
             )
 

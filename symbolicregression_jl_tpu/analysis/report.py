@@ -16,7 +16,8 @@ JSON schema (schema_version 1):
       "surface": {...} | null,        # compile-surface section, if run
       "memory": {...} | null,         # srmem section, if run
       "cost": {...} | null,           # srcost section, if run
-      "keys": {...} | null            # srkey section, if run
+      "keys": {...} | null,           # srkey section, if run
+      "shard": {...} | null           # srshard section, if run
     }
 """
 
@@ -37,6 +38,7 @@ class AnalysisReport:
     memory: Optional[dict] = None  # memory.check_memory() output
     cost: Optional[dict] = None  # cost.check_cost() output
     keys: Optional[dict] = None  # keys.check_keys() output
+    shard: Optional[dict] = None  # shard.check_shard() output
 
     @property
     def active(self) -> List[Violation]:
@@ -53,6 +55,8 @@ class AnalysisReport:
         if self.cost is not None and not self.cost.get("ok", True):
             return False
         if self.keys is not None and not self.keys.get("ok", True):
+            return False
+        if self.shard is not None and not self.shard.get("ok", True):
             return False
         return True
 
@@ -74,6 +78,7 @@ class AnalysisReport:
             "memory": self.memory,
             "cost": self.cost,
             "keys": self.keys,
+            "shard": self.shard,
         }
 
     def to_json(self) -> str:
@@ -111,6 +116,8 @@ class AnalysisReport:
             lines.append(render_cost_text(self.cost))
         if self.keys is not None:
             lines.append(render_keys_text(self.keys))
+        if self.shard is not None:
+            lines.append(render_shard_text(self.shard))
         return "\n".join(lines)
 
 
@@ -261,6 +268,62 @@ def render_keys_text(keys: dict) -> str:
         + (
             f", differentially traced over {len(configs)} config(s)"
             if keys.get("traced") else ", differential tracing skipped"
+        )
+    )
+    return "\n".join(lines)
+
+
+def render_shard_text(shard: dict) -> str:
+    lines: List[str] = []
+    for problem in shard.get("problems", []):
+        lines.append(f"srshard: {problem}")
+    for note in shard.get("notes", []):
+        lines.append(f"srshard: note: {note}")
+    configs = shard.get("configs", {})
+    for name in sorted(configs):
+        entry = configs[name]
+        if "skipped" in entry:
+            lines.append(f"srshard: {name}: skipped ({entry['skipped']})")
+            continue
+        shape = "x".join(
+            str(s) for s in (entry.get("mesh_shape") or {}).values()
+        )
+        n_coll = sum(
+            sum(s.get("collectives", {}).values())
+            for s in entry.get("stages", {}).values()
+        )
+        comm = sum(
+            s.get("comm_bytes", 0)
+            for s in entry.get("stages", {}).values()
+        )
+        line = (
+            f"srshard: {name}: mesh {shape}, "
+            f"{len(entry.get('stages', {}))} stage(s), {n_coll} "
+            f"collective(s), {_mb(comm)} comm"
+        )
+        fused = entry.get("fused")
+        if fused:
+            line += (
+                f"; fused {sum(fused['collectives'].values())} "
+                f"collective(s), {_mb(fused['comm_bytes'])} comm, "
+                f"comms share {fused['comms_fraction'] * 100:.1f}%, "
+                f"max replication x{fused['max_replication_factor']:g}"
+            )
+        lines.append(line)
+    status = "ok" if shard.get("ok", False) else "FAIL"
+    cross = shard.get("cross_tenant_collectives", 0)
+    lines.append(
+        f"srshard: {status} — {len(configs)} config(s), "
+        + (
+            "zero cross-tenant collectives"
+            if not cross else f"{cross} CROSS-TENANT collective(s)"
+        )
+        + f", max replication x{shard.get('max_replication_factor', 0):g}"
+        + (
+            " (baseline match)"
+            if shard.get("baseline_match") else
+            (" (baseline MISMATCH)" if shard.get("baseline_checked")
+             else " (no baseline check)")
         )
     )
     return "\n".join(lines)

@@ -236,6 +236,31 @@ RULES: Dict[str, Rule] = {
                 "reference) instead."
             ),
         ),
+        Rule(
+            id="SR012",
+            name="sharding-constraint-in-batched-body",
+            summary=(
+                "with_sharding_constraint / NamedSharding construction "
+                "inside a vmapped or scanned body referencing an outer "
+                "mesh object"
+            ),
+            rationale=(
+                "A sharding constraint inside a jax.vmap / lax.scan / "
+                "lax.map body names mesh axes against array dims the "
+                "BATCHED trace cannot see: the constraint either "
+                "crashes on rank mismatch or silently pins the wrong "
+                "dims once the batching transform inserts the leading "
+                "axis. Placement for a batched program belongs on the "
+                "jit's in/out shardings (api.py threads inner_mesh=None "
+                "into the tenant-vmapped iteration for exactly this "
+                "reason, and srshard's constraint census asserts the "
+                "compiled tenant body carries zero "
+                "sharding_constraint primitives). Helpers that take the "
+                "mesh as a PARAMETER are exempt — their callers decide "
+                "whether a mesh exists (parallel/migration.py's "
+                "pin_replicated pattern)."
+            ),
+        ),
     ]
 }
 

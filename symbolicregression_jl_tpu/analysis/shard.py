@@ -1,0 +1,1140 @@
+"""srshard — static SPMD sharding-contract checker with a communication
+cost model and a replication-blowup gate (the sixth analysis engine).
+
+The island model became a compiled mesh contract in the multi-chip work
+(``P('islands')`` over every IslandState carry leaf; ``P('tenants',
+'islands')`` once serving batched tenants in front) — but until this
+engine the only static guard was compile_surface's flat collective
+census on one 8-device mesh, and nothing modeled what those collectives
+COST or noticed a carry leaf silently falling back to full replication.
+srshard AOT-lowers the production stage programs
+(``analysis.memory.build_stage_programs``) and the fused iteration over
+a matrix of forced-host device meshes and checks three things, all
+trace/compile-only (nothing executes):
+
+- **sharding contract, structurally**: the compiled output/input
+  shardings are walked leaf-by-leaf — every IslandState carry leaf must
+  carry the island (and tenant) axis end-to-end, the merged HallOfFame
+  comes back replicated (per-tenant on a tenant mesh), the memo
+  snapshot slot is replicated in the shard vocabulary, and the jaxpr
+  constraint census is mode-correct (the solo fused program carries the
+  migration/HoF-merge replicated pins; the tenant-batched program
+  carries ZERO ``sharding_constraint`` primitives — the ``inner_mesh =
+  None`` rule SR012 enforces statically);
+- **replication blowups**: any compiled output leaf whose per-device
+  footprint exceeds a threshold multiple of what the contract's
+  expected sharding would give is flagged BY NAME — the "GSPMD gave up
+  and all-gathered the population" failure srmem cannot see because it
+  models one device;
+- **tenant isolation + communication pricing**: tenants are
+  embarrassingly parallel, so a collective whose replica groups mix
+  tenant coordinates AND can combine tenant values (any data
+  all-reduce / reduce-scatter / all-to-all / collective-permute) is a
+  correctness leak — decoded from the optimized HLO's replica groups
+  (iota and brace forms) and bisected to the culprit output leaf by
+  group-halving, srkey-style. Two GSPMD artifacts are exempt as
+  structurally value-preserving (``cross_tenant_collectives``
+  docstring): cross-tenant all-gathers (replication data movement,
+  still priced + census-gated + bounded by the replication gate) and
+  the 1-byte ``pred[]`` all-reduce of SPMD while-loop condition
+  convergence. Every
+  collective is additionally priced (payload bytes x a ring-model
+  factor over a tabled ICI bandwidth) and joined with srcost's
+  per-stage compute numbers into a modeled comms-vs-compute fraction
+  per stage, gated against the checked-in ``shard_baseline.json``
+  (census drift or >10% comm-byte growth fails; same writer/refresh
+  workflow as the other baselines).
+
+Mesh matrix (8 forced-host devices, ``analysis.pin_platform``):
+``mesh1x8`` / ``mesh2x4`` / ``mesh4x2`` (islands x rows) and
+``tenants2x4`` (tenants x islands). Compile cost is the budget here —
+the fused iteration costs ~1 min per mesh on the CI host and the cycle/
+mutate stage programs ~40s each — so coverage is tiered EXPLICITLY
+(never silently): the canonical ``mesh4x2`` compiles every stage plus
+the fused iteration; the other island meshes compile the cheap
+comm-bearing stage set; ``tenants2x4`` compiles the fused tenant
+program (the zero-cross-tenant gate) plus the cheap stages vmapped over
+the tenant axis. The skipped stages are recorded in each config's
+``stage_set`` and called out as notes.
+
+Hosts with fewer than 8 devices skip every config (skipped != missing:
+skipped entries are never written into the baseline and never fail the
+diff — the same discipline as compile_surface's ``sharded`` config).
+
+CLI: ``python -m symbolicregression_jl_tpu.analysis --only shard
+[--update-baseline]`` (docs/static_analysis.md, docs/multichip.md).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .compile_surface import (
+    _BASE_KWARGS,
+    _NFEAT,
+    _NROWS,
+    _abstract_inputs,
+    count_primitives,
+)
+
+BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "shard_baseline.json"
+)
+
+#: Comm-byte growth beyond this fraction of the baseline fails CI
+#: (shrinks only note a refresh) — same tolerance as srmem/srcost.
+REGRESSION_TOLERANCE = 0.10
+
+#: A compiled output leaf holding more than this multiple of its
+#: contract-expected per-device bytes is a replication blowup.
+REPLICATION_BLOWUP_FACTOR = 1.5
+
+#: Leaves below this global size are exempt from replication accounting
+#: (scalars and tiny counters are replicated by design).
+_REPLICATION_MIN_BYTES = 1024
+
+#: The device kind the comms/compute fractions are modeled against.
+#: Fixed — NOT the host's own kind — so the modeled numbers are
+#: deterministic across CI hosts (a CPU host "models a v5e pod slice").
+MODEL_DEVICE_KIND = "v5e"
+
+#: One-way aggregate inter-chip-interconnect bandwidth per chip,
+#: bytes/s — coarse public anchors, the same scale-anchor convention as
+#: telemetry/profile.py's TPU_PEAKS (substring-matched, longest key
+#: first). These price the collectives' wire time in the modeled
+#: comms-vs-compute fraction; they are scale anchors, not promises.
+ICI_BANDWIDTH: Dict[str, float] = {
+    "v5 lite": 2.0e11,
+    "v5e": 2.0e11,
+    "v5p": 6.0e11,
+    "v6 lite": 4.5e11,
+    "v6e": 4.5e11,
+    "v4": 3.0e11,
+    "v3": 8.2e10,
+    "v2": 6.2e10,
+}
+
+#: Fallback for host interconnect (multi-host DCN / forced-host CPU
+#: devices): a 100Gb NIC — pessimistic on purpose, so a collective that
+#: would ride DCN instead of ICI prices loudly.
+HOST_INTERCONNECT_BYTES_PER_S = 1.25e10
+
+#: Ring-model wire factors per collective: the fraction of the payload
+#: each participant moves over the interconnect for a group of size g.
+_RING_FACTORS: Dict[str, Callable[[int], float]] = {
+    "all-gather": lambda g: (g - 1) / g,
+    "all-reduce": lambda g: 2.0 * (g - 1) / g,
+    "reduce-scatter": lambda g: (g - 1) / g,
+    "all-to-all": lambda g: (g - 1) / g,
+    "collective-permute": lambda g: 1.0,
+}
+
+_COLLECTIVE_OPS = (
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
+    "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8, "c64": 8, "c128": 16,
+}
+
+_SHAPE_RE = re.compile(r"([a-z]+\d*)\[([0-9,]*)\]")
+
+#: srshard's Options base: compile_surface's matrix kwargs at 8 islands,
+#: so every mesh in the matrix tiles 8 devices exactly.
+_SHARD_KWARGS = dict(_BASE_KWARGS, npopulations=8)
+
+#: The cheap comm-bearing stage subset (each compiles in seconds on the
+#: CI host; cycle/mutate cost ~40s each and ride the canonical mesh).
+_CHEAP_STAGES = ("init", "eval", "simplify", "optimize", "merge_migrate")
+_ALL_STAGES = (
+    "init", "cycle", "mutate", "eval", "simplify", "optimize",
+    "merge_migrate",
+)
+
+#: The canonical config: full stage set + the fused iteration, and the
+#: per-stage comms fractions srprof's report joins against.
+CANONICAL_CONFIG = "mesh4x2"
+
+#: (name, extra Options kwargs, stage subset, compile the fused jit?).
+#: Mesh shape falls out of make_mesh: 8 islands with row_shards r give
+#: an (8/r, r) (islands, rows) mesh; tenants=2 gives (2, 4)
+#: (tenants, islands).
+_MESH_MATRIX: Tuple[Tuple[str, dict, Tuple[str, ...], bool], ...] = (
+    ("mesh1x8", dict(row_shards=8), _CHEAP_STAGES, False),
+    ("mesh2x4", dict(row_shards=4), _CHEAP_STAGES, False),
+    ("mesh4x2", dict(row_shards=2), _ALL_STAGES, True),
+    ("tenants2x4", dict(tenants=2), _CHEAP_STAGES, True),
+)
+
+#: Per-stage in_shardings, written in the search_shardings vocabulary
+#: (parallel/mesh.py) so ONE table serves both mesh modes: on a solo
+#: (islands, rows) mesh ``tenant`` aliases ``replicated`` and these are
+#: exactly the production specs; on a (tenants, islands) mesh every
+#: name composes with the leading tenant axis. Keyed by the
+#: build_stage_programs argument order.
+_STAGE_ARG_SPECS: Dict[str, Tuple[str, ...]] = {
+    "init": ("island", "x", "rows", "tenant", "replicated"),
+    "cycle": ("island", "replicated", "x", "rows", "tenant", "replicated"),
+    "mutate": ("island", "replicated", "replicated"),
+    "eval": ("island", "x", "rows", "tenant", "replicated"),
+    "simplify": (
+        "island", "replicated", "x", "rows", "tenant", "replicated"
+    ),
+    "optimize": ("island", "island", "x", "rows", "tenant", "replicated"),
+    "merge_migrate": ("tenant", "island", "replicated"),
+}
+
+#: vmap in_axes per stage for the tenant-batched variants (the leading
+#: tenants dim rides on everything per-tenant; curmaxsize and the
+#: traced-scalar knobs are shared across the bucket).
+_TENANT_STAGE_AXES: Dict[str, Tuple] = {
+    "init": (0, 0, 0, 0, None),
+    "cycle": (0, None, 0, 0, 0, None),
+    "mutate": (0, None, None),
+    "eval": (0, 0, 0, 0, None),
+    "simplify": (0, None, 0, 0, 0, None),
+    "optimize": (0, 0, 0, 0, 0, None),
+    "merge_migrate": (0, 0, None),
+}
+
+
+# ---------------------------------------------------------------------------
+# HLO collective parsing
+# ---------------------------------------------------------------------------
+
+
+def _decode_iota_groups(
+    ngroups: int, gsize: int, dims: Sequence[int],
+    perm: Optional[Sequence[int]],
+) -> List[List[int]]:
+    """Decode HLO's iota replica-group form
+    ``[ngroups,gsize]<=[dims]T(perm)``: iota over ``dims``, transpose by
+    ``perm``, flatten, reshape to (ngroups, gsize). Example:
+    ``[4,2]<=[2,4]T(1,0)`` -> ``[[0,4],[1,5],[2,6],[3,7]]``."""
+    import numpy as np
+
+    n = 1
+    for d in dims:
+        n *= int(d)
+    arr = np.arange(n).reshape(tuple(int(d) for d in dims))
+    if perm is not None:
+        arr = np.transpose(arr, tuple(int(p) for p in perm))
+    return arr.reshape(ngroups, gsize).tolist()
+
+
+_BRACE_GROUPS_RE = re.compile(r"replica_groups=\{((?:\{[^}]*\},?)*)\}")
+_IOTA_GROUPS_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([0-9,]+)\](?:T\(([0-9,]+)\))?"
+)
+_PAIRS_RE = re.compile(r"source_target_pairs=\{((?:\{[^}]*\},?)*)\}")
+
+
+def _participant_groups(attrs: str, n_devices: int) -> List[List[int]]:
+    """Participant groups of one collective instruction's attribute
+    text. ``replica_groups={}`` (and an absent attribute) mean one group
+    of all participants; collective-permute's source_target_pairs count
+    as 2-participant groups."""
+    m = _IOTA_GROUPS_RE.search(attrs)
+    if m:
+        dims = [int(x) for x in m.group(3).split(",")]
+        perm = (
+            [int(x) for x in m.group(4).split(",")] if m.group(4) else None
+        )
+        return _decode_iota_groups(int(m.group(1)), int(m.group(2)),
+                                   dims, perm)
+    m = _BRACE_GROUPS_RE.search(attrs)
+    if m:
+        groups = [
+            [int(x) for x in g.split(",") if x.strip()]
+            for g in re.findall(r"\{([^}]*)\}", m.group(1))
+        ]
+        groups = [g for g in groups if g]
+        if groups:
+            return groups
+        return [list(range(n_devices))]
+    m = _PAIRS_RE.search(attrs)
+    if m:
+        pairs = [
+            [int(x) for x in g.split(",") if x.strip()]
+            for g in re.findall(r"\{([^}]*)\}", m.group(1))
+        ]
+        return [p for p in pairs if p]
+    return [list(range(n_devices))]
+
+
+def _result_bytes(result_text: str) -> int:
+    """Payload bytes of one collective: the largest shape in the result
+    portion (async ``-start`` results are (operand, output) tuples — the
+    output is never smaller than what moves on the wire per rank)."""
+    best = 0
+    for dtype, dims in _SHAPE_RE.findall(result_text):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        best = max(best, n * _DTYPE_BYTES.get(dtype, 4))
+    return best
+
+
+def parse_collectives(hlo_text: str, n_devices: int) -> List[dict]:
+    """Structured census of the cross-device collectives in optimized
+    HLO text: ``[{"op", "bytes", "groups"}, ...]``. Counts each async
+    pair once (by its ``-start`` half) — the compile_surface
+    collective_census convention, with payloads and decoded participant
+    groups on top."""
+    out: List[dict] = []
+    for line in hlo_text.splitlines():
+        eq = line.find(" = ")
+        if eq < 0:
+            continue
+        for op in _COLLECTIVE_OPS:
+            idx = -1
+            for tok in (f" {op}(", f" {op}-start("):
+                idx = line.find(tok, eq)
+                if idx >= 0:
+                    break
+            if idx < 0:
+                continue
+            out.append({
+                "op": op,
+                "bytes": _result_bytes(line[eq + 3:idx]),
+                "groups": _participant_groups(line[idx:], n_devices),
+            })
+            break
+    return out
+
+
+def census_of(collectives: List[dict]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for c in collectives:
+        counts[c["op"]] = counts.get(c["op"], 0) + 1
+    return dict(sorted(counts.items()))
+
+
+# ---------------------------------------------------------------------------
+# communication cost model
+# ---------------------------------------------------------------------------
+
+
+def interconnect_bandwidth(device_kind: str) -> float:
+    """ICI bytes/s for a device kind (substring match, longest key
+    first), or the host-interconnect fallback."""
+    low = (device_kind or "").lower()
+    for key in sorted(ICI_BANDWIDTH, key=len, reverse=True):
+        if key in low:
+            return ICI_BANDWIDTH[key]
+    return HOST_INTERCONNECT_BYTES_PER_S
+
+
+def price_comms(
+    collectives: List[dict], device_kind: str = MODEL_DEVICE_KIND
+) -> dict:
+    """Ring-model wire time of a collective census:
+    ``{"comm_bytes", "modeled_s"}``. comm_bytes is the raw payload sum
+    (the deterministic, table-independent quantity the baseline gates);
+    modeled_s prices each payload by its ring factor at the group size
+    over the tabled bandwidth."""
+    bw = interconnect_bandwidth(device_kind)
+    total = 0
+    seconds = 0.0
+    for c in collectives:
+        g = max((len(grp) for grp in c["groups"]), default=1)
+        total += int(c["bytes"])
+        seconds += c["bytes"] * _RING_FACTORS[c["op"]](max(g, 1)) / bw
+    return {"comm_bytes": int(total), "modeled_s": seconds}
+
+
+def comms_fraction(modeled_comms_s: float, flops: float) -> float:
+    """Modeled comms share of one program's wall time against
+    MODEL_DEVICE_KIND's compute rate: comms_s / (comms_s + compute_s)."""
+    from ..telemetry.profile import TPU_PEAKS
+
+    compute_s = flops / TPU_PEAKS[MODEL_DEVICE_KIND]["flops_per_s"]
+    denom = modeled_comms_s + compute_s
+    return (modeled_comms_s / denom) if denom > 0 else 0.0
+
+
+# ---------------------------------------------------------------------------
+# tenant isolation
+# ---------------------------------------------------------------------------
+
+
+def cross_tenant_collectives(
+    collectives: List[dict], n_island_shards: int
+) -> List[dict]:
+    """The collectives whose participant groups mix tenant coordinates
+    AND can leak one tenant's values into another's results.
+    Participant k of a compiled (tenants, islands)-mesh program is
+    ``mesh.devices.ravel()[k]`` (C order), so its tenant coordinate is
+    ``k // n_island_shards``.
+
+    Two GSPMD artifacts are structurally benign and exempt (both appear
+    in the real tenant-batched iteration, whose per-tenant bit-identity
+    to solo runs is pinned by tests/test_serving.py):
+
+    - **all-gather** — pure data movement: every participant's shard is
+      preserved verbatim, never arithmetically combined, so a tenant's
+      math can only consume its own slices back. GSPMD emits one when
+      it replicates an intermediate it declines to partition (e.g. the
+      constant-optimizer ``top_k`` operand); the payload still rides
+      the priced census and the replication gate bounds the blowup.
+    - **scalar-predicate all-reduce** (1-byte payload: ``pred[]``) —
+      SPMD ``while``-loop condition convergence: every device on the
+      mesh must agree on the loop predicate, so XLA and-reduces it
+      across ALL devices by construction. Control flow, not data.
+
+    Everything else crossing the tenant axis — any all-reduce of real
+    data (the injected-``psum`` defect class), reduce-scatter,
+    all-to-all, collective-permute — is a correctness leak."""
+    bad = []
+    for c in collectives:
+        if c["op"] == "all-gather":
+            continue
+        if c["op"] == "all-reduce" and c["bytes"] <= 1:
+            continue
+        for g in c["groups"]:
+            if len({p // n_island_shards for p in g}) > 1:
+                bad.append(c)
+                break
+    return bad
+
+
+def _bisect_tenant_culprits(
+    compile_hlo: Callable[[Tuple[int, ...]], str],
+    n_leaves: int,
+    n_island_shards: int,
+    n_devices: int,
+) -> List[int]:
+    """Group-halving bisection (the srkey pattern) over output-leaf
+    indices: ``compile_hlo(idxs)`` compiles the program restricted to
+    those output leaves; any subset still emitting a cross-tenant
+    collective recurses into its halves until single leaves are named.
+    O(c log n) compiles for c culprits."""
+    culprits: List[int] = []
+
+    def bad(idxs: Tuple[int, ...]) -> bool:
+        colls = parse_collectives(compile_hlo(idxs), n_devices)
+        return bool(cross_tenant_collectives(colls, n_island_shards))
+
+    def rec(idxs: Tuple[int, ...]) -> None:
+        if not bad(idxs):
+            return
+        if len(idxs) == 1:
+            culprits.append(idxs[0])
+            return
+        mid = len(idxs) // 2
+        rec(idxs[:mid])
+        rec(idxs[mid:])
+
+    rec(tuple(range(n_leaves)))
+    return culprits
+
+
+# ---------------------------------------------------------------------------
+# structural contract + replication accounting
+# ---------------------------------------------------------------------------
+
+
+def _aval_bytes(aval) -> int:
+    n = 1
+    for d in getattr(aval, "shape", ()):
+        n *= int(d)
+    return n * int(aval.dtype.itemsize)
+
+
+def _shard_bytes(sharding, aval) -> int:
+    n = 1
+    for d in sharding.shard_shape(tuple(aval.shape)):
+        n *= int(d)
+    return n * int(aval.dtype.itemsize)
+
+
+def _replication_stats(
+    name: str,
+    out_avals,
+    out_shardings,
+    expected_shardings,
+    n_devices: int,
+    factor: float = REPLICATION_BLOWUP_FACTOR,
+) -> Tuple[List[str], float]:
+    """(problems, max_replication_factor) of a compiled program's
+    outputs. A leaf whose actual per-device bytes exceed ``factor`` x
+    the contract-expected per-device bytes is flagged by its pytree
+    path; the returned max factor is ``n_devices * shard_bytes /
+    global_bytes`` over all non-tiny leaves (1.0 = fully sharded,
+    n_devices = fully replicated)."""
+    import jax
+
+    problems: List[str] = []
+    max_factor = 0.0
+    aval_leaves = jax.tree_util.tree_flatten_with_path(out_avals)[0]
+    sh_leaves = jax.tree_util.tree_leaves(
+        out_shardings, is_leaf=lambda x: hasattr(x, "shard_shape")
+    )
+    exp_leaves = jax.tree_util.tree_leaves(
+        expected_shardings, is_leaf=lambda x: hasattr(x, "shard_shape")
+    )
+    if not (len(aval_leaves) == len(sh_leaves) == len(exp_leaves)):
+        return (
+            [f"{name}: output sharding tree has {len(sh_leaves)} leaves "
+             f"vs {len(aval_leaves)} avals / {len(exp_leaves)} expected "
+             "— the replication gate no longer covers the outputs"],
+            0.0,
+        )
+    for (path, aval), sh, exp in zip(aval_leaves, sh_leaves, exp_leaves):
+        g = _aval_bytes(aval)
+        if g < _REPLICATION_MIN_BYTES:
+            continue
+        got_b = _shard_bytes(sh, aval)
+        max_factor = max(max_factor, n_devices * got_b / g)
+        want_b = _shard_bytes(exp, aval)
+        if want_b > 0 and got_b > factor * want_b:
+            problems.append(
+                f"{name}: replication blowup on output leaf"
+                f"{jax.tree_util.keystr(path)} — {got_b} bytes/device "
+                f"where the contract shards it to {want_b} "
+                f"(x{got_b / want_b:.1f}; sharding {sh.spec} vs expected "
+                f"{exp.spec}) — GSPMD fell back toward replication"
+            )
+    return problems, max_factor
+
+
+def _fused_contract_problems(
+    name: str, options, compiled, states_aval, tenant_mode: bool
+) -> List[str]:
+    """Walk the compiled fused iteration's output AND input shardings:
+    carry leaves island-sharded (tenant+island on a tenant mesh) in and
+    out, the merged HoF replicated (per-tenant on a tenant mesh)."""
+    import jax
+
+    problems: List[str] = []
+    try:
+        out_sh = compiled.output_shardings
+        in_sh = compiled.input_shardings[0]
+    except Exception as e:  # pragma: no cover - jax API variance
+        return [f"{name}: could not read compiled shardings: {e}"]
+    st_sh, ghof_sh = out_sh[0], out_sh[1]
+    n_sh = len(jax.tree_util.tree_leaves(st_sh))
+    n_aval = len(jax.tree_util.tree_leaves(states_aval))
+    if n_sh != n_aval:
+        problems.append(
+            f"{name}: compiled output-sharding tree has {n_sh} leaves "
+            f"but the IslandState aval has {n_aval} — the contract "
+            "check no longer covers the carry"
+        )
+
+    def check_carry(tag: str, tree) -> None:
+        for path, sh in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            spec = tuple(getattr(sh, "spec", ()) or ())
+            ok = (
+                spec[:2] == (options.tenant_axis, options.island_axis)
+                if tenant_mode else
+                bool(spec) and spec[0] == options.island_axis
+            )
+            if not ok:
+                problems.append(
+                    f"{name}: {tag} IslandState leaf"
+                    f"{jax.tree_util.keystr(path)} has sharding {sh} "
+                    "instead of island-axis sharding — a replicated "
+                    "carry serializes every later iteration on one "
+                    "device"
+                )
+
+    check_carry("carried", st_sh)
+    check_carry("input", in_sh[0])
+    for path, sh in jax.tree_util.tree_flatten_with_path(ghof_sh)[0]:
+        spec = tuple(getattr(sh, "spec", ()) or ())
+        ok = (
+            spec[:1] == (options.tenant_axis,) if tenant_mode
+            else sh.is_fully_replicated
+        )
+        if not ok:
+            problems.append(
+                f"{name}: merged HoF leaf{jax.tree_util.keystr(path)} "
+                f"is not {'tenant-sharded' if tenant_mode else 'replicated'}"
+                f" ({sh}) — host-side candidate extraction would gather "
+                "per-iteration"
+            )
+    return problems
+
+
+def _memo_vocabulary_problems(name: str, mesh, options_kwargs: dict
+                              ) -> List[str]:
+    """The memo snapshot's place in the shard vocabulary, checked
+    without compiling: the cache-enabled iteration signature must take
+    the memo replicated (every device serves hits locally) and emit the
+    absorb snapshot island-sharded."""
+    from ..api import _iteration_shard_kw
+    from ..models.options import make_options
+
+    cache_opts = make_options(
+        **{**options_kwargs, "cache_fitness": True,
+           "cache_device_slots": 8}
+    )
+    kw = _iteration_shard_kw(cache_opts, mesh, False)
+    problems: List[str] = []
+    memo_in = kw["in_shardings"][-1]
+    absorb_out = kw["out_shardings"][-1]
+    if not memo_in.is_fully_replicated:
+        problems.append(
+            f"{name}: memo snapshot input spec is {memo_in.spec} — the "
+            "contract replicates it (every device serves memo hits "
+            "locally)"
+        )
+    spec = tuple(absorb_out.spec or ())
+    if not spec or spec[0] != cache_opts.island_axis:
+        problems.append(
+            f"{name}: absorb snapshot output spec is {spec} — the "
+            "contract shards it over the island axis"
+        )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# program compilation
+# ---------------------------------------------------------------------------
+
+
+def _stage_in_shardings(stage: str, sh: dict):
+    return tuple(sh[k] for k in _STAGE_ARG_SPECS[stage])
+
+
+def _solo_stage_programs(options, stage_set: Sequence[str]) -> Dict:
+    from .memory import build_stage_programs
+
+    progs = build_stage_programs(options)
+    return {s: progs[s] for s in stage_set}
+
+
+def _tenant_stage_programs(options, stage_set: Sequence[str]) -> Dict:
+    """The tenant-batched stage variants: each solo stage program
+    vmapped over the leading tenants axis with its per-argument in_axes,
+    traced at (T, ...) avals — the stage decomposition of the serving
+    fused program."""
+    import dataclasses
+
+    import jax
+
+    from .memory import build_stage_programs
+
+    T = options.tenants
+    solo = dataclasses.replace(options, tenants=1)
+    progs = build_stage_programs(solo)
+    out: Dict = {}
+    for stage in stage_set:
+        fn, args = progs[stage]
+        axes = _TENANT_STAGE_AXES[stage]
+        targs = tuple(
+            jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct((T,) + l.shape, l.dtype),
+                a,
+            ) if ax == 0 else a
+            for a, ax in zip(args, axes)
+        )
+        out[stage] = (jax.vmap(fn, in_axes=axes), targs)
+    return out
+
+
+def _check_stage(
+    name: str,
+    stage: str,
+    fn,
+    args,
+    mesh,
+    options,
+    stage_flops: float,
+    tenant_mode: bool,
+) -> Tuple[dict, List[str]]:
+    """AOT-compile one stage program under its contract in_shardings and
+    return its entry (census, priced comms, replication report) plus any
+    problems (cross-tenant collectives on a tenant mesh)."""
+    import jax
+
+    from ..parallel.mesh import search_shardings
+
+    sh = search_shardings(mesh, options)
+    in_sh = _stage_in_shardings(stage, sh)
+    compiled = (
+        jax.jit(fn, in_shardings=in_sh).lower(*args).compile()
+    )
+    n_dev = int(mesh.devices.size)
+    colls = parse_collectives(compiled.as_text(), n_dev)
+    priced = price_comms(colls)
+    outs = jax.eval_shape(fn, *args)
+    # report-only replication factor over the stage outputs (GSPMD
+    # chooses them freely; the fused program is where the contract pins)
+    _, max_factor = _replication_stats(
+        f"{name}.{stage}", outs, compiled.output_shardings,
+        compiled.output_shardings, n_dev,
+    )
+    problems: List[str] = []
+    if tenant_mode:
+        n_islands = int(mesh.devices.shape[1])
+        for c in cross_tenant_collectives(colls, n_islands):
+            problems.append(
+                f"{name}.{stage}: CROSS-TENANT {c['op']} "
+                f"({c['bytes']} bytes, groups {c['groups']}) — tenants "
+                "are embarrassingly parallel; a collective crossing the "
+                "tenant axis is a correctness leak"
+            )
+    entry = {
+        "collectives": census_of(colls),
+        "comm_bytes": priced["comm_bytes"],
+        "modeled_comms_s": priced["modeled_s"],
+        "comms_fraction": round(
+            comms_fraction(priced["modeled_s"], stage_flops), 6
+        ),
+        "max_replication_factor": round(max_factor, 3),
+    }
+    return entry, problems
+
+
+def _check_fused(
+    name: str, options, mesh, tenant_mode: bool, compute_flops: float
+) -> Tuple[dict, List[str]]:
+    """The fused production iteration on this mesh: structural sharding
+    contract, replication-blowup gate against the contract's expected
+    out shardings, constraint-primitive census, collective census +
+    pricing, and (tenant mesh) the zero-cross-tenant gate with
+    leaf-level bisection on failure."""
+    import jax
+
+    from ..api import _iteration_shard_kw, _make_iteration_fn
+
+    problems: List[str] = []
+    I = options.npopulations
+    states, key, cm, X, y, bl, scalars, memo, _ = _abstract_inputs(
+        options, I
+    )
+    it_fn = _make_iteration_fn(options, False, mesh=mesh)
+    args = (states, key, cm, X, y, bl, scalars)
+    outs = jax.eval_shape(it_fn, *args)
+    compiled = it_fn.lower(*args).compile()
+    n_dev = int(mesh.devices.size)
+    colls = parse_collectives(compiled.as_text(), n_dev)
+    priced = price_comms(colls)
+    if not colls:
+        problems.append(
+            f"{name}: the partitioned fused iteration compiled to ZERO "
+            "cross-device collectives — the islands axis was "
+            "partitioned away (migration/HoF-merge no longer "
+            "communicate)"
+        )
+
+    problems += _fused_contract_problems(
+        name, options, compiled, states, tenant_mode
+    )
+    shard_kw = _iteration_shard_kw(options, mesh, False)
+    isl, ten = shard_kw["out_shardings"][0], shard_kw["out_shardings"][1]
+    expected = (
+        jax.tree_util.tree_map(lambda _: isl, outs[0]),
+        jax.tree_util.tree_map(lambda _: ten, outs[1]),
+    )
+    rep_problems, max_factor = _replication_stats(
+        name, (outs[0], outs[1]),
+        (compiled.output_shardings[0], compiled.output_shardings[1]),
+        expected, n_dev,
+    )
+    problems += rep_problems
+
+    # constraint census: the solo fused program must carry the
+    # migration/HoF-merge replicated pins; the tenant-batched body must
+    # carry NONE (the inner_mesh=None rule — SR012's runtime complement)
+    n_constraints = count_primitives(
+        jax.make_jaxpr(it_fn)(*args)
+    ).get("sharding_constraint", 0)
+    if tenant_mode and n_constraints:
+        problems.append(
+            f"{name}: {n_constraints} sharding_constraint primitive(s) "
+            "inside the tenant-batched iteration — constraints inside "
+            "the vmapped body name axes the tenant program cannot see "
+            "(the inner_mesh=None rule; lint rule SR012)"
+        )
+    elif not tenant_mode and not n_constraints:
+        problems.append(
+            f"{name}: the solo fused iteration carries no "
+            "sharding_constraint primitives — the migration topn-pool / "
+            "merged-HoF replicated pins vanished (parallel/migration.py)"
+        )
+
+    cross_tenant = 0
+    if tenant_mode:
+        n_islands = int(mesh.devices.shape[1])
+        bad = cross_tenant_collectives(colls, n_islands)
+        cross_tenant = len(bad)
+        if bad:
+            flat_out_sh = jax.tree_util.tree_leaves(
+                compiled.output_shardings,
+                is_leaf=lambda x: hasattr(x, "shard_shape"),
+            )
+            leaf_paths = [
+                jax.tree_util.keystr(p)
+                for p, _ in jax.tree_util.tree_flatten_with_path(outs)[0]
+            ]
+
+            def compile_hlo(idxs: Tuple[int, ...]) -> str:
+                f = lambda *a: tuple(  # noqa: E731
+                    jax.tree_util.tree_leaves(it_fn(*a))[i] for i in idxs
+                )
+                return (
+                    jax.jit(
+                        f,
+                        out_shardings=tuple(flat_out_sh[i] for i in idxs),
+                    )
+                    .lower(*args).compile().as_text()
+                )
+
+            culprits = _bisect_tenant_culprits(
+                compile_hlo, len(leaf_paths), n_islands, n_dev
+            )
+            ops = ", ".join(
+                f"{c['op']} ({c['bytes']} bytes)" for c in bad
+            )
+            problems.append(
+                f"{name}: {len(bad)} CROSS-TENANT collective(s) in the "
+                f"fused iteration — {ops}; bisected culprit leaf(s): "
+                + ", ".join(leaf_paths[i] for i in culprits)
+            )
+
+    entry = {
+        "collectives": census_of(colls),
+        "comm_bytes": priced["comm_bytes"],
+        "modeled_comms_s": priced["modeled_s"],
+        "comms_fraction": round(
+            comms_fraction(priced["modeled_s"], compute_flops), 6
+        ),
+        "max_replication_factor": round(max_factor, 3),
+        "sharding_constraints": int(n_constraints),
+        "cross_tenant_collectives": int(cross_tenant),
+    }
+    return entry, problems
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def _baseline_entry(entry: dict) -> dict:
+    def section(sec: dict) -> dict:
+        return {
+            "collectives": sec["collectives"],
+            "comm_bytes": sec["comm_bytes"],
+            # derived (bandwidth table + srcost join) — recorded for
+            # srprof's report join, never diffed
+            "comms_fraction": sec["comms_fraction"],
+        }
+
+    out = {
+        "mesh_shape": entry["mesh_shape"],
+        "n_devices": entry["n_devices"],
+        "stage_set": entry["stage_set"],
+        "stages": {s: section(se) for s, se in entry["stages"].items()},
+    }
+    if "fused" in entry:
+        out["fused"] = section(entry["fused"])
+    return out
+
+
+def diff_shard_baseline(
+    configs: Dict[str, dict],
+    baseline: dict,
+    tolerance: float = REGRESSION_TOLERANCE,
+) -> Tuple[List[str], List[str]]:
+    """(problems, notes). Collective-census drift fails exactly (a
+    changed census is a compiled-traffic-shape change); comm-byte
+    GROWTH beyond tolerance fails, shrinks note a refresh."""
+    problems: List[str] = []
+    notes: List[str] = []
+    base_configs = baseline.get("configs", {})
+    skipped = {n for n, e in configs.items() if "skipped" in e}
+
+    def diff_section(tag: str, want: dict, got: dict) -> None:
+        want_c, got_c = want.get("collectives", {}), got["collectives"]
+        for op in sorted(set(want_c) | set(got_c)):
+            w, g = want_c.get(op, 0), got_c.get(op, 0)
+            if w != g:
+                problems.append(
+                    f"{tag}: collective census drift for {op!r}: "
+                    f"baseline {w} -> now {g} (intentional? refresh "
+                    "with --update-baseline)"
+                )
+        w, g = want.get("comm_bytes", 0), got["comm_bytes"]
+        if w > 0:
+            ratio = g / w
+            if ratio > 1.0 + tolerance:
+                problems.append(
+                    f"{tag}: modeled comm bytes grew {w} -> {g} "
+                    f"(+{(ratio - 1) * 100:.0f}%, tolerance "
+                    f"{tolerance * 100:.0f}%) — a cross-device traffic "
+                    "regression; fix it or refresh with "
+                    "--update-baseline and justify in the PR"
+                )
+            elif ratio < 1.0 - tolerance:
+                notes.append(
+                    f"{tag}: modeled comm bytes shrank {w} -> {g} "
+                    f"({(1 - ratio) * 100:.0f}% better) — refresh the "
+                    "baseline with --update-baseline to lock it in"
+                )
+        elif g > 0:
+            problems.append(
+                f"{tag}: baseline has zero comm bytes but this run "
+                f"moved {g} — refresh with --update-baseline"
+            )
+
+    for name, entry in configs.items():
+        if name in skipped:
+            continue
+        if name not in base_configs:
+            problems.append(
+                f"shard baseline has no config {name!r} — run with "
+                "--update-baseline"
+            )
+            continue
+        base = base_configs[name]
+        if base.get("stage_set") != entry["stage_set"]:
+            problems.append(
+                f"{name}: compiled stage set changed "
+                f"{base.get('stage_set')} -> {entry['stage_set']} — "
+                "refresh with --update-baseline"
+            )
+        if base.get("mesh_shape") != entry["mesh_shape"]:
+            problems.append(
+                f"{name}: mesh shape changed {base.get('mesh_shape')} "
+                f"-> {entry['mesh_shape']} — refresh with "
+                "--update-baseline"
+            )
+        base_stages = base.get("stages", {})
+        for stage, s_entry in entry["stages"].items():
+            if stage not in base_stages:
+                problems.append(
+                    f"shard baseline has no stage {name}.{stage} — "
+                    "refresh with --update-baseline"
+                )
+                continue
+            diff_section(f"{name}.{stage}", base_stages[stage], s_entry)
+        for stage in base_stages:
+            if stage not in entry["stages"]:
+                problems.append(
+                    f"shard baseline stage {name}.{stage} no longer "
+                    "produced — refresh with --update-baseline"
+                )
+        if "fused" in entry:
+            if "fused" not in base:
+                problems.append(
+                    f"shard baseline has no fused section for {name!r} "
+                    "— refresh with --update-baseline"
+                )
+            else:
+                diff_section(f"{name}.fused", base["fused"],
+                             entry["fused"])
+        elif "fused" in base:
+            problems.append(
+                f"shard baseline fused section for {name!r} no longer "
+                "produced — refresh with --update-baseline"
+            )
+    for name in base_configs:
+        if name not in configs and name not in skipped:
+            problems.append(
+                f"shard baseline config {name!r} no longer produced — "
+                "refresh with --update-baseline"
+            )
+    return problems, notes
+
+
+def baseline_stage_comms(
+    baseline_path: Optional[str] = None, config: str = CANONICAL_CONFIG
+) -> Dict[str, float]:
+    """{stage: modeled comms fraction} from the checked-in shard
+    baseline's canonical config — the join telemetry/profile.py's
+    report annotates its stage table with. {} when no baseline (or no
+    such config) exists; never raises."""
+    path = baseline_path or BASELINE_PATH
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    cfg = (data.get("configs") or {}).get(config) or {}
+    out: Dict[str, float] = {}
+    for stage, entry in (cfg.get("stages") or {}).items():
+        frac = entry.get("comms_fraction")
+        if isinstance(frac, (int, float)):
+            out[stage] = float(frac)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the checker
+# ---------------------------------------------------------------------------
+
+
+def check_shard(
+    update_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    matrix: Optional[Tuple[Tuple[str, dict, Tuple[str, ...], bool], ...]]
+    = None,
+    tolerance: float = REGRESSION_TOLERANCE,
+) -> dict:
+    """Run the srshard gate over the mesh matrix; returns the report
+    dict rendered by report.render_shard_text (and embedded in the CLI
+    JSON). Hosts with fewer than 8 devices skip every config (skipped
+    != missing — the baseline diff exempts them and the refresh
+    preserves their checked-in entries)."""
+    import jax
+
+    from ..analysis.cost import stage_costs
+    from ..models.options import make_options
+    from ..parallel.mesh import describe_mesh, make_mesh, spec_table
+
+    baseline_path = baseline_path or BASELINE_PATH
+    devices = jax.devices()
+    out_configs: Dict[str, dict] = {}
+    problems: List[str] = []
+    notes: List[str] = []
+    cross_tenant_total = 0
+    max_repl = 0.0
+    for name, extra, stage_set, fused in (matrix or _MESH_MATRIX):
+        if len(devices) < 8:
+            out_configs[name] = {
+                "skipped": f"{len(devices)} device(s) — the srshard "
+                "mesh matrix needs 8"
+            }
+            continue
+        options = make_options(**{**_SHARD_KWARGS, **extra})
+        tenant_mode = options.tenants > 1
+        mesh = make_mesh(
+            options, options.npopulations, devices=devices[:8],
+            row_shards=extra.get("row_shards", 1),
+            tenants=options.tenants,
+        )
+        import dataclasses
+
+        solo_opts = (
+            dataclasses.replace(options, tenants=1) if tenant_mode
+            else options
+        )
+        flops_by_stage = {
+            s: c["flops"] * (options.tenants if tenant_mode else 1)
+            for s, c in stage_costs(solo_opts, _NFEAT, _NROWS).items()
+        }
+        entry: dict = {
+            "mesh_shape": describe_mesh(mesh, devices[:8])["mesh_shape"],
+            "n_devices": int(mesh.devices.size),
+            "stage_set": list(stage_set),
+            "specs": spec_table(mesh, options),
+            "stages": {},
+        }
+        progs = (
+            _tenant_stage_programs(options, stage_set) if tenant_mode
+            else _solo_stage_programs(options, stage_set)
+        )
+        for stage, (fn, args) in progs.items():
+            s_entry, s_problems = _check_stage(
+                name, stage, fn, args, mesh, options,
+                flops_by_stage[stage], tenant_mode,
+            )
+            # stage factors stay per-entry informational: GSPMD chooses
+            # stage-program outputs freely (e.g. on a (1, 8) mesh the
+            # carry replicates across rows by design); only the fused
+            # programs' contract-pinned outputs roll up into the gate's
+            # headline factor
+            entry["stages"][stage] = s_entry
+            problems += s_problems
+        if fused:
+            # whole-iteration compute = the per-iteration stage flops
+            # (init is a one-shot program, not part of the iteration)
+            compute = sum(
+                v for s, v in flops_by_stage.items() if s != "init"
+            )
+            f_entry, f_problems = _check_fused(
+                name, options, mesh, tenant_mode, compute
+            )
+            entry["fused"] = f_entry
+            problems += f_problems
+            cross_tenant_total += f_entry["cross_tenant_collectives"]
+            max_repl = max(max_repl, f_entry["max_replication_factor"])
+            if not tenant_mode:
+                problems += _memo_vocabulary_problems(
+                    name, mesh, _SHARD_KWARGS
+                )
+        else:
+            notes.append(
+                f"{name}: fused iteration not compiled on this mesh "
+                "(compile-cost budget; the canonical "
+                f"{CANONICAL_CONFIG} config covers it)"
+            )
+        missing = [s for s in _ALL_STAGES if s not in stage_set]
+        if missing:
+            notes.append(
+                f"{name}: stage(s) {', '.join(missing)} not compiled "
+                "on this mesh (compile-cost budget; the canonical "
+                f"{CANONICAL_CONFIG} config covers them)"
+            )
+        out_configs[name] = entry
+
+    baseline_checked = baseline_match = False
+    if update_baseline:
+        from .report import build_baseline_configs, write_baseline_json
+
+        payload = {
+            "schema_version": 1,
+            "jax_version": jax.__version__,
+            "model_device_kind": MODEL_DEVICE_KIND,
+            # skipped configs (a <8-device host) keep their prior
+            # checked-in entries — see report.build_baseline_configs
+            "configs": build_baseline_configs(
+                baseline_path, out_configs, _baseline_entry
+            ),
+        }
+        write_baseline_json(baseline_path, payload)
+    elif os.path.exists(baseline_path):
+        with open(baseline_path) as f:
+            baseline = json.load(f)
+        baseline_checked = True
+        base_problems, base_notes = diff_shard_baseline(
+            out_configs, baseline, tolerance
+        )
+        baseline_match = not base_problems
+        problems += base_problems
+        notes += base_notes
+        if baseline.get("jax_version") != jax.__version__:
+            baseline_match = False
+            problems.append(
+                "shard baseline was written under jax "
+                f"{baseline.get('jax_version')} but this is "
+                f"{jax.__version__} — refresh with --update-baseline"
+            )
+    else:
+        problems.append(
+            f"no shard baseline at {baseline_path} — create one with "
+            "--update-baseline"
+        )
+
+    canonical = out_configs.get(CANONICAL_CONFIG, {})
+    return {
+        "ok": not problems,
+        "problems": problems,
+        "notes": notes,
+        "configs": out_configs,
+        "baseline_checked": baseline_checked,
+        "baseline_match": baseline_match,
+        "baseline_path": baseline_path,
+        "jax_version": jax.__version__,
+        "model_device_kind": MODEL_DEVICE_KIND,
+        "cross_tenant_collectives": int(cross_tenant_total),
+        "max_replication_factor": round(max_repl, 3),
+        "comms_fraction": (
+            canonical.get("fused", {}).get("comms_fraction")
+            if "skipped" not in canonical else None
+        ),
+    }

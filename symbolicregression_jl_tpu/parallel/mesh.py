@@ -67,9 +67,10 @@ def make_mesh(
                 f"{n_islands} does not tile {n_dev} devices — using a "
                 f"({t_shards}, {island_shards}) ({options.tenant_axis}, "
                 f"{options.island_axis}) mesh on {use} device(s) and "
-                f"leaving {n_dev - use} idle. Pick tenants/npopulations "
-                f"whose product's divisors tile {n_dev} to use every "
-                "device.",
+                f"leaving {n_dev - use} idle "
+                f"({', '.join(str(d) for d in devices[use:])}). Pick "
+                f"tenants/npopulations whose product's divisors tile "
+                f"{n_dev} to use every device.",
                 stacklevel=2,
             )
         dev_array = np.array(devices[:use]).reshape(t_shards, island_shards)
@@ -204,6 +205,20 @@ def search_shardings(mesh: Optional[Mesh], options: Options):
         "x": NamedSharding(mesh, P(None, options.row_axis)),
         "rows": NamedSharding(mesh, P(options.row_axis)),
         "events": NamedSharding(mesh, P(None, options.island_axis)),
+    }
+
+
+def spec_table(mesh: Optional[Mesh], options: Options) -> Optional[Dict]:
+    """JSON-able view of :func:`search_shardings` — ``{name:
+    [axis-or-null, ...]}`` — the introspection hook srshard records per
+    mesh config (analysis/shard.py) and docs/multichip.md's
+    PartitionSpec table is generated against. None mesh -> None."""
+    sh = search_shardings(mesh, options)
+    if sh is None:
+        return None
+    return {
+        name: [None if axis is None else str(axis) for axis in ns.spec]
+        for name, ns in sh.items()
     }
 
 

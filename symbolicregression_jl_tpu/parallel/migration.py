@@ -31,6 +31,25 @@ from ..models.trees import TreeBatch
 
 Array = jax.Array
 
+#: The replicated-pin sites of the fused iteration, by name — the
+#: ``sharding_constraint`` primitives srshard's constraint census counts
+#: in the solo compiled program (and asserts absent from the
+#: tenant-batched body, where ``inner_mesh=None`` / lint rule SR012
+#: forbid constraints entirely). analysis/shard.py introspects this.
+REPLICATED_PINS = ("topn_pool", "merged_hof")
+
+
+def pin_replicated(tree, mesh: Mesh):
+    """Pin every leaf of ``tree`` fully replicated over ``mesh`` with
+    ``with_sharding_constraint`` — the one place the fused iteration
+    constrains GSPMD by hand (see :data:`REPLICATED_PINS`). Callers must
+    hold a real mesh; inside a tenant-vmapped body there is no mesh to
+    name (api.py passes ``inner_mesh=None``) and this is never reached."""
+    repl = NamedSharding(mesh, P())
+    return jax.tree_util.tree_map(
+        lambda x: jax.lax.with_sharding_constraint(x, repl), tree
+    )
+
 
 def _topn_pool(states: IslandState, topn: int):
     """(I, topn) best members of every island -> flattened pool (I*topn,)."""
@@ -77,11 +96,9 @@ def migrate(
 
     pool_trees, pool_scores, pool_losses = _topn_pool(states, topn)
     if mesh is not None:
-        repl = NamedSharding(mesh, P())
-        constrain = lambda x: jax.lax.with_sharding_constraint(x, repl)
-        pool_trees = jax.tree_util.tree_map(constrain, pool_trees)
-        pool_scores = constrain(pool_scores)
-        pool_losses = constrain(pool_losses)
+        pool_trees, pool_scores, pool_losses = pin_replicated(
+            (pool_trees, pool_scores, pool_losses), mesh
+        )
     pool_size = I * topn
 
     k1, k2, k3, k4 = jax.random.split(key, 4)
@@ -169,8 +186,5 @@ def merge_hofs_across_islands(
         exists=jnp.any(hofs.exists, axis=0),
     )
     if mesh is not None:
-        repl = NamedSharding(mesh, P())
-        merged = jax.tree_util.tree_map(
-            lambda x: jax.lax.with_sharding_constraint(x, repl), merged
-        )
+        merged = pin_replicated(merged, mesh)
     return merged

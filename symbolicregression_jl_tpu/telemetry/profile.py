@@ -27,6 +27,14 @@ clamped into (0, 1] (the analytic model over-counts what fusion
 eliminates, so raw fractions can exceed 1 on tiny programs —
 ``fraction_raw`` keeps the unclamped value).
 
+The report additionally joins srshard's checked-in communication model
+(analysis/shard_baseline.json, canonical mesh4x2 config): each stage
+row carries ``modeled_comms_fraction`` — the modeled share of that
+stage's step time spent in collectives on the production mesh — so the
+profile answers "is this stage compute- or comms-dominated when
+sharded" next to "how close to roof is it here". Best-effort: a
+missing baseline simply leaves the column blank (docs/multichip.md).
+
 Everything here is host-side orchestration: the modeled half is
 trace-only (``jax.make_jaxpr``), the measured half reads spans already
 taken — zero primitives are added to any jitted search program and the
@@ -343,6 +351,22 @@ def profile_report(source: Union[str, List[dict]]) -> Dict[str, Any]:
         ms, ws = s["modeled_share"], s["wall_share"]
         s["skew"] = (ws / ms) if (ms and ws is not None) else None
 
+    # srshard join: annotate each stage with the statically-modeled
+    # communication share from the checked-in shard baseline (canonical
+    # mesh4x2 config). Best-effort — a missing/stale baseline or an
+    # import failure leaves the rows unannotated rather than breaking
+    # the report (the profile is about THIS run; the comms column is
+    # cross-referenced context from the static engine).
+    try:
+        from ..analysis.shard import baseline_stage_comms
+
+        comms = baseline_stage_comms()
+    except Exception:
+        comms = {}
+    for name, s in stages.items():
+        if name in comms:
+            s["modeled_comms_fraction"] = comms[name]
+
     missing = [s for s in STAGES if s not in stages]
     return {
         "path": path,
@@ -382,7 +406,7 @@ def render_text(report: Dict[str, Any]) -> str:
         lines.append(
             f"{'stage':>14} {'el-ops':>9} {'bytes':>9} {'AI':>6} "
             f"{'waste':>6} {'wall s':>9} {'share':>6} {'roofline':>8} "
-            f"{'skew':>6}"
+            f"{'skew':>6} {'comms':>6}"
         )
         for name, s in stages.items():
             lines.append(
@@ -393,7 +417,8 @@ def render_text(report: Dict[str, Any]) -> str:
                 f"{_fmt(s.get('measured_total_s'), '.4f'):>9} "
                 f"{_pct(s.get('wall_share')):>6} "
                 f"{_pct(s.get('roofline_fraction')):>8} "
-                f"{_fmt(s.get('skew'), '.1f'):>6}"
+                f"{_fmt(s.get('skew'), '.1f'):>6} "
+                f"{_pct(s.get('modeled_comms_fraction')):>6}"
             )
     comp = report.get("compile", {})
     if comp:

@@ -1,0 +1,84 @@
+"""SR012 fixture: with_sharding_constraint / NamedSharding inside a
+vmapped/scanned body referencing an outer mesh object. Parsed by the
+linter, never imported. The batched bodies below are marked by the
+jax.vmap / jax.lax.scan calls in driver(); helpers taking the mesh as a
+PARAMETER (the migration.py pin_replicated pattern) stay clean."""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+MESH = object()  # stands in for a module-level jax.sharding.Mesh
+
+
+def batched_body(x):
+    # VIOLATION SR012: constraint inside a vmapped body naming the
+    # outer mesh — the batched trace cannot see MESH's dims
+    return jax.lax.with_sharding_constraint(
+        x * 2, NamedSharding(MESH, P("islands"))
+    )
+
+
+def batched_named(x):
+    # VIOLATION SR012: bare NamedSharding construction against the
+    # outer mesh inside a vmapped body
+    sharding = NamedSharding(MESH, P())
+    return jax.device_put(x, sharding)
+
+
+def scan_body(carry, x):
+    # VIOLATION SR012: same rule through jax.lax.scan
+    pinned = jax.lax.with_sharding_constraint(
+        carry + x, NamedSharding(MESH, P())
+    )
+    return pinned, x
+
+
+def _inner_helper(x):
+    # VIOLATION SR012: not itself passed to vmap, but reachable from
+    # batched_caller below — it still runs under the batching transform
+    return jax.lax.with_sharding_constraint(x, NamedSharding(MESH, P()))
+
+
+def batched_caller(x):
+    return _inner_helper(x) + 1
+
+
+def good_param_mesh(x, mesh):
+    # OK: mesh is a parameter — the caller threads None under vmap
+    # (api.py's inner_mesh rule), so the constraint never fires batched
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+
+
+def good_local_mesh(x):
+    # OK: the mesh is built locally from the body's own data
+    mesh = make_local_mesh()
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+
+
+def host_constrain(x):
+    # OK: never vmapped/scanned — host-side placement is SR012-clean
+    return jax.lax.with_sharding_constraint(x, NamedSharding(MESH, P()))
+
+
+def pragma_body(x):
+    return jax.lax.with_sharding_constraint(  # srlint: disable=SR012 -- fixture pragma
+        x, NamedSharding(MESH, P())
+    )
+
+
+def make_local_mesh():
+    return object()
+
+
+def driver(xs, carry):
+    a = jax.vmap(batched_body)(xs)
+    b = jax.vmap(batched_named)(xs)
+    c, _ = jax.lax.scan(scan_body, carry, xs)
+    d = jax.vmap(batched_caller)(xs)
+    e = jax.vmap(lambda x: good_param_mesh(x, None))(xs)
+    f = jax.vmap(good_local_mesh)(xs)
+    g = jax.vmap(pragma_body)(xs)
+    return jnp.stack([a, b, c, d, e, f, g]), host_constrain(xs)

@@ -291,12 +291,37 @@ def test_sr011_callable_id_in_key_detected():
 
 
 @pytest.mark.fast
-def test_package_clean_under_sr010_sr011():
+def test_sr012_sharding_constraint_in_batched_body_detected():
+    vs = _lint_fixture("fixture_sr012.py")
+    hits = _active(vs, "SR012")
+    assert len(hits) == 4, [v.to_dict() for v in vs]
+    assert {v.function for v in hits} == {
+        "batched_body", "batched_named", "scan_body", "_inner_helper"
+    }
+    # mesh-as-parameter, local mesh, and never-batched hosts are exempt
+    assert not any(
+        v.function in ("good_param_mesh", "good_local_mesh",
+                       "host_constrain", "driver")
+        for v in hits
+    )
+    # every active hit names the offending outer mesh object
+    assert all("MESH" in v.message for v in hits)
+    sup = [v for v in vs if v.suppressed and v.rule_id == "SR012"]
+    assert len(sup) == 1 and sup[0].function == "pragma_body"
+
+
+@pytest.mark.fast
+def test_package_clean_under_sr010_sr011_sr012():
     from symbolicregression_jl_tpu.analysis import lint_package
 
     vs = lint_package()
     assert not _active(vs, "SR010"), [v.to_dict() for v in vs]
     assert not _active(vs, "SR011"), [v.to_dict() for v in vs]
+    # the production tenant-vmapped iteration takes its mesh as a
+    # parameter (inner_mesh) — SR012's exemption — so the package scans
+    # clean; a constraint naming an outer mesh inside a batched body
+    # would fail here before srshard's compile-time census sees it
+    assert not _active(vs, "SR012"), [v.to_dict() for v in vs]
 
 
 # ---------------------------------------------------------------------------

@@ -186,6 +186,7 @@ def test_json_report_schema():
     assert payload["suppressed"] == 3
     assert payload["surface"] is None
     assert payload["memory"] is None
+    assert payload["shard"] is None
     for v in payload["violations"]:
         assert set(v) == {
             "rule", "name", "path", "line", "col", "function", "message",
@@ -561,13 +562,15 @@ def test_cli_in_process_exit_codes(tmp_path, monkeypatch):
 
 @pytest.mark.slow
 def test_cli_full_run_green_at_head():
-    """The full gate — srlint + compile surface + srmem vs the checked-in
-    baselines — exits 0 on the repo at HEAD (the ISSUE 3/4 acceptance
-    criterion). Slow: traces the whole Options matrix twice (~2 min)."""
+    """The full gate — all six engines vs the checked-in baselines —
+    exits 0 on the repo at HEAD (the ISSUE 3/4 acceptance criterion).
+    Slow: traces the whole Options matrix twice AND AOT-compiles the
+    srshard mesh matrix (~20 min cold; a warm persistent JAX compile
+    cache, inherited via JAX_COMPILATION_CACHE_DIR, cuts it to ~3)."""
     proc = subprocess.run(
         [sys.executable, "-m", "symbolicregression_jl_tpu.analysis",
          "--format", "json"],
-        capture_output=True, text=True, cwd=REPO, timeout=900,
+        capture_output=True, text=True, cwd=REPO, timeout=2700,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
@@ -575,6 +578,8 @@ def test_cli_full_run_green_at_head():
     assert payload["ok"] is True
     assert payload["surface"]["baseline_match"] is True
     assert payload["memory"]["baseline_match"] is True
+    assert payload["shard"]["baseline_match"] is True
+    assert payload["shard"]["cross_tenant_collectives"] == 0
 
 
 @pytest.mark.slow
@@ -594,6 +599,502 @@ def test_cli_memory_only_nonzero_on_tiny_budget():
     assert any(
         "budget" in p for p in payload["memory"]["problems"]
     )
+
+
+# ---------------------------------------------------------------------------
+# srshard: sharding contract + communication cost model (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.fast
+def test_shard_replica_group_decoding():
+    """HLO replica-group forms decode to real participant lists: the
+    iota form (with transpose), the brace form, source_target_pairs,
+    and the empty/absent forms meaning all participants."""
+    from symbolicregression_jl_tpu.analysis.shard import (
+        _decode_iota_groups,
+        _participant_groups,
+    )
+
+    # [4,2]<=[2,4]T(1,0): iota over (2,4), transposed, reshaped (4,2)
+    assert _decode_iota_groups(4, 2, [2, 4], [1, 0]) == [
+        [0, 4], [1, 5], [2, 6], [3, 7],
+    ]
+    assert _decode_iota_groups(2, 4, [2, 4], None) == [
+        [0, 1, 2, 3], [4, 5, 6, 7],
+    ]
+    assert _participant_groups(
+        "replica_groups=[4,2]<=[2,4]T(1,0)", 8
+    ) == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    assert _participant_groups(
+        "replica_groups={{0,1},{2,3}}", 8
+    ) == [[0, 1], [2, 3]]
+    assert _participant_groups(
+        "source_target_pairs={{0,1},{1,0}}", 8
+    ) == [[0, 1], [1, 0]]
+    # empty groups / absent attribute = one group of everyone
+    assert _participant_groups("replica_groups={}", 4) == [[0, 1, 2, 3]]
+    assert _participant_groups("channel_id=1", 4) == [[0, 1, 2, 3]]
+
+
+@pytest.mark.fast
+def test_shard_collective_parse_and_pricing():
+    """parse_collectives reads op, payload bytes, and groups off HLO
+    text (counting async pairs once); price_comms applies the ring
+    factors over the tabled bandwidth."""
+    from symbolicregression_jl_tpu.analysis import shard
+
+    hlo = "\n".join([
+        "  %ar = f32[8,16]{1,0} all-reduce(f32[8,16]{1,0} %x), "
+        "replica_groups={{0,1,2,3},{4,5,6,7}}, to_apply=%add",
+        "  %ag.s = (f32[4]{0}, f32[16]{0}) all-gather-start(f32[4]{0} "
+        "%y), replica_groups=[2,4]<=[8], dimensions={0}",
+        "  %ag.d = f32[16]{0} all-gather-done((f32[4]{0}, f32[16]{0}) "
+        "%ag.s)",
+        "  %cp = f32[256]{0} collective-permute(f32[256]{0} %z), "
+        "source_target_pairs={{0,1},{1,0}}",
+        "  %noise = f32[2]{0} add(f32[2]{0} %a, f32[2]{0} %b)",
+    ])
+    colls = shard.parse_collectives(hlo, 8)
+    assert shard.census_of(colls) == {
+        "all-gather": 1, "all-reduce": 1, "collective-permute": 1,
+    }
+    by_op = {c["op"]: c for c in colls}
+    assert by_op["all-reduce"]["bytes"] == 8 * 16 * 4
+    assert by_op["all-reduce"]["groups"] == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    # async start: the largest tuple element (the gathered output)
+    assert by_op["all-gather"]["bytes"] == 16 * 4
+    assert by_op["all-gather"]["groups"] == [
+        [0, 1, 2, 3], [4, 5, 6, 7],
+    ]
+    assert by_op["collective-permute"]["groups"] == [[0, 1], [1, 0]]
+
+    priced = shard.price_comms(colls, "v5e")
+    assert priced["comm_bytes"] == 512 + 64 + 1024
+    bw = shard.ICI_BANDWIDTH["v5e"]
+    want_s = (
+        512 * 2 * 3 / 4 / bw  # all-reduce, g=4: 2(g-1)/g
+        + 64 * 3 / 4 / bw     # all-gather, g=4: (g-1)/g
+        + 1024 * 1.0 / bw     # collective-permute
+    )
+    assert abs(priced["modeled_s"] - want_s) < 1e-18
+
+    # bandwidth table: substring match, unknown kind -> host fallback
+    assert shard.interconnect_bandwidth("TPU v5 lite") == bw
+    assert (
+        shard.interconnect_bandwidth("cpu")
+        == shard.HOST_INTERCONNECT_BYTES_PER_S
+    )
+    # comms fraction against the fixed model device kind
+    assert shard.comms_fraction(0.0, 1e9) == 0.0
+    frac = shard.comms_fraction(1e-3, 3.9e9)  # compute_s = 1e-3
+    assert abs(frac - 0.5) < 1e-9
+
+
+@pytest.mark.fast
+def test_shard_cross_tenant_detection_and_bisection():
+    """ISSUE 19 acceptance (injected defect b): a deliberate
+    cross-tenant reduction on the (tenants, islands) mesh is detected
+    from the compiled HLO's replica groups, and the group-halving
+    bisection names the culprit output leaf."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from symbolicregression_jl_tpu.analysis import shard
+    from symbolicregression_jl_tpu.models.options import make_options
+    from symbolicregression_jl_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8 forced-host devices")
+    opts = make_options(binary_operators=["+"], npopulations=4, tenants=2)
+    mesh = make_mesh(opts, 4, devices=jax.devices()[:8], tenants=2)
+    assert mesh is not None and mesh.devices.shape == (2, 4)
+    sh = NamedSharding(mesh, P(opts.tenant_axis, opts.island_axis))
+    x = jax.ShapeDtypeStruct((2, 4, 512), jnp.float32)
+
+    # leaf 0 is elementwise (tenant-local); leaf 1 reduces over EVERY
+    # axis including tenants — the injected isolation leak
+    def leaky(a):
+        return (a * 2.0, jnp.sum(a))
+
+    compiled = jax.jit(leaky, in_shardings=sh).lower(x).compile()
+    colls = shard.parse_collectives(compiled.as_text(), 8)
+    bad = shard.cross_tenant_collectives(colls, n_island_shards=4)
+    assert bad, "cross-tenant reduction not detected"
+    assert any(c["op"] == "all-reduce" for c in bad)
+
+    # per-tenant reduction stays clean: sum over islands+rows only
+    def clean(a):
+        return (a * 2.0, jnp.sum(a, axis=(1, 2)))
+
+    c2 = jax.jit(clean, in_shardings=sh).lower(x).compile()
+    colls2 = shard.parse_collectives(c2.as_text(), 8)
+    assert shard.cross_tenant_collectives(colls2, 4) == []
+
+    # bisection: compiling output-leaf subsets pins the leak to leaf 1
+    def compile_hlo(idxs):
+        f = lambda a: tuple(leaky(a)[i] for i in idxs)  # noqa: E731
+        return (
+            jax.jit(f, in_shardings=sh).lower(x).compile().as_text()
+        )
+
+    culprits = shard._bisect_tenant_culprits(
+        compile_hlo, n_leaves=2, n_island_shards=4, n_devices=8
+    )
+    assert culprits == [1]
+
+
+@pytest.mark.fast
+def test_shard_cross_tenant_exemptions():
+    """The two structurally value-preserving GSPMD artifacts the real
+    tenant-batched iteration emits are exempt from the cross-tenant
+    gate; everything else crossing the tenant axis stays a violation
+    (cross_tenant_collectives docstring)."""
+    from symbolicregression_jl_tpu.analysis.shard import (
+        cross_tenant_collectives,
+    )
+
+    cross = [[0, 4], [1, 5], [2, 6], [3, 7]]  # pairs across 2 tenants
+    within = [[0, 1, 2, 3], [4, 5, 6, 7]]
+    # replication data movement: exempt even across tenants
+    ag = {"op": "all-gather", "bytes": 768, "groups": cross}
+    # SPMD while-predicate convergence: pred[] scalar, exempt
+    pred_ar = {"op": "all-reduce", "bytes": 1, "groups": cross}
+    # a real data psum across tenants (f32[] = 4 bytes): violation
+    data_ar = {"op": "all-reduce", "bytes": 4, "groups": cross}
+    # data movement ops that can mis-route tenant data: violations
+    cp = {"op": "collective-permute", "bytes": 64,
+          "groups": [[0, 4], [4, 0]]}
+    rs = {"op": "reduce-scatter", "bytes": 128, "groups": cross}
+    # within-tenant traffic never flags regardless of op
+    ok_ar = {"op": "all-reduce", "bytes": 4096, "groups": within}
+
+    bad = cross_tenant_collectives(
+        [ag, pred_ar, data_ar, cp, rs, ok_ar], n_island_shards=4
+    )
+    assert bad == [data_ar, cp, rs]
+
+
+@pytest.mark.fast
+def test_shard_replication_blowup_names_leaf():
+    """ISSUE 19 acceptance (injected defect a): dropping the island
+    out_sharding on one carry leaf makes GSPMD replicate it; the
+    replication gate flags exactly that leaf BY NAME against the
+    contract's expected sharding."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from symbolicregression_jl_tpu.analysis.shard import (
+        _replication_stats,
+    )
+    from symbolicregression_jl_tpu.models.options import make_options
+    from symbolicregression_jl_tpu.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8 forced-host devices")
+    opts = make_options(binary_operators=["+"], npopulations=8)
+    mesh = make_mesh(opts, 8, devices=jax.devices()[:8])
+    isl = NamedSharding(mesh, P(opts.island_axis))
+    rep = NamedSharding(mesh, P())
+
+    avals = {
+        "trees": jax.ShapeDtypeStruct((8, 64, 8), jnp.float32),
+        "losses": jax.ShapeDtypeStruct((8, 64), jnp.float32),
+    }
+
+    def f(t):
+        return {k: v * 2.0 for k, v in t.items()}
+
+    # the injected defect: trees' island out_sharding dropped -> P()
+    compiled = (
+        jax.jit(
+            f,
+            in_shardings=({"trees": isl, "losses": isl},),
+            out_shardings={"trees": rep, "losses": isl},
+        )
+        .lower(avals).compile()
+    )
+    expected = {"trees": isl, "losses": isl}
+    problems, max_factor = _replication_stats(
+        "fused", jax.eval_shape(f, avals), compiled.output_shardings,
+        expected, n_devices=8,
+    )
+    assert len(problems) == 1, problems
+    assert "replication blowup" in problems[0]
+    assert "'trees'" in problems[0] and "'losses'" not in problems[0]
+    assert max_factor == pytest.approx(8.0)
+
+    # contract-conforming shardings pass with factor 1
+    ok_compiled = (
+        jax.jit(
+            f,
+            in_shardings=({"trees": isl, "losses": isl},),
+            out_shardings={"trees": isl, "losses": isl},
+        )
+        .lower(avals).compile()
+    )
+    problems, max_factor = _replication_stats(
+        "fused", jax.eval_shape(f, avals),
+        ok_compiled.output_shardings, expected, n_devices=8,
+    )
+    assert problems == []
+    assert max_factor == pytest.approx(1.0)
+
+
+@pytest.mark.fast
+def test_shard_baseline_diff_gates():
+    """diff_shard_baseline: census drift fails exactly; comm-byte
+    growth beyond tolerance fails while shrinks only note; skipped
+    configs are exempt in both directions; structural drift (stage set,
+    mesh shape, missing sections) fails."""
+    from symbolicregression_jl_tpu.analysis.shard import (
+        diff_shard_baseline,
+    )
+
+    def entry(comm=1000, census=None, fused=None):
+        e = {
+            "mesh_shape": {"islands": 4, "rows": 2},
+            "n_devices": 8,
+            "stage_set": ["eval"],
+            "stages": {
+                "eval": {
+                    "collectives": dict(census or {"all-reduce": 2}),
+                    "comm_bytes": comm,
+                    "comms_fraction": 0.1,
+                },
+            },
+        }
+        if fused is not None:
+            e["fused"] = fused
+        return e
+
+    base = {"configs": {"mesh4x2": entry()}}
+
+    probs, notes = diff_shard_baseline({"mesh4x2": entry()}, base)
+    assert probs == [] and notes == []
+
+    # census drift fails exactly
+    probs, _ = diff_shard_baseline(
+        {"mesh4x2": entry(census={"all-reduce": 3})}, base
+    )
+    assert any("census drift" in p for p in probs)
+
+    # +11% comm bytes fails at the 10% tolerance; -20% only notes
+    probs, _ = diff_shard_baseline({"mesh4x2": entry(comm=1111)}, base)
+    assert any("grew" in p for p in probs)
+    probs, notes = diff_shard_baseline({"mesh4x2": entry(comm=800)}, base)
+    assert probs == []
+    assert any("shrank" in n for n in notes)
+
+    # skipped exempts the config in both directions
+    probs, notes = diff_shard_baseline(
+        {"mesh4x2": {"skipped": "1 device(s)"}}, base
+    )
+    assert probs == [] and notes == []
+
+    # structural drift: stage set, mesh shape, missing config/section
+    changed = entry()
+    changed["stage_set"] = ["eval", "init"]
+    probs, _ = diff_shard_baseline({"mesh4x2": changed}, base)
+    assert any("stage set changed" in p for p in probs)
+
+    changed = entry()
+    changed["mesh_shape"] = {"islands": 8, "rows": 1}
+    probs, _ = diff_shard_baseline({"mesh4x2": changed}, base)
+    assert any("mesh shape changed" in p for p in probs)
+
+    probs, _ = diff_shard_baseline({"mesh1x8": entry()}, base)
+    assert any("no config" in p for p in probs)
+    assert any("no longer produced" in p for p in probs)
+
+    # a fused section appearing without a baseline fails toward refresh
+    probs, _ = diff_shard_baseline(
+        {"mesh4x2": entry(fused={
+            "collectives": {}, "comm_bytes": 0, "comms_fraction": 0.0,
+        })},
+        base,
+    )
+    assert any("fused" in p for p in probs)
+
+
+@pytest.mark.fast
+def test_shard_baseline_stage_comms_join(tmp_path):
+    """baseline_stage_comms never raises: {} without a baseline; the
+    canonical config's stage fractions otherwise (the srprof report
+    join)."""
+    from symbolicregression_jl_tpu.analysis.shard import (
+        baseline_stage_comms,
+    )
+
+    missing = str(tmp_path / "nope.json")
+    assert baseline_stage_comms(baseline_path=missing) == {}
+
+    bp = tmp_path / "shard_baseline.json"
+    bp.write_text(json.dumps({
+        "configs": {
+            "mesh4x2": {
+                "stages": {
+                    "eval": {"comm_bytes": 10, "comms_fraction": 0.25},
+                    "cycle": {"comm_bytes": 10, "comms_fraction": 0.5},
+                    "broken": {"comm_bytes": 10},
+                },
+            },
+        },
+    }))
+    assert baseline_stage_comms(baseline_path=str(bp)) == {
+        "eval": 0.25, "cycle": 0.5,
+    }
+    bp.write_text("not json")
+    assert baseline_stage_comms(baseline_path=str(bp)) == {}
+
+
+@pytest.mark.fast
+def test_shard_skips_below_eight_devices(monkeypatch, tmp_path):
+    """<8 devices: every config is SKIPPED (not missing) — no compile,
+    no baseline failure in update mode, and skipped entries are never
+    written into the baseline."""
+    import jax
+
+    from symbolicregression_jl_tpu.analysis import shard
+
+    one = list(jax.devices())[:1]
+    monkeypatch.setattr(jax, "devices", lambda *a, **k: one)
+    bp = str(tmp_path / "shard_baseline.json")
+
+    res = shard.check_shard(baseline_path=bp)
+    assert all("skipped" in e for e in res["configs"].values())
+    assert res["comms_fraction"] is None
+    # no baseline at all is still a problem (the gate must be armed)
+    assert any("no shard baseline" in p for p in res["problems"])
+
+    res = shard.check_shard(update_baseline=True, baseline_path=bp)
+    assert res["ok"], res["problems"]
+    written = json.load(open(bp))
+    assert written["configs"] == {}, (
+        "skipped configs must never be written into the baseline"
+    )
+
+
+@pytest.mark.fast
+def test_render_shard_text_lines():
+    from symbolicregression_jl_tpu.analysis.report import (
+        render_shard_text,
+    )
+
+    shard = {
+        "ok": False,
+        "problems": ["mesh4x2: CROSS-TENANT all-reduce"],
+        "notes": ["mesh1x8: fused iteration not compiled on this mesh"],
+        "configs": {
+            "mesh4x2": {
+                "mesh_shape": {"islands": 4, "rows": 2},
+                "stage_set": ["eval"],
+                "stages": {
+                    "eval": {
+                        "collectives": {"all-reduce": 2},
+                        "comm_bytes": 2048,
+                        "comms_fraction": 0.1,
+                    },
+                },
+                "fused": {
+                    "collectives": {"all-gather": 3},
+                    "comm_bytes": 4096,
+                    "comms_fraction": 0.25,
+                    "max_replication_factor": 1.0,
+                },
+            },
+            "skipme": {"skipped": "1 device(s)"},
+        },
+        "baseline_checked": True,
+        "baseline_match": False,
+        "cross_tenant_collectives": 1,
+        "max_replication_factor": 1.0,
+    }
+    text = render_shard_text(shard)
+    assert "srshard: mesh4x2: CROSS-TENANT all-reduce" in text
+    assert "note: mesh1x8" in text
+    assert "mesh 4x2" in text and "comms share 25.0%" in text
+    assert "skipme: skipped" in text
+    assert "FAIL" in text and "1 CROSS-TENANT collective(s)" in text
+    assert "baseline MISMATCH" in text
+
+
+@pytest.mark.slow
+def test_shard_small_matrix_gate_end_to_end(tmp_path):
+    """check_shard on a one-stage matrix round-trips its baseline, and
+    an injected >10% comm-byte growth (a tampered baseline) fails the
+    gate — the ISSUE 19 regression-gate acceptance without the full
+    ~5-minute matrix."""
+    from symbolicregression_jl_tpu.analysis import shard
+
+    matrix = (("mesh4x2", dict(row_shards=2), ("eval",), False),)
+    bp = str(tmp_path / "shard_baseline.json")
+
+    res = shard.check_shard(
+        update_baseline=True, baseline_path=bp, matrix=matrix
+    )
+    assert res["ok"], res["problems"]
+    entry = res["configs"]["mesh4x2"]
+    assert entry["mesh_shape"] == {"islands": 4, "rows": 2}
+    assert entry["specs"]["island"] == ["islands"]
+    assert entry["stages"]["eval"]["comm_bytes"] > 0, (
+        "the row-sharded eval must reduce across the rows axis"
+    )
+
+    res2 = shard.check_shard(baseline_path=bp, matrix=matrix)
+    assert res2["ok"], res2["problems"]
+    assert res2["baseline_checked"] and res2["baseline_match"]
+
+    # injected regression: pretend the baseline was 20% leaner
+    data = json.load(open(bp))
+    sec = data["configs"]["mesh4x2"]["stages"]["eval"]
+    sec["comm_bytes"] = int(sec["comm_bytes"] / 1.2)
+    with open(bp, "w") as f:
+        json.dump(data, f)
+    res3 = shard.check_shard(baseline_path=bp, matrix=matrix)
+    assert not res3["ok"]
+    assert any(
+        "comm bytes grew" in p and "mesh4x2.eval" in p
+        for p in res3["problems"]
+    )
+
+
+@pytest.mark.slow
+def test_checked_in_shard_baseline_exists_and_well_formed():
+    """The shard baseline rides the repo like the other four: present,
+    schema-stamped, and covering the full mesh matrix with the
+    canonical config carrying a fused section."""
+    from symbolicregression_jl_tpu.analysis.shard import (
+        BASELINE_PATH,
+        CANONICAL_CONFIG,
+        _MESH_MATRIX,
+    )
+
+    assert os.path.exists(BASELINE_PATH), (
+        "analysis/shard_baseline.json must be committed"
+    )
+    with open(BASELINE_PATH) as f:
+        data = json.load(f)
+    assert data["schema_version"] == 1
+    assert data["model_device_kind"] == "v5e"
+    names = {name for name, *_ in _MESH_MATRIX}
+    assert set(data["configs"]) == names
+    canon = data["configs"][CANONICAL_CONFIG]
+    assert "fused" in canon
+    assert set(canon["stages"]) == {
+        "init", "cycle", "mutate", "eval", "simplify", "optimize",
+        "merge_migrate",
+    }
+    for cfg in data["configs"].values():
+        for sec in list(cfg["stages"].values()) + (
+            [cfg["fused"]] if "fused" in cfg else []
+        ):
+            assert set(sec) == {
+                "collectives", "comm_bytes", "comms_fraction",
+            }
+            assert sec["comm_bytes"] >= 0
 
 
 @pytest.mark.slow

@@ -260,6 +260,135 @@ def test_make_mesh_warns_on_idle_devices():
     assert info1["mesh_shape"] is None and info1["n_devices"] == 1
 
 
+def test_tenant_mesh_warns_naming_idle_devices():
+    """ISSUE 19 satellite: (tenants=3, islands=4) cannot tile 8 devices
+    — the tenant branch of make_mesh must warn naming WHICH devices sit
+    idle (not just how many), so a degraded serving deployment is
+    attributable from the log alone."""
+    import warnings
+
+    devices = jax.devices()
+    opts = make_options(binary_operators=["+"], npopulations=4, tenants=3)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        m = mesh_mod.make_mesh(opts, 4, tenants=3)
+    # 3 tenant shards x 2 island shards = 6 of 8 devices
+    assert m is not None and m.devices.shape == (3, 2)
+    assert m.axis_names == (opts.tenant_axis, opts.island_axis)
+    msgs = [str(x.message) for x in w if "make_mesh" in str(x.message)]
+    assert msgs, "no idle-device warning from the tenant mesh branch"
+    assert "2 idle" in msgs[0] and "(3, 2)" in msgs[0]
+    for d in devices[6:8]:
+        assert str(d) in msgs[0], f"idle device {d} not named in warning"
+
+    info = mesh_mod.describe_mesh(m)
+    assert info["mesh_shape"] == {
+        opts.tenant_axis: 3, opts.island_axis: 2,
+    }
+    assert info["n_devices"] == 6
+    assert info["idle_devices"] == len(devices) - 6
+
+
+@pytest.mark.slow
+def test_degraded_mesh_lands_in_run_start(tmp_path):
+    """Slow (compiles a fresh search on a 6x1 mesh, ~3 min). The
+    degraded-mesh facts are machine-readable, not just a warning:
+    a search whose island count does not tile the devices must stamp
+    mesh_shape + idle_devices into the telemetry run_start event via
+    describe_mesh (ISSUE 19 satellite)."""
+    from symbolicregression_jl_tpu.telemetry.analyze import (
+        load_events,
+        resolve_log,
+    )
+
+    X, y = make_data()
+    with pytest.warns(UserWarning, match="make_mesh"):
+        sr.equation_search(
+            X, y, niterations=1, seed=5, telemetry=True,
+            telemetry_dir=str(tmp_path), **{**TINY, "npopulations": 6}
+        )
+    events, skipped = load_events(resolve_log(str(tmp_path)))
+    assert skipped == 0
+    start = next(e for e in events if e.get("type") == "run_start")
+    assert start["mesh_shape"] == {"islands": 6, "rows": 1}
+    assert start["n_devices"] == 6
+    assert start["idle_devices"] == len(jax.devices()) - 6
+
+
+def test_search_shardings_cover_island_state():
+    """ISSUE 19 satellite: the search_shardings vocabulary structurally
+    covers the carry — EVERY post-init IslandState leaf accepts the
+    ``island`` spec (leading dim = the island count, divisible by the
+    islands axis), so srshard's contract check (analysis/shard.py) and
+    the api jit factories can pin the whole tree from one vocabulary
+    entry with no per-leaf exceptions. Also pins the vocabulary key
+    sets srshard's stage specs are written against."""
+    from symbolicregression_jl_tpu.models.evolve import init_island_state
+
+    I = 4
+    opts = make_options(
+        binary_operators=["+", "*"], npop=16, npopulations=I,
+        maxsize=10, should_optimize_constants=False,
+    )
+    mesh = mesh_mod.make_mesh(opts, I)
+    assert mesh is not None
+    sh = mesh_mod.search_shardings(mesh, opts)
+    assert set(sh) == {
+        "island", "tenant", "replicated", "x", "rows", "events",
+    }
+
+    rng = np.random.default_rng(0)
+    X = jnp.asarray(rng.standard_normal((2, 32)).astype(np.float32))
+    y = X[0] * X[0]
+    baseline = jnp.var(y)
+    keys = jax.random.split(jax.random.PRNGKey(0), I)
+    # trace-only: the structural claim is about shapes, not values
+    states = jax.eval_shape(
+        jax.vmap(
+            lambda k: init_island_state(k, opts, 2, X, y, None, baseline)
+        ),
+        keys,
+    )
+
+    leaves = jax.tree_util.tree_flatten_with_path(states)[0]
+    assert leaves, "empty IslandState pytree"
+    n_island_shards = mesh.shape[opts.island_axis]
+    for path, leaf in leaves:
+        name = jax.tree_util.keystr(path)
+        assert leaf.ndim >= 1, f"{name}: rank-0 leaf cannot ride P(islands)"
+        assert leaf.shape[0] == I, (
+            f"{name}: leading dim {leaf.shape[0]} != island count {I}"
+        )
+        assert leaf.shape[0] % n_island_shards == 0, (
+            f"{name}: leading dim does not tile the islands axis"
+        )
+        # the spec is genuinely applicable: shard_shape must accept it
+        shard = sh["island"].shard_shape(leaf.shape)
+        assert shard[0] == leaf.shape[0] // n_island_shards, name
+
+    # tenant-mesh vocabulary: same coverage story with a leading tenant
+    # dim composed in front (and no events entry — the recorder is a
+    # solo-driver feature)
+    topts = make_options(
+        binary_operators=["+", "*"], npop=16, npopulations=2,
+        maxsize=10, should_optimize_constants=False, tenants=2,
+    )
+    tmesh = mesh_mod.make_mesh(topts, 2, tenants=2)
+    assert tmesh is not None
+    tsh = mesh_mod.search_shardings(tmesh, topts)
+    assert set(tsh) == {"island", "tenant", "replicated", "x", "rows"}
+    assert tuple(tsh["island"].spec) == (
+        topts.tenant_axis, topts.island_axis,
+    )
+
+    # the JSON-able view (what srshard records per config) round-trips
+    # the same names and axes
+    table = mesh_mod.spec_table(mesh, opts)
+    assert set(table) == set(sh)
+    assert table["island"] == [opts.island_axis]
+    assert mesh_mod.spec_table(None, opts) is None
+
+
 # one island per virtual device — the ISSUE 9 acceptance configuration
 TINY8 = {**TINY, "npopulations": 8}
 

@@ -24,6 +24,13 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
+@pytest.mark.skip(
+    reason="jaxlib CPU backend cannot run multi-process computations "
+    "('Multiprocess computations aren't implemented on the CPU "
+    "backend') — the collective launch fails identically on every CI "
+    "host since this test landed. Un-skip on a real multi-host TPU/GPU "
+    "slice; tracked as ROADMAP #4 (cross-host pod-slice meshes)."
+)
 def test_two_process_sharded_search():
     port = _free_port()
     env = dict(os.environ)
